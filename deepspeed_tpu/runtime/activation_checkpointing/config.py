@@ -1,0 +1,52 @@
+"""Activation-checkpointing sub-config
+(reference ``deepspeed/runtime/activation_checkpointing/config.py:59``).
+
+On TPU the knobs map onto ``jax.checkpoint`` policies:
+- partition_activations → save sharded residuals over the model axis
+- cpu_checkpointing     → ``jax.checkpoint`` with host offload policy
+- contiguous_memory_optimization / synchronize_checkpoint_boundary are no-ops
+  under XLA (the compiler owns buffers and streams) but are accepted.
+"""
+
+from deepspeed_tpu.runtime import constants as C
+from deepspeed_tpu.runtime.config_utils import get_scalar_param
+
+
+class DeepSpeedActivationCheckpointingConfig:
+
+    def __init__(self, param_dict):
+        self.partition_activations = None
+        self.contiguous_memory_optimization = None
+        self.cpu_checkpointing = None
+        self.number_checkpoints = None
+        self.synchronize_checkpoint_boundary = None
+        self.profile = None
+
+        act_dict = param_dict.get(C.ACTIVATION_CHECKPOINTING, {})
+        self._initialize(act_dict)
+
+    def _initialize(self, act_dict):
+        self.partition_activations = get_scalar_param(
+            act_dict, C.ACT_CKPT_PARTITION_ACTIVATIONS,
+            C.ACT_CKPT_PARTITION_ACTIVATIONS_DEFAULT)
+        self.contiguous_memory_optimization = get_scalar_param(
+            act_dict, C.ACT_CKPT_CONTIGUOUS_MEMORY_OPTIMIZATION,
+            C.ACT_CKPT_CONTIGUOUS_MEMORY_OPTIMIZATION_DEFAULT)
+        self.cpu_checkpointing = get_scalar_param(
+            act_dict, C.ACT_CKPT_CPU_CHECKPOINTING,
+            C.ACT_CKPT_CPU_CHECKPOINTING_DEFAULT)
+        self.number_checkpoints = get_scalar_param(
+            act_dict, C.ACT_CKPT_NUMBER_CHECKPOINTS,
+            C.ACT_CKPT_NUMBER_CHECKPOINTS_DEFAULT)
+        self.profile = get_scalar_param(
+            act_dict, C.ACT_CKPT_PROFILE, C.ACT_CKPT_PROFILE_DEFAULT)
+        self.synchronize_checkpoint_boundary = get_scalar_param(
+            act_dict, C.ACT_CKPT_SYNCHRONIZE_CHECKPOINT_BOUNDARY,
+            C.ACT_CKPT_SYNCHRONIZE_CHECKPOINT_BOUNDARY_DEFAULT)
+
+    def repr(self):
+        return self.__dict__
+
+    def __repr__(self):
+        import json
+        return json.dumps(self.__dict__, sort_keys=True, indent=4)

@@ -1,0 +1,476 @@
+"""The single-JSON config system.
+
+TPU-native analog of the reference's ``deepspeed/runtime/config.py``
+(DeepSpeedConfig at config.py:464). One JSON file (or dict) drives the whole
+framework. The batch-size triangle invariant is preserved
+(reference config.py:557)::
+
+    train_batch_size == train_micro_batch_size_per_gpu
+                        * gradient_accumulation_steps
+                        * data-parallel world size
+"""
+
+import json
+import os
+from typing import Optional
+
+from deepspeed_tpu.runtime import constants as C
+from deepspeed_tpu.runtime.config_utils import (
+    get_scalar_param,
+    dict_raise_error_on_duplicate_keys,
+)
+from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
+from deepspeed_tpu.runtime.zero.constants import MAX_STAGE_ZERO_OPTIMIZATION
+from deepspeed_tpu.runtime.activation_checkpointing.config import (
+    DeepSpeedActivationCheckpointingConfig,
+)
+from deepspeed_tpu.utils.logging import logger
+
+
+class DeepSpeedConfigError(Exception):
+    pass
+
+
+def get_fp16_enabled(param_dict):
+    if C.FP16 in param_dict:
+        return get_scalar_param(param_dict[C.FP16], C.FP16_ENABLED,
+                                C.FP16_ENABLED_DEFAULT)
+    return False
+
+
+def get_bf16_enabled(param_dict):
+    if C.BF16 in param_dict:
+        return get_scalar_param(param_dict[C.BF16], C.BF16_ENABLED,
+                                C.BF16_ENABLED_DEFAULT)
+    return False
+
+
+def get_loss_scale(param_dict):
+    if get_fp16_enabled(param_dict):
+        return get_scalar_param(param_dict[C.FP16], C.FP16_LOSS_SCALE,
+                                C.FP16_LOSS_SCALE_DEFAULT)
+    return C.FP16_LOSS_SCALE_DEFAULT
+
+
+def get_initial_dynamic_scale(param_dict):
+    if get_fp16_enabled(param_dict):
+        initial_scale_power = get_scalar_param(param_dict[C.FP16],
+                                               C.FP16_INITIAL_SCALE_POWER,
+                                               C.FP16_INITIAL_SCALE_POWER_DEFAULT)
+    else:
+        initial_scale_power = C.FP16_INITIAL_SCALE_POWER_DEFAULT
+    return 2**initial_scale_power
+
+
+def get_dynamic_loss_scale_args(param_dict):
+    loss_scale_args = None
+    if get_fp16_enabled(param_dict):
+        fp16_dict = param_dict[C.FP16]
+        dynamic_props = [
+            C.FP16_INITIAL_SCALE_POWER, C.FP16_LOSS_SCALE_WINDOW,
+            C.FP16_MIN_LOSS_SCALE, C.FP16_HYSTERESIS
+        ]
+        if any(d in fp16_dict for d in dynamic_props):
+            init_scale = get_scalar_param(fp16_dict, C.FP16_INITIAL_SCALE_POWER,
+                                          C.FP16_INITIAL_SCALE_POWER_DEFAULT)
+            scale_window = get_scalar_param(fp16_dict, C.FP16_LOSS_SCALE_WINDOW,
+                                            C.FP16_LOSS_SCALE_WINDOW_DEFAULT)
+            delayed_shift = get_scalar_param(fp16_dict, C.FP16_HYSTERESIS,
+                                             C.FP16_HYSTERESIS_DEFAULT)
+            min_loss_scale = get_scalar_param(fp16_dict, C.FP16_MIN_LOSS_SCALE,
+                                              C.FP16_MIN_LOSS_SCALE_DEFAULT)
+            loss_scale_args = {
+                "init_scale": 2**init_scale,
+                "scale_window": scale_window,
+                "delayed_shift": delayed_shift,
+                "min_scale": min_loss_scale,
+            }
+    return loss_scale_args
+
+
+def get_gradient_accumulation_steps(param_dict):
+    return get_scalar_param(param_dict, C.GRADIENT_ACCUMULATION_STEPS,
+                            C.GRADIENT_ACCUMULATION_STEPS_DEFAULT)
+
+
+def get_sparse_gradients_enabled(param_dict):
+    return get_scalar_param(param_dict, C.SPARSE_GRADIENTS,
+                            C.SPARSE_GRADIENTS_DEFAULT)
+
+
+def get_steps_per_print(param_dict):
+    return get_scalar_param(param_dict, C.STEPS_PER_PRINT,
+                            C.STEPS_PER_PRINT_DEFAULT)
+
+
+def get_disable_allgather(param_dict):
+    return get_scalar_param(param_dict, C.DISABLE_ALLGATHER,
+                            C.DISABLE_ALLGATHER_DEFAULT)
+
+
+def get_dump_state(param_dict):
+    return get_scalar_param(param_dict, C.DUMP_STATE, C.DUMP_STATE_DEFAULT)
+
+
+def get_gradient_clipping(param_dict):
+    return get_scalar_param(param_dict, C.GRADIENT_CLIPPING,
+                            C.GRADIENT_CLIPPING_DEFAULT)
+
+
+def get_prescale_gradients(param_dict):
+    return get_scalar_param(param_dict, C.PRESCALE_GRADIENTS,
+                            C.PRESCALE_GRADIENTS_DEFAULT)
+
+
+def get_gradient_predivide_factor(param_dict):
+    return get_scalar_param(param_dict, C.GRADIENT_PREDIVIDE_FACTOR,
+                            C.GRADIENT_PREDIVIDE_FACTOR_DEFAULT)
+
+
+def get_sparse_attention(param_dict):
+    """Parse the sparse-attention sub-config (reference config.py:156-317)."""
+    if C.SPARSE_ATTENTION not in param_dict:
+        return None
+    sparsity = param_dict[C.SPARSE_ATTENTION]
+    mode = get_scalar_param(sparsity, C.SPARSE_MODE, C.SPARSE_MODE_DEFAULT)
+
+    common = {
+        C.SPARSE_MODE: mode,
+        C.SPARSE_BLOCK: get_scalar_param(sparsity, C.SPARSE_BLOCK, C.SPARSE_BLOCK_DEFAULT),
+        C.SPARSE_DIFFERENT_LAYOUT_PER_HEAD: get_scalar_param(
+            sparsity, C.SPARSE_DIFFERENT_LAYOUT_PER_HEAD,
+            C.SPARSE_DIFFERENT_LAYOUT_PER_HEAD_DEFAULT),
+    }
+    if mode == C.SPARSE_DENSE_MODE:
+        return common
+    if mode == C.SPARSE_FIXED_MODE:
+        common.update({
+            C.SPARSE_NUM_LOCAL_BLOCKS: get_scalar_param(
+                sparsity, C.SPARSE_NUM_LOCAL_BLOCKS, C.SPARSE_NUM_LOCAL_BLOCKS_DEFAULT),
+            C.SPARSE_NUM_GLOBAL_BLOCKS: get_scalar_param(
+                sparsity, C.SPARSE_NUM_GLOBAL_BLOCKS, C.SPARSE_NUM_GLOBAL_BLOCKS_DEFAULT),
+            C.SPARSE_ATTENTION_TYPE: get_scalar_param(
+                sparsity, C.SPARSE_ATTENTION_TYPE, C.SPARSE_ATTENTION_TYPE_DEFAULT),
+            C.SPARSE_HORIZONTAL_GLOBAL_ATTENTION: get_scalar_param(
+                sparsity, C.SPARSE_HORIZONTAL_GLOBAL_ATTENTION,
+                C.SPARSE_HORIZONTAL_GLOBAL_ATTENTION_DEFAULT),
+            C.SPARSE_NUM_DIFFERENT_GLOBAL_PATTERNS: get_scalar_param(
+                sparsity, C.SPARSE_NUM_DIFFERENT_GLOBAL_PATTERNS,
+                C.SPARSE_NUM_DIFFERENT_GLOBAL_PATTERNS_DEFAULT),
+        })
+        return common
+    if mode == C.SPARSE_VARIABLE_MODE:
+        common.update({
+            C.SPARSE_NUM_RANDOM_BLOCKS: get_scalar_param(
+                sparsity, C.SPARSE_NUM_RANDOM_BLOCKS, C.SPARSE_NUM_RANDOM_BLOCKS_DEFAULT),
+            C.SPARSE_LOCAL_WINDOW_BLOCKS: get_scalar_param(
+                sparsity, C.SPARSE_LOCAL_WINDOW_BLOCKS, C.SPARSE_LOCAL_WINDOW_BLOCKS_DEFAULT),
+            C.SPARSE_GLOBAL_BLOCK_INDICES: get_scalar_param(
+                sparsity, C.SPARSE_GLOBAL_BLOCK_INDICES, C.SPARSE_GLOBAL_BLOCK_INDICES_DEFAULT),
+            C.SPARSE_GLOBAL_BLOCK_END_INDICES: get_scalar_param(
+                sparsity, C.SPARSE_GLOBAL_BLOCK_END_INDICES,
+                C.SPARSE_GLOBAL_BLOCK_END_INDICES_DEFAULT),
+            C.SPARSE_ATTENTION_TYPE: get_scalar_param(
+                sparsity, C.SPARSE_ATTENTION_TYPE, C.SPARSE_ATTENTION_TYPE_DEFAULT),
+            C.SPARSE_HORIZONTAL_GLOBAL_ATTENTION: get_scalar_param(
+                sparsity, C.SPARSE_HORIZONTAL_GLOBAL_ATTENTION,
+                C.SPARSE_HORIZONTAL_GLOBAL_ATTENTION_DEFAULT),
+        })
+        return common
+    if mode == C.SPARSE_BIGBIRD_MODE:
+        common.update({
+            C.SPARSE_NUM_RANDOM_BLOCKS: get_scalar_param(
+                sparsity, C.SPARSE_NUM_RANDOM_BLOCKS, C.SPARSE_NUM_RANDOM_BLOCKS_DEFAULT),
+            C.SPARSE_NUM_SLIDING_WINDOW_BLOCKS: get_scalar_param(
+                sparsity, C.SPARSE_NUM_SLIDING_WINDOW_BLOCKS,
+                C.SPARSE_NUM_SLIDING_WINDOW_BLOCKS_DEFAULT),
+            C.SPARSE_NUM_GLOBAL_BLOCKS: get_scalar_param(
+                sparsity, C.SPARSE_NUM_GLOBAL_BLOCKS, C.SPARSE_NUM_GLOBAL_BLOCKS_DEFAULT),
+        })
+        return common
+    if mode == C.SPARSE_BSLONGFORMER_MODE:
+        common.update({
+            C.SPARSE_NUM_SLIDING_WINDOW_BLOCKS: get_scalar_param(
+                sparsity, C.SPARSE_NUM_SLIDING_WINDOW_BLOCKS,
+                C.SPARSE_NUM_SLIDING_WINDOW_BLOCKS_DEFAULT),
+            C.SPARSE_GLOBAL_BLOCK_INDICES: get_scalar_param(
+                sparsity, C.SPARSE_GLOBAL_BLOCK_INDICES, C.SPARSE_GLOBAL_BLOCK_INDICES_DEFAULT),
+            C.SPARSE_GLOBAL_BLOCK_END_INDICES: get_scalar_param(
+                sparsity, C.SPARSE_GLOBAL_BLOCK_END_INDICES,
+                C.SPARSE_GLOBAL_BLOCK_END_INDICES_DEFAULT),
+        })
+        return common
+    raise NotImplementedError(
+        f"Given sparsity mode, {mode}, has not been implemented yet!")
+
+
+def get_pipeline_config(param_dict):
+    """Parse the pipeline sub-config (reference config.py:327)."""
+    default_pipeline = {
+        C.PIPELINE_STAGES: C.PIPELINE_STAGES_DEFAULT,
+        C.PIPELINE_PARTITION: C.PIPELINE_PARTITION_DEFAULT,
+        C.PIPELINE_SEED_LAYERS: C.PIPELINE_SEED_LAYERS_DEFAULT,
+        C.PIPELINE_ACTIVATION_CHECKPOINT_INTERVAL:
+            C.PIPELINE_ACTIVATION_CHECKPOINT_INTERVAL_DEFAULT,
+    }
+    config = default_pipeline.copy()
+    for key, val in param_dict.get(C.PIPELINE, {}).items():
+        config[key] = val
+    return config
+
+
+def get_optimizer_name(param_dict):
+    if C.OPTIMIZER in param_dict and C.TYPE in param_dict[C.OPTIMIZER]:
+        return param_dict[C.OPTIMIZER][C.TYPE]
+    return C.OPTIMIZER_TYPE_DEFAULT
+
+
+def get_optimizer_params(param_dict):
+    if get_optimizer_name(param_dict) is not None and \
+            C.OPTIMIZER_PARAMS in param_dict[C.OPTIMIZER]:
+        return param_dict[C.OPTIMIZER][C.OPTIMIZER_PARAMS]
+    return None
+
+
+def get_optimizer_gradient_clipping(param_dict):
+    optimizer_params = get_optimizer_params(param_dict)
+    if optimizer_params is not None and C.MAX_GRAD_NORM in optimizer_params:
+        return optimizer_params[C.MAX_GRAD_NORM]
+    return None
+
+
+def get_optimizer_legacy_fusion(param_dict):
+    if C.OPTIMIZER in param_dict and C.LEGACY_FUSION in param_dict[C.OPTIMIZER]:
+        return param_dict[C.OPTIMIZER][C.LEGACY_FUSION]
+    return C.LEGACY_FUSION_DEFAULT
+
+
+def get_scheduler_name(param_dict):
+    if C.SCHEDULER in param_dict and C.TYPE in param_dict[C.SCHEDULER]:
+        return param_dict[C.SCHEDULER][C.TYPE]
+    return C.SCHEDULER_TYPE_DEFAULT
+
+
+def get_scheduler_params(param_dict):
+    if get_scheduler_name(param_dict) is not None and \
+            C.SCHEDULER_PARAMS in param_dict[C.SCHEDULER]:
+        return param_dict[C.SCHEDULER][C.SCHEDULER_PARAMS]
+    return None
+
+
+def get_train_batch_size(param_dict):
+    return get_scalar_param(param_dict, C.TRAIN_BATCH_SIZE,
+                            C.TRAIN_BATCH_SIZE_DEFAULT)
+
+
+def get_train_micro_batch_size_per_gpu(param_dict):
+    v = get_scalar_param(param_dict, C.TRAIN_MICRO_BATCH_SIZE_PER_GPU, None)
+    if v is None:
+        v = get_scalar_param(param_dict, C.TRAIN_MICRO_BATCH_SIZE_PER_CHIP,
+                             C.TRAIN_MICRO_BATCH_SIZE_PER_GPU_DEFAULT)
+    return v
+
+
+def get_wall_clock_breakdown(param_dict):
+    return get_scalar_param(param_dict, C.WALL_CLOCK_BREAKDOWN,
+                            C.WALL_CLOCK_BREAKDOWN_DEFAULT)
+
+
+def get_memory_breakdown(param_dict):
+    return get_scalar_param(param_dict, C.MEMORY_BREAKDOWN,
+                            C.MEMORY_BREAKDOWN_DEFAULT)
+
+
+def get_tensorboard_enabled(param_dict):
+    if C.TENSORBOARD in param_dict:
+        return get_scalar_param(param_dict[C.TENSORBOARD], C.TENSORBOARD_ENABLED,
+                                C.TENSORBOARD_ENABLED_DEFAULT)
+    return False
+
+
+def get_tensorboard_output_path(param_dict):
+    if get_tensorboard_enabled(param_dict):
+        return get_scalar_param(param_dict[C.TENSORBOARD],
+                                C.TENSORBOARD_OUTPUT_PATH,
+                                C.TENSORBOARD_OUTPUT_PATH_DEFAULT)
+    return C.TENSORBOARD_OUTPUT_PATH_DEFAULT
+
+
+def get_tensorboard_job_name(param_dict):
+    if get_tensorboard_enabled(param_dict):
+        return get_scalar_param(param_dict[C.TENSORBOARD], C.TENSORBOARD_JOB_NAME,
+                                C.TENSORBOARD_JOB_NAME_DEFAULT)
+    return C.TENSORBOARD_JOB_NAME_DEFAULT
+
+
+def get_mesh_axes(param_dict):
+    """TPU-native extension: explicit named mesh axes in the JSON config."""
+    mesh = param_dict.get(C.MESH, None)
+    if mesh is None:
+        return None
+    return mesh.get(C.MESH_AXES, None)
+
+
+class DeepSpeedConfig:
+    """Parsed view of the JSON config (reference config.py:464)."""
+
+    def __init__(self, json_file_or_dict, mpu=None, world_size: Optional[int] = None):
+        if isinstance(json_file_or_dict, dict):
+            self._param_dict = json_file_or_dict
+        else:
+            with open(json_file_or_dict, "r") as f:
+                self._param_dict = json.load(
+                    f, object_pairs_hook=dict_raise_error_on_duplicate_keys)
+
+        if world_size is not None:
+            self.world_size = world_size
+        elif mpu is not None:
+            self.world_size = mpu.get_data_parallel_world_size()
+        else:
+            self.world_size = int(os.environ.get("DSTPU_DP_WORLD_SIZE", "1"))
+
+        self._initialize_params(self._param_dict)
+        self._configure_train_batch_size()
+        self._do_sanity_check()
+
+    def _initialize_params(self, param_dict):
+        self.train_batch_size = get_train_batch_size(param_dict)
+        self.train_micro_batch_size_per_gpu = get_train_micro_batch_size_per_gpu(param_dict)
+        self.gradient_accumulation_steps = get_gradient_accumulation_steps(param_dict)
+        self.steps_per_print = get_steps_per_print(param_dict)
+        self.dump_state = get_dump_state(param_dict)
+
+        self.disable_allgather = get_disable_allgather(param_dict)
+        self.gradient_predivide_factor = get_gradient_predivide_factor(param_dict)
+        self.sparse_gradients_enabled = get_sparse_gradients_enabled(param_dict)
+
+        self.zero_config = DeepSpeedZeroConfig(param_dict)
+        self.zero_optimization_stage = self.zero_config.stage
+        self.zero_enabled = self.zero_optimization_stage > 0
+
+        self.activation_checkpointing_config = \
+            DeepSpeedActivationCheckpointingConfig(param_dict)
+
+        self.gradient_clipping = get_gradient_clipping(param_dict)
+        self.fp16_enabled = get_fp16_enabled(param_dict)
+        self.bf16_enabled = get_bf16_enabled(param_dict)
+        self.loss_scale = get_loss_scale(param_dict)
+        self.initial_dynamic_scale = get_initial_dynamic_scale(param_dict)
+        self.dynamic_loss_scale_args = get_dynamic_loss_scale_args(param_dict)
+
+        self.optimizer_name = get_optimizer_name(param_dict)
+        if self.optimizer_name is not None and \
+                self.optimizer_name.lower() in C.DEEPSPEED_OPTIMIZERS:
+            self.optimizer_name = self.optimizer_name.lower()
+        self.optimizer_params = get_optimizer_params(param_dict)
+        self.optimizer_legacy_fusion = get_optimizer_legacy_fusion(param_dict)
+
+        self.scheduler_name = get_scheduler_name(param_dict)
+        self.scheduler_params = get_scheduler_params(param_dict)
+
+        self.wall_clock_breakdown = get_wall_clock_breakdown(param_dict)
+        self.memory_breakdown = get_memory_breakdown(param_dict)
+        self.tensorboard_enabled = get_tensorboard_enabled(param_dict)
+        self.tensorboard_output_path = get_tensorboard_output_path(param_dict)
+        self.tensorboard_job_name = get_tensorboard_job_name(param_dict)
+
+        self.sparse_attention = get_sparse_attention(param_dict)
+        self.pipeline = get_pipeline_config(param_dict)
+        self.prescale_gradients = get_prescale_gradients(param_dict)
+        self.mesh_axes = get_mesh_axes(param_dict)
+
+    def _batch_assertion(self):
+        train_batch = self.train_batch_size
+        micro_batch = self.train_micro_batch_size_per_gpu
+        grad_acc = self.gradient_accumulation_steps
+
+        assert train_batch > 0, f"Train batch size: {train_batch} has to be greater than 0"
+        assert micro_batch > 0, f"Micro batch size per gpu: {micro_batch} has to be greater than 0"
+        assert grad_acc > 0, f"Gradient accumulation steps: {grad_acc} has to be greater than 0"
+        assert train_batch == micro_batch * grad_acc * self.world_size, (
+            f"Check batch related parameters. train_batch_size is not equal "
+            f"to micro_batch_per_gpu * gradient_acc_step * world_size "
+            f"{train_batch} != {micro_batch} * {grad_acc} * {self.world_size}")
+
+    def _set_batch_related_parameters(self):
+        """Solve the batch triangle (reference config.py:562-608)."""
+        train_batch = self.train_batch_size
+        micro_batch = self.train_micro_batch_size_per_gpu
+        grad_acc = self.gradient_accumulation_steps
+
+        # all three parameters provided
+        if all(x is not None for x in [train_batch, micro_batch, grad_acc]):
+            return
+        # two parameters provided: derive the third
+        elif train_batch is not None and micro_batch is not None:
+            grad_acc = train_batch // micro_batch
+            grad_acc //= self.world_size
+            self.gradient_accumulation_steps = grad_acc
+        elif train_batch is not None and grad_acc is not None:
+            micro_batch = train_batch // self.world_size
+            micro_batch //= grad_acc
+            self.train_micro_batch_size_per_gpu = micro_batch
+        elif micro_batch is not None and grad_acc is not None:
+            self.train_batch_size = micro_batch * grad_acc * self.world_size
+        # one parameter provided
+        elif train_batch is not None:
+            self.gradient_accumulation_steps = 1
+            self.train_micro_batch_size_per_gpu = train_batch // self.world_size
+        elif micro_batch is not None:
+            self.train_batch_size = micro_batch * self.world_size
+            self.gradient_accumulation_steps = 1
+        else:
+            raise DeepSpeedConfigError(
+                "Either train_batch_size or train_micro_batch_size_per_gpu "
+                "needs to be provided")
+
+    def _configure_train_batch_size(self):
+        self._set_batch_related_parameters()
+        self._batch_assertion()
+
+    def _do_sanity_check(self):
+        self._do_error_check()
+        self._do_warning_check()
+
+    def _do_error_check(self):
+        assert self.train_micro_batch_size_per_gpu, \
+            f"DeepSpeedConfig: {C.TRAIN_MICRO_BATCH_SIZE_PER_GPU} is not defined"
+        assert self.gradient_accumulation_steps, \
+            f"DeepSpeedConfig: {C.GRADIENT_ACCUMULATION_STEPS} is not defined"
+        if self.zero_enabled:
+            assert self.zero_optimization_stage <= MAX_STAGE_ZERO_OPTIMIZATION, (
+                f"DeepSpeedConfig: Maximum supported ZeRO stage is "
+                f"{MAX_STAGE_ZERO_OPTIMIZATION}")
+        if self.fp16_enabled and self.bf16_enabled:
+            raise DeepSpeedConfigError(
+                "fp16 and bf16 cannot both be enabled; pick one")
+
+    def _do_warning_check(self):
+        fp16_enabled = self.fp16_enabled or self.zero_enabled
+        vocabulary_size = get_scalar_param(self._param_dict, C.VOCABULARY_SIZE,
+                                           C.VOCABULARY_SIZE_DEFAULT)
+        if vocabulary_size and vocabulary_size % 8 != 0:
+            logger.warning(
+                "DeepSpeedConfig: vocabulary size should be aligned to 8 "
+                "(128 on TPU for best MXU tiling)")
+        if self.optimizer_params is not None and \
+                C.MAX_GRAD_NORM in self.optimizer_params and \
+                self.optimizer_params[C.MAX_GRAD_NORM] > 0:
+            if fp16_enabled:
+                logger.warning(
+                    f"DeepSpeedConfig: In FP16 mode, DeepSpeed-TPU will pass "
+                    f"{C.MAX_GRAD_NORM}:"
+                    f"{self.optimizer_params[C.MAX_GRAD_NORM]} to FP16 wrapper")
+            else:
+                logger.warning(
+                    f"DeepSpeedConfig: In FP32 mode, DeepSpeed-TPU does not "
+                    f"permit MAX_GRAD_NORM; set gradient_clipping instead")
+                self.optimizer_params[C.MAX_GRAD_NORM] = 0.0
+
+    def print(self, name="DeepSpeedConfig"):
+        logger.info(f"{name}:")
+        for arg in sorted(vars(self)):
+            if arg != "_param_dict":
+                logger.info(f"  {arg} {getattr(self, arg)}")
+        logger.info("  json = {}".format(
+            json.dumps(self._param_dict, sort_keys=True, indent=4, default=str)))

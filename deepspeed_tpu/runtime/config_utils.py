@@ -1,0 +1,33 @@
+"""Config helpers (reference ``deepspeed/runtime/config_utils.py``)."""
+
+import collections
+
+
+def get_scalar_param(param_dict, param_name, param_default_value):
+    """Fetch a scalar config value with a default (reference config_utils.py:12)."""
+    if param_dict is None:
+        return param_default_value
+    return param_dict.get(param_name, param_default_value)
+
+
+def get_list_param(param_dict, param_name, param_default_value):
+    if param_dict is None:
+        return param_default_value
+    return param_dict.get(param_name, param_default_value)
+
+
+def get_dict_param(param_dict, param_name, param_default_value):
+    if param_dict is None:
+        return param_default_value
+    return param_dict.get(param_name, param_default_value)
+
+
+def dict_raise_error_on_duplicate_keys(ordered_pairs):
+    """json object_pairs_hook that rejects duplicate keys
+    (reference config_utils.py:16)."""
+    d = dict((k, v) for k, v in ordered_pairs)
+    if len(d) != len(ordered_pairs):
+        counter = collections.Counter(k for k, _ in ordered_pairs)
+        keys = [k for k, v in counter.items() if v > 1]
+        raise ValueError("Duplicate keys in DeepSpeed-TPU config: {}".format(keys))
+    return d

@@ -1,0 +1,67 @@
+"""Logging utilities.
+
+TPU-native analog of the reference's ``deepspeed/utils/logging.py``
+(LoggerFactory at utils/logging.py:7, log_dist at :40). On TPU we filter by
+``jax.process_index()`` instead of torch.distributed rank.
+"""
+
+import logging
+import os
+import sys
+from typing import Iterable, Optional
+
+LOG_LEVELS = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "critical": logging.CRITICAL,
+}
+
+
+class LoggerFactory:
+
+    @staticmethod
+    def create_logger(name: str = "DeepSpeedTPU", level=logging.INFO) -> logging.Logger:
+        """Create a logger with a stdout stream handler."""
+        if name is None:
+            raise ValueError("name for logger cannot be None")
+        formatter = logging.Formatter(
+            "[%(asctime)s] [%(levelname)s] [%(name)s] %(message)s")
+        logger_ = logging.getLogger(name)
+        logger_.setLevel(level)
+        logger_.propagate = False
+        if not logger_.handlers:
+            ch = logging.StreamHandler(stream=sys.stdout)
+            ch.setLevel(level)
+            ch.setFormatter(formatter)
+            logger_.addHandler(ch)
+        return logger_
+
+
+logger = LoggerFactory.create_logger(
+    name="DeepSpeedTPU",
+    level=LOG_LEVELS.get(os.environ.get("DSTPU_LOG_LEVEL", "info"), logging.INFO),
+)
+
+
+def _process_index() -> int:
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def log_dist(message: str, ranks: Optional[Iterable[int]] = None, level=logging.INFO) -> None:
+    """Log ``message`` only on the listed process indices.
+
+    ``ranks=None`` or ``ranks=[-1]`` logs on every process (mirrors reference
+    utils/logging.py:40 semantics, with jax.process_index() standing in for
+    the torch.distributed rank).
+    """
+    my_rank = _process_index()
+    ranks = list(ranks) if ranks is not None else []
+    should_log = not ranks or (-1 in ranks) or (my_rank in ranks)
+    if should_log:
+        logger.log(level, f"[Rank {my_rank}] {message}")

@@ -1,0 +1,20 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's single-node multi-process fixture strategy
+(tests/unit/common.py:14 @distributed_test) but improves on it: instead of
+forking NCCL processes we use XLA's host-platform device partitioning, so all
+"distributed" logic (sharding, collectives, topology) runs in-process on CPU.
+"""
+
+import os
+
+# Must be set before jax is imported anywhere.
+os.environ.setdefault("XLA_FLAGS",
+                      os.environ.get("XLA_FLAGS", "") +
+                      " --xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_threefry_partitionable", True)
