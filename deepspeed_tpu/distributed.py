@@ -1,0 +1,56 @@
+"""Multi-host bootstrap — the torch.distributed.init_process_group analog.
+
+The reference engine called ``dist.init_process_group('nccl')``
+(engine.py:139) with env-var rendezvous set up by its launcher
+(launch.py:106-116) and an optional MPI bootstrap (engine.py:198 _mpi_check).
+On TPU the same role is played by ``jax.distributed.initialize``: one process
+per host, chips auto-discovered, XLA collectives ride ICI/DCN.
+"""
+
+import os
+from typing import Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+_initialized = False
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> None:
+    """Initialize multi-host JAX if launched by ``dstpu`` (or explicitly).
+
+    Reads DSTPU_COORDINATOR / DSTPU_NUM_PROCESSES / DSTPU_PROCESS_ID set by
+    the launcher; falls back to TPU-pod auto-detection via
+    ``jax.distributed.initialize()`` no-arg form when JAX can discover the
+    topology itself; no-op for single-process runs.
+    """
+    global _initialized
+    if _initialized:
+        return
+
+    import jax
+
+    coordinator = coordinator_address or os.environ.get("DSTPU_COORDINATOR")
+    nprocs = num_processes if num_processes is not None else \
+        int(os.environ.get("DSTPU_NUM_PROCESSES", "0") or 0)
+    pid = process_id if process_id is not None else \
+        int(os.environ.get("DSTPU_PROCESS_ID", "-1") or -1)
+
+    if coordinator and nprocs > 1 and pid >= 0:
+        logger.info(f"jax.distributed.initialize(coordinator={coordinator}, "
+                    f"num_processes={nprocs}, process_id={pid})")
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=nprocs,
+                                   process_id=pid)
+        _initialized = True
+    elif os.environ.get("TPU_WORKER_HOSTNAMES", "").count(",") > 0:
+        # Multi-worker TPU pod slice: jax can self-discover.
+        logger.info("jax.distributed.initialize() [TPU pod auto-detect]")
+        jax.distributed.initialize()
+        _initialized = True
+    # else: single process, nothing to do.
+
+
+def is_initialized() -> bool:
+    return _initialized

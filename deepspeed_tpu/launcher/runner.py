@@ -1,0 +1,261 @@
+"""``dstpu`` — multi-host launcher.
+
+TPU-native analog of the reference launcher stack
+(``deepspeed/launcher/runner.py`` main :251, hostfile parse fetch_hostfile
+:115, include/exclude filter parse_resource_filter :143;
+``launcher/launch.py`` per-node spawner; ``launcher/multinode_runner.py``
+PDSH/MPI runners; shell entrypoints ``bin/deepspeed``/``bin/ds``).
+
+Key difference from the reference: on GPU, one *process per device* had to be
+spawned and wired into NCCL via RANK/WORLD_SIZE env. On TPU, JAX is
+multi-controller: exactly one process per *host*, each seeing its local
+chips; ``jax.distributed.initialize()`` handles rendezvous. So the launcher's
+job shrinks to (1) enumerating hosts, (2) running one copy of the user script
+per host with coordinator env vars, (3) propagating ``.deepspeed_env``.
+
+Single host:  dstpu train.py --deepspeed_config ds.json
+Multi host:   dstpu --hostfile /job/hostfile train.py ...
+"""
+
+import argparse
+import base64
+import json
+import os
+import shlex
+import subprocess
+import sys
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+DLTS_HOSTFILE = "/job/hostfile"
+ENV_FILE = ".deepspeed_env"
+EXPORT_ENVS = ["PYTHONPATH", "PATH", "LD_LIBRARY_PATH", "TPU_", "JAX_", "XLA_",
+               "DSTPU_"]
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(
+        description="DeepSpeed-TPU launcher: run a training script across "
+                    "TPU hosts")
+    parser.add_argument("-H", "--hostfile", type=str, default=DLTS_HOSTFILE,
+                        help="Hostfile path: lines of '<hostname> slots=<n>'")
+    parser.add_argument("-i", "--include", type=str, default="",
+                        help="Host filter, e.g. 'worker-0@worker-1'")
+    parser.add_argument("-e", "--exclude", type=str, default="",
+                        help="Host exclusion filter")
+    parser.add_argument("--num_nodes", type=int, default=-1,
+                        help="Limit number of hosts")
+    parser.add_argument("--master_port", type=int, default=29500,
+                        help="Coordinator port for jax.distributed")
+    parser.add_argument("--master_addr", type=str, default="",
+                        help="Coordinator address (default: first host)")
+    parser.add_argument("--launcher", type=str, default="ssh",
+                        choices=["ssh", "pdsh", "local"],
+                        help="Multi-node transport")
+    parser.add_argument("--force_multi", action="store_true",
+                        help="Treat as multi-node even for one host")
+    parser.add_argument("user_script", type=str,
+                        help="User training script")
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args=args)
+
+
+def fetch_hostfile(hostfile_path: str) -> Optional[Dict[str, int]]:
+    """Parse '<hostname> slots=<n>' lines (reference runner.py:115)."""
+    if not os.path.isfile(hostfile_path):
+        logger.warning(f"Unable to find hostfile, will proceed with training "
+                       f"with local resources only: {hostfile_path}")
+        return None
+    resource_pool: "OrderedDict[str, int]" = OrderedDict()
+    with open(hostfile_path, "r") as fd:
+        for line in fd.readlines():
+            line = line.strip()
+            if line == "" or line.startswith("#"):
+                continue
+            try:
+                hostname, slots = line.split()
+                key, slot_count = slots.split("=")
+                if key != "slots":
+                    raise ValueError(f"expected slots=<n>, got {slots}")
+                slot_count = int(slot_count)
+            except ValueError:
+                logger.error(f"Hostfile is not formatted correctly, unable to "
+                             f"proceed with training: '{line}'")
+                raise ValueError(f"bad hostfile line: '{line}'")
+            if hostname in resource_pool:
+                logger.error(f"Hostfile contains duplicate hosts, unable to "
+                             f"proceed with training: {hostname}")
+                raise ValueError(f"duplicate host: {hostname}")
+            resource_pool[hostname] = slot_count
+    return resource_pool
+
+
+def _parse_filter_str(s: str) -> Dict[str, Optional[List[int]]]:
+    """Parse 'host1@host2:0,2' style filters (reference runner.py:143).
+
+    Returns host -> list of slot indices (None = all slots).
+    """
+    out: "OrderedDict[str, Optional[List[int]]]" = OrderedDict()
+    if not s:
+        return out
+    for term in s.split("@"):
+        term = term.strip()
+        if ":" in term:
+            host, slot_str = term.split(":")
+            slots = [int(x) for x in slot_str.split(",")]
+            out[host] = slots
+        else:
+            out[term] = None
+    return out
+
+
+def parse_resource_filter(host_info: Dict[str, int], include_str: str = "",
+                          exclude_str: str = "") -> Dict[str, List[int]]:
+    """Apply include/exclude filters to the host pool."""
+    if include_str and exclude_str:
+        raise ValueError("include_str and exclude_str are mutually exclusive")
+
+    full = OrderedDict(
+        (host, list(range(slots))) for host, slots in host_info.items())
+
+    if include_str:
+        inc = _parse_filter_str(include_str)
+        filtered = OrderedDict()
+        for host, slots in inc.items():
+            if host not in full:
+                raise ValueError(f"include host {host} not in hostfile")
+            use = slots if slots is not None else full[host]
+            for s in use:
+                if s not in full[host]:
+                    raise ValueError(f"include slot {host}:{s} does not exist")
+            filtered[host] = use
+        return filtered
+
+    if exclude_str:
+        exc = _parse_filter_str(exclude_str)
+        for host, slots in exc.items():
+            if host not in full:
+                raise ValueError(f"exclude host {host} not in hostfile")
+            if slots is not None:
+                for s in slots:
+                    if s not in full[host]:
+                        raise ValueError(
+                            f"exclude slot {host}:{s} does not exist")
+        filtered = OrderedDict()
+        for host, slots in full.items():
+            if host in exc:
+                if exc[host] is None:
+                    continue  # exclude whole host
+                keep = [s for s in slots if s not in exc[host]]
+                if keep:
+                    filtered[host] = keep
+            else:
+                filtered[host] = slots
+        return filtered
+
+    return full
+
+
+def encode_world_info(resource_pool: Dict[str, List[int]]) -> str:
+    """Base64-encode the host->slots map for env transport
+    (reference runner.py:245)."""
+    world_info = json.dumps(resource_pool)
+    return base64.urlsafe_b64encode(world_info.encode("utf-8")).decode("utf-8")
+
+
+def decode_world_info(encoded: str) -> Dict[str, List[int]]:
+    return json.loads(base64.urlsafe_b64decode(encoded).decode("utf-8"))
+
+
+def collect_env_exports() -> Dict[str, str]:
+    """Env vars to propagate to remote hosts, plus .deepspeed_env overrides
+    (reference runner.py:345-351)."""
+    exports = {}
+    for var, val in os.environ.items():
+        if any(var == v or (v.endswith("_") and var.startswith(v))
+               for v in EXPORT_ENVS):
+            exports[var] = val
+    env_file = os.path.join(os.path.expanduser("~"), ENV_FILE)
+    for candidate in [ENV_FILE, env_file]:
+        if os.path.isfile(candidate):
+            with open(candidate) as f:
+                for line in f:
+                    line = line.strip()
+                    if "=" in line and not line.startswith("#"):
+                        key, val = line.split("=", 1)
+                        exports[key.strip()] = val.strip()
+    return exports
+
+
+def build_host_cmd(host: str, process_id: int, num_processes: int,
+                   coordinator: str, args, exports: Dict[str, str],
+                   transport: str = "ssh") -> List[str]:
+    """Command that runs the user script on one host with jax.distributed
+    env; mirrors reference multinode_runner.py get_cmd methods."""
+    env_parts = [f"{k}={shlex.quote(v)}" for k, v in sorted(exports.items())]
+    env_parts += [
+        f"DSTPU_COORDINATOR={coordinator}",
+        f"DSTPU_NUM_PROCESSES={num_processes}",
+        f"DSTPU_PROCESS_ID={process_id}",
+    ]
+    remote_cmd = (f"cd {shlex.quote(os.getcwd())} && "
+                  + " ".join(env_parts)
+                  + f" {shlex.quote(sys.executable)} -u "
+                  + shlex.quote(args.user_script) + " "
+                  + " ".join(map(shlex.quote, args.user_args)))
+    if host in ("localhost", "127.0.0.1"):
+        return ["/bin/sh", "-c", remote_cmd]
+    if transport == "pdsh":
+        return ["pdsh", "-w", host, remote_cmd]
+    return ["ssh", "-o", "StrictHostKeyChecking=no", host, remote_cmd]
+
+
+def main(args=None):
+    args = parse_args(args)
+    resource_pool = fetch_hostfile(args.hostfile)
+    if resource_pool is None and args.force_multi:
+        # single-host multi-controller: run the coordinator env path against
+        # localhost so jax.distributed still initializes
+        resource_pool = OrderedDict(localhost=1)
+
+    if resource_pool is None or args.launcher == "local":
+        # single host: exec in-place; jax.distributed is a no-op single
+        # process and local chips are auto-discovered.
+        cmd = [sys.executable, "-u", args.user_script] + args.user_args
+        logger.info(f"dstpu local launch: {' '.join(cmd)}")
+        result = subprocess.Popen(cmd, env=os.environ.copy())
+        result.wait()
+        # propagate first failing exit code (reference runner.py:356)
+        if result.returncode != 0:
+            sys.exit(result.returncode)
+        return
+
+    active = parse_resource_filter(resource_pool, args.include, args.exclude)
+    if args.num_nodes > 0:
+        active = OrderedDict(list(active.items())[:args.num_nodes])
+
+    hosts = list(active.keys())
+    coordinator_addr = args.master_addr or hosts[0]
+    coordinator = f"{coordinator_addr}:{args.master_port}"
+    exports = collect_env_exports()
+    exports["DSTPU_WORLD_INFO"] = encode_world_info(active)
+
+    procs = []
+    for pid, host in enumerate(hosts):
+        cmd = build_host_cmd(host, pid, len(hosts), coordinator, args,
+                             exports, transport=args.launcher)
+        logger.info(f"dstpu launching on {host}: process {pid}/{len(hosts)}")
+        procs.append(subprocess.Popen(cmd))
+    exit_code = 0
+    for p in procs:
+        p.wait()
+        if p.returncode != 0 and exit_code == 0:
+            exit_code = p.returncode
+    if exit_code != 0:
+        sys.exit(exit_code)
+
+
+if __name__ == "__main__":
+    main()

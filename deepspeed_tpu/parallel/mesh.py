@@ -1,0 +1,112 @@
+"""Device-mesh construction — the TPU replacement for NCCL process groups.
+
+Where the reference hand-built torch.distributed groups from topology rank
+lists (topology.py:303-364), here one ``jax.sharding.Mesh`` with named axes
+serves every parallel dimension; collectives inside jit take axis names.
+
+Canonical axis names (any subset may be present, size-1 axes are legal):
+
+- ``pipe``  : pipeline stages
+- ``data``  : data parallel (ZeRO shards along this axis too)
+- ``seq``   : sequence/context parallel (ring attention) — TPU-native
+              extension; absent from the reference snapshot
+- ``model`` : tensor (megatron-style) parallel; innermost so TP peers sit on
+              ICI nearest neighbors
+"""
+
+import math
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from deepspeed_tpu.parallel.topology import ProcessTopology
+
+CANONICAL_AXIS_ORDER = ("pipe", "data", "seq", "model")
+
+
+def _order_axes(axes: Dict[str, int]) -> Dict[str, int]:
+    """Order axes canonically (major → minor); unknown axes go after 'data'."""
+    ordered = {}
+    for name in CANONICAL_AXIS_ORDER:
+        if name in axes:
+            ordered[name] = axes[name]
+    for name, size in axes.items():
+        if name not in ordered:
+            ordered[name] = size
+    return ordered
+
+
+def build_mesh(axes: Optional[Dict[str, int]] = None,
+               devices: Optional[Sequence] = None) -> Mesh:
+    """Build a named-axis Mesh over the available devices.
+
+    ``axes`` maps axis name -> size; at most one size may be -1 (inferred).
+    Default: all devices on the ``data`` axis.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+
+    if not axes:
+        axes = {"data": n}
+    axes = _order_axes(dict(axes))
+
+    # resolve a single -1
+    unknown = [k for k, v in axes.items() if v == -1]
+    if len(unknown) > 1:
+        raise ValueError(f"at most one mesh axis may be -1, got {axes}")
+    if unknown:
+        known = math.prod(v for v in axes.values() if v != -1)
+        if n % known != 0:
+            raise ValueError(
+                f"cannot infer axis {unknown[0]}: {n} devices not divisible "
+                f"by {known}")
+        axes[unknown[0]] = n // known
+
+    size = math.prod(axes.values())
+    if size != n:
+        raise ValueError(
+            f"mesh axes {axes} require {size} devices but {n} are available")
+
+    names = tuple(axes.keys())
+    dims = tuple(axes.values())
+    try:
+        from jax.experimental import mesh_utils
+        device_array = mesh_utils.create_device_mesh(dims, devices=devices)
+    except Exception:
+        # CPU/host platform: physical layout doesn't matter
+        device_array = np.asarray(devices).reshape(dims)
+    return Mesh(device_array, axis_names=names)
+
+
+def mesh_from_topology(topo: ProcessTopology,
+                       devices: Optional[Sequence] = None) -> Mesh:
+    """Mesh whose named axes mirror a ProcessTopology's axes/dims."""
+    return build_mesh(dict(zip(topo.axes, topo.dims)), devices=devices)
+
+
+def data_sharding(mesh: Mesh, batch_axis: str = "data") -> NamedSharding:
+    """Sharding for a batch: leading dim split over the data axis (and seq
+    axis for the sequence dim if present is handled by callers)."""
+    if batch_axis not in mesh.axis_names:
+        return NamedSharding(mesh, PartitionSpec())
+    return NamedSharding(mesh, PartitionSpec(batch_axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def axis_size(mesh: Mesh, name: str) -> int:
+    """Size of a mesh axis, 1 if absent."""
+    if name in mesh.axis_names:
+        return mesh.shape[name]
+    return 1
+
+
+def single_device_mesh() -> Mesh:
+    """1-device mesh with the canonical axes, for tests/single-chip runs."""
+    dev = np.array(jax.devices()[:1]).reshape(1, 1, 1)
+    return Mesh(dev, axis_names=("pipe", "data", "model"))

@@ -1,0 +1,85 @@
+"""Partitioning math shared by pipeline-module layer assignment and ZeRO.
+
+TPU-native analog of the reference's ``deepspeed/runtime/utils.py`` partition
+helpers (partition_uniform :295, partition_balanced :361 with binary-search +
+linear probe _lprobe :310).
+"""
+
+import bisect
+from typing import List, Sequence
+
+
+def partition_uniform(num_items: int, num_parts: int) -> List[int]:
+    """Split ``num_items`` into ``num_parts`` near-equal contiguous chunks.
+
+    Returns ``num_parts+1`` boundaries; part p owns [parts[p], parts[p+1]).
+    Remainder spread one-each over the leading parts (so sizes differ by at
+    most 1 — an improvement over the reference's floor+tail-dump).
+    """
+    if num_parts <= 0:
+        raise ValueError("num_parts must be positive")
+    base, rem = divmod(num_items, num_parts)
+    parts = [0]
+    for p in range(num_parts):
+        parts.append(parts[-1] + base + (1 if p < rem else 0))
+    return parts
+
+
+def prefix_sum_inc(weights: Sequence[float]) -> List[float]:
+    """Inclusive prefix sum (reference runtime/utils.py:303)."""
+    out = []
+    acc = 0
+    for w in weights:
+        acc += w
+        out.append(acc)
+    return out
+
+
+def partition_balanced(weights: Sequence[float], num_parts: int) -> List[int]:
+    """Balanced contiguous partition of weighted items.
+
+    Minimizes the maximum part weight (same contract as reference
+    runtime/utils.py:361). Implemented as a binary search over the bottleneck
+    value with a greedy feasibility check — O(n log(sum/min_gap)) instead of
+    the reference's probe loop, same results on its test cases.
+    """
+    n = len(weights)
+    if num_parts <= 0:
+        raise ValueError("num_parts must be positive")
+    if n == 0:
+        return [0] * (num_parts + 1)
+
+    prefix = prefix_sum_inc(weights)
+    total = prefix[-1]
+
+    def feasible(bottleneck: float) -> List[int] | None:
+        """Greedy: place each boundary as far right as possible while the
+        part weight stays <= bottleneck."""
+        parts = [0]
+        start_w = 0.0
+        for _ in range(num_parts):
+            # furthest index j such that prefix[j-1] - start_w <= bottleneck
+            j = bisect.bisect_right(prefix, start_w + bottleneck)
+            j = max(j, parts[-1])  # never move backwards
+            parts.append(j)
+            if j >= n:
+                break
+            start_w = prefix[j - 1] if j > 0 else 0.0
+        while len(parts) < num_parts + 1:
+            parts.append(n)
+        return parts if parts[num_parts] == n else None
+
+    lo = max((w for w in weights), default=0.0)
+    hi = total
+    # binary search on the bottleneck weight
+    for _ in range(64):
+        mid = (lo + hi) / 2
+        if feasible(mid) is not None:
+            hi = mid
+        else:
+            lo = mid
+        if hi - lo <= 1e-9 * max(1.0, total):
+            break
+    result = feasible(hi)
+    assert result is not None
+    return result

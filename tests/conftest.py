@@ -4,17 +4,23 @@ Mirrors the reference's single-node multi-process fixture strategy
 (tests/unit/common.py:14 @distributed_test) but improves on it: instead of
 forking NCCL processes we use XLA's host-platform device partitioning, so all
 "distributed" logic (sharding, collectives, topology) runs in-process on CPU.
+
+NB: this environment preloads jax via sitecustomize (axon TPU plugin), so
+JAX_PLATFORMS in os.environ is too late — we must use jax.config.update.
+XLA_FLAGS still works because backend initialization is lazy.
 """
 
 import os
 
-# Must be set before jax is imported anywhere.
-os.environ.setdefault("XLA_FLAGS",
-                      os.environ.get("XLA_FLAGS", "") +
-                      " --xla_force_host_platform_device_count=8")
-os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8")
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)
+
+assert jax.device_count() == 8, (
+    f"tests expect an 8-device CPU mesh, got {jax.device_count()} "
+    f"{jax.default_backend()} devices")

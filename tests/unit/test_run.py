@@ -1,0 +1,95 @@
+"""Launcher hostfile/filter tests (mirrors reference tests/unit/test_run.py)."""
+
+import pytest
+
+from deepspeed_tpu.launcher.runner import (
+    fetch_hostfile,
+    parse_resource_filter,
+    encode_world_info,
+    decode_world_info,
+    parse_args,
+)
+
+
+@pytest.fixture
+def hostfile(tmp_path):
+    p = tmp_path / "hostfile"
+    p.write_text(
+        "# comment\n"
+        "worker-0 slots=4\n"
+        "worker-1 slots=4\n"
+        "\n"
+        "worker-2 slots=8\n")
+    return str(p)
+
+
+def test_fetch_hostfile(hostfile):
+    pool = fetch_hostfile(hostfile)
+    assert pool == {"worker-0": 4, "worker-1": 4, "worker-2": 8}
+
+
+def test_fetch_hostfile_missing(tmp_path):
+    assert fetch_hostfile(str(tmp_path / "nope")) is None
+
+
+def test_fetch_hostfile_bad_format(tmp_path):
+    p = tmp_path / "hostfile"
+    p.write_text("worker-0 gpus=4\n")
+    with pytest.raises(ValueError):
+        fetch_hostfile(str(p))
+
+
+def test_fetch_hostfile_duplicate(tmp_path):
+    p = tmp_path / "hostfile"
+    p.write_text("worker-0 slots=4\nworker-0 slots=4\n")
+    with pytest.raises(ValueError):
+        fetch_hostfile(str(p))
+
+
+class TestResourceFilter:
+    pool = {"worker-0": 2, "worker-1": 2}
+
+    def test_no_filter(self):
+        active = parse_resource_filter(self.pool)
+        assert active == {"worker-0": [0, 1], "worker-1": [0, 1]}
+
+    def test_include_host(self):
+        active = parse_resource_filter(self.pool, include_str="worker-1")
+        assert active == {"worker-1": [0, 1]}
+
+    def test_include_slots(self):
+        active = parse_resource_filter(self.pool, include_str="worker-0:1")
+        assert active == {"worker-0": [1]}
+
+    def test_exclude_host(self):
+        active = parse_resource_filter(self.pool, exclude_str="worker-1")
+        assert active == {"worker-0": [0, 1]}
+
+    def test_exclude_slot(self):
+        active = parse_resource_filter(self.pool, exclude_str="worker-1:0")
+        assert active == {"worker-0": [0, 1], "worker-1": [1]}
+
+    def test_mutually_exclusive(self):
+        with pytest.raises(ValueError):
+            parse_resource_filter(self.pool, include_str="worker-0",
+                                  exclude_str="worker-1")
+
+    def test_include_unknown_host(self):
+        with pytest.raises(ValueError):
+            parse_resource_filter(self.pool, include_str="worker-9")
+
+    def test_include_unknown_slot(self):
+        with pytest.raises(ValueError):
+            parse_resource_filter(self.pool, include_str="worker-0:7")
+
+
+def test_world_info_roundtrip():
+    active = {"worker-0": [0, 1], "worker-1": [0]}
+    assert decode_world_info(encode_world_info(active)) == active
+
+
+def test_parse_args_remainder():
+    args = parse_args(["train.py", "--deepspeed_config", "ds.json"])
+    assert args.user_script == "train.py"
+    assert args.user_args == ["--deepspeed_config", "ds.json"]
+    assert args.launcher == "ssh"

@@ -1,0 +1,161 @@
+"""Topology/mesh/partition rank-math tests (mirrors reference
+tests/unit/test_topology.py and test_partition.py — pure logic tier)."""
+
+import jax
+import numpy as np
+import pytest
+
+from deepspeed_tpu.parallel.topology import (
+    ProcessTopology,
+    PipeDataParallelTopology,
+    PipeModelDataParallelTopology,
+    ParallelGrid,
+)
+from deepspeed_tpu.parallel.mesh import (
+    build_mesh, mesh_from_topology, axis_size,
+)
+from deepspeed_tpu.utils.partition import (
+    partition_uniform, partition_balanced,
+)
+
+
+class TestProcessTopology:
+
+    def test_2d_mapping(self):
+        topo = ProcessTopology(axes=["x", "y"], dims=[2, 2])
+        assert topo.world_size() == 4
+        assert topo.get_rank(x=0, y=0) == 0
+        assert topo.get_rank(x=0, y=1) == 1
+        assert topo.get_rank(x=1, y=0) == 2
+        assert topo.get_rank(x=1, y=1) == 3
+        assert topo.get_coord(1) == topo.ProcessCoord(x=0, y=1)
+
+    def test_roundtrip(self):
+        topo = ProcessTopology(axes=["a", "b", "c"], dims=[2, 3, 4])
+        for r in range(topo.world_size()):
+            coord = topo.get_coord(r)
+            assert topo.get_rank(**coord._asdict()) == r
+
+    def test_axis_comm_lists(self):
+        topo = PipeDataParallelTopology(num_pp=2, num_dp=2)
+        data_lists = topo.get_axis_comm_lists("data")
+        pipe_lists = topo.get_axis_comm_lists("pipe")
+        assert sorted(map(tuple, data_lists)) == [(0, 1), (2, 3)]
+        assert sorted(map(tuple, pipe_lists)) == [(0, 2), (1, 3)]
+
+    def test_filter_match(self):
+        topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+        assert topo.filter_match(pipe=0, model=0) == [0, 2]
+        assert topo.filter_match(pipe=1) == [4, 5, 6, 7]
+
+    def test_axis_list(self):
+        topo = PipeDataParallelTopology(num_pp=2, num_dp=4)
+        assert topo.get_axis_list("pipe", 1) == [4, 5, 6, 7]
+
+    def test_rank_repr(self):
+        topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+        # data omitted by default (DP replicas share weights)
+        assert topo.get_rank_repr(0) == "pipe_0-model_0"
+        assert topo.get_rank_repr(7) == "pipe_1-model_1"
+
+    def test_errors(self):
+        topo = ProcessTopology(axes=["x"], dims=[2])
+        with pytest.raises(ValueError):
+            topo.get_rank(x=5)
+        with pytest.raises(ValueError):
+            topo.get_coord(99)
+        with pytest.raises(ValueError):
+            ProcessTopology(axes=["x", "x"], dims=[2, 2])
+
+
+class TestParallelGrid:
+
+    def test_3d_grid_sizes(self):
+        topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+        grid = ParallelGrid(topo, process_index=0)
+        assert grid.get_pipe_parallel_world_size() == 2
+        assert grid.get_data_parallel_world_size() == 2
+        assert grid.get_model_parallel_world_size() == 2
+        assert grid.get_data_parallel_group() == "data"
+        assert grid.get_model_parallel_group() == "model"
+
+    def test_stage_mapping(self):
+        topo = PipeDataParallelTopology(num_pp=4, num_dp=2)
+        grid = ParallelGrid(topo, process_index=0)
+        assert grid.is_first_stage()
+        assert not grid.is_last_stage()
+        assert grid.stage_to_global(stage_id=3) == 6
+        grid7 = ParallelGrid(topo, process_index=7)
+        assert grid7.is_last_stage()
+        assert grid7.get_data_parallel_rank() == 1
+
+    def test_p2p_pairs_adjacent(self):
+        topo = PipeDataParallelTopology(num_pp=4, num_dp=1)
+        grid = ParallelGrid(topo, process_index=0)
+        pairs = grid.p2p_pairs()
+        assert [0, 1] in pairs and [1, 2] in pairs and [2, 3] in pairs
+        assert [0, 3] in pairs  # wraparound
+
+
+class TestMesh:
+
+    def test_default_mesh_all_data(self):
+        mesh = build_mesh()
+        assert axis_size(mesh, "data") == jax.device_count()
+
+    def test_explicit_axes(self):
+        mesh = build_mesh({"data": 4, "model": 2})
+        assert axis_size(mesh, "data") == 4
+        assert axis_size(mesh, "model") == 2
+        assert axis_size(mesh, "pipe") == 1  # absent => 1
+
+    def test_canonical_ordering(self):
+        mesh = build_mesh({"model": 2, "pipe": 2, "data": 2})
+        assert mesh.axis_names == ("pipe", "data", "model")
+
+    def test_infer_axis(self):
+        mesh = build_mesh({"data": -1, "model": 2})
+        assert axis_size(mesh, "data") == jax.device_count() // 2
+
+    def test_mismatch_raises(self):
+        with pytest.raises(ValueError):
+            build_mesh({"data": 3})
+
+    def test_mesh_from_topology(self):
+        topo = PipeDataParallelTopology(num_pp=2, num_dp=4)
+        mesh = mesh_from_topology(topo)
+        assert mesh.axis_names == ("pipe", "data")
+        assert mesh.shape["pipe"] == 2 and mesh.shape["data"] == 4
+
+
+class TestPartition:
+
+    def test_uniform_even(self):
+        assert partition_uniform(8, 4) == [0, 2, 4, 6, 8]
+
+    def test_uniform_remainder(self):
+        parts = partition_uniform(10, 4)
+        sizes = [parts[i + 1] - parts[i] for i in range(4)]
+        assert sum(sizes) == 10
+        assert max(sizes) - min(sizes) <= 1
+
+    def test_balanced_uniform_weights(self):
+        parts = partition_balanced([1.0] * 8, 4)
+        assert parts == [0, 2, 4, 6, 8]
+
+    def test_balanced_skewed(self):
+        weights = [10.0, 1.0, 1.0, 1.0, 1.0, 10.0]
+        parts = partition_balanced(weights, 2)
+        sizes = [sum(weights[parts[i]:parts[i + 1]]) for i in range(2)]
+        assert max(sizes) == 12.0  # optimal bottleneck
+
+    def test_balanced_more_parts_than_items(self):
+        parts = partition_balanced([5.0, 5.0], 4)
+        assert parts[0] == 0 and parts[-1] == 2
+        assert len(parts) == 5
+        # each item in its own part
+        covered = [parts[i + 1] - parts[i] for i in range(4)]
+        assert sum(covered) == 2
+
+    def test_balanced_single_part(self):
+        assert partition_balanced([3.0, 1.0, 4.0], 1) == [0, 3]
