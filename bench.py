@@ -1,0 +1,102 @@
+"""Benchmark: prints ONE JSON line with the headline metric.
+
+Run on real TPU hardware by the driver at end of round. Currently measures
+the engine's fused train-step throughput on a matmul-heavy MLP in bf16
+(placeholder until the GPT-2/BERT model families land); reports achieved
+TFLOP/s and MFU vs the reference's 52%-of-peak V100 BERT number
+(BASELINE.md: 66 TFLOPS/GPU = 52% of V100 peak).
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import deepspeed_tpu
+
+    hidden = 2048
+    n_layers = 8
+    batch = 256
+    steps = 100
+
+    key = jax.random.PRNGKey(0)
+    params = {}
+    for i in range(n_layers):
+        key, k = jax.random.split(key)
+        params[f"layer_{i}"] = {
+            "w": jax.random.normal(k, (hidden, hidden), jnp.float32)
+            / np.sqrt(hidden),
+            "b": jnp.zeros((hidden,), jnp.float32),
+        }
+
+    def loss_fn(p, b):
+        x = b["x"]
+        for i in range(n_layers):
+            layer = p[f"layer_{i}"]
+            x = x @ layer["w"].astype(x.dtype) + layer["b"].astype(x.dtype)
+            if i < n_layers - 1:
+                x = jax.nn.relu(x)
+        return jnp.mean((x - b["y"].astype(x.dtype)) ** 2)
+
+    n_dev = jax.device_count()
+    config = {
+        "train_micro_batch_size_per_gpu": batch // n_dev if n_dev > 1 else batch,
+        "gradient_accumulation_steps": 1,
+        "bf16": {"enabled": True},
+        "steps_per_print": 10**9,  # no mid-bench host fetches
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+    }
+    engine, *_ = deepspeed_tpu.initialize(
+        model=loss_fn, model_parameters=params, config=config)
+
+    rng = np.random.RandomState(0)
+    b = {"x": rng.randn(batch, hidden).astype(np.float32),
+         "y": rng.randn(batch, hidden).astype(np.float32)}
+    # device-resident batch: host->device transfer is NOT part of the
+    # measured step (and the device may sit across a network tunnel)
+    from jax.sharding import NamedSharding, PartitionSpec
+    b = jax.device_put(b, NamedSharding(
+        engine.mesh, PartitionSpec("data" if n_dev > 1 else None)))
+
+    # warmup/compile; a value fetch (not block_until_ready) is the only
+    # reliable completion barrier across the device tunnel
+    loss = engine.train_batch(iter([b]))
+    np.asarray(loss)
+    zf = jax.jit(lambda: jax.numpy.zeros(()))
+    np.asarray(zf())  # compile
+    rtts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        np.asarray(zf())
+        rtts.append(time.perf_counter() - t0)
+    rtt = min(rtts)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = engine.train_batch(iter([b]))
+    np.asarray(loss)
+    dt = max(time.perf_counter() - t0 - rtt, 1e-9)
+
+    # fwd+bwd ≈ 3x fwd matmul flops
+    flops_per_step = 3 * 2 * batch * hidden * hidden * n_layers
+    tflops = flops_per_step * steps / dt / 1e12
+    # v5e peak bf16 ≈ 197 TFLOP/s; v5p ≈ 459
+    peak = 197.0
+    mfu = tflops / peak
+    # reference fused-kernel hardware efficiency: 52% of peak (BASELINE.md)
+    print(json.dumps({
+        "metric": "train_step_mfu",
+        "value": round(mfu, 4),
+        "unit": "fraction_of_peak_bf16",
+        "vs_baseline": round(mfu / 0.52, 4),
+        "detail": {"tflops": round(tflops, 2), "steps_per_s": round(steps / dt, 2),
+                   "loss": float(loss)},
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,92 @@
+"""DeepSpeed-TPU: a TPU-native training framework.
+
+Re-implements the capabilities of the reference DeepSpeed snapshot
+(``deepspeed/__init__.py``; initialize at :52, add_config_arguments at :195)
+on JAX/XLA/Pallas: ZeRO via GSPMD sharding, pipeline + 3D parallelism over a
+named device mesh, fused transformer kernels in Pallas, bf16-first mixed
+precision, block-sparse attention, and a multi-host launcher.
+"""
+
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine, TrainState
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+from deepspeed_tpu.runtime.lr_schedules import (
+    WarmupLR, OneCycle, LRRangeTest)
+from deepspeed_tpu.runtime.dataloader import (
+    DeepSpeedDataLoader, RepeatingLoader)
+from deepspeed_tpu.parallel.topology import (
+    ProcessTopology, PipeDataParallelTopology, PipeModelDataParallelTopology,
+    ParallelGrid)
+from deepspeed_tpu.parallel.mesh import build_mesh
+from deepspeed_tpu.ops.optimizers import (
+    Adam, FusedAdam, Lamb, FusedLamb, SGD)
+
+__version__ = "0.1.0"
+__git_hash__ = None
+__git_branch__ = None
+
+
+def initialize(args=None,
+               model=None,
+               optimizer=None,
+               model_parameters=None,
+               training_data=None,
+               lr_scheduler=None,
+               mpu=None,
+               param_specs=None,
+               dist_init_required=None,
+               collate_fn=None,
+               config=None,
+               config_params=None):
+    """Initialize the DeepSpeed-TPU engine (reference __init__.py:52).
+
+    Returns the same 4-tuple as the reference:
+    ``(engine, optimizer, training_dataloader, lr_scheduler)``.
+
+    Model contract (TPU-native): ``model`` is a pure loss function
+    ``loss_fn(params, batch[, rng]) -> loss | (loss, aux)`` and
+    ``model_parameters`` is the initial parameter pytree. Use
+    :func:`flax_loss_fn` to adapt a flax module + criterion.
+    """
+    engine = DeepSpeedEngine(args=args,
+                             model=model,
+                             optimizer=optimizer,
+                             model_parameters=model_parameters,
+                             training_data=training_data,
+                             lr_scheduler=lr_scheduler,
+                             mpu=mpu,
+                             param_specs=param_specs,
+                             collate_fn=collate_fn,
+                             config=config,
+                             config_params=config_params)
+    return (engine, engine.optimizer, engine.training_dataloader,
+            engine.lr_scheduler)
+
+
+def flax_loss_fn(module, criterion):
+    """Adapt a flax linen Module + criterion to the engine's loss contract.
+
+    ``criterion(outputs, batch) -> loss``; batches are pytrees whose
+    structure the criterion understands (e.g. dicts with 'x'/'y').
+    """
+    def loss_fn(params, batch, rng):
+        inputs = batch["x"] if isinstance(batch, dict) else batch[0]
+        outputs = module.apply({"params": params}, inputs,
+                               rngs={"dropout": rng})
+        return criterion(outputs, batch)
+    return loss_fn
+
+
+def add_config_arguments(parser):
+    """Add --deepspeed / --deepspeed_config CLI args
+    (reference __init__.py:144-192)."""
+    group = parser.add_argument_group("DeepSpeed-TPU",
+                                      "DeepSpeed-TPU configurations")
+    group.add_argument("--deepspeed", default=False, action="store_true",
+                       help="Enable DeepSpeed-TPU (helper flag)")
+    group.add_argument("--deepspeed_config", default=None, type=str,
+                       help="DeepSpeed-TPU json configuration file")
+    group.add_argument("--deepscale", default=False, action="store_true",
+                       help="Deprecated alias of --deepspeed")
+    group.add_argument("--deepscale_config", default=None, type=str,
+                       help="Deprecated alias of --deepspeed_config")
+    return parser

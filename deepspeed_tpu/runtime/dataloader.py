@@ -1,0 +1,104 @@
+"""Data loading: repeating + distributed-sharded loaders.
+
+TPU-native analog of the reference's ``deepspeed/runtime/dataloader.py``
+(RepeatingLoader :10, DeepSpeedDataLoader :33 which auto-installed a
+DistributedSampler per dp rank). Under single-controller SPMD we instead
+device_put each host batch with a NamedSharding over the ``data`` axis — the
+global batch is laid out across chips in one call; no sampler zoo.
+"""
+
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+import jax
+import numpy as np
+
+
+class RepeatingLoader:
+    """Wraps an iterator to restart on StopIteration (reference
+    dataloader.py:10)."""
+
+    def __init__(self, loader: Iterable):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            batch = next(self.data_iter)
+        except StopIteration:
+            self.data_iter = iter(self.loader)
+            batch = next(self.data_iter)
+        return batch
+
+
+class DeepSpeedDataLoader:
+    """Yields device-sharded global batches.
+
+    ``dataset`` is any indexable of pytrees (dict/tuple of numpy arrays) or
+    an iterable of already-batched pytrees. When ``mesh`` is given, each
+    batch's leading dim is sharded over ``batch_axis``.
+    """
+
+    def __init__(self, dataset, batch_size: int, mesh=None,
+                 batch_axis: str = "data", shuffle: bool = True,
+                 seed: int = 0, drop_last: bool = True,
+                 collate_fn: Optional[Callable] = None,
+                 data_sampler=None):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.mesh = mesh
+        self.batch_axis = batch_axis
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.collate_fn = collate_fn
+        self.data_sampler = data_sampler
+        self._epoch = 0
+        try:
+            n = len(dataset)
+            self.len = (n // batch_size if drop_last
+                        else -(-n // batch_size))
+        except TypeError:
+            self.len = None
+
+    def __len__(self):
+        if self.len is None:
+            raise TypeError("underlying dataset has no length")
+        return self.len
+
+    def _sharding(self):
+        if self.mesh is None or self.batch_axis not in self.mesh.axis_names:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec
+        return NamedSharding(self.mesh, PartitionSpec(self.batch_axis))
+
+    def _put(self, batch):
+        sharding = self._sharding()
+        if sharding is None:
+            return batch
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(np.asarray(x), sharding), batch)
+
+    def __iter__(self) -> Iterator[Any]:
+        if hasattr(self.dataset, "__getitem__") and self.len is not None:
+            n_total = len(self.dataset)
+            n = (self.len * self.batch_size if self.drop_last else n_total)
+            order = np.arange(n_total)
+            if self.shuffle:
+                rng = np.random.RandomState(self.seed + self._epoch)
+                rng.shuffle(order)
+            self._epoch += 1
+            for i in range(0, n, self.batch_size):
+                idx = order[i:i + self.batch_size]
+                items = [self.dataset[int(j)] for j in idx]
+                if self.collate_fn is not None:
+                    batch = self.collate_fn(items)
+                else:
+                    batch = jax.tree_util.tree_map(
+                        lambda *xs: np.stack(xs), *items)
+                yield self._put(batch)
+        else:
+            for batch in self.dataset:
+                yield self._put(batch)

@@ -1,0 +1,93 @@
+"""Loss scaling for fp16 training.
+
+TPU-native analog of the reference's ``deepspeed/runtime/fp16/loss_scaler.py``
+(LossScalerBase :34, static LossScaler :56, DynamicLossScaler :79 — init
+2^32, x2 growth every ``scale_window`` good steps, /2 on overflow with
+``delayed_shift`` hysteresis).
+
+Difference from the reference: the scaler state is a jittable pytree and the
+overflow-skip decision happens *inside* the compiled train step via
+``jnp.where`` — there is no Python-side has_overflow round trip per step.
+bf16 (TPU default) needs none of this; fp16 is kept for behavioral parity.
+"""
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class LossScaleState(NamedTuple):
+    scale: jnp.ndarray          # float32 scalar
+    good_steps: jnp.ndarray     # int32: consecutive non-overflow steps
+    hysteresis: jnp.ndarray     # int32: remaining tolerated overflows
+
+
+class DynamicLossScaler:
+    """Stateless transition rules over LossScaleState."""
+
+    def __init__(self, init_scale: float = 2.0**32, scale_factor: float = 2.0,
+                 scale_window: int = 1000, min_scale: float = 1.0,
+                 delayed_shift: int = 1, consecutive_hysteresis: bool = False):
+        self.init_scale = init_scale
+        self.scale_factor = scale_factor
+        self.scale_window = scale_window
+        self.min_scale = min_scale
+        self.delayed_shift = delayed_shift
+        self.consecutive_hysteresis = consecutive_hysteresis
+
+    def init(self) -> LossScaleState:
+        return LossScaleState(
+            scale=jnp.asarray(self.init_scale, jnp.float32),
+            good_steps=jnp.zeros((), jnp.int32),
+            hysteresis=jnp.asarray(self.delayed_shift, jnp.int32),
+        )
+
+    def update(self, state: LossScaleState, overflow) -> LossScaleState:
+        """One-step transition (reference loss_scaler.py:151-166)."""
+        overflow = jnp.asarray(overflow)
+        # on overflow: consume hysteresis; halve scale once exhausted
+        new_hyst = jnp.where(overflow,
+                             jnp.maximum(state.hysteresis - 1, 0),
+                             state.hysteresis)
+        shrink = overflow & (state.hysteresis <= 1)
+        shrunk_scale = jnp.maximum(state.scale / self.scale_factor,
+                                   self.min_scale)
+        # growth after scale_window consecutive good steps
+        grown = (~overflow) & (state.good_steps + 1 >= self.scale_window)
+        new_scale = jnp.where(shrink, shrunk_scale,
+                              jnp.where(grown, state.scale * self.scale_factor,
+                                        state.scale))
+        new_good = jnp.where(overflow | grown, 0, state.good_steps + 1)
+        if self.consecutive_hysteresis:
+            # restock hysteresis on any good step
+            new_hyst = jnp.where(~overflow,
+                                 jnp.asarray(self.delayed_shift, jnp.int32),
+                                 new_hyst)
+        return LossScaleState(scale=new_scale,
+                              good_steps=new_good.astype(jnp.int32),
+                              hysteresis=new_hyst.astype(jnp.int32))
+
+
+class StaticLossScaler(DynamicLossScaler):
+    """Fixed scale (reference LossScaler :56)."""
+
+    def __init__(self, scale: float = 1.0):
+        super().__init__(init_scale=scale)
+
+    def update(self, state: LossScaleState, overflow) -> LossScaleState:
+        return state
+
+
+def has_overflow(grads) -> jnp.ndarray:
+    """True if any grad leaf contains inf/nan (reference
+    CheckOverflow, runtime/utils.py:41). Computed on-device; under pjit the
+    reduction spans all shards, so this is globally consistent."""
+    import jax
+    leaves = jax.tree_util.tree_leaves(grads)
+    if not leaves:
+        return jnp.zeros((), bool)
+    flags = [~jnp.isfinite(g.astype(jnp.float32)).all() for g in leaves]
+    out = flags[0]
+    for f in flags[1:]:
+        out = out | f
+    return out

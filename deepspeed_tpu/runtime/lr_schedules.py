@@ -1,0 +1,205 @@
+"""LR schedules constructible from JSON config.
+
+TPU-native analog of the reference's ``deepspeed/runtime/lr_schedules.py``
+(LRRangeTest :298, OneCycle :398, WarmupLR :642). Each schedule is a pure
+function of the global step implemented with jnp ops, so the engine can fold
+the LR computation *inside* the compiled train step (no host round-trip per
+step); the object wrapper keeps the torch-scheduler-style
+step()/get_lr()/state_dict() facade for reference-API parity.
+"""
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+LR_SCHEDULE = "lr_schedule"
+LR_RANGE_TEST = "LRRangeTest"
+ONE_CYCLE = "OneCycle"
+WARMUP_LR = "WarmupLR"
+VALID_LR_SCHEDULES = [LR_RANGE_TEST, ONE_CYCLE, WARMUP_LR]
+
+
+class _Schedule:
+    """Host-facing facade; ``lr_at(step)`` is the jittable core."""
+
+    def __init__(self):
+        self.last_batch_iteration = -1
+        self._last_lr = None
+
+    def lr_at(self, step):
+        raise NotImplementedError
+
+    def step(self, last_batch_iteration: Optional[int] = None):
+        if last_batch_iteration is None:
+            last_batch_iteration = self.last_batch_iteration + 1
+        self.last_batch_iteration = last_batch_iteration
+        self._last_lr = float(self.lr_at(jnp.asarray(last_batch_iteration)))
+
+    def get_lr(self):
+        if self._last_lr is None:
+            return [float(self.lr_at(jnp.asarray(0)))]
+        return [self._last_lr]
+
+    def get_last_lr(self):
+        return self.get_lr()
+
+    def state_dict(self):
+        return {"last_batch_iteration": self.last_batch_iteration}
+
+    def load_state_dict(self, sd):
+        self.last_batch_iteration = sd["last_batch_iteration"]
+
+
+class WarmupLR(_Schedule):
+    """Linear (or log) warmup from warmup_min_lr to warmup_max_lr over
+    warmup_num_steps, then constant (reference lr_schedules.py:642)."""
+
+    def __init__(self, optimizer=None, warmup_min_lr: float = 0.0,
+                 warmup_max_lr: float = 0.001, warmup_num_steps: int = 1000,
+                 warmup_type: str = "log", last_batch_iteration: int = -1):
+        super().__init__()
+        self.warmup_min_lr = warmup_min_lr
+        self.warmup_max_lr = warmup_max_lr
+        self.warmup_num_steps = max(1, warmup_num_steps)
+        self.warmup_type = warmup_type
+        self.inverse_log_warm_up = 1.0 / jnp.log(self.warmup_num_steps) \
+            if self.warmup_num_steps > 1 else 1.0
+        self.last_batch_iteration = last_batch_iteration
+
+    def lr_at(self, step):
+        step = jnp.maximum(step, 0).astype(jnp.float32)
+        if self.warmup_type == "log":
+            # reference lr_schedules.py:705: gamma = log(step + 1) / log(N)
+            gamma = jnp.where(
+                step + 1 >= self.warmup_num_steps, 1.0,
+                self.inverse_log_warm_up * jnp.log(step + 1.0))
+        else:
+            gamma = jnp.minimum(step / self.warmup_num_steps, 1.0)
+        return self.warmup_min_lr + (self.warmup_max_lr - self.warmup_min_lr) * gamma
+
+
+class LRRangeTest(_Schedule):
+    """LR range test: ramp lr by lr_range_test_step_rate every
+    lr_range_test_step_size steps, continuous or staircase
+    (reference lr_schedules.py:298)."""
+
+    def __init__(self, optimizer=None, lr_range_test_min_lr: float = 1e-3,
+                 lr_range_test_step_size: int = 2000,
+                 lr_range_test_step_rate: float = 1.0,
+                 lr_range_test_staircase: bool = False,
+                 last_batch_iteration: int = -1):
+        super().__init__()
+        self.min_lr = lr_range_test_min_lr
+        self.step_size = max(1, lr_range_test_step_size)
+        self.step_rate = lr_range_test_step_rate
+        self.staircase = lr_range_test_staircase
+        self.last_batch_iteration = last_batch_iteration
+
+    def lr_at(self, step):
+        step = jnp.maximum(step, 0).astype(jnp.float32)
+        if self.staircase:
+            count = jnp.floor(step / self.step_size)
+        else:
+            count = step / self.step_size
+        return self.min_lr * (1.0 + self.step_rate * count)
+
+
+class OneCycle(_Schedule):
+    """1-cycle policy: lr up then down, optional momentum counter-cycling
+    and post-cycle decay (reference lr_schedules.py:398)."""
+
+    def __init__(self, optimizer=None, cycle_min_lr: float = 1e-4,
+                 cycle_max_lr: float = 1e-3,
+                 decay_lr_rate: float = 0.0,
+                 cycle_first_step_size: int = 2000,
+                 cycle_second_step_size: Optional[int] = None,
+                 cycle_first_stair_count: int = 0,
+                 cycle_second_stair_count: Optional[int] = None,
+                 decay_step_size: int = 0,
+                 cycle_momentum: bool = True,
+                 cycle_min_mom: float = 0.85,
+                 cycle_max_mom: float = 0.99,
+                 decay_mom_rate: float = 0.0,
+                 last_batch_iteration: int = -1):
+        super().__init__()
+        self.cycle_min_lr = cycle_min_lr
+        self.cycle_max_lr = cycle_max_lr
+        self.decay_lr_rate = decay_lr_rate
+        self.first_size = max(1, cycle_first_step_size)
+        self.second_size = (cycle_second_step_size
+                            if cycle_second_step_size is not None
+                            else self.first_size)
+        self.first_stair_count = cycle_first_stair_count
+        self.second_stair_count = (cycle_second_stair_count
+                                   if cycle_second_stair_count is not None
+                                   else cycle_first_stair_count)
+        self.decay_step_size = decay_step_size
+        self.cycle_momentum = cycle_momentum
+        self.cycle_min_mom = cycle_min_mom
+        self.cycle_max_mom = cycle_max_mom
+        self.decay_mom_rate = decay_mom_rate
+        self.total_size = self.first_size + self.second_size
+        self.last_batch_iteration = last_batch_iteration
+
+    @staticmethod
+    def _stair(frac, stair_count):
+        """Quantize a [0,1] phase fraction into stair_count flat steps
+        (reference lr_schedules.py staircase interpolation)."""
+        if stair_count and stair_count > 0:
+            return jnp.floor(frac * stair_count) / stair_count
+        return frac
+
+    def lr_at(self, step):
+        step = jnp.maximum(step, 0).astype(jnp.float32)
+        in_cycle = step <= self.total_size
+        # position within the (single) cycle
+        up_frac = self._stair(jnp.clip(step / self.first_size, 0.0, 1.0),
+                              self.first_stair_count)
+        down_frac = self._stair(
+            jnp.clip((step - self.first_size) / self.second_size, 0.0, 1.0),
+            self.second_stair_count)
+        cycle_lr = jnp.where(
+            step < self.first_size,
+            self.cycle_min_lr + (self.cycle_max_lr - self.cycle_min_lr) * up_frac,
+            self.cycle_max_lr - (self.cycle_max_lr - self.cycle_min_lr) * down_frac)
+        # post-cycle decay
+        if self.decay_step_size > 0:
+            decay_steps = jnp.maximum(step - self.total_size, 0.0) / self.decay_step_size
+        else:
+            decay_steps = jnp.maximum(step - self.total_size, 0.0)
+        decay_lr = self.cycle_min_lr / (1.0 + self.decay_lr_rate * decay_steps)
+        return jnp.where(in_cycle, cycle_lr, decay_lr)
+
+    def mom_at(self, step):
+        """Momentum counter-cycles the LR (reference lr_schedules.py:518)."""
+        step = jnp.maximum(step, 0).astype(jnp.float32)
+        up_frac = jnp.clip(step / self.first_size, 0.0, 1.0)
+        down_frac = jnp.clip((step - self.first_size) / self.second_size,
+                             0.0, 1.0)
+        in_cycle = step <= self.total_size
+        cycle_mom = jnp.where(
+            step < self.first_size,
+            self.cycle_max_mom - (self.cycle_max_mom - self.cycle_min_mom) * up_frac,
+            self.cycle_min_mom + (self.cycle_max_mom - self.cycle_min_mom) * down_frac)
+        if self.decay_step_size > 0:
+            decay_steps = jnp.maximum(step - self.total_size, 0.0) / self.decay_step_size
+        else:
+            decay_steps = jnp.maximum(step - self.total_size, 0.0)
+        decay_mom = self.cycle_max_mom * (1.0 + self.decay_mom_rate * decay_steps)
+        return jnp.where(in_cycle, cycle_mom, decay_mom)
+
+
+def build_lr_schedule(name: Optional[str], params: Optional[dict]):
+    """Construct from JSON config (reference engine.py:402-417)."""
+    if name is None:
+        return None
+    params = dict(params or {})
+    params.pop("warmup_proportion", None)  # client-side extension, ignored
+    if name == WARMUP_LR:
+        return WarmupLR(**params)
+    if name == LR_RANGE_TEST:
+        return LRRangeTest(**params)
+    if name == ONE_CYCLE:
+        return OneCycle(**params)
+    raise ValueError(
+        f"Unknown scheduler {name}; valid: {VALID_LR_SCHEDULES}")

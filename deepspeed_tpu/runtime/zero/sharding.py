@@ -1,0 +1,81 @@
+"""ZeRO = sharding rules over the ``data`` mesh axis.
+
+The reference implements ZeRO with ~2.8 kLoC of hook-and-bucket machinery
+(``runtime/zero/stage1.py``, ``stage2.py``): flatten params, partition,
+register per-param backward hooks, bucket reductions onto a side stream,
+reduce-to-owner, step on the local partition, allgather updated params.
+All of that exists because PyTorch is eager.
+
+Under XLA/GSPMD the whole dance is a *sharding assignment*: give the fp32
+master params + optimizer state (and, for stage 2, the gradient accumulator)
+a NamedSharding over the ``data`` axis, and the compiler emits exactly the
+ZeRO communication pattern inside the one compiled train step —
+reduce-scatter of grads to the owning shard, sharded optimizer math, and an
+all-gather of updated params where the next forward needs them — scheduled
+with overlap by XLA's latency-hiding scheduler (the reference's
+``overlap_comm`` stream juggling, stage2.py:291-294, for free).
+
+Stage map (reference zero/constants.py:28-40):
+- stage 0: everything replicated (plain DP)
+- stage 1: optimizer state + fp32 master sharded (stage1.py sub-partitions)
+- stage 2: + gradient accumulator sharded (stage2.py grad partitioning)
+- stage 3: + a param-sharded forward; see runtime/zero/stage3.py
+"""
+
+from typing import Any, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from deepspeed_tpu.parallel.mesh import axis_size
+
+
+def leaf_partition_spec(shape, axis_name: str, axis_n: int,
+                        model_spec: Optional[PartitionSpec] = None
+                        ) -> PartitionSpec:
+    """Choose a PartitionSpec that shards one array over ``axis_name``.
+
+    Picks the first dimension divisible by the axis size that is not already
+    taken by ``model_spec`` (tensor-parallel sharding); falls back to
+    replication for small/indivisible leaves (cheap: they are tiny).
+    """
+    base = list(model_spec) if model_spec is not None else []
+    base += [None] * (len(shape) - len(base))
+    for i, d in enumerate(shape):
+        if base[i] is None and d % axis_n == 0 and d >= axis_n:
+            base[i] = axis_name
+            return PartitionSpec(*base)
+    return PartitionSpec(*base) if model_spec is not None else PartitionSpec()
+
+
+def zero_shardings(tree: Any, mesh: Mesh, stage: int,
+                   axis_name: str = "data",
+                   model_specs: Optional[Any] = None) -> Any:
+    """NamedSharding pytree for optimizer state / master params.
+
+    ``model_specs`` optionally carries per-leaf tensor-parallel
+    PartitionSpecs to compose with (ZeRO over 'data' × TP over 'model').
+    """
+    n = axis_size(mesh, axis_name)
+
+    def one(leaf, mspec=None):
+        if not hasattr(leaf, "shape") or leaf.ndim == 0 or stage < 1 or n == 1:
+            return NamedSharding(mesh, mspec if mspec is not None
+                                 else PartitionSpec())
+        return NamedSharding(
+            mesh, leaf_partition_spec(leaf.shape, axis_name, n, mspec))
+
+    if model_specs is None:
+        return jax.tree_util.tree_map(one, tree)
+    return jax.tree_util.tree_map(one, tree, model_specs)
+
+
+def replicated_shardings(tree: Any, mesh: Mesh,
+                         model_specs: Optional[Any] = None) -> Any:
+    def one(leaf, mspec=None):
+        return NamedSharding(mesh, mspec if mspec is not None
+                             else PartitionSpec())
+    if model_specs is None:
+        return jax.tree_util.tree_map(one, tree)
+    return jax.tree_util.tree_map(one, tree, model_specs)

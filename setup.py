@@ -1,0 +1,16 @@
+"""DeepSpeed-TPU installation (reference setup.py, minus CUDA extensions —
+native components are prebuilt ctypes shared libraries under csrc/)."""
+
+from setuptools import find_packages, setup
+
+setup(
+    name="deepspeed_tpu",
+    version="0.1.0",
+    description="TPU-native deep learning optimization library: ZeRO, "
+                "pipeline/3D parallelism, fused Pallas kernels, sparse "
+                "attention — DeepSpeed capabilities on JAX/XLA",
+    packages=find_packages(include=["deepspeed_tpu", "deepspeed_tpu.*"]),
+    scripts=["bin/dstpu"],
+    python_requires=">=3.10",
+    install_requires=["jax", "numpy"],
+)

@@ -1,0 +1,63 @@
+"""Shared tiny-model fixtures (mirrors reference tests/unit/simple_model.py:
+SimpleModel :9, random_dataloader :104, config helpers :115-134) — rebuilt
+as pure-JAX loss functions per the engine's model contract."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def init_simple_params(key, hidden_dim: int, n_layers: int = 2):
+    """Linear stack params: n_layers of hidden->hidden + bias."""
+    params = {}
+    for i in range(n_layers):
+        key, k1 = jax.random.split(key)
+        params[f"layer_{i}"] = {
+            "w": jax.random.normal(k1, (hidden_dim, hidden_dim),
+                                   jnp.float32) / np.sqrt(hidden_dim),
+            "b": jnp.zeros((hidden_dim,), jnp.float32),
+        }
+    return params
+
+
+def simple_loss_fn(params, batch):
+    """Linear stack + mean-squared-error regression loss."""
+    x = batch["x"]
+    for i in range(len(params)):
+        layer = params[f"layer_{i}"]
+        x = x @ layer["w"].astype(x.dtype) + layer["b"].astype(x.dtype)
+        if i < len(params) - 1:
+            x = jax.nn.relu(x)
+    return jnp.mean((x - batch["y"].astype(x.dtype)) ** 2)
+
+
+def random_dataset(n_samples: int, hidden_dim: int, seed: int = 0):
+    """In-memory dataset of (x, y) dicts."""
+    rng = np.random.RandomState(seed)
+    xs = rng.randn(n_samples, hidden_dim).astype(np.float32)
+    ys = rng.randn(n_samples, hidden_dim).astype(np.float32)
+    return [{"x": xs[i], "y": ys[i]} for i in range(n_samples)]
+
+
+def random_batches(n_batches: int, batch_size: int, hidden_dim: int,
+                   seed: int = 0):
+    """Learnable task: y = x @ W_true, so loss can approach 0."""
+    rng = np.random.RandomState(seed)
+    w_true = (np.random.RandomState(1234).randn(hidden_dim, hidden_dim)
+              .astype(np.float32) / np.sqrt(hidden_dim))
+    out = []
+    for _ in range(n_batches):
+        x = rng.randn(batch_size, hidden_dim).astype(np.float32)
+        out.append({"x": x, "y": x @ w_true})
+    return out
+
+
+def base_config(**overrides):
+    cfg = {
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "steps_per_print": 1000,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+    }
+    cfg.update(overrides)
+    return cfg

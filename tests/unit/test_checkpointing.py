@@ -1,0 +1,114 @@
+"""Checkpoint save/load round-trips (mirrors reference
+tests/unit/test_checkpointing.py: ZeRO stages, fp16 state, lr scheduler,
+elastic world-size changes)."""
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from tests.unit.simple_model import (
+    base_config, init_simple_params, random_batches, simple_loss_fn)
+
+HIDDEN = 16
+
+
+def make_engine(config, seed=0):
+    params = init_simple_params(jax.random.PRNGKey(seed), HIDDEN)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=simple_loss_fn, model_parameters=params, config=config)
+    return engine
+
+
+def train_steps(engine, n, seed=0):
+    batches = iter(random_batches(
+        n * engine.gradient_accumulation_steps, 16, HIDDEN, seed=seed))
+    losses = [float(engine.train_batch(batches)) for _ in range(n)]
+    return losses
+
+
+def params_equal(a, b):
+    fa = jax.tree_util.tree_leaves(a)
+    fb = jax.tree_util.tree_leaves(b)
+    return all(np.allclose(np.asarray(x), np.asarray(y)) for x, y in zip(fa, fb))
+
+
+@pytest.mark.parametrize("stage", [0, 2])
+def test_roundtrip_preserves_training(tmp_path, stage):
+    cfg = base_config(zero_optimization={"stage": stage})
+    e1 = make_engine(cfg, seed=1)
+    train_steps(e1, 5, seed=2)
+    e1.save_checkpoint(str(tmp_path), client_state={"note": "hi"})
+
+    e2 = make_engine(cfg, seed=99)  # different init
+    path, client = e2.load_checkpoint(str(tmp_path))
+    assert path is not None
+    assert client == {"note": "hi"}
+    assert e2.global_steps == 5
+    assert params_equal(e1.state.params, e2.state.params)
+    assert params_equal(e1.state.opt_state.exp_avg, e2.state.opt_state.exp_avg)
+
+    # resumed training must follow the same trajectory
+    l1 = train_steps(e1, 3, seed=5)
+    l2 = train_steps(e2, 3, seed=5)
+    np.testing.assert_allclose(l1, l2, rtol=1e-5)
+
+
+def test_latest_tag_and_explicit_tag(tmp_path):
+    e = make_engine(base_config())
+    train_steps(e, 2)
+    e.save_checkpoint(str(tmp_path))
+    train_steps(e, 2)
+    e.save_checkpoint(str(tmp_path))
+    # "latest" points to step 4
+    e2 = make_engine(base_config())
+    e2.load_checkpoint(str(tmp_path))
+    assert e2.global_steps == 4
+    # explicit older tag still loadable
+    e3 = make_engine(base_config())
+    e3.load_checkpoint(str(tmp_path), tag="global_step2")
+    assert e3.global_steps == 2
+
+
+def test_elastic_zero_resharding(tmp_path):
+    """Save under ZeRO-2, reload under stage 0 (different 'partitioning') —
+    the reference needed merge-then-repartition (stage2.py:1713); here it is
+    free because checkpoints are global arrays."""
+    e1 = make_engine(base_config(zero_optimization={"stage": 2}), seed=1)
+    train_steps(e1, 3)
+    e1.save_checkpoint(str(tmp_path))
+
+    e2 = make_engine(base_config(), seed=2)  # stage 0
+    e2.load_checkpoint(str(tmp_path))
+    assert params_equal(e1.state.params, e2.state.params)
+    l1 = train_steps(e1, 2, seed=9)
+    l2 = train_steps(e2, 2, seed=9)
+    np.testing.assert_allclose(l1, l2, rtol=1e-5)
+
+
+def test_missing_checkpoint_warns(tmp_path):
+    e = make_engine(base_config())
+    path, client = e.load_checkpoint(str(tmp_path))
+    assert path is None and client == {}
+
+
+def test_fp16_scaler_state_restored(tmp_path):
+    cfg = base_config(fp16={"enabled": True, "initial_scale_power": 10})
+    e1 = make_engine(cfg)
+    train_steps(e1, 3)
+    e1.save_checkpoint(str(tmp_path))
+    e2 = make_engine(cfg)
+    e2.load_checkpoint(str(tmp_path))
+    assert e2.loss_scale() == e1.loss_scale()
+
+
+def test_lr_scheduler_state_restored(tmp_path):
+    cfg = base_config(scheduler={
+        "type": "WarmupLR",
+        "params": {"warmup_max_lr": 1e-2, "warmup_num_steps": 100}})
+    e1 = make_engine(cfg)
+    train_steps(e1, 4)
+    e1.save_checkpoint(str(tmp_path))
+    e2 = make_engine(cfg)
+    e2.load_checkpoint(str(tmp_path))
+    assert e2.get_lr() == e1.get_lr()

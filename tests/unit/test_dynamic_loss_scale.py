@@ -1,0 +1,76 @@
+"""Dynamic loss scaler transition tests (mirrors reference
+tests/unit/test_dynamic_loss_scale.py: overflow→halving sequences, growth
+after scale_window, hysteresis)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.runtime.fp16.loss_scaler import (
+    DynamicLossScaler, StaticLossScaler, has_overflow)
+
+
+def test_initial_scale():
+    s = DynamicLossScaler(init_scale=2.0**16)
+    st = s.init()
+    assert float(st.scale) == 2.0**16
+
+
+def test_overflow_halves():
+    s = DynamicLossScaler(init_scale=256.0, delayed_shift=1)
+    st = s.init()
+    for i in range(3):
+        st = s.update(st, jnp.asarray(True))
+    assert float(st.scale) == 32.0  # 256 / 2^3
+
+
+def test_min_scale_floor():
+    s = DynamicLossScaler(init_scale=4.0, min_scale=1.0, delayed_shift=1)
+    st = s.init()
+    for _ in range(10):
+        st = s.update(st, jnp.asarray(True))
+    assert float(st.scale) == 1.0
+
+
+def test_growth_after_window():
+    s = DynamicLossScaler(init_scale=256.0, scale_window=5)
+    st = s.init()
+    for _ in range(5):
+        st = s.update(st, jnp.asarray(False))
+    assert float(st.scale) == 512.0
+    # good_steps resets after growth
+    assert int(st.good_steps) == 0
+
+
+def test_overflow_resets_good_steps():
+    s = DynamicLossScaler(init_scale=256.0, scale_window=5, delayed_shift=1)
+    st = s.init()
+    for _ in range(4):
+        st = s.update(st, jnp.asarray(False))
+    st = s.update(st, jnp.asarray(True))
+    assert float(st.scale) == 128.0
+    assert int(st.good_steps) == 0
+
+
+def test_hysteresis_tolerates_overflows():
+    s = DynamicLossScaler(init_scale=256.0, delayed_shift=2)
+    st = s.init()
+    st = s.update(st, jnp.asarray(True))  # first overflow: consume hysteresis
+    assert float(st.scale) == 256.0
+    st = s.update(st, jnp.asarray(True))  # second: now halve
+    assert float(st.scale) == 128.0
+
+
+def test_static_scaler_never_changes():
+    s = StaticLossScaler(128.0)
+    st = s.init()
+    st = s.update(st, jnp.asarray(True))
+    assert float(st.scale) == 128.0
+
+
+def test_has_overflow():
+    good = {"a": jnp.ones((4,)), "b": jnp.zeros((2, 2))}
+    assert not bool(has_overflow(good))
+    bad_inf = {"a": jnp.array([1.0, jnp.inf]), "b": jnp.zeros((2,))}
+    assert bool(has_overflow(bad_inf))
+    bad_nan = {"a": jnp.ones((4,)), "b": jnp.array([jnp.nan])}
+    assert bool(has_overflow(bad_nan))

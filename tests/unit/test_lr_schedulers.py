@@ -1,0 +1,98 @@
+"""LR schedule tests (mirrors reference tests/unit/test_lr_schedulers.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.lr_schedules import (
+    WarmupLR, OneCycle, LRRangeTest, build_lr_schedule)
+
+
+class TestWarmupLR:
+
+    def test_linear_ramp(self):
+        s = WarmupLR(warmup_min_lr=0.0, warmup_max_lr=1.0,
+                     warmup_num_steps=10, warmup_type="linear")
+        assert float(s.lr_at(jnp.asarray(0))) == pytest.approx(0.0)
+        assert float(s.lr_at(jnp.asarray(5))) == pytest.approx(0.5)
+        assert float(s.lr_at(jnp.asarray(10))) == pytest.approx(1.0)
+        assert float(s.lr_at(jnp.asarray(100))) == pytest.approx(1.0)
+
+    def test_log_ramp_monotone(self):
+        s = WarmupLR(warmup_min_lr=0.0, warmup_max_lr=1.0,
+                     warmup_num_steps=100, warmup_type="log")
+        lrs = [float(s.lr_at(jnp.asarray(i))) for i in range(0, 120, 10)]
+        assert all(b >= a - 1e-7 for a, b in zip(lrs, lrs[1:]))
+        assert lrs[-1] == pytest.approx(1.0)
+
+    def test_step_facade(self):
+        s = WarmupLR(warmup_min_lr=0.0, warmup_max_lr=1.0,
+                     warmup_num_steps=4, warmup_type="linear")
+        for _ in range(4):
+            s.step()
+        assert s.get_lr()[0] == pytest.approx(0.75)
+        sd = s.state_dict()
+        s2 = WarmupLR(warmup_min_lr=0.0, warmup_max_lr=1.0,
+                      warmup_num_steps=4, warmup_type="linear")
+        s2.load_state_dict(sd)
+        assert s2.last_batch_iteration == s.last_batch_iteration
+
+
+class TestLRRangeTest:
+
+    def test_continuous(self):
+        s = LRRangeTest(lr_range_test_min_lr=0.1,
+                        lr_range_test_step_size=10,
+                        lr_range_test_step_rate=1.0)
+        assert float(s.lr_at(jnp.asarray(0))) == pytest.approx(0.1)
+        assert float(s.lr_at(jnp.asarray(10))) == pytest.approx(0.2)
+        assert float(s.lr_at(jnp.asarray(20))) == pytest.approx(0.3)
+
+    def test_staircase(self):
+        s = LRRangeTest(lr_range_test_min_lr=0.1,
+                        lr_range_test_step_size=10,
+                        lr_range_test_step_rate=1.0,
+                        lr_range_test_staircase=True)
+        assert float(s.lr_at(jnp.asarray(9))) == pytest.approx(0.1)
+        assert float(s.lr_at(jnp.asarray(10))) == pytest.approx(0.2)
+        assert float(s.lr_at(jnp.asarray(19))) == pytest.approx(0.2)
+
+
+class TestOneCycle:
+
+    def test_triangle(self):
+        s = OneCycle(cycle_min_lr=0.1, cycle_max_lr=1.0,
+                     cycle_first_step_size=10, cycle_second_step_size=10)
+        assert float(s.lr_at(jnp.asarray(0))) == pytest.approx(0.1)
+        assert float(s.lr_at(jnp.asarray(5))) == pytest.approx(0.55)
+        assert float(s.lr_at(jnp.asarray(10))) == pytest.approx(1.0)
+        assert float(s.lr_at(jnp.asarray(15))) == pytest.approx(0.55)
+        assert float(s.lr_at(jnp.asarray(20))) == pytest.approx(0.1)
+
+    def test_decay_phase(self):
+        s = OneCycle(cycle_min_lr=0.1, cycle_max_lr=1.0,
+                     cycle_first_step_size=5, cycle_second_step_size=5,
+                     decay_step_size=5, decay_lr_rate=1.0)
+        after = float(s.lr_at(jnp.asarray(15)))  # 5 steps past cycle end
+        assert after == pytest.approx(0.1 / 2.0)
+
+    def test_momentum_counter_cycles(self):
+        s = OneCycle(cycle_min_lr=0.1, cycle_max_lr=1.0,
+                     cycle_first_step_size=10, cycle_second_step_size=10,
+                     cycle_min_mom=0.85, cycle_max_mom=0.99)
+        assert float(s.mom_at(jnp.asarray(0))) == pytest.approx(0.99)
+        assert float(s.mom_at(jnp.asarray(10))) == pytest.approx(0.85)
+        assert float(s.mom_at(jnp.asarray(20))) == pytest.approx(0.99)
+
+
+def test_build_from_config():
+    s = build_lr_schedule("WarmupLR", {"warmup_max_lr": 0.5})
+    assert isinstance(s, WarmupLR)
+    s = build_lr_schedule("OneCycle", {"cycle_min_lr": 0.01,
+                                       "cycle_max_lr": 0.1})
+    assert isinstance(s, OneCycle)
+    s = build_lr_schedule("LRRangeTest", {})
+    assert isinstance(s, LRRangeTest)
+    assert build_lr_schedule(None, None) is None
+    with pytest.raises(ValueError):
+        build_lr_schedule("CosineNope", {})
