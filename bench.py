@@ -1,10 +1,17 @@
 """Benchmark: prints ONE JSON line with the headline metric.
 
-Run on real TPU hardware by the driver at end of round. Currently measures
-the engine's fused train-step throughput on a matmul-heavy MLP in bf16
-(placeholder until the GPT-2/BERT model families land); reports achieved
-TFLOP/s and MFU vs the reference's 52%-of-peak V100 BERT number
-(BASELINE.md: 66 TFLOPS/GPU = 52% of V100 peak).
+Flagship workload: GPT-2 pretraining step (the reference's Megatron-GPT2 +
+ZeRO-2 headline, BASELINE.md) — bf16, Pallas flash attention, fused compiled
+train step, on whatever devices are live (1 real TPU chip under the driver).
+
+Timing protocol: value-fetch completion barrier + RTT subtraction, because
+block_until_ready acks early across the device tunnel (see
+.claude/skills/verify/SKILL.md).
+
+MFU accounting: model flops/token = 6*N + 12*L*S*H (PaLM appendix formula:
+6N covers fwd+bwd matmuls, attention term extra); peak = 197 TFLOP/s bf16
+(TPU v5e). vs_baseline compares against the reference's 52%-of-peak
+hardware-efficiency headline (BASELINE.md: 66/126.6 TFLOPS on V100).
 """
 
 import json
@@ -17,63 +24,53 @@ def main():
     import jax
     import jax.numpy as jnp
     import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import (
+        GPT2Config, count_params, gpt2_loss_fn, init_gpt2_params)
 
-    hidden = 2048
-    n_layers = 8
-    batch = 256
-    steps = 100
-
-    key = jax.random.PRNGKey(0)
-    params = {}
-    for i in range(n_layers):
-        key, k = jax.random.split(key)
-        params[f"layer_{i}"] = {
-            "w": jax.random.normal(k, (hidden, hidden), jnp.float32)
-            / np.sqrt(hidden),
-            "b": jnp.zeros((hidden,), jnp.float32),
-        }
-
-    def loss_fn(p, b):
-        x = b["x"]
-        for i in range(n_layers):
-            layer = p[f"layer_{i}"]
-            x = x @ layer["w"].astype(x.dtype) + layer["b"].astype(x.dtype)
-            if i < n_layers - 1:
-                x = jax.nn.relu(x)
-        return jnp.mean((x - b["y"].astype(x.dtype)) ** 2)
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = GPT2Config(vocab_size=50304,  # 128-aligned vocab
+                         max_position_embeddings=1024,
+                         hidden_size=768, num_layers=12, num_heads=12,
+                         embd_dropout=0.0, attn_dropout=0.0,
+                         resid_dropout=0.0)
+        batch, seq, steps = 8, 1024, 30
+    else:  # CPU smoke fallback
+        cfg = GPT2Config(vocab_size=512, max_position_embeddings=128,
+                         hidden_size=64, num_layers=2, num_heads=2,
+                         embd_dropout=0.0, attn_dropout=0.0,
+                         resid_dropout=0.0)
+        batch, seq, steps = 4, 64, 3
 
     n_dev = jax.device_count()
-    config = {
-        "train_micro_batch_size_per_gpu": batch // n_dev if n_dev > 1 else batch,
-        "gradient_accumulation_steps": 1,
-        "bf16": {"enabled": True},
-        "steps_per_print": 10**9,  # no mid-bench host fetches
-        "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
-    }
+    params = init_gpt2_params(cfg, jax.random.PRNGKey(0))
+    n_params = count_params(params)
+    loss_fn = gpt2_loss_fn(cfg, dtype=jnp.bfloat16, deterministic=True)
+
     engine, *_ = deepspeed_tpu.initialize(
-        model=loss_fn, model_parameters=params, config=config)
+        model=loss_fn, model_parameters=params,
+        config={
+            "train_micro_batch_size_per_gpu": max(batch // n_dev, 1),
+            "gradient_accumulation_steps": 1,
+            "bf16": {"enabled": True},
+            "steps_per_print": 10**9,
+            "zero_optimization": {"stage": 2 if n_dev > 1 else 0},
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+        })
 
     rng = np.random.RandomState(0)
-    b = {"x": rng.randn(batch, hidden).astype(np.float32),
-         "y": rng.randn(batch, hidden).astype(np.float32)}
-    # device-resident batch: host->device transfer is NOT part of the
-    # measured step (and the device may sit across a network tunnel)
+    ids = rng.randint(0, cfg.vocab_size, (batch, seq + 1)).astype(np.int32)
     from jax.sharding import NamedSharding, PartitionSpec
-    b = jax.device_put(b, NamedSharding(
-        engine.mesh, PartitionSpec("data" if n_dev > 1 else None)))
+    b = {"input_ids": jax.device_put(
+        ids, NamedSharding(engine.mesh,
+                           PartitionSpec("data" if n_dev > 1 else None)))}
 
-    # warmup/compile; a value fetch (not block_until_ready) is the only
-    # reliable completion barrier across the device tunnel
     loss = engine.train_batch(iter([b]))
-    np.asarray(loss)
-    zf = jax.jit(lambda: jax.numpy.zeros(()))
-    np.asarray(zf())  # compile
-    rtts = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        np.asarray(zf())
-        rtts.append(time.perf_counter() - t0)
-    rtt = min(rtts)
+    np.asarray(loss)  # compile + settle
+
+    zf = jax.jit(lambda: jnp.zeros(()))
+    np.asarray(zf())
+    rtt = min(_fetch_time(zf) for _ in range(3))
 
     t0 = time.perf_counter()
     for _ in range(steps):
@@ -81,21 +78,34 @@ def main():
     np.asarray(loss)
     dt = max(time.perf_counter() - t0 - rtt, 1e-9)
 
-    # fwd+bwd ≈ 3x fwd matmul flops
-    flops_per_step = 3 * 2 * batch * hidden * hidden * n_layers
-    tflops = flops_per_step * steps / dt / 1e12
-    # v5e peak bf16 ≈ 197 TFLOP/s; v5p ≈ 459
-    peak = 197.0
-    mfu = tflops / peak
-    # reference fused-kernel hardware efficiency: 52% of peak (BASELINE.md)
+    tokens_per_step = batch * seq
+    tokens_per_s = tokens_per_step * steps / dt
+    flops_per_token = (6 * n_params +
+                       12 * cfg.num_layers * seq * cfg.hidden_size)
+    tflops = tokens_per_s * flops_per_token / 1e12
+    peak = 197.0 if on_tpu else 1e9
+    mfu = tflops / peak / max(n_dev, 1)
+
     print(json.dumps({
-        "metric": "train_step_mfu",
+        "metric": "gpt2_train_mfu",
         "value": round(mfu, 4),
         "unit": "fraction_of_peak_bf16",
         "vs_baseline": round(mfu / 0.52, 4),
-        "detail": {"tflops": round(tflops, 2), "steps_per_s": round(steps / dt, 2),
-                   "loss": float(loss)},
+        "detail": {
+            "model": f"gpt2-{n_params/1e6:.0f}M",
+            "tokens_per_s_per_chip": round(tokens_per_s / max(n_dev, 1), 1),
+            "tflops_per_chip": round(tflops / max(n_dev, 1), 2),
+            "step_ms": round(dt / steps * 1000, 2),
+            "loss": float(loss),
+        },
     }))
+
+
+def _fetch_time(zf):
+    import numpy as np
+    t0 = time.perf_counter()
+    np.asarray(zf())
+    return time.perf_counter() - t0
 
 
 if __name__ == "__main__":

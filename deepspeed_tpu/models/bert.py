@@ -1,0 +1,146 @@
+"""BERT model family — the bing_bert workload.
+
+Recreates the reference's BERT pretraining workload (BASELINE.md: BERT-large
++ fused transformer kernel; tests/unit/modeling.py + modelingpreln.py were
+its post-LN/pre-LN reference implementations) on the DeepSpeedTransformerLayer
+stack: embeddings (token+position+type) → N layers → MLM head.
+"""
+
+from typing import Any, Dict, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.ops.transformer.transformer import (
+    DeepSpeedTransformerConfig, init_transformer_params,
+    transformer_layer_forward)
+
+
+class BertConfig(NamedTuple):
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout: float = 0.1
+    attn_dropout: float = 0.1
+    initializer_range: float = 0.02
+    pre_layer_norm: bool = True     # modelingpreln.py variant (default for
+    #                                 the reference's fused kernel training)
+
+
+BERT_BASE = BertConfig()
+BERT_LARGE = BertConfig(hidden_size=1024, num_layers=24, num_heads=16,
+                        intermediate_size=4096)
+
+
+def layer_config(config: BertConfig, training: bool = True
+                 ) -> DeepSpeedTransformerConfig:
+    return DeepSpeedTransformerConfig(
+        hidden_size=config.hidden_size,
+        intermediate_size=config.intermediate_size,
+        heads=config.num_heads,
+        attn_dropout_ratio=config.attn_dropout,
+        hidden_dropout_ratio=config.hidden_dropout,
+        num_hidden_layers=config.num_layers,
+        initializer_range=config.initializer_range,
+        pre_layer_norm=config.pre_layer_norm,
+        training=training)
+
+
+def init_bert_params(config: BertConfig, key) -> Dict[str, Any]:
+    h = config.hidden_size
+    rng = config.initializer_range
+    lcfg = layer_config(config)
+    keys = jax.random.split(key, 4 + config.num_layers)
+    params: Dict[str, Any] = {
+        "tok_emb": jax.random.normal(keys[0], (config.vocab_size, h),
+                                     jnp.float32) * rng,
+        "pos_emb": jax.random.normal(keys[1],
+                                     (config.max_position_embeddings, h),
+                                     jnp.float32) * rng,
+        "type_emb": jax.random.normal(keys[2], (config.type_vocab_size, h),
+                                      jnp.float32) * rng,
+        "emb_ln": {"w": jnp.ones((h,), jnp.float32),
+                   "b": jnp.zeros((h,), jnp.float32)},
+        "mlm_dense": {"w": jax.random.normal(keys[3], (h, h),
+                                             jnp.float32) * rng,
+                      "b": jnp.zeros((h,), jnp.float32)},
+        "mlm_ln": {"w": jnp.ones((h,), jnp.float32),
+                   "b": jnp.zeros((h,), jnp.float32)},
+        "mlm_bias": jnp.zeros((config.vocab_size,), jnp.float32),
+    }
+    for i in range(config.num_layers):
+        params[f"layer_{i}"] = init_transformer_params(lcfg, keys[4 + i], i)
+    return params
+
+
+from deepspeed_tpu.ops.functional import (
+    layer_norm as _ln_wb, matmul_bf16_accum_fp32)
+
+
+def _ln(x, p, eps=1e-12):
+    return _ln_wb(x, p["w"], p["b"], eps)
+
+
+def bert_encoder(params, config: BertConfig, input_ids, attention_mask=None,
+                 token_type_ids=None, rng=None, deterministic: bool = True,
+                 dtype=jnp.bfloat16, remat: bool = False):
+    """Sequence output (B, S, H). attention_mask: (B, S) with 1=keep."""
+    B, S = input_ids.shape
+    lcfg = layer_config(config, training=not deterministic)
+    pos = jnp.arange(S)[None, :]
+    tt = token_type_ids if token_type_ids is not None else \
+        jnp.zeros_like(input_ids)
+    x = (params["tok_emb"][input_ids] + params["pos_emb"][pos] +
+         params["type_emb"][tt])
+    x = _ln(x, params["emb_ln"]).astype(dtype)
+
+    add_mask = None
+    if attention_mask is not None:
+        add_mask = ((1.0 - attention_mask[:, None, None, :].astype(
+            jnp.float32)) * -1e9)
+
+    fwd = transformer_layer_forward
+    if remat:
+        fwd = jax.checkpoint(transformer_layer_forward,
+                             static_argnums=(1, 5, 6))
+    for i in range(config.num_layers):
+        if rng is not None:
+            rng, r = jax.random.split(rng)
+        else:
+            r = None
+        x = fwd(params[f"layer_{i}"], lcfg, x, add_mask, r, deterministic)
+    return x
+
+
+def bert_mlm_loss_fn(config: BertConfig, dtype=jnp.bfloat16,
+                     remat: bool = False, deterministic: bool = False):
+    """Engine-contract MLM loss. batch: input_ids (B,S), labels (B,S) with
+    -100 = unmasked (ignored), attention_mask (B,S) optional."""
+    def loss_fn(params, batch, rng):
+        x = bert_encoder(params, config, batch["input_ids"],
+                         attention_mask=batch.get("attention_mask"),
+                         token_type_ids=batch.get("token_type_ids"),
+                         rng=rng, deterministic=deterministic, dtype=dtype,
+                         remat=remat)
+        # MLM head: dense+gelu+LN then decode against tied embeddings
+        mh = x @ params["mlm_dense"]["w"].astype(dtype) + \
+            params["mlm_dense"]["b"].astype(dtype)
+        mh = jax.nn.gelu(mh, approximate=False)
+        mh = _ln(mh, params["mlm_ln"])
+        # bf16 operands / fp32 accumulation for the vocab GEMM (MXU fast
+        # path, same pattern as gpt2_forward)
+        logits = matmul_bf16_accum_fp32(mh, params["tok_emb"]) + \
+            params["mlm_bias"]
+        labels = batch["labels"]
+        mask = (labels != -100)
+        safe_labels = jnp.where(mask, labels, 0)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+        denom = jnp.maximum(jnp.sum(mask), 1)
+        return -jnp.sum(jnp.where(mask, ll, 0.0)) / denom
+    return loss_fn

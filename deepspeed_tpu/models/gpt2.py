@@ -1,0 +1,213 @@
+"""GPT-2 model family — the flagship workload.
+
+Recreates the Megatron-GPT2 workload the reference trained through
+DeepSpeedExamples (BASELINE.md: GPT-2 345M + ZeRO-2, GPT-2 1.5B 3D-parallel)
+as a native model of this framework: causal flash attention, bf16 compute,
+and first-class tensor-parallel PartitionSpecs (Megatron column/row sharding
+over the ``model`` mesh axis — what the reference delegated to the client's
+mpu, SURVEY §2.3).
+"""
+
+from typing import Any, Dict, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.ops.attention.flash import flash_attention
+
+
+class GPT2Config(NamedTuple):
+    vocab_size: int = 50257
+    max_position_embeddings: int = 1024
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 0      # 0 => 4*hidden
+    embd_dropout: float = 0.1
+    attn_dropout: float = 0.1
+    resid_dropout: float = 0.1
+    initializer_range: float = 0.02
+    layer_norm_eps: float = 1e-5
+
+    @property
+    def inter(self):
+        return self.intermediate_size or 4 * self.hidden_size
+
+
+# canonical sizes (Megatron/GPT-2 papers)
+GPT2_SMALL = GPT2Config()                                          # 124M
+GPT2_MEDIUM = GPT2Config(hidden_size=1024, num_layers=24,
+                         num_heads=16)                             # 345M
+GPT2_LARGE = GPT2Config(hidden_size=1280, num_layers=36,
+                        num_heads=20)                              # 774M
+GPT2_XL = GPT2Config(hidden_size=1600, num_layers=48,
+                     num_heads=25)                                 # 1.5B
+
+
+def init_gpt2_params(config: GPT2Config, key) -> Dict[str, Any]:
+    h, inter = config.hidden_size, config.inter
+    rng = config.initializer_range
+    out_rng = rng / np.sqrt(2.0 * config.num_layers)
+    keys = jax.random.split(key, 2 + 4 * config.num_layers)
+    params: Dict[str, Any] = {
+        "wte": jax.random.normal(keys[0], (config.vocab_size, h),
+                                 jnp.float32) * rng,
+        "wpe": jax.random.normal(keys[1], (config.max_position_embeddings, h),
+                                 jnp.float32) * rng,
+        "ln_f": {"w": jnp.ones((h,), jnp.float32),
+                 "b": jnp.zeros((h,), jnp.float32)},
+    }
+    for i in range(config.num_layers):
+        k = keys[2 + 4 * i: 6 + 4 * i]
+        params[f"h_{i}"] = {
+            "ln_1": {"w": jnp.ones((h,), jnp.float32),
+                     "b": jnp.zeros((h,), jnp.float32)},
+            "attn": {
+                "qkvw": jax.random.normal(k[0], (h, 3 * h), jnp.float32) * rng,
+                "qkvb": jnp.zeros((3 * h,), jnp.float32),
+                "ow": jax.random.normal(k[1], (h, h), jnp.float32) * out_rng,
+                "ob": jnp.zeros((h,), jnp.float32),
+            },
+            "ln_2": {"w": jnp.ones((h,), jnp.float32),
+                     "b": jnp.zeros((h,), jnp.float32)},
+            "mlp": {
+                "fc_w": jax.random.normal(k[2], (h, inter), jnp.float32) * rng,
+                "fc_b": jnp.zeros((inter,), jnp.float32),
+                "proj_w": jax.random.normal(k[3], (inter, h),
+                                            jnp.float32) * out_rng,
+                "proj_b": jnp.zeros((h,), jnp.float32),
+            },
+        }
+    return params
+
+
+def gpt2_param_specs(config: GPT2Config) -> Dict[str, Any]:
+    """Megatron-style tensor-parallel shardings over the 'model' axis:
+    column-parallel qkv/fc (shard output dim), row-parallel proj/ow (shard
+    input dim); embeddings sharded over vocab."""
+    layer = {
+        "ln_1": {"w": P(), "b": P()},
+        "attn": {"qkvw": P(None, "model"), "qkvb": P("model"),
+                 "ow": P("model", None), "ob": P()},
+        "ln_2": {"w": P(), "b": P()},
+        "mlp": {"fc_w": P(None, "model"), "fc_b": P("model"),
+                "proj_w": P("model", None), "proj_b": P()},
+    }
+    specs: Dict[str, Any] = {
+        "wte": P("model", None),
+        "wpe": P(),
+        "ln_f": {"w": P(), "b": P()},
+    }
+    for i in range(config.num_layers):
+        specs[f"h_{i}"] = layer
+    return specs
+
+
+from deepspeed_tpu.ops.functional import dropout as _dropout
+from deepspeed_tpu.ops.functional import layer_norm as _ln_wb
+
+
+def _layer_norm(x, p, eps):
+    return _ln_wb(x, p["w"], p["b"], eps)
+
+
+def gpt2_block(block_params, config: GPT2Config, x, rng, deterministic,
+               dtype):
+    B, S, h = x.shape
+    heads = config.num_heads
+    hd = h // heads
+    if rng is not None:
+        r1, r2 = jax.random.split(rng)
+    else:
+        r1 = r2 = None
+
+    # attention (pre-LN)
+    a_in = _layer_norm(x, block_params["ln_1"], config.layer_norm_eps)
+    ap = block_params["attn"]
+    qkv = a_in @ ap["qkvw"].astype(dtype) + ap["qkvb"].astype(dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, S, heads, hd).transpose(0, 2, 1, 3)
+    k = k.reshape(B, S, heads, hd).transpose(0, 2, 1, 3)
+    v = v.reshape(B, S, heads, hd).transpose(0, 2, 1, 3)
+    if config.attn_dropout > 0.0 and not deterministic and rng is not None:
+        # flash kernel has no in-kernel dropout yet: use the dense path so
+        # the configured attention dropout is actually applied
+        r1, r_attn = (jax.random.split(r1) if r1 is not None
+                      else (None, None))
+        sm_scale = 1.0 / np.sqrt(hd)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) * sm_scale
+        idx_q = jnp.arange(S)[:, None]
+        idx_k = jnp.arange(S)[None, :]
+        s = jnp.where(idx_q >= idx_k, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1).astype(dtype)
+        p = _dropout(p, config.attn_dropout, r_attn, deterministic)
+        ctx = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    else:
+        ctx = flash_attention(q, k, v, causal=True)
+    ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, h)
+    attn_out = ctx @ ap["ow"].astype(dtype) + ap["ob"].astype(dtype)
+    x = x + _dropout(attn_out, config.resid_dropout, r1, deterministic)
+
+    # mlp
+    m_in = _layer_norm(x, block_params["ln_2"], config.layer_norm_eps)
+    mp = block_params["mlp"]
+    hmid = m_in @ mp["fc_w"].astype(dtype) + mp["fc_b"].astype(dtype)
+    hmid = jax.nn.gelu(hmid, approximate=True)
+    m_out = hmid @ mp["proj_w"].astype(dtype) + mp["proj_b"].astype(dtype)
+    x = x + _dropout(m_out, config.resid_dropout, r2, deterministic)
+    return x
+
+
+def gpt2_forward(params, config: GPT2Config, input_ids, rng=None,
+                 deterministic: bool = True, dtype=jnp.bfloat16,
+                 remat: bool = False):
+    """Logits (B, S, vocab). Embedding output layer is tied to wte."""
+    B, S = input_ids.shape
+    pos = jnp.arange(S)[None, :]
+    x = (params["wte"][input_ids] + params["wpe"][pos]).astype(dtype)
+    if rng is not None:
+        rng, r_emb = jax.random.split(rng)
+        x = _dropout(x, config.embd_dropout, r_emb, deterministic)
+
+    block = gpt2_block
+    if remat:
+        block = jax.checkpoint(gpt2_block,
+                               static_argnums=(1, 4, 5))
+    for i in range(config.num_layers):
+        if rng is not None:
+            rng, r = jax.random.split(rng)
+        else:
+            r = None
+        x = block(params[f"h_{i}"], config, x, r, deterministic, dtype)
+
+    x = _layer_norm(x, params["ln_f"], config.layer_norm_eps)
+    # bf16 operands, fp32 accumulation: keeps the vocab GEMM on the MXU's
+    # fast path while the downstream softmax stays fp32
+    logits = jax.lax.dot_general(
+        x.astype(dtype), params["wte"].astype(dtype),
+        (((2,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+    return logits
+
+
+def gpt2_loss_fn(config: GPT2Config, dtype=jnp.bfloat16, remat: bool = False,
+                 deterministic: bool = False):
+    """Engine-contract loss: batch = {'input_ids': (B, S+1) int32} —
+    next-token cross entropy on shifted ids."""
+    def loss_fn(params, batch, rng):
+        ids = batch["input_ids"]
+        inputs, targets = ids[:, :-1], ids[:, 1:]
+        logits = gpt2_forward(params, config, inputs, rng=rng,
+                              deterministic=deterministic, dtype=dtype,
+                              remat=remat)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return -jnp.mean(ll)
+    return loss_fn
+
+
+def count_params(params) -> int:
+    return sum(int(np.prod(p.shape))
+               for p in jax.tree_util.tree_leaves(params))

@@ -1,0 +1,445 @@
+"""Pallas flash attention — the MXU-native core of the transformer stack.
+
+TPU-native replacement for the reference's fused CUDA attention pipeline
+(csrc/transformer/ds_transformer_cuda.cpp Forward :153: QK^T strided GEMM →
+launch_attn_softmax → PV) — but O(S) memory instead of materializing the
+(S, S) score matrix, which is what buys the long-sequence headroom the
+reference gets from block-sparse attention (and more).
+
+Design: online-softmax tiling. Grid = (batch*heads, Sq/block_q); each program
+streams K/V blocks through VMEM with running max/sum in fp32. Backward
+recomputes the score tiles (flash-style) in two passes (dq; dk+dv).
+
+Falls back to a jnp reference implementation off-TPU (same math, used as the
+numerics oracle in tests) or when attention dropout is active (in-kernel
+dropout not yet wired; the reference's attn_dropout_checkpoint knob maps to
+recompute policy instead).
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+NEG_INF = -1e30
+
+
+# --------------------------------------------------------------------- #
+# reference (oracle / fallback) implementation
+# --------------------------------------------------------------------- #
+def attention_reference(q, k, v, mask=None, causal=False,
+                        sm_scale: Optional[float] = None):
+    """Plain jnp attention. q,k,v: (B, H, S, D); mask: additive, broadcastable
+    to (B, H, Sq, Sk)."""
+    if sm_scale is None:
+        sm_scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    if mask is not None:
+        s = s + mask.astype(jnp.float32)
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        idx_q = jnp.arange(sq)[:, None]
+        idx_k = jnp.arange(sk)[None, :]
+        s = jnp.where(idx_q >= idx_k, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)
+                      ).astype(q.dtype)
+
+
+# --------------------------------------------------------------------- #
+# pallas kernels
+# --------------------------------------------------------------------- #
+def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, *,
+                sm_scale, block_k, causal, seq_k, block_q):
+    qb = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * sm_scale          # (bq, d)
+    d = q.shape[-1]
+
+    if causal:
+        # process K blocks up to (and including) the diagonal
+        num_kb = (qb * block_q + block_q + block_k - 1) // block_k
+    else:
+        num_kb = seq_k // block_k
+
+    def body(i, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if mask_ref is not None:
+            s += mask_ref[0, 0, pl.ds(i * block_k, block_k)][None, :]
+        if causal:
+            q_idx = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_idx = i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_idx >= k_idx, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0, :, 0] = m + jnp.log(l_safe)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, *, sm_scale, block_k, causal, seq_k, block_q):
+    qb = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * sm_scale
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, :, 0]
+    delta = delta_ref[0, :, 0]
+    d = q.shape[-1]
+
+    if causal:
+        num_kb = (qb * block_q + block_q + block_k - 1) // block_k
+    else:
+        num_kb = seq_k // block_k
+
+    def body(i, dq):
+        k = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if mask_ref is not None:
+            s += mask_ref[0, 0, pl.ds(i * block_k, block_k)][None, :]
+        if causal:
+            q_idx = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_idx = i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_idx >= k_idx, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])                      # (bq, bk)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, num_kb, body, jnp.zeros((block_q, d),
+                                                      jnp.float32))
+    dq_ref[0] = (dq * sm_scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, sm_scale, block_q, causal, seq_q,
+                    block_k):
+    kb = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)                       # (bk, d)
+    v = v_ref[0].astype(jnp.float32)
+    d = k.shape[-1]
+
+    if causal:
+        # only q blocks at/after this k block contribute
+        first_qb = (kb * block_k) // block_q
+    else:
+        first_qb = 0
+    num_qb = seq_q // block_q
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32) \
+            * sm_scale
+        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * block_q, block_q), 0]
+        delta = delta_ref[0, pl.ds(i * block_q, block_q), 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if mask_ref is not None:
+            s += mask_ref[0, 0, pl.ds(kb * block_k, block_k)][None, :]
+        if causal:
+            q_idx = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_idx = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_idx >= k_idx, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])                      # (bq, bk)
+        dv_new = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                          preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        dk_new = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                          preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    dk0 = jnp.zeros((k.shape[0], d), jnp.float32)
+    dv0 = jnp.zeros((k.shape[0], d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(first_qb, num_qb, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+# --------------------------------------------------------------------- #
+# pallas_call wrappers
+# --------------------------------------------------------------------- #
+def _largest_divisor_block(seq):
+    for b in (256, 128, 64, 32, 16):
+        if seq % b == 0:
+            return b
+    return seq
+
+
+def _pick_blocks(seq_q, seq_k):
+    return _largest_divisor_block(seq_q), _largest_divisor_block(seq_k)
+
+
+def _flash_fwd(q, k, v, mask, causal, sm_scale, interpret):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq, bk = _pick_blocks(sq, sk)
+    assert sq % bq == 0 and sk % bk == 0, (sq, sk, bq, bk)
+    qr = q.reshape(b * h, sq, d)
+    kr = k.reshape(b * h, sk, d)
+    vr = v.reshape(b * h, sk, d)
+
+    kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, block_k=bk,
+                               causal=causal, seq_k=sk, block_q=bq)
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
+    ]
+    args = [qr, kr, vr]
+    if mask is not None:
+        # additive key mask (B, 1, 1, Sk) -> (B, 1, Sk); shared across heads
+        maskr = mask.reshape(b, 1, sk)
+        in_specs.append(pl.BlockSpec((1, 1, sk), lambda i, j: (i // h, 0, 0)))
+        args.append(maskr)
+    else:
+        kernel = _nomask(kernel)
+
+    out_shape = [
+        jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        # trailing singleton keeps the (sublane, lane) tile legal for any bq
+        jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32),
+    ]
+    out_specs = [
+        pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((1, bq, 1), lambda i, j: (i, j, 0)),
+    ]
+    compiler_params = None
+    if pltpu is not None and not interpret:
+        compiler_params = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"))
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(b * h, sq // bq),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=interpret,
+        compiler_params=compiler_params,
+    )(*args)
+    return o.reshape(b, h, sq, d), lse.reshape(b, h, sq)
+
+
+def _nomask(kernel):
+    def k2(q_ref, k_ref, v_ref, *rest, **kw):
+        return kernel(q_ref, k_ref, v_ref, None, *rest, **kw)
+    return k2
+
+
+def _flash_bwd(res, g, causal, sm_scale, interpret):
+    q, k, v, mask, o, lse = res
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq, bk = _pick_blocks(sq, sk)
+    do = g
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)                               # (b,h,sq)
+
+    qr = q.reshape(b * h, sq, d)
+    kr = k.reshape(b * h, sk, d)
+    vr = v.reshape(b * h, sk, d)
+    dor = do.reshape(b * h, sq, d)
+    lser = lse.reshape(b * h, sq, 1)
+    deltar = delta.reshape(b * h, sq, 1)
+
+    common = [qr, kr, vr]
+    if mask is not None:
+        maskr = mask.reshape(b, 1, sk)
+
+    # ---- dq ----
+    kernel = functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, block_k=bk,
+                               causal=causal, seq_k=sk, block_q=bq)
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),   # q
+        pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),   # k
+        pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),   # v
+    ]
+    args = list(common)
+    if mask is not None:
+        in_specs.append(pl.BlockSpec((1, 1, sk), lambda i, j: (i // h, 0, 0)))
+        args.append(maskr)
+    else:
+        kernel = _nomask_bwd_dq(kernel)
+    in_specs += [
+        pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),   # do
+        pl.BlockSpec((1, bq, 1), lambda i, j: (i, j, 0)),   # lse
+        pl.BlockSpec((1, bq, 1), lambda i, j: (i, j, 0)),   # delta
+    ]
+    args += [dor, lser, deltar]
+    compiler_params = None
+    if pltpu is not None and not interpret:
+        compiler_params = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"))
+    dq = pl.pallas_call(
+        kernel,
+        grid=(b * h, sq // bq),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        interpret=interpret,
+        compiler_params=compiler_params,
+    )(*args)
+
+    # ---- dk, dv ----
+    kernel = functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, block_q=bq,
+                               causal=causal, seq_q=sq, block_k=bk)
+    in_specs = [
+        pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)),   # q (full)
+        pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),   # k block
+        pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),   # v block
+    ]
+    args = list(common)
+    if mask is not None:
+        in_specs.append(pl.BlockSpec((1, 1, sk), lambda i, j: (i // h, 0, 0)))
+        args.append(maskr)
+    else:
+        kernel = _nomask_bwd_dkv(kernel)
+    in_specs += [
+        pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)),   # do (full)
+        pl.BlockSpec((1, sq, 1), lambda i, j: (i, 0, 0)),   # lse (full)
+        pl.BlockSpec((1, sq, 1), lambda i, j: (i, 0, 0)),   # delta (full)
+    ]
+    args += [dor, lser, deltar]
+    dk, dv = pl.pallas_call(
+        kernel,
+        grid=(b * h, sk // bk),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
+        ],
+        interpret=interpret,
+        compiler_params=compiler_params,
+    )(*args)
+
+    dq = dq.reshape(b, h, sq, d)
+    dk = dk.reshape(b, h, sk, d)
+    dv = dv.reshape(b, h, sk, d)
+    dmask = None if mask is None else jnp.zeros_like(mask)
+    return dq, dk, dv, dmask
+
+
+def _nomask_bwd_dq(kernel):
+    def k2(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref):
+        return kernel(q_ref, k_ref, v_ref, None, do_ref, lse_ref, delta_ref,
+                      dq_ref)
+    return k2
+
+
+def _nomask_bwd_dkv(kernel):
+    def k2(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref):
+        return kernel(q_ref, k_ref, v_ref, None, do_ref, lse_ref, delta_ref,
+                      dk_ref, dv_ref)
+    return k2
+
+
+# --------------------------------------------------------------------- #
+# public API
+# --------------------------------------------------------------------- #
+def _use_pallas():
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_attention(q, k, v, causal, sm_scale, interpret):
+    o, _ = _flash_fwd(q, k, v, None, causal, sm_scale, interpret)
+    return o
+
+
+def _flash_attention_fwd(q, k, v, causal, sm_scale, interpret):
+    o, lse = _flash_fwd(q, k, v, None, causal, sm_scale, interpret)
+    return o, (q, k, v, None, o, lse)
+
+
+def _flash_attention_bwd(causal, sm_scale, interpret, res, g):
+    dq, dk, dv, _ = _flash_bwd(res, g, causal, sm_scale, interpret)
+    return dq, dk, dv
+
+
+_flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash_attention_masked(q, k, v, mask, causal, sm_scale, interpret):
+    o, _ = _flash_fwd(q, k, v, mask, causal, sm_scale, interpret)
+    return o
+
+
+def _flash_attention_masked_fwd(q, k, v, mask, causal, sm_scale, interpret):
+    o, lse = _flash_fwd(q, k, v, mask, causal, sm_scale, interpret)
+    return o, (q, k, v, mask, o, lse)
+
+
+def _flash_attention_masked_bwd(causal, sm_scale, interpret, res, g):
+    return _flash_bwd(res, g, causal, sm_scale, interpret)
+
+
+_flash_attention_masked.defvjp(_flash_attention_masked_fwd,
+                               _flash_attention_masked_bwd)
+
+
+def flash_attention(q, k, v, mask=None, causal: bool = False,
+                    sm_scale: Optional[float] = None,
+                    interpret: Optional[bool] = None,
+                    force_reference: bool = False):
+    """Flash attention with O(S) memory.
+
+    q, k, v: (batch, heads, seq, head_dim).
+    mask: optional *additive* key mask of shape (batch, 1, 1, seq_k)
+    (BERT-style padding mask). For 2D masks use the reference path.
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / np.sqrt(q.shape[-1])
+    if interpret is None:
+        interpret = not _use_pallas()
+    sq, sk = q.shape[2], k.shape[2]
+    if force_reference or sq % 16 != 0 or sk % 16 != 0:
+        return attention_reference(q, k, v, mask=mask, causal=causal,
+                                   sm_scale=sm_scale)
+    if mask is None:
+        return _flash_attention(q, k, v, causal, float(sm_scale), interpret)
+    assert mask.ndim == 4 and mask.shape[1] == 1 and mask.shape[2] == 1, \
+        f"flash path expects (B,1,1,Sk) additive mask, got {mask.shape}"
+    return _flash_attention_masked(q, k, v, mask, causal, float(sm_scale),
+                                   interpret)

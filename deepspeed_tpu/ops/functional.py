@@ -1,0 +1,39 @@
+"""Shared elementwise building blocks (LayerNorm, dropout).
+
+Single home for the fp32-upcast LayerNorm and inverted dropout used by the
+transformer layer and the model families — the TPU analog of the reference's
+normalize_kernels.cu / dropout_kernels.cu, except XLA fuses these into the
+surrounding GEMMs so the "kernel" is just the math.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def layer_norm(x, w, b, eps: float = 1e-12):
+    """LayerNorm in fp32 regardless of input dtype (matches the reference
+    kernels' fp32 statistics), output in input dtype."""
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
+    out = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    return (out * w.astype(jnp.float32) + b.astype(jnp.float32)).astype(x.dtype)
+
+
+def dropout(x, rate: float, rng, deterministic: bool):
+    """Inverted dropout; identity when deterministic/rate==0/rng is None."""
+    if deterministic or rate == 0.0 or rng is None:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(rng, keep, x.shape)
+    return jnp.where(mask, x / keep, jnp.zeros_like(x))
+
+
+def matmul_bf16_accum_fp32(x, w_t):
+    """x @ w_t.T with bf16-cast operands and fp32 accumulation — the MXU
+    fast path for vocab-size projections. w_t: (vocab, hidden)."""
+    dtype = x.dtype if x.dtype in (jnp.bfloat16, jnp.float16) else jnp.bfloat16
+    return jax.lax.dot_general(
+        x.astype(dtype), w_t.astype(dtype),
+        (((x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)

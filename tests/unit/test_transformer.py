@@ -1,0 +1,169 @@
+"""Transformer layer + flash attention numerics (mirrors reference
+tests/unit/test_cuda_forward.py / test_cuda_backward.py: fused layer vs
+reference implementation across a shape/precision/pre-LN grid)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.attention.flash import (
+    attention_reference, flash_attention)
+from deepspeed_tpu.ops.transformer.transformer import (
+    DeepSpeedTransformerConfig, DeepSpeedTransformerLayer,
+    init_transformer_params, transformer_layer_forward)
+
+
+class TestFlashAttention:
+
+    @pytest.mark.parametrize("S", [64, 128, 256])
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_fwd_matches_reference(self, S, causal):
+        rng = np.random.RandomState(0)
+        q, k, v = (jnp.asarray(rng.randn(2, 4, S, 64), jnp.float32)
+                   for _ in range(3))
+        o_ref = attention_reference(q, k, v, causal=causal)
+        o = flash_attention(q, k, v, causal=causal, interpret=True)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(o_ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_fwd_with_padding_mask(self):
+        rng = np.random.RandomState(1)
+        q, k, v = (jnp.asarray(rng.randn(2, 2, 128, 32), jnp.float32)
+                   for _ in range(3))
+        mask = jnp.asarray(
+            np.where(rng.rand(2, 1, 1, 128) > 0.3, 0.0, -1e9), jnp.float32)
+        o_ref = attention_reference(q, k, v, mask=mask)
+        o = flash_attention(q, k, v, mask=mask, interpret=True)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(o_ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_grads_match_reference(self, causal):
+        rng = np.random.RandomState(2)
+        q, k, v = (jnp.asarray(rng.randn(1, 2, 128, 32), jnp.float32)
+                   for _ in range(3))
+
+        def f_ref(q, k, v):
+            return jnp.sum(attention_reference(q, k, v, causal=causal) ** 2)
+
+        def f_fl(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=causal,
+                                           interpret=True) ** 2)
+
+        gr = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+        gf = jax.grad(f_fl, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gr, gf):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       atol=5e-4, rtol=1e-3)
+
+    def test_masked_grads_match_reference(self):
+        rng = np.random.RandomState(3)
+        q, k, v = (jnp.asarray(rng.randn(2, 2, 64, 32), jnp.float32)
+                   for _ in range(3))
+        mask = jnp.asarray(
+            np.where(rng.rand(2, 1, 1, 64) > 0.3, 0.0, -1e9), jnp.float32)
+
+        def f_ref(q, k, v):
+            return jnp.sum(attention_reference(q, k, v, mask=mask) ** 2)
+
+        def f_fl(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, mask=mask,
+                                           interpret=True) ** 2)
+
+        gr = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+        gf = jax.grad(f_fl, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gr, gf):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       atol=5e-4, rtol=1e-3)
+
+    def test_irregular_seq_falls_back(self):
+        rng = np.random.RandomState(4)
+        q, k, v = (jnp.asarray(rng.randn(1, 1, 50, 16), jnp.float32)
+                   for _ in range(3))
+        o = flash_attention(q, k, v)  # 50 % 16 != 0 -> reference path
+        o_ref = attention_reference(q, k, v)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(o_ref),
+                                   atol=1e-6)
+
+
+def torch_free_reference_layer(params, config, x, mask=None):
+    """Unfused jnp encoder layer — the analog of the reference's
+    tests/unit/modeling.py BERT layer used as ground truth."""
+    return transformer_layer_forward(params, config, x, attention_mask=mask,
+                                     rng=None, deterministic=True,
+                                     use_flash=False)
+
+
+class TestTransformerLayer:
+
+    def _mk(self, batch=2, seq=64, hidden=64, heads=4, pre_ln=True,
+            fp32=True):
+        cfg = DeepSpeedTransformerConfig(
+            batch_size=batch, max_seq_length=seq, hidden_size=hidden,
+            intermediate_size=4 * hidden, heads=heads,
+            attn_dropout_ratio=0.0, hidden_dropout_ratio=0.0,
+            num_hidden_layers=2, initializer_range=0.02,
+            pre_layer_norm=pre_ln, bf16=not fp32, training=False)
+        params = init_transformer_params(cfg, jax.random.PRNGKey(0))
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(batch, seq, hidden), jnp.float32)
+        return cfg, params, x
+
+    @pytest.mark.parametrize("pre_ln", [True, False])
+    @pytest.mark.parametrize("seq", [64, 128])
+    def test_flash_vs_unfused(self, pre_ln, seq):
+        cfg, params, x = self._mk(seq=seq, pre_ln=pre_ln)
+        out_ref = torch_free_reference_layer(params, cfg, x)
+        out = transformer_layer_forward(params, cfg, x, deterministic=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(out_ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_with_padding_mask(self):
+        cfg, params, x = self._mk(seq=64)
+        rng = np.random.RandomState(1)
+        mask = jnp.asarray(
+            np.where(rng.rand(2, 1, 1, 64) > 0.3, 0.0, -1e9), jnp.float32)
+        out_ref = torch_free_reference_layer(params, cfg, x, mask=mask)
+        out = transformer_layer_forward(params, cfg, x, attention_mask=mask,
+                                        deterministic=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(out_ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_backward_matches(self):
+        cfg, params, x = self._mk(seq=64)
+
+        def loss_flash(p):
+            return jnp.sum(transformer_layer_forward(
+                p, cfg, x, deterministic=True) ** 2)
+
+        def loss_ref(p):
+            return jnp.sum(torch_free_reference_layer(p, cfg, x) ** 2)
+
+        gf = jax.grad(loss_flash)(params)
+        gr = jax.grad(loss_ref)(params)
+        for kname in params:
+            np.testing.assert_allclose(
+                np.asarray(gf[kname]), np.asarray(gr[kname]),
+                atol=5e-3, rtol=5e-3, err_msg=kname)
+
+    def test_dropout_changes_output_and_is_seeded(self):
+        cfg, params, x = self._mk()
+        cfg.training = True
+        cfg.hidden_dropout_ratio = 0.5
+        r = jax.random.PRNGKey(7)
+        o1 = transformer_layer_forward(params, cfg, x, rng=r,
+                                       deterministic=False)
+        o2 = transformer_layer_forward(params, cfg, x, rng=r,
+                                       deterministic=False)
+        o3 = transformer_layer_forward(params, cfg, x,
+                                       rng=jax.random.PRNGKey(8),
+                                       deterministic=False)
+        np.testing.assert_allclose(np.asarray(o1), np.asarray(o2))
+        assert not np.allclose(np.asarray(o1), np.asarray(o3))
+
+    def test_layer_object_facade(self):
+        cfg, params, x = self._mk()
+        layer = DeepSpeedTransformerLayer(cfg, initial_params=params)
+        out = layer(x, deterministic=True)
+        assert out.shape == x.shape
