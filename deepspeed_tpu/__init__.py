@@ -9,6 +9,9 @@ precision, block-sparse attention, and a multi-host launcher.
 
 from deepspeed_tpu.runtime.engine import DeepSpeedEngine, TrainState
 from deepspeed_tpu.runtime.config import DeepSpeedConfig
+from deepspeed_tpu.runtime.pipe import (
+    LayerSpec, PipelineModule, PipelineSpec, TiedLayerSpec)
+from deepspeed_tpu.runtime.pipe.engine import PipelineEngine
 from deepspeed_tpu.runtime.lr_schedules import (
     WarmupLR, OneCycle, LRRangeTest)
 from deepspeed_tpu.runtime.dataloader import (
@@ -47,17 +50,33 @@ def initialize(args=None,
     ``model_parameters`` is the initial parameter pytree. Use
     :func:`flax_loss_fn` to adapt a flax module + criterion.
     """
-    engine = DeepSpeedEngine(args=args,
-                             model=model,
-                             optimizer=optimizer,
-                             model_parameters=model_parameters,
-                             training_data=training_data,
-                             lr_scheduler=lr_scheduler,
-                             mpu=mpu,
-                             param_specs=param_specs,
-                             collate_fn=collate_fn,
-                             config=config,
-                             config_params=config_params)
+    if isinstance(model, (PipelineModule, PipelineSpec)):
+        # (reference __init__.py:111-133 dispatches on PipelineModule)
+        assert mpu is None, "mpu is owned by the PipelineModule's topology"
+        assert param_specs is None, \
+            "pipeline models carry their own shardings (PipelineSpec " \
+            "pre/stage/post_specs); param_specs is not consumed here"
+        engine = PipelineEngine(model=model,
+                                args=args,
+                                optimizer=optimizer,
+                                model_parameters=model_parameters,
+                                training_data=training_data,
+                                lr_scheduler=lr_scheduler,
+                                collate_fn=collate_fn,
+                                config=config,
+                                config_params=config_params)
+    else:
+        engine = DeepSpeedEngine(args=args,
+                                 model=model,
+                                 optimizer=optimizer,
+                                 model_parameters=model_parameters,
+                                 training_data=training_data,
+                                 lr_scheduler=lr_scheduler,
+                                 mpu=mpu,
+                                 param_specs=param_specs,
+                                 collate_fn=collate_fn,
+                                 config=config,
+                                 config_params=config_params)
     return (engine, engine.optimizer, engine.training_dataloader,
             engine.lr_scheduler)
 

@@ -113,6 +113,27 @@ def _layer_norm(x, p, eps):
     return _ln_wb(x, p["w"], p["b"], eps)
 
 
+def _embed(wte, wpe, ids, dtype):
+    """Token + position embedding (shared by flat and pipelined forms)."""
+    pos = jnp.arange(ids.shape[1])[None, :]
+    return (wte[ids] + wpe[pos]).astype(dtype)
+
+
+def _tied_logits(x, wte, dtype):
+    """LM head tied to the embedding: bf16 operands, fp32 accumulation —
+    keeps the vocab GEMM on the MXU's fast path while the downstream
+    softmax stays fp32."""
+    return jax.lax.dot_general(
+        x.astype(dtype), wte.astype(dtype),
+        (((2,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+
+
+def _next_token_xent(logits, targets):
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
 def gpt2_block(block_params, config: GPT2Config, x, rng, deterministic,
                dtype):
     B, S, h = x.shape
@@ -165,9 +186,7 @@ def gpt2_forward(params, config: GPT2Config, input_ids, rng=None,
                  deterministic: bool = True, dtype=jnp.bfloat16,
                  remat: bool = False):
     """Logits (B, S, vocab). Embedding output layer is tied to wte."""
-    B, S = input_ids.shape
-    pos = jnp.arange(S)[None, :]
-    x = (params["wte"][input_ids] + params["wpe"][pos]).astype(dtype)
+    x = _embed(params["wte"], params["wpe"], input_ids, dtype)
     if rng is not None:
         rng, r_emb = jax.random.split(rng)
         x = _dropout(x, config.embd_dropout, r_emb, deterministic)
@@ -184,12 +203,7 @@ def gpt2_forward(params, config: GPT2Config, input_ids, rng=None,
         x = block(params[f"h_{i}"], config, x, r, deterministic, dtype)
 
     x = _layer_norm(x, params["ln_f"], config.layer_norm_eps)
-    # bf16 operands, fp32 accumulation: keeps the vocab GEMM on the MXU's
-    # fast path while the downstream softmax stays fp32
-    logits = jax.lax.dot_general(
-        x.astype(dtype), params["wte"].astype(dtype),
-        (((2,), (1,)), ((), ())), preferred_element_type=jnp.float32)
-    return logits
+    return _tied_logits(x, params["wte"], dtype)
 
 
 def gpt2_loss_fn(config: GPT2Config, dtype=jnp.bfloat16, remat: bool = False,
@@ -202,12 +216,92 @@ def gpt2_loss_fn(config: GPT2Config, dtype=jnp.bfloat16, remat: bool = False,
         logits = gpt2_forward(params, config, inputs, rng=rng,
                               deterministic=deterministic, dtype=dtype,
                               remat=remat)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-        return -jnp.mean(ll)
+        return _next_token_xent(logits, targets)
     return loss_fn
 
 
 def count_params(params) -> int:
     return sum(int(np.prod(p.shape))
                for p in jax.tree_util.tree_leaves(params))
+
+
+def gpt2_pipeline_spec(config: GPT2Config, num_stages: int,
+                       dtype=None, deterministic: bool = True):
+    """GPT-2 as a PipelineSpec for the compiled SPMD pipeline
+    (runtime/pipe/spmd.py) — the 3D-parallel (pipe × data × model)
+    flagship workload (BASELINE.md: GPT-2 1.5B 3D-parallel; reference ran
+    it via PipelineModule + Megatron mpu).
+
+    - pre: token+position embedding (stage-0 slot, wte/wpe replicated over
+      'pipe', vocab-sharded over 'model');
+    - stages: ``num_layers/num_stages`` blocks each, params stacked
+      ``(S, L/S, ...)``, applied via ``lax.scan`` over the layer dim;
+    - post: final LN + logits tied to wte (TiedLayerSpec semantics) +
+      next-token cross entropy.
+
+    Micro-batch contract: ``{"input_ids": (mb, seq+1) int32}``.
+
+    ``dtype=None`` (default) inherits the engine's configured compute dtype
+    — the pipeline loss fn casts params inside the mapped program
+    (spmd.py ``compute_dtype``), and these fns read the dtype off the cast
+    param leaves, so an fp16 config really computes fp16.
+    """
+    from deepspeed_tpu.runtime.pipe.spmd import PipelineSpec
+
+    L = config.num_layers
+    if L % num_stages != 0:
+        raise ValueError(f"num_layers {L} must divide into {num_stages} "
+                         f"pipeline stages")
+    lps = L // num_stages
+
+    def init(key):
+        full = init_gpt2_params(config, key)
+        per_stage = []
+        for s in range(num_stages):
+            blocks = [full[f"h_{s * lps + j}"] for j in range(lps)]
+            per_stage.append(jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *blocks))
+        stages = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *per_stage)
+        return {"pre": {"wte": full["wte"], "wpe": full["wpe"]},
+                "stages": stages,
+                "post": {"ln_f": full["ln_f"]}}
+
+    def _dtype_of(leaf):
+        return dtype if dtype is not None else leaf.dtype
+
+    def pre_apply(pre_p, micro, rng):
+        ids = micro["input_ids"][:, :-1]
+        x = _embed(pre_p["wte"], pre_p["wpe"], ids, _dtype_of(pre_p["wte"]))
+        if not deterministic and rng is not None:
+            x = _dropout(x, config.embd_dropout, rng, deterministic)
+        return x
+
+    def stage_apply(st_p, act, rng):
+        # st_p leaves: (lps, ...) — scan the layer dim
+        def body(x, inp):
+            j, lp = inp
+            r = jax.random.fold_in(rng, j) if rng is not None else None
+            return gpt2_block(lp, config, x, r, deterministic,
+                              _dtype_of(act)), None
+        out, _ = jax.lax.scan(body, act, (jnp.arange(lps), st_p))
+        return out
+
+    def post_apply(post_p, pre_p, act, micro):
+        targets = micro["input_ids"][:, 1:]
+        x = _layer_norm(act, post_p["ln_f"], config.layer_norm_eps)
+        logits = _tied_logits(x, pre_p["wte"], _dtype_of(act))
+        return _next_token_xent(logits, targets)
+
+    block_specs = gpt2_param_specs(config)["h_0"]
+    # stacked stage leaves carry (lps, ...) — shift TP specs right one dim
+    stage_specs = jax.tree_util.tree_map(
+        lambda s: P(None, *tuple(s)), block_specs,
+        is_leaf=lambda x: isinstance(x, P))
+
+    return PipelineSpec(
+        init=init, pre_apply=pre_apply, stage_apply=stage_apply,
+        post_apply=post_apply, num_stages=num_stages,
+        pre_specs={"wte": P("model", None), "wpe": P()},
+        stage_specs=stage_specs,
+        post_specs={"ln_f": {"w": P(), "b": P()}})

@@ -223,7 +223,14 @@ class DeepSpeedEngine:
             loss_scale=jax.tree_util.tree_map(lambda _: repl, state.loss_scale),
             global_step=repl, micro_step=repl, skipped_steps=repl, rng=repl,
         )
-        self.state = jax.device_put(state, self._state_shardings)
+        placed = jax.device_put(state, self._state_shardings)
+
+        # device_put can alias the source buffers (same-device shards) —
+        # but the compiled step DONATES the state, which would delete the
+        # caller's model_parameters out from under them. One explicit copy
+        # at init decouples the engine state from user arrays.
+        self.state = jax.tree_util.tree_map(
+            lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x, placed)
 
         self.gradient_clipping = self._config.gradient_clipping
 
@@ -331,7 +338,10 @@ class DeepSpeedEngine:
     def _compute_loss_and_grads(self, params, batch, rng, scale):
         """value_and_grad of the (scaled) loss in the compute dtype."""
         def scaled_loss_fn(p):
-            cp = _tree_cast(p, self.compute_dtype)
+            # a loss fn may own the fp32->compute cast (pipeline loss fns
+            # cast inside shard_map so grad psums stay fp32)
+            cp = (p if getattr(self._loss_fn, "owns_cast", False)
+                  else _tree_cast(p, self.compute_dtype))
             if self._loss_takes_rng:
                 out = self._loss_fn(cp, batch, rng)
             else:
@@ -540,7 +550,8 @@ class DeepSpeedEngine:
         """Loss without grads/update."""
         if not hasattr(self, "_compiled_eval"):
             def ev(params, batch, rng):
-                cp = _tree_cast(params, self.compute_dtype)
+                cp = (params if getattr(self._loss_fn, "owns_cast", False)
+                      else _tree_cast(params, self.compute_dtype))
                 out = (self._loss_fn(cp, batch, rng) if self._loss_takes_rng
                        else self._loss_fn(cp, batch))
                 return out[0] if isinstance(out, tuple) else out

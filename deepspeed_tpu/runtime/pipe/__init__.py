@@ -1,0 +1,12 @@
+"""Pipeline parallelism (reference ``deepspeed/runtime/pipe/``)."""
+
+from deepspeed_tpu.runtime.pipe.module import (
+    LayerSpec, PipelineModule, TiedLayerSpec)
+from deepspeed_tpu.runtime.pipe.schedule import (
+    BackwardPass, DataParallelSchedule, ForwardPass, InferenceSchedule,
+    LoadMicroBatch, OptimizerStep, PipeInstruction, PipeSchedule,
+    RecvActivation, RecvGrad, ReduceGrads, ReduceTiedGrads, SendActivation,
+    SendGrad, TrainSchedule)
+from deepspeed_tpu.runtime.pipe.spmd import (
+    PipelineSpec, build_pipeline_loss_fn, module_pipeline_spec,
+    pipeline_param_specs)

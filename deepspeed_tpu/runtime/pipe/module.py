@@ -1,0 +1,364 @@
+"""PipelineModule — express a model as a list of layers, partition it into
+pipeline stages.
+
+TPU-native analog of the reference's ``deepspeed/runtime/pipe/module.py``
+(LayerSpec :23 with lazy build :63, TiedLayerSpec :71, PipelineModule :85,
+``_partition_layers`` :348 with uniform/parameters/type:regex methods,
+sequential ``forward`` :292 with activation-checkpoint intervals :323-345,
+per-layer checkpoint files :526-546).
+
+Functional layer contract (this framework's analog of nn.Module):
+
+- a **layer object** exposes ``init(key) -> params`` and is callable as
+  ``layer(params, x, *, rng=None) -> y``;
+- a **plain callable** ``f(x) -> y`` is a param-less layer (like the
+  reference's lambda layers, module.py:259-263).
+
+``LayerSpec`` defers construction (the reference builds layers lazily so a
+trillion-param model never materializes on one host, module.py:63 — here it
+additionally keeps `init` pure so params can be created directly into
+sharded device buffers).
+
+Stage grouping for the compiled SPMD executor (runtime/pipe/spmd.py)
+requires the per-stage param pytrees to be *homogeneous* (same treedef and
+leaf shapes) so they can be stacked over the ``pipe`` mesh axis; the
+partitioner checks and reports this. Heterogeneous first/last layers
+(embedding, loss head) should go through ``PipelineSpec``'s pre/post slots
+instead — see spmd.py.
+"""
+
+import re
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+from deepspeed_tpu.utils.partition import partition_balanced, partition_uniform
+
+
+class LayerSpec:
+    """Deferred layer constructor (reference module.py:23)."""
+
+    def __init__(self, typename: Callable, *module_args, **module_kwargs):
+        self.typename = typename
+        self.module_args = module_args
+        self.module_kwargs = module_kwargs
+        if not callable(typename):
+            raise RuntimeError("LayerSpec requires a callable type")
+
+    @property
+    def name(self) -> str:
+        return getattr(self.typename, "__name__", str(self.typename))
+
+    def build(self, log: bool = False):
+        """(reference module.py:63)"""
+        if log:
+            logger.info(f"building {self}")
+        return self.typename(*self.module_args, **self.module_kwargs)
+
+    def __repr__(self):
+        return f"LayerSpec({self.name})"
+
+
+class TiedLayerSpec(LayerSpec):
+    """Layer whose params are shared with every other tied layer of the same
+    ``key`` (reference module.py:71 — e.g. embedding reused as the LM head).
+
+    In the functional regime tying is *structural*: all tied instances read
+    the same entry of the params pytree, so their gradient contributions sum
+    automatically in the backward pass — the reference needed explicit
+    all-reduce groups for this (module.py:405-474); compiled SPMD gets it
+    from the psum transpose.
+    """
+
+    def __init__(self, key: str, typename: Callable, *module_args,
+                 forward_fn: Optional[Callable] = None, **module_kwargs):
+        super().__init__(typename, *module_args, **module_kwargs)
+        self.key = key
+        self.forward_fn = forward_fn
+
+    def __repr__(self):
+        return f"TiedLayerSpec({self.name}, key={self.key!r})"
+
+
+def _as_spec(obj) -> LayerSpec:
+    if isinstance(obj, LayerSpec):
+        return obj
+    if callable(obj):
+        # an already-built layer object or plain function
+        return LayerSpec(lambda o=obj: o)
+    raise TypeError(f"layer must be a LayerSpec or callable, got {type(obj)}")
+
+
+def _layer_init(layer, key):
+    if hasattr(layer, "init"):
+        return layer.init(key)
+    return None  # param-less
+
+
+def _layer_apply(layer, params, x, rng=None):
+    if params is None:
+        return layer(x)
+    try:
+        return layer(params, x, rng=rng)
+    except TypeError:
+        return layer(params, x)
+
+
+def _num_params(tree) -> int:
+    return sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(tree)
+               if hasattr(l, "shape"))
+
+
+class PipelineModule:
+    """A model as a layer list + a stage partitioning (reference
+    module.py:85).
+
+    Parameters
+    ----------
+    layers: sequence of LayerSpec / layer objects / callables.
+    num_stages: pipeline depth (defaults to the topology's 'pipe' dim, 1 if
+        absent).
+    topology: optional ProcessTopology carrying the 'pipe' axis.
+    loss_fn: ``loss_fn(outputs, batch) -> scalar`` applied after the last
+        layer (reference passed ``loss_fn`` to PipelineModule too).
+    partition_method: 'parameters' (balance param counts — reference
+        default), 'uniform' (balance layer counts), or 'type:regex'
+        (balance layers whose class name matches; reference module.py:352).
+    activation_checkpoint_interval: remat every N layers in ``forward``
+        (reference module.py:323-345; 0 disables).
+    """
+
+    def __init__(self,
+                 layers: Sequence,
+                 num_stages: Optional[int] = None,
+                 topology=None,
+                 loss_fn: Optional[Callable] = None,
+                 partition_method: str = "parameters",
+                 activation_checkpoint_interval: int = 0,
+                 seed: int = 1234):
+        self.specs: List[LayerSpec] = [_as_spec(l) for l in layers]
+        self.loss_fn = loss_fn
+        self.partition_method = partition_method
+        self.activation_checkpoint_interval = activation_checkpoint_interval
+        self.seed = seed
+
+        if num_stages is None:
+            num_stages = topology.get_dim("pipe") if topology is not None else 1
+            num_stages = max(1, num_stages)
+        self.num_stages = num_stages
+        self.topology = topology
+
+        # build all layers (host-side objects are light; params are built
+        # separately/purely in init_params)
+        self.layers = [spec.build() for spec in self.specs]
+        self.tied_keys = sorted({s.key for s in self.specs
+                                 if isinstance(s, TiedLayerSpec)})
+
+        self.parts = self._partition_layers()
+
+    # ------------------------------------------------------------------ #
+    # partitioning (reference module.py:348-404)
+    # ------------------------------------------------------------------ #
+    def _layer_weights(self) -> List[float]:
+        method = self.partition_method.lower()
+        if method == "uniform":
+            return [1.0] * len(self.specs)
+        if method == "parameters":
+            weights = []
+            key = jax.random.PRNGKey(self.seed)
+            for layer in self.layers:
+                params = _layer_init(layer, key)
+                weights.append(float(_num_params(params)) if params is not None
+                               else 0.0)
+            # all-zero (param-less model) degrades to uniform
+            return weights if any(weights) else [1.0] * len(self.specs)
+        if method.startswith("type:"):
+            pattern = self.partition_method[len("type:"):]
+            return [1.0 if re.search(pattern, spec.name, re.IGNORECASE)
+                    else 0.0 for spec in self.specs]
+        raise NotImplementedError(
+            f"partition_method {self.partition_method!r} not supported")
+
+    def _partition_layers(self) -> List[int]:
+        parts = partition_balanced(self._layer_weights(), self.num_stages)
+        if any(parts[i] == parts[i + 1] for i in range(self.num_stages)) \
+                and len(self.specs) >= self.num_stages:
+            logger.warning(
+                f"partition {parts} leaves an empty stage; "
+                f"falling back to uniform")
+            parts = partition_uniform(len(self.specs), self.num_stages)
+        return parts
+
+    def stage_layers(self, stage_id: int) -> List[int]:
+        """Layer indices owned by a stage."""
+        return list(range(self.parts[stage_id], self.parts[stage_id + 1]))
+
+    def stage_of_layer(self, layer_idx: int) -> int:
+        for s in range(self.num_stages):
+            if self.parts[s] <= layer_idx < self.parts[s + 1]:
+                return s
+        raise IndexError(layer_idx)
+
+    # ------------------------------------------------------------------ #
+    # params
+    # ------------------------------------------------------------------ #
+    def init_params(self, key=None) -> Dict[str, Any]:
+        """Build the full params pytree:
+        ``{"layer_00": ..., "tied": {key: ...}}``.
+
+        Tied specs' params live once under ``tied/<key>``; their per-layer
+        slot is the string reference (resolved in apply)."""
+        if key is None:
+            key = jax.random.PRNGKey(self.seed)
+        params: Dict[str, Any] = {}
+        tied: Dict[str, Any] = {}
+        keys = jax.random.split(key, len(self.layers))
+        for i, (spec, layer) in enumerate(zip(self.specs, self.layers)):
+            if isinstance(spec, TiedLayerSpec):
+                if spec.key not in tied:
+                    tied[spec.key] = _layer_init(layer, keys[i])
+                continue
+            p = _layer_init(layer, keys[i])
+            if p is not None:
+                params[f"layer_{i:02d}"] = p
+        if tied:
+            params["tied"] = tied
+        return params
+
+    def _params_for(self, params: Dict[str, Any], i: int):
+        spec = self.specs[i]
+        if isinstance(spec, TiedLayerSpec):
+            return params["tied"][spec.key]
+        return params.get(f"layer_{i:02d}")
+
+    # ------------------------------------------------------------------ #
+    # sequential forward (correctness path / single stage;
+    # reference module.py:292)
+    # ------------------------------------------------------------------ #
+    def forward(self, params: Dict[str, Any], x, rng=None,
+                start: int = 0, stop: Optional[int] = None):
+        stop = len(self.layers) if stop is None else stop
+        interval = self.activation_checkpoint_interval
+
+        def run_span(x, lo, hi, rng):
+            for i in range(lo, hi):
+                spec, layer = self.specs[i], self.layers[i]
+                p = self._params_for(params, i)
+                r = None
+                if rng is not None:
+                    r = jax.random.fold_in(rng, i)
+                if isinstance(spec, TiedLayerSpec) and spec.forward_fn:
+                    x = spec.forward_fn(p, x)
+                else:
+                    x = _layer_apply(layer, p, x, rng=r)
+            return x
+
+        if interval and interval > 0:
+            lo = start
+            while lo < stop:
+                hi = min(lo + interval, stop)
+                x = jax.checkpoint(
+                    lambda x, rng, lo=lo, hi=hi: run_span(x, lo, hi, rng)
+                )(x, rng)
+                lo = hi
+            return x
+        return run_span(x, start, stop, rng)
+
+    __call__ = forward
+
+    # ------------------------------------------------------------------ #
+    # stage stacking for the compiled SPMD pipeline (spmd.py)
+    # ------------------------------------------------------------------ #
+    def stackable(self, params: Dict[str, Any]) -> bool:
+        """True if every stage's param sub-tree has identical structure."""
+        try:
+            self.stack_stage_params(params)
+            return True
+        except ValueError:
+            return False
+
+    def stage_params(self, params: Dict[str, Any], stage_id: int) -> List:
+        return [self._params_for(params, i)
+                for i in self.stage_layers(stage_id)]
+
+    def stack_stage_params(self, params: Dict[str, Any]):
+        """Stack per-stage param lists into leaves with a leading ``pipe``
+        dim: returns a pytree whose leaves have shape (num_stages, ...)."""
+        per_stage = [self.stage_params(params, s)
+                     for s in range(self.num_stages)]
+        ref = jax.tree_util.tree_structure(per_stage[0])
+        shapes0 = [l.shape for l in jax.tree_util.tree_leaves(per_stage[0])]
+        for s, sp in enumerate(per_stage[1:], start=1):
+            if jax.tree_util.tree_structure(sp) != ref:
+                raise ValueError(
+                    f"stage {s} params structure differs from stage 0 — "
+                    f"stages must be homogeneous to stack over the pipe "
+                    f"axis; move odd layers into PipelineSpec pre/post")
+            shapes = [l.shape for l in jax.tree_util.tree_leaves(sp)]
+            if shapes != shapes0:
+                raise ValueError(
+                    f"stage {s} param shapes {shapes} != stage 0 {shapes0}")
+        return jax.tree_util.tree_map(
+            lambda *leaves: jnp.stack(leaves), *per_stage)
+
+    def stage_apply_fn(self) -> Callable:
+        """Returns ``f(stage_param_list, x, rng)`` applying one stage's
+        layers; identical code for every stage (required by SPMD)."""
+        lo, hi = self.parts[0], self.parts[1]
+        layers = self.layers[lo:hi]
+
+        def apply(stage_params: List, x, rng=None):
+            for j, layer in enumerate(layers):
+                r = jax.random.fold_in(rng, j) if rng is not None else None
+                x = _layer_apply(layer, stage_params[j], x, rng=r)
+            return x
+        return apply
+
+    # ------------------------------------------------------------------ #
+    # per-layer checkpoints (reference module.py:526-546)
+    # ------------------------------------------------------------------ #
+    def ckpt_layer_path(self, ckpt_dir: str, layer_idx: int) -> str:
+        import os
+        return os.path.join(ckpt_dir, f"layer_{layer_idx:02d}-model_states.npz")
+
+    def save_state_dict(self, params: Dict[str, Any], ckpt_dir: str):
+        import os
+        from deepspeed_tpu.runtime import checkpoint as ckpt
+        os.makedirs(ckpt_dir, exist_ok=True)
+        for i in range(len(self.layers)):
+            p = self._params_for(params, i)
+            if p is None:
+                continue
+            if isinstance(self.specs[i], TiedLayerSpec) and \
+                    self.stage_of_layer(i) != 0 and \
+                    any(isinstance(s, TiedLayerSpec) and s.key ==
+                        self.specs[i].key for s in self.specs[:i]):
+                continue  # tied copy already saved by its first occurrence
+            ckpt.save_tree(self.ckpt_layer_path(ckpt_dir, i), p)
+
+    def load_state_dir(self, params: Dict[str, Any], ckpt_dir: str):
+        """Load per-layer files into a params pytree (repartitioning across
+        stage counts is free: files are per *layer*, reference
+        module.py:548)."""
+        from deepspeed_tpu.runtime import checkpoint as ckpt
+        import os
+        new_params = dict(params)
+        tied = dict(params.get("tied", {}))
+        seen_tied = set()
+        for i in range(len(self.layers)):
+            path = self.ckpt_layer_path(ckpt_dir, i)
+            spec = self.specs[i]
+            if isinstance(spec, TiedLayerSpec):
+                if spec.key in seen_tied or not os.path.exists(path):
+                    continue
+                tied[spec.key] = ckpt.load_tree(path, tied[spec.key])
+                seen_tied.add(spec.key)
+            elif f"layer_{i:02d}" in new_params and os.path.exists(path):
+                new_params[f"layer_{i:02d}"] = ckpt.load_tree(
+                    path, new_params[f"layer_{i:02d}"])
+        if tied:
+            new_params["tied"] = tied
+        return new_params

@@ -1,0 +1,230 @@
+"""Pipeline schedules — instruction streams for pipelined training.
+
+TPU-native analog of the reference's ``deepspeed/runtime/pipe/schedule.py``
+(PipeSchedule :6, InferenceSchedule :129, TrainSchedule :182,
+DataParallelSchedule :292, instruction classes :336-478).
+
+Role in this framework: on GPU the engine *interprets* these instructions
+rank-by-rank with blocking NCCL p2p. On TPU the hot path is a single
+compiled SPMD program (runtime/pipe/spmd.py) whose dataflow — ppermute
+rotations inside a ``lax.scan`` — realizes exactly the dependency structure
+these schedules describe. The instruction stream remains first-class
+because (a) it is the specification the compiled executor is tested
+against, (b) host-side orchestration (multi-controller deployments,
+logging, debugging) still walks it, and (c) it is the reference's best
+abstraction and part of the public API surface.
+
+Tick math (derived, not copied): with M micro-batches and S stages,
+stage ``s`` runs ForwardPass of micro-batch ``m`` at tick ``2m + s`` and
+BackwardPass of ``m`` at tick ``2m + 2S - 1 - s``; total ticks
+``2(M + S - 1)`` (matches the reference's step count, schedule.py:192).
+Forward slots have tick parity ``s % 2``, backward slots the opposite, so
+the two waves interleave 1F1B-style without collisions.
+"""
+
+from typing import Iterable, List
+
+
+class PipeInstruction:
+    """Base class; instructions carry kwargs (micro_batch_id, buffer_id)."""
+
+    def __init__(self, **kwargs):
+        self.name = self.__class__.__name__
+        self.kwargs = kwargs
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    def __repr__(self):
+        if not self.kwargs:
+            return self.name
+        args = ", ".join(f"{k}={v}" for k, v in sorted(self.kwargs.items()))
+        return f"{self.name}({args})"
+
+    def __eq__(self, other):
+        return (type(self) is type(other)) and self.kwargs == other.kwargs
+
+    def __hash__(self):
+        return hash((self.name, tuple(sorted(self.kwargs.items()))))
+
+
+class OptimizerStep(PipeInstruction):
+    """Apply the optimizer at the batch boundary (reference schedule.py:336)."""
+
+
+class ReduceGrads(PipeInstruction):
+    """Data-parallel gradient reduction (reference schedule.py:346)."""
+
+
+class ReduceTiedGrads(PipeInstruction):
+    """All-reduce of tied-weight grads across owning stages (ref :352)."""
+
+
+class BufferOpInstruction(PipeInstruction):
+    """Instruction operating on a pipeline buffer slot (ref :358)."""
+
+    def __init__(self, buffer_id: int, **kwargs):
+        super().__init__(buffer_id=buffer_id, **kwargs)
+
+
+class LoadMicroBatch(BufferOpInstruction):
+    """First/last stage pulls a micro-batch from the loader (ref :375)."""
+
+
+class ForwardPass(BufferOpInstruction):
+    """Run the stage's layers forward on a buffer (ref :388)."""
+
+
+class BackwardPass(BufferOpInstruction):
+    """Backprop through the stage's layers for a buffer (ref :400)."""
+
+
+class SendActivation(BufferOpInstruction):
+    """Send a buffer's activations to the next stage (ref :416)."""
+
+
+class RecvActivation(BufferOpInstruction):
+    """Receive activations from the previous stage (ref :432)."""
+
+
+class SendGrad(BufferOpInstruction):
+    """Send input-activation grads to the previous stage (ref :448)."""
+
+
+class RecvGrad(BufferOpInstruction):
+    """Receive output grads from the next stage (ref :463)."""
+
+
+class PipeSchedule:
+    """Iterable of per-tick instruction lists for one (stage, micro_batches)
+    pair (reference schedule.py:6).
+
+    Subclasses implement ``steps()`` yielding ``List[PipeInstruction]``.
+    """
+
+    def __init__(self, micro_batches: int, stages: int, stage_id: int):
+        assert 0 <= stage_id < stages
+        self.micro_batches = micro_batches
+        self.stages = stages
+        self.stage_id = stage_id
+        self.prev_stage = stage_id - 1
+        self.next_stage = stage_id + 1
+
+    def steps(self) -> Iterable[List[PipeInstruction]]:
+        raise NotImplementedError
+
+    def num_pipe_buffers(self) -> int:
+        """Number of in-flight activation buffers this stage needs."""
+        raise NotImplementedError
+
+    @property
+    def is_first_stage(self) -> bool:
+        return self.stage_id == 0
+
+    @property
+    def is_last_stage(self) -> bool:
+        return self.stage_id == self.stages - 1
+
+    def _buffer_idx(self, micro_batch_id: int) -> int:
+        return micro_batch_id % self.num_pipe_buffers()
+
+    def __iter__(self):
+        return iter(self.steps())
+
+
+class InferenceSchedule(PipeSchedule):
+    """Forward-only wavefront (reference schedule.py:129): stage ``s``
+    forwards micro-batch ``m`` at tick ``m + s``; double-buffered."""
+
+    def num_pipe_buffers(self) -> int:
+        return 2  # reference schedule.py:173
+
+    def steps(self):
+        total = self.micro_batches + self.stages - 1
+        for tick in range(total):
+            cmds: List[PipeInstruction] = []
+            m = tick - self.stage_id
+            if 0 <= m < self.micro_batches:
+                buf = self._buffer_idx(m)
+                if self.is_first_stage or self.is_last_stage:
+                    cmds.append(LoadMicroBatch(buf, micro_batch_id=m))
+                if not self.is_first_stage:
+                    cmds.append(RecvActivation(buf, micro_batch_id=m))
+                cmds.append(ForwardPass(buf, micro_batch_id=m))
+                if not self.is_last_stage:
+                    cmds.append(SendActivation(buf, micro_batch_id=m))
+            yield cmds
+
+
+class TrainSchedule(PipeSchedule):
+    """1F1B interleave (reference schedule.py:182): forward of ``m`` at tick
+    ``2m + s``, backward at ``2m + 2S - 1 - s``; 2(M+S-1) total ticks."""
+
+    def _fwd_micro_batch(self, tick: int):
+        m, r = divmod(tick - self.stage_id, 2)
+        if r == 0 and 0 <= m < self.micro_batches:
+            return m
+        return None
+
+    def _bwd_micro_batch(self, tick: int):
+        m, r = divmod(tick - (2 * self.stages - 1 - self.stage_id), 2)
+        if r == 0 and 0 <= m < self.micro_batches:
+            return m
+        return None
+
+    def num_pipe_buffers(self) -> int:
+        """Max forwarded-but-not-backwarded micro-batches = pipeline depth
+        remaining below this stage (reference schedule.py:243 keeps
+        min(S - s, M) buffers; derivation: fwd(m) at 2m+s, bwd(m) at
+        2m+2S-1-s → (S - s) in flight in steady state)."""
+        return max(1, min(self.stages - self.stage_id, self.micro_batches))
+
+    def steps(self):
+        total = 2 * (self.micro_batches + self.stages - 1)
+        for tick in range(total):
+            cmds: List[PipeInstruction] = []
+            fwd = self._fwd_micro_batch(tick)
+            bwd = self._bwd_micro_batch(tick)
+
+            if bwd is not None:
+                buf = self._buffer_idx(bwd)
+                if not self.is_last_stage:
+                    cmds.append(RecvGrad(buf, micro_batch_id=bwd))
+                cmds.append(BackwardPass(buf, micro_batch_id=bwd))
+                if not self.is_first_stage:
+                    cmds.append(SendGrad(buf, micro_batch_id=bwd))
+
+            if fwd is not None:
+                buf = self._buffer_idx(fwd)
+                if self.is_first_stage or self.is_last_stage:
+                    cmds.append(LoadMicroBatch(buf, micro_batch_id=fwd))
+                if not self.is_first_stage:
+                    cmds.append(RecvActivation(buf, micro_batch_id=fwd))
+                cmds.append(ForwardPass(buf, micro_batch_id=fwd))
+                if not self.is_last_stage:
+                    cmds.append(SendActivation(buf, micro_batch_id=fwd))
+
+            if tick == total - 1:
+                # batch boundary (reference schedule.py:230-236)
+                cmds.append(ReduceTiedGrads())
+                cmds.append(ReduceGrads())
+                cmds.append(OptimizerStep())
+            yield cmds
+
+
+class DataParallelSchedule(PipeSchedule):
+    """Degenerate single-stage schedule: plain gradient accumulation
+    (reference schedule.py:292)."""
+
+    def num_pipe_buffers(self) -> int:
+        return 1
+
+    def steps(self):
+        for m in range(self.micro_batches):
+            cmds: List[PipeInstruction] = [
+                LoadMicroBatch(0, micro_batch_id=m),
+                ForwardPass(0, micro_batch_id=m),
+                BackwardPass(0, micro_batch_id=m),
+            ]
+            if m == self.micro_batches - 1:
+                cmds.extend([ReduceGrads(), OptimizerStep()])
+            yield cmds

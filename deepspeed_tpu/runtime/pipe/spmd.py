@@ -1,0 +1,259 @@
+"""Compiled SPMD pipeline execution — the TPU-native pipeline engine core.
+
+The reference executes pipeline schedules as a per-rank Python interpreter
+over blocking NCCL p2p ops (``runtime/pipe/engine.py:1145`` _exec_schedule,
+``p2p.py:31,44`` send/recv as 2-rank broadcasts). On TPU that design wastes
+the compiler: instead, the *entire* pipelined batch — all micro-batches,
+all stages, forward and backward — is ONE jitted program over a mesh with a
+``pipe`` axis:
+
+- stage weights are stacked on a leading ``pipe``-sharded dimension, so
+  "stage s holds layers [s]" is a *sharding*, not a process assignment;
+- each scan tick, every stage applies its layers to its current activation
+  and the activations rotate one stage forward via ``lax.ppermute`` (the
+  ICI-neighbor collective — the analog of p2p.send/recv);
+- micro-batch injection at stage 0 and loss extraction at stage S-1 are
+  ``where``-masks on ``lax.axis_index('pipe')``;
+- the backward schedule is not hand-written at all: it is the transpose of
+  the forward scan (ppermute transposes to the reverse rotation), which
+  yields the inverted-wavefront grad flow the reference implements manually
+  (_exec_backward_pass / SendGrad / RecvGrad).
+
+Schedule realized: GPipe-style fill-drain with ``M + S - 1`` forward ticks
+followed by the transposed backward sweep; remat (``jax.checkpoint``) on
+the stage body keeps the activation footprint at one carry per tick, the
+same asymptotics as the reference's 1F1B + activation checkpointing. The
+instruction-stream view of this dataflow lives in runtime/pipe/schedule.py
+and is what the tests check the executor against.
+
+Cost note (inherent to single-program SPMD): the pre/post functions
+(embedding, loss head) run redundantly on every pipe row with their
+results masked off except at the owning row. This buys compiler-scheduled
+overlap and zero host involvement; pre/post are small relative to S stage
+bodies for the deep models pipelining targets.
+"""
+
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.parallel.mesh import axis_size
+
+
+class PipelineSpec(NamedTuple):
+    """A pipelined model in functional form.
+
+    - ``init(key) -> {"pre": ..., "stages": ..., "post": ...}`` where the
+      ``stages`` leaves carry a leading ``num_stages`` dim (stacked).
+    - ``pre_apply(pre_params, micro_batch, rng) -> act``: input layers
+      (embedding); runs at stage 0's slot.
+    - ``stage_apply(stage_params, act, rng) -> act``: one stage's layers;
+      ``stage_params`` is the leading-dim slice for this stage.
+    - ``post_apply(post_params, pre_params, act, micro_batch) -> scalar``:
+      output layers + loss; receives ``pre_params`` so heads can tie to
+      embedding weights (reference TiedLayerSpec, module.py:71).
+    - ``*_specs``: optional PartitionSpec pytrees for tensor-parallel
+      sharding of each group; stage specs are per-stacked-leaf *without*
+      the leading pipe dim (it is prepended here).
+    """
+    init: Callable
+    pre_apply: Callable
+    stage_apply: Callable
+    post_apply: Callable
+    num_stages: int
+    pre_specs: Optional[Any] = None
+    stage_specs: Optional[Any] = None
+    post_specs: Optional[Any] = None
+
+
+def _prepend_pipe(spec: Optional[P]) -> P:
+    if spec is None:
+        return P("pipe")
+    return P("pipe", *tuple(spec))
+
+
+def pipeline_param_specs(spec: PipelineSpec, params: Any) -> Any:
+    """PartitionSpec pytree for the full pipeline params: stacked stage
+    leaves get 'pipe' on dim 0 (+ any TP spec shifted right); pre/post get
+    their TP specs or replication."""
+    def expand(group, tp_specs, stacked: bool):
+        if tp_specs is None:
+            return jax.tree_util.tree_map(
+                lambda _: _prepend_pipe(None) if stacked else P(), group)
+        return jax.tree_util.tree_map(
+            lambda _, s: _prepend_pipe(s) if stacked else (s or P()),
+            group, tp_specs)
+    return {
+        "pre": expand(params["pre"], spec.pre_specs, stacked=False),
+        "stages": expand(params["stages"], spec.stage_specs, stacked=True),
+        "post": expand(params["post"], spec.post_specs, stacked=False),
+    }
+
+
+def build_pipeline_loss_fn(spec: PipelineSpec, mesh: Mesh, num_micro: int,
+                           remat: bool = True,
+                           compute_dtype=None) -> Callable:
+    """Return ``loss_fn(params, batch, rng) -> scalar`` running the full
+    pipelined forward; engine-contract compatible (runtime/engine.py).
+
+    ``batch`` leaves must have leading dim ``num_micro`` then the global
+    micro-batch dim (sharded over 'data').
+
+    ``compute_dtype``: when set, fp32 params are cast INSIDE the mapped
+    program (the returned fn carries ``owns_cast=True`` so the engine skips
+    its own cast). This keeps every cross-stage gradient psum in fp32 —
+    the master-grad precision ZeRO expects — with only the bf16 compute
+    copies crossing into the stage bodies.
+    """
+    if "pipe" not in mesh.axis_names:
+        raise ValueError("pipeline execution requires a 'pipe' mesh axis")
+    S = spec.num_stages
+    M = num_micro
+    if axis_size(mesh, "pipe") != S:
+        raise ValueError(
+            f"mesh pipe axis {axis_size(mesh, 'pipe')} != num_stages {S}")
+
+    stage_apply = spec.stage_apply
+    if remat:
+        stage_apply = jax.checkpoint(spec.stage_apply)
+
+    # pipeline + data flow are hand-scheduled (manual axes); tensor/sequence
+    # parallel axes stay in "auto" mode so GSPMD keeps doing TP inside each
+    # stage body (specs naming auto axes must be filtered from in_specs)
+    manual_axes = frozenset(a for a in ("pipe", "data")
+                            if a in mesh.axis_names)
+
+    def manual_only(p: P) -> P:
+        def keep(entry):
+            if entry is None:
+                return None
+            if isinstance(entry, (tuple, list)):
+                kept = tuple(a for a in entry if a in manual_axes)
+                return kept if kept else None
+            return entry if entry in manual_axes else None
+        return P(*(keep(e) for e in tuple(p)))
+
+    def per_device(params, batch, rng):
+        if compute_dtype is not None:
+            params = jax.tree_util.tree_map(
+                lambda x: x.astype(compute_dtype)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
+        s_idx = jax.lax.axis_index("pipe")
+        pre_p, post_p = params["pre"], params["post"]
+        # local slice of the stacked stage weights: (1, ...) -> (...)
+        st_p = jax.tree_util.tree_map(lambda x: x[0], params["stages"])
+
+        def tick(carry, t):
+            act, outbuf = carry
+            in_idx = jnp.clip(t, 0, M - 1)
+            micro = jax.tree_util.tree_map(lambda x: x[in_idx], batch)
+            # LoadMicroBatch + first-stage layers (masked to stage 0)
+            fresh = spec.pre_apply(pre_p, micro, jax.random.fold_in(rng, t))
+            act_in = jnp.where(s_idx == 0, fresh.astype(act.dtype), act)
+            # ForwardPass for every stage's current micro-batch
+            r = jax.random.fold_in(rng, t * (S + 1) + s_idx + 1)
+            out = stage_apply(st_p, act_in, r)
+            # collect the wave exiting the last stage (micro-batch t-(S-1))
+            out_t = t - (S - 1)
+            o_idx = jnp.clip(out_t, 0, M - 1)
+            cur = jax.lax.dynamic_index_in_dim(outbuf, o_idx, keepdims=True)
+            valid = jnp.logical_and(out_t >= 0, out_t < M)
+            outbuf = jax.lax.dynamic_update_slice_in_dim(
+                outbuf, jnp.where(valid, out[None], cur), o_idx, axis=0)
+            # SendActivation/RecvActivation: rotate stage s -> s+1
+            act = jax.lax.ppermute(
+                out, "pipe", [(i, (i + 1) % S) for i in range(S)])
+            return (act, outbuf), None
+
+        # probe activation shape/dtype via the first micro-batch
+        micro0 = jax.tree_util.tree_map(lambda x: x[0], batch)
+        probe = jax.eval_shape(spec.pre_apply, pre_p, micro0, rng)
+        act0 = jnp.zeros(probe.shape, probe.dtype)
+        outbuf0 = jnp.zeros((M,) + probe.shape, probe.dtype)
+
+        (_, outbuf), _ = jax.lax.scan(
+            tick, (act0, outbuf0), jnp.arange(M + S - 1))
+
+        # output layers + loss over all M collected micro-batches at once
+        # (batched: better MXU shapes than per-tick heads)
+        losses = jax.vmap(
+            lambda a, mb: spec.post_apply(post_p, pre_p, a, mb),
+            in_axes=(0, 0))(outbuf, batch)
+        # _aggregate_total_loss (reference pipe/engine.py:374): select the
+        # last stage's mean, share it with every stage/DP rank
+        local = jnp.where(s_idx == S - 1, jnp.mean(losses), 0.0)
+        total = jax.lax.psum(local, "pipe")
+        if "data" in manual_axes:
+            total = jax.lax.pmean(total, "data")
+        return total
+
+    def loss_fn(params, batch, rng):
+        # spec trees built against the actual pytree (PipelineSpec TP specs
+        # may be None => replicated/pipe-stacked defaults), then filtered to
+        # the manual axes — TP ('model'/'seq') sharding is carried by the
+        # arguments themselves in auto mode
+        full_specs = jax.tree_util.tree_map(
+            manual_only, pipeline_param_specs(spec, params),
+            is_leaf=lambda x: isinstance(x, P))
+        batch_specs = jax.tree_util.tree_map(
+            lambda _: P(None, "data") if "data" in mesh.axis_names else P(),
+            batch)
+        mapped = jax.shard_map(
+            per_device, mesh=mesh,
+            in_specs=(full_specs, batch_specs, P()),
+            out_specs=P(),
+            axis_names=manual_axes,
+            check_vma=False)
+        return mapped(params, batch, rng)
+
+    loss_fn.owns_cast = compute_dtype is not None
+    return loss_fn
+
+
+def microbatch_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for a stacked (M, global_mb, ...) pipeline batch."""
+    if "data" in mesh.axis_names:
+        return NamedSharding(mesh, P(None, "data"))
+    return NamedSharding(mesh, P())
+
+
+def module_pipeline_spec(module, mesh_or_stages, input_key: str = "x",
+                         loss_fn: Optional[Callable] = None) -> PipelineSpec:
+    """Adapt a PipelineModule with homogeneous stages to a PipelineSpec.
+
+    - pre: identity on ``micro_batch[input_key]`` (first stage "loads" the
+      micro-batch, reference pipe/engine.py:613);
+    - stage: the module's per-stage layer chain;
+    - post: ``loss_fn(act, micro_batch)`` (module.loss_fn by default).
+    """
+    num_stages = (mesh_or_stages if isinstance(mesh_or_stages, int)
+                  else axis_size(mesh_or_stages, "pipe"))
+    if module.num_stages != num_stages:
+        raise ValueError(f"module has {module.num_stages} stages, "
+                         f"mesh/pipe axis has {num_stages}")
+    final_loss = loss_fn or module.loss_fn
+    if final_loss is None:
+        raise ValueError("a loss_fn is required (module.loss_fn or arg)")
+
+    stage_fn = module.stage_apply_fn()
+
+    def init(key):
+        flat = module.init_params(key)
+        return {"pre": {}, "stages": module.stack_stage_params(flat),
+                "post": {}}
+
+    def pre_apply(pre_p, micro, rng):
+        x = micro[input_key] if isinstance(micro, dict) else micro
+        return x
+
+    def stage_apply(st_p, act, rng):
+        return stage_fn(st_p, act, rng=rng)
+
+    def post_apply(post_p, pre_p, act, micro):
+        return final_loss(act, micro)
+
+    return PipelineSpec(init=init, pre_apply=pre_apply,
+                        stage_apply=stage_apply, post_apply=post_apply,
+                        num_stages=num_stages)

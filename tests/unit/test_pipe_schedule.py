@@ -1,0 +1,177 @@
+"""Pipeline schedule tests (mirrors reference tests/unit/test_pipe_schedule.py
+— pure-CPU instruction-sequence assertions)."""
+
+import pytest
+
+from deepspeed_tpu.runtime.pipe.schedule import (
+    BackwardPass, DataParallelSchedule, ForwardPass, InferenceSchedule,
+    LoadMicroBatch, OptimizerStep, RecvActivation, RecvGrad, ReduceGrads,
+    ReduceTiedGrads, SendActivation, SendGrad, TrainSchedule)
+
+
+def _cmds_of(sched, cls):
+    out = []
+    for tick, cmds in enumerate(sched):
+        for c in cmds:
+            if isinstance(c, cls):
+                out.append((tick, c))
+    return out
+
+
+@pytest.mark.parametrize("micro_batches,stages", [(1, 1), (4, 2), (2, 4),
+                                                  (8, 4), (3, 3)])
+def test_train_schedule_complete(micro_batches, stages):
+    """Every stage forwards and backwards every micro-batch exactly once,
+    forward strictly before backward."""
+    for stage in range(stages):
+        sched = TrainSchedule(micro_batches, stages, stage)
+        fwd = _cmds_of(sched, ForwardPass)
+        bwd = _cmds_of(sched, BackwardPass)
+        assert sorted(c.micro_batch_id for _, c in fwd) == \
+            list(range(micro_batches))
+        assert sorted(c.micro_batch_id for _, c in bwd) == \
+            list(range(micro_batches))
+        fwd_tick = {c.micro_batch_id: t for t, c in fwd}
+        bwd_tick = {c.micro_batch_id: t for t, c in bwd}
+        for m in range(micro_batches):
+            assert fwd_tick[m] < bwd_tick[m]
+
+
+@pytest.mark.parametrize("micro_batches,stages", [(4, 2), (2, 4), (8, 4)])
+def test_train_schedule_dataflow(micro_batches, stages):
+    """Cross-stage dependencies: stage s+1 forwards m only after stage s;
+    stage s backwards m only after stage s+1."""
+    fwd_tick = {}
+    bwd_tick = {}
+    for stage in range(stages):
+        sched = TrainSchedule(micro_batches, stages, stage)
+        for t, c in _cmds_of(sched, ForwardPass):
+            fwd_tick[(stage, c.micro_batch_id)] = t
+        for t, c in _cmds_of(sched, BackwardPass):
+            bwd_tick[(stage, c.micro_batch_id)] = t
+    for m in range(micro_batches):
+        for s in range(stages - 1):
+            assert fwd_tick[(s, m)] < fwd_tick[(s + 1, m)]
+            assert bwd_tick[(s + 1, m)] < bwd_tick[(s, m)]
+        # backward starts only after the last stage forwarded it
+        assert fwd_tick[(stages - 1, m)] <= bwd_tick[(stages - 1, m)]
+
+
+def test_train_schedule_tick_count():
+    """Total ticks = 2*(M + S - 1) (reference schedule.py:192)."""
+    for m, s in [(4, 2), (1, 4), (8, 8)]:
+        sched = TrainSchedule(m, s, 0)
+        assert len(list(sched.steps())) == 2 * (m + s - 1)
+
+
+def test_train_schedule_sends_match_recvs():
+    """SendActivation at stage s pairs with RecvActivation of the same
+    micro-batch at stage s+1 (and SendGrad/RecvGrad mirrored)."""
+    M, S = 4, 3
+    scheds = [TrainSchedule(M, S, s) for s in range(S)]
+    for s in range(S - 1):
+        sends = {c.micro_batch_id for _, c in
+                 _cmds_of(scheds[s], SendActivation)}
+        recvs = {c.micro_batch_id for _, c in
+                 _cmds_of(scheds[s + 1], RecvActivation)}
+        assert sends == recvs == set(range(M))
+        gsends = {c.micro_batch_id for _, c in
+                  _cmds_of(scheds[s + 1], SendGrad)}
+        grecvs = {c.micro_batch_id for _, c in
+                  _cmds_of(scheds[s], RecvGrad)}
+        assert gsends == grecvs == set(range(M))
+    # boundary stages have no external comm
+    assert not _cmds_of(scheds[0], RecvActivation)
+    assert not _cmds_of(scheds[0], SendGrad)
+    assert not _cmds_of(scheds[S - 1], SendActivation)
+    assert not _cmds_of(scheds[S - 1], RecvGrad)
+
+
+def test_train_schedule_no_slot_collision():
+    """At most one ForwardPass and one BackwardPass per tick per stage."""
+    for stage in range(4):
+        sched = TrainSchedule(8, 4, stage)
+        for cmds in sched:
+            assert sum(isinstance(c, ForwardPass) for c in cmds) <= 1
+            assert sum(isinstance(c, BackwardPass) for c in cmds) <= 1
+
+
+def test_train_schedule_buffer_bound():
+    """In-flight (forwarded, not yet backwarded) micro-batches never exceed
+    num_pipe_buffers (reference schedule.py:243)."""
+    M, S = 8, 4
+    for stage in range(S):
+        sched = TrainSchedule(M, S, stage)
+        outstanding = 0
+        peak = 0
+        for cmds in sched:
+            for c in cmds:
+                if isinstance(c, ForwardPass):
+                    outstanding += 1
+                elif isinstance(c, BackwardPass):
+                    outstanding -= 1
+            peak = max(peak, outstanding)
+        assert peak <= sched.num_pipe_buffers()
+        # buffer ids stay in range
+        for cmds in sched.steps():
+            for c in cmds:
+                if hasattr(c, "buffer_id"):
+                    assert 0 <= c.buffer_id < sched.num_pipe_buffers()
+
+
+def test_train_schedule_batch_boundary():
+    """Last tick carries ReduceTiedGrads -> ReduceGrads -> OptimizerStep
+    (reference schedule.py:230-236)."""
+    sched = TrainSchedule(4, 2, 0)
+    ticks = list(sched.steps())
+    names = [type(c) for c in ticks[-1]]
+    assert names[-3:] == [ReduceTiedGrads, ReduceGrads, OptimizerStep]
+    for cmds in ticks[:-1]:
+        assert not any(isinstance(c, OptimizerStep) for c in cmds)
+
+
+def test_load_micro_batch_first_last_only():
+    """Only first/last stages load data (reference pipe/engine.py:613-649)."""
+    M, S = 4, 4
+    for stage in range(S):
+        sched = TrainSchedule(M, S, stage)
+        loads = _cmds_of(sched, LoadMicroBatch)
+        if stage in (0, S - 1):
+            assert len(loads) == M
+        else:
+            assert not loads
+
+
+@pytest.mark.parametrize("micro_batches,stages", [(4, 2), (2, 4)])
+def test_inference_schedule(micro_batches, stages):
+    """Forward-only wavefront, m at tick m+s, double-buffered
+    (reference schedule.py:129-173)."""
+    for stage in range(stages):
+        sched = InferenceSchedule(micro_batches, stages, stage)
+        assert sched.num_pipe_buffers() == 2
+        ticks = list(sched.steps())
+        assert len(ticks) == micro_batches + stages - 1
+        fwd = _cmds_of(sched, ForwardPass)
+        assert [c.micro_batch_id for _, c in fwd] == list(range(micro_batches))
+        for t, c in fwd:
+            assert t == c.micro_batch_id + stage
+        assert not _cmds_of(sched, BackwardPass)
+
+
+def test_data_parallel_schedule():
+    sched = DataParallelSchedule(micro_batches=3, stages=1, stage_id=0)
+    ticks = list(sched.steps())
+    assert len(ticks) == 3
+    assert sched.num_pipe_buffers() == 1
+    last = [type(c) for c in ticks[-1]]
+    assert ReduceGrads in last and OptimizerStep in last
+    for cmds in ticks[:-1]:
+        assert OptimizerStep not in [type(c) for c in cmds]
+
+
+def test_instruction_repr_and_eq():
+    a = ForwardPass(1, micro_batch_id=3)
+    b = ForwardPass(1, micro_batch_id=3)
+    c = ForwardPass(0, micro_batch_id=2)
+    assert a == b and a != c
+    assert "ForwardPass" in repr(a) and "micro_batch_id=3" in repr(a)
