@@ -335,13 +335,17 @@ class DeepSpeedEngine:
             return self.lr_scheduler.lr_at(step)
         return jnp.asarray(self.base_lr, jnp.float32)
 
+    def _cast_for_loss(self, params):
+        """fp32 master -> compute dtype, unless the loss fn owns the cast
+        (pipeline loss fns cast inside shard_map so grad psums stay fp32)."""
+        if getattr(self._loss_fn, "owns_cast", False):
+            return params
+        return _tree_cast(params, self.compute_dtype)
+
     def _compute_loss_and_grads(self, params, batch, rng, scale):
         """value_and_grad of the (scaled) loss in the compute dtype."""
         def scaled_loss_fn(p):
-            # a loss fn may own the fp32->compute cast (pipeline loss fns
-            # cast inside shard_map so grad psums stay fp32)
-            cp = (p if getattr(self._loss_fn, "owns_cast", False)
-                  else _tree_cast(p, self.compute_dtype))
+            cp = self._cast_for_loss(p)
             if self._loss_takes_rng:
                 out = self._loss_fn(cp, batch, rng)
             else:
@@ -550,8 +554,7 @@ class DeepSpeedEngine:
         """Loss without grads/update."""
         if not hasattr(self, "_compiled_eval"):
             def ev(params, batch, rng):
-                cp = (params if getattr(self._loss_fn, "owns_cast", False)
-                      else _tree_cast(params, self.compute_dtype))
+                cp = self._cast_for_loss(params)
                 out = (self._loss_fn(cp, batch, rng) if self._loss_takes_rng
                        else self._loss_fn(cp, batch))
                 return out[0] if isinstance(out, tuple) else out

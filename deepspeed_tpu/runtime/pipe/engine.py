@@ -32,7 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deepspeed_tpu.parallel.mesh import axis_size
-from deepspeed_tpu.runtime.engine import DeepSpeedEngine, TrainState, _tree_cast
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine, TrainState
 from deepspeed_tpu.runtime.pipe.module import PipelineModule
 from deepspeed_tpu.runtime.pipe.spmd import (
     PipelineSpec, build_pipeline_loss_fn, microbatch_sharding,
@@ -118,6 +118,11 @@ class PipelineEngine(DeepSpeedEngine):
                          param_specs=specs, config=inner, seed=seed,
                          **kwargs)
         self.num_stages = num_stages
+        # the inner config runs at gas=1, but each train_batch() consumes
+        # the full accumulation window — retune the throughput timer so
+        # samples/sec reflects micro_batches per tick
+        self.tput_timer.batch_size = (
+            self._true_train_batch_size // max(self.dp_world_size, 1))
         self._batch_sharding = microbatch_sharding(self.mesh)
         log_dist(
             f"PipelineEngine: stages={num_stages} "
@@ -165,9 +170,7 @@ class PipelineEngine(DeepSpeedEngine):
         realizes InferenceSchedule's wavefront (the same scan, no grad)."""
         if not hasattr(self, "_compiled_pipe_eval"):
             def ev(params, batch, rng):
-                cp = (params if getattr(self._loss_fn, "owns_cast", False)
-                      else _tree_cast(params, self.compute_dtype))
-                return self._loss_fn(cp, batch, rng)
+                return self._loss_fn(self._cast_for_loss(params), batch, rng)
             self._compiled_pipe_eval = jax.jit(ev)
         batch = self._stack_micro_batches(data_iter)
         return self._compiled_pipe_eval(self.state.params, batch,

@@ -170,7 +170,12 @@ class PipelineModule:
             weights = []
             key = jax.random.PRNGKey(self.seed)
             for layer in self.layers:
-                params = _layer_init(layer, key)
+                # count params abstractly — eval_shape never materializes
+                # the weights, so a huge model costs nothing to weigh
+                if hasattr(layer, "init"):
+                    params = jax.eval_shape(layer.init, key)
+                else:
+                    params = None
                 weights.append(float(_num_params(params)) if params is not None
                                else 0.0)
             # all-zero (param-less model) degrades to uniform

@@ -150,7 +150,10 @@ def build_pipeline_loss_fn(spec: PipelineSpec, mesh: Mesh, num_micro: int,
             in_idx = jnp.clip(t, 0, M - 1)
             micro = jax.tree_util.tree_map(lambda x: x[in_idx], batch)
             # LoadMicroBatch + first-stage layers (masked to stage 0)
-            fresh = spec.pre_apply(pre_p, micro, jax.random.fold_in(rng, t))
+            # disjoint fold-in domains mod (S+1): pre uses residue 0, stages
+            # use residues 1..S — no dropout-mask key ever collides
+            fresh = spec.pre_apply(pre_p, micro,
+                                   jax.random.fold_in(rng, t * (S + 1)))
             act_in = jnp.where(s_idx == 0, fresh.astype(act.dtype), act)
             # ForwardPass for every stage's current micro-batch
             r = jax.random.fold_in(rng, t * (S + 1) + s_idx + 1)
