@@ -22,6 +22,8 @@ from deepspeed_tpu.parallel.topology import (
 from deepspeed_tpu.parallel.mesh import build_mesh
 from deepspeed_tpu.ops.optimizers import (
     Adam, FusedAdam, Lamb, FusedLamb, SGD)
+# reference exports `deepspeed.checkpointing` (__init__.py:16)
+from deepspeed_tpu.runtime.activation_checkpointing import checkpointing
 
 __version__ = "0.1.0"
 __git_hash__ = None

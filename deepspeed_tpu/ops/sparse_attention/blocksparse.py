@@ -331,10 +331,10 @@ def _sparse_attention_fn(layout: np.ndarray, block: int, sm_scale: float,
         compiler_params = pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary"))
 
-    # index-map helpers; i = batch, t = triple id; scalar refs trail.
-    # trow encodes h * nq + qb, so bh = i * H + trow // nq, qb = trow % nq.
-    def _bh_row(i, t, trow, *_):
-        return i * H + trow[t] // nq
+    # index-map convention (repeated inline in every BlockSpec below):
+    # i = batch, t = triple id; row triples encode h * nq + qb, so
+    # bh = i * H + tr[t] // nq and qb = tr[t] % nq; column-major triples
+    # (cr) encode h * nk + kb analogously.
 
     def fwd_impl(q, k, v, kpm, am):
         B, _, S, D = q.shape

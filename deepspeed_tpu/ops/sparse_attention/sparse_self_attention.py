@@ -95,6 +95,9 @@ class BertSparseSelfAttention:
         hidden = config.hidden_size
         heads = getattr(config, "num_attention_heads",
                         getattr(config, "num_heads", None))
+        if heads is None:
+            raise ValueError(
+                "config must define num_attention_heads (or num_heads)")
         if hidden % heads != 0:
             raise ValueError(
                 f"hidden size {hidden} not a multiple of heads {heads}")

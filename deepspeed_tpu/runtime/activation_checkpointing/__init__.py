@@ -1,0 +1,3 @@
+from deepspeed_tpu.runtime.activation_checkpointing import checkpointing
+from deepspeed_tpu.runtime.activation_checkpointing.config import (
+    DeepSpeedActivationCheckpointingConfig)

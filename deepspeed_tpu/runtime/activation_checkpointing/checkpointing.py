@@ -1,0 +1,357 @@
+"""Activation checkpointing, TPU-native.
+
+Re-implements the reference subsystem
+(``deepspeed/runtime/activation_checkpointing/checkpointing.py``:
+``CheckpointFunction:314``, ``configure():653``, RNG tracker
+``CudaRNGStatesTracker:147``, ``model_parallel_cuda_manual_seed:223``) on
+JAX. The eager-autograd machinery — stashing inputs, restoring RNG states,
+re-running forward inside backward — collapses onto ``jax.checkpoint``
+(rematerialization): under remat XLA recomputes the wrapped function during
+the backward pass and RNG is functional (keys are part of the program), so no
+state save/restore is needed.
+
+Knob mapping (reference config flags → TPU semantics):
+
+- ``partition_activations`` (ref ``checkpointing.py:370-413``): the stashed
+  activation inputs are sharded across the ``model`` mesh axis instead of
+  replicated. Here: a ``with_sharding_constraint`` over the model axis is
+  applied to the saved inputs, so under GSPMD each model-parallel shard holds
+  1/mp_size of the checkpoint. The backward-pass allgather that the reference
+  does by hand (``get_full_inputs:281``) is inserted by XLA when the
+  recomputation needs the full value.
+- ``cpu_checkpointing`` / ``checkpoint_in_cpu`` (ref ``PA_TO_CPU:410``): the
+  saved inputs are placed in ``pinned_host`` memory via in-jit
+  ``jax.device_put``; XLA schedules the D2H/H2D transfers around the
+  recompute.
+- ``contiguous_memory_optimization`` / ``synchronize_checkpoint_boundary``:
+  accepted no-ops — XLA owns buffer layout and stream ordering.
+- ``profile``: wraps each checkpointed call in a ``jax.named_scope`` so the
+  cost shows up under a stable name in ``jax.profiler`` traces (the
+  reference logs wall-clock per call, ``checkpointing.py:331-335``).
+"""
+
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name  # re-exported for users
+
+from deepspeed_tpu.utils.logging import logger
+
+__all__ = [
+    "configure", "is_configured", "reset", "checkpoint", "checkpoint_name",
+    "non_reentrant_checkpoint", "RNGStatesTracker", "get_rng_tracker",
+    "get_cuda_rng_tracker", "model_parallel_seed",
+    "model_parallel_cuda_manual_seed", "CheckpointFunction",
+]
+
+# module-level flags (reference checkpointing.py:50-54)
+_CONFIGURED = False
+PARTITION_ACTIVATIONS = False
+PA_TO_CPU = False
+CONTIGUOUS_CHECKPOINTING = False
+SYNCHRONIZE = False
+PROFILE_TIME = False
+
+num_layers: Optional[int] = None
+mpu = None
+_MODEL_AXIS = "model"
+_MESH: Optional[jax.sharding.Mesh] = None
+_WARNED_NO_MESH = False
+_WARNED_NO_HOST = False
+
+
+def set_mesh(mesh: Optional[jax.sharding.Mesh]):
+    """Record the device mesh partition_activations shards over. Called by
+    the engine at init (the TPU analogue of the reference passing ``mpu``);
+    user code may also call it directly."""
+    global _MESH
+    _MESH = mesh
+
+
+def _detect_model_axis():
+    """Mesh axis the activation checkpoints are partitioned over."""
+    if mpu is not None and hasattr(mpu, "model_axis_name"):
+        return mpu.model_axis_name
+    return _MODEL_AXIS
+
+
+def configure(mpu_=None,
+              deepspeed_config=None,
+              partition_activations=None,
+              contiguous_checkpointing=None,
+              num_checkpoints=None,
+              checkpoint_in_cpu=None,
+              synchronize=None,
+              profile=None):
+    """Configure activation checkpointing (reference ``configure():653``).
+
+    ``deepspeed_config`` may be a path/dict consumed by ``DeepSpeedConfig``
+    or an already-built config object with an
+    ``activation_checkpointing_config`` attribute. Explicit kwargs override
+    the config file, as in the reference.
+    """
+    global _CONFIGURED, PARTITION_ACTIVATIONS, PA_TO_CPU
+    global CONTIGUOUS_CHECKPOINTING, SYNCHRONIZE, PROFILE_TIME
+    global num_layers, mpu
+
+    mpu = mpu_
+
+    cfg = None
+    if deepspeed_config is not None:
+        if hasattr(deepspeed_config, "activation_checkpointing_config"):
+            cfg = deepspeed_config.activation_checkpointing_config
+        else:
+            from deepspeed_tpu.runtime.config import DeepSpeedConfig
+            cfg = DeepSpeedConfig(deepspeed_config) \
+                .activation_checkpointing_config
+
+    def pick(explicit, from_cfg, default):
+        if explicit is not None:
+            return explicit
+        if from_cfg is not None:
+            return from_cfg
+        return default
+
+    PARTITION_ACTIVATIONS = pick(
+        partition_activations,
+        getattr(cfg, "partition_activations", None), False)
+    CONTIGUOUS_CHECKPOINTING = pick(
+        contiguous_checkpointing,
+        getattr(cfg, "contiguous_memory_optimization", None), False)
+    num_layers = pick(
+        num_checkpoints, getattr(cfg, "number_checkpoints", None), None)
+    PA_TO_CPU = pick(
+        checkpoint_in_cpu, getattr(cfg, "cpu_checkpointing", None), False)
+    SYNCHRONIZE = pick(
+        synchronize,
+        getattr(cfg, "synchronize_checkpoint_boundary", None), False)
+    PROFILE_TIME = pick(profile, getattr(cfg, "profile", None), False)
+
+    if CONTIGUOUS_CHECKPOINTING:
+        assert PARTITION_ACTIVATIONS, \
+            "contiguous_checkpointing requires partition_activations " \
+            "(reference checkpointing.py asserts the same)"
+        logger.info("contiguous_memory_optimization accepted; XLA owns "
+                    "buffer allocation so this is a no-op on TPU")
+    _CONFIGURED = True
+
+
+def is_configured() -> bool:
+    return _CONFIGURED
+
+
+def reset():
+    """Reset flags to defaults (reference ``reset():630``). The recorded
+    mesh is environmental and survives reset."""
+    global _CONFIGURED, PARTITION_ACTIVATIONS, PA_TO_CPU
+    global CONTIGUOUS_CHECKPOINTING, SYNCHRONIZE, PROFILE_TIME, num_layers
+    global _WARNED_NO_MESH, _WARNED_NO_HOST
+    _WARNED_NO_MESH = False
+    _WARNED_NO_HOST = False
+    _CONFIGURED = False
+    PARTITION_ACTIVATIONS = False
+    PA_TO_CPU = False
+    CONTIGUOUS_CHECKPOINTING = False
+    SYNCHRONIZE = False
+    PROFILE_TIME = False
+    num_layers = None
+
+
+def _is_floating(x) -> bool:
+    return isinstance(x, (jax.Array, jnp.ndarray)) and \
+        jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact)
+
+
+def _current_mesh() -> Optional[jax.sharding.Mesh]:
+    if _MESH is not None and not _MESH.empty:
+        return _MESH
+    # fall back to an ambient `with mesh:` context if the user entered one
+    try:
+        env_mesh = jax.interpreters.pxla.thread_resources.env.physical_mesh
+        if not env_mesh.empty:
+            return env_mesh
+    except Exception:
+        pass
+    return None
+
+
+def _constrain_saved(args):
+    """Apply the partition/offload placement to the values jax.checkpoint
+    will stash (its primal inputs)."""
+    def place(x):
+        global _WARNED_NO_MESH, _WARNED_NO_HOST
+        if not _is_floating(x):
+            return x
+        if PARTITION_ACTIVATIONS:
+            axis = _detect_model_axis()
+            mesh = _current_mesh()
+            if mesh is None or axis not in mesh.axis_names:
+                if not _WARNED_NO_MESH:
+                    _WARNED_NO_MESH = True
+                    logger.warning(
+                        "partition_activations=True but no mesh with a "
+                        f"'{axis}' axis is known — call checkpointing."
+                        "set_mesh(mesh) (the engine does this automatically)"
+                        "; activations stay replicated")
+            else:
+                x = jnp.asarray(x)
+                # shard the stashed copy along its last partitionable dim;
+                # explicit NamedSharding works inside jit w/o a mesh context
+                spec = [None] * x.ndim
+                sz = mesh.shape[axis]
+                for d in range(x.ndim - 1, -1, -1):
+                    if x.shape[d] % sz == 0 and x.shape[d] >= sz:
+                        spec[d] = axis
+                        break
+                x = jax.lax.with_sharding_constraint(
+                    x, jax.sharding.NamedSharding(
+                        mesh, jax.sharding.PartitionSpec(*spec)))
+        if PA_TO_CPU:
+            try:
+                x = jax.device_put(x, jax.memory.Space.Host)
+            except Exception as e:  # backend without host memory space
+                if not _WARNED_NO_HOST:
+                    _WARNED_NO_HOST = True
+                    logger.warning(
+                        "cpu_checkpointing requested but host memory space "
+                        f"unavailable on this backend ({e}); checkpoints "
+                        "stay in device memory")
+        return x
+    return jax.tree_util.tree_map(place, args)
+
+
+def checkpoint(function, *args, **kwargs):
+    """Checkpoint a forward segment (reference ``CheckpointFunction:314`` /
+    module-level ``checkpoint():578``).
+
+    The segment's outputs are returned; during the backward pass the segment
+    is recomputed instead of its intermediates being saved. Differentiable
+    and jit-compatible: call inside a jitted/`grad`ed function.
+
+    With ``cpu_checkpointing`` the primal inputs (what ``jax.checkpoint``
+    stashes) are placed in host memory before the remat boundary and fetched
+    back to device inside it, so the live fwd→bwd value is the host copy and
+    the backward recompute pays one H2D transfer (reference ``PA_TO_CPU``
+    semantics, ``get_full_inputs:281``).
+    """
+    inner = function
+    if PA_TO_CPU:
+        def inner(*a, _fn=function):
+            def to_dev(x):
+                if _is_floating(x):
+                    try:
+                        return jax.device_put(x, jax.memory.Space.Device)
+                    except Exception:
+                        return x
+                return x
+            return _fn(*jax.tree_util.tree_map(to_dev, a))
+    rematted = jax.checkpoint(inner, **kwargs)
+    args = _constrain_saved(args)
+    if PROFILE_TIME:
+        with jax.named_scope("ds_act_checkpoint"):
+            return rematted(*args)
+    return rematted(*args)
+
+
+def non_reentrant_checkpoint(function, *args):
+    """Alias — JAX remat has no reentrancy distinction."""
+    return checkpoint(function, *args)
+
+
+class CheckpointFunction:
+    """API-parity shim for code written against the reference's
+    ``torch.autograd.Function`` class (``checkpointing.py:314``)."""
+
+    @staticmethod
+    def apply(run_function, *args):
+        return checkpoint(run_function, *args)
+
+
+# ---------------------------------------------------------------------------
+# RNG tracker (reference CudaRNGStatesTracker:147 / Megatron mpu/random.py).
+# JAX RNG is functional, so "states" are just named base keys; fork() hands
+# out a fresh fold_in'd subkey each call, which is the functional analogue of
+# advancing a stateful generator.
+# ---------------------------------------------------------------------------
+
+_MODEL_PARALLEL_RNG = "model-parallel-rng"
+_DATA_PARALLEL_RNG = "data-parallel-rng"
+
+
+class RNGStatesTracker:
+
+    def __init__(self):
+        self._keys = {}
+        self._counts = {}
+
+    def reset(self):
+        self._keys.clear()
+        self._counts.clear()
+
+    def get_states(self):
+        return dict(self._keys), dict(self._counts)
+
+    def set_states(self, states):
+        keys, counts = states
+        self._keys = dict(keys)
+        self._counts = dict(counts)
+
+    def add(self, name: str, seed: int):
+        if name in self._keys:
+            raise Exception(f"rng state {name} already exists")
+        self._keys[name] = jax.random.PRNGKey(seed)
+        self._counts[name] = 0
+
+    def key(self, name: str = _MODEL_PARALLEL_RNG) -> jax.Array:
+        """A fresh subkey from the named stream (advances the stream)."""
+        if name not in self._keys:
+            raise Exception(f"rng state {name} is not added")
+        k = jax.random.fold_in(self._keys[name], self._counts[name])
+        self._counts[name] += 1
+        return k
+
+    class _Fork:
+        def __init__(self, key):
+            self.key = key
+
+        def __enter__(self):
+            return self.key
+
+        def __exit__(self, *exc):
+            return False
+
+    def fork(self, name: str = _MODEL_PARALLEL_RNG):
+        """Context manager yielding a fresh subkey (reference ``fork:186``)."""
+        return self._Fork(self.key(name))
+
+
+_RNG_TRACKER = RNGStatesTracker()
+
+
+def get_rng_tracker() -> RNGStatesTracker:
+    return _RNG_TRACKER
+
+
+# reference-name alias (``get_cuda_rng_tracker:215``)
+get_cuda_rng_tracker = get_rng_tracker
+
+
+def model_parallel_seed(seed: int, model_parallel_rank: Optional[int] = None):
+    """Seed the named RNG streams (reference
+    ``model_parallel_cuda_manual_seed:223``): the data-parallel stream is the
+    raw seed (same across MP ranks), the model-parallel stream is offset per
+    MP rank so dropout differs across tensor shards."""
+    if model_parallel_rank is None:
+        if mpu is not None and hasattr(mpu, "get_model_parallel_rank"):
+            model_parallel_rank = mpu.get_model_parallel_rank()
+        else:
+            model_parallel_rank = 0
+    _RNG_TRACKER.reset()
+    _RNG_TRACKER.add(_DATA_PARALLEL_RNG, seed)
+    _RNG_TRACKER.add(_MODEL_PARALLEL_RNG, seed + 2718 + model_parallel_rank)
+
+
+# reference-name alias
+model_parallel_cuda_manual_seed = model_parallel_seed

@@ -116,6 +116,12 @@ class DeepSpeedEngine:
         self.mesh = build_mesh(mesh_axes)
         self.dp_world_size = axis_size(self.mesh, "data")
         self.mp_world_size = axis_size(self.mesh, "model")
+        # make the mesh known to the activation-checkpointing subsystem so
+        # partition_activations can shard the stash (the reference threads
+        # mpu into deepspeed.checkpointing.configure; here the mesh is it)
+        from deepspeed_tpu.runtime.activation_checkpointing import (
+            checkpointing as _ds_ckpt)
+        _ds_ckpt.set_mesh(self.mesh)
 
         self._config = DeepSpeedConfig(raw, world_size=self.dp_world_size)
         self.mpu = mpu

@@ -262,12 +262,17 @@ class PipelineModule:
             return x
 
         if interval and interval > 0:
+            # route through the checkpointing subsystem so configure()'s
+            # partition/offload knobs apply (reference module.py:323-345
+            # calls deepspeed.checkpointing.checkpoint here)
+            from deepspeed_tpu.runtime.activation_checkpointing import (
+                checkpointing as ds_ckpt)
             lo = start
             while lo < stop:
                 hi = min(lo + interval, stop)
-                x = jax.checkpoint(
-                    lambda x, rng, lo=lo, hi=hi: run_span(x, lo, hi, rng)
-                )(x, rng)
+                x = ds_ckpt.checkpoint(
+                    lambda x, rng, lo=lo, hi=hi: run_span(x, lo, hi, rng),
+                    x, rng)
                 lo = hi
             return x
         return run_span(x, start, stop, rng)
