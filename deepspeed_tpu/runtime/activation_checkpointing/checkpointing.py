@@ -165,11 +165,24 @@ def _is_floating(x) -> bool:
 
 
 def _current_mesh() -> Optional[jax.sharding.Mesh]:
+    """The mesh partition_activations shards over. Precedence: explicit
+    set_mesh() (what the engine wires) > ambient jax.sharding.set_mesh
+    context > legacy `with mesh:` context (deprecated thread_resources —
+    guarded so its eventual removal degrades to the set_mesh path)."""
     if _MESH is not None and not _MESH.empty:
         return _MESH
-    # fall back to an ambient `with mesh:` context if the user entered one
     try:
-        env_mesh = jax.interpreters.pxla.thread_resources.env.physical_mesh
+        gm = jax.sharding.get_mesh()
+        if isinstance(gm, jax.sharding.Mesh) and not gm.empty:
+            return gm
+    except Exception:
+        pass
+    try:
+        import warnings
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", DeprecationWarning)
+            env_mesh = \
+                jax.interpreters.pxla.thread_resources.env.physical_mesh
         if not env_mesh.empty:
             return env_mesh
     except Exception:
@@ -304,12 +317,23 @@ class RNGStatesTracker:
         self._keys[name] = jax.random.PRNGKey(seed)
         self._counts[name] = 0
 
-    def key(self, name: str = _MODEL_PARALLEL_RNG) -> jax.Array:
-        """A fresh subkey from the named stream (advances the stream)."""
+    def key(self, name: str = _MODEL_PARALLEL_RNG, step=None) -> jax.Array:
+        """A fresh subkey from the named stream (advances the stream).
+
+        WARNING (jit semantics): the Python-side counter advances at *trace*
+        time. Calling ``key()`` with no ``step`` inside a jitted train step
+        bakes one constant key into the compiled program — every execution
+        would reuse the same dropout mask. Inside jit, pass the traced step
+        counter: ``tracker.key(step=state.global_step)``; the key is then
+        ``fold_in(base, count, step)`` and varies per executed step. (The
+        framework's own engines thread rng through TrainState instead.)
+        """
         if name not in self._keys:
             raise Exception(f"rng state {name} is not added")
         k = jax.random.fold_in(self._keys[name], self._counts[name])
         self._counts[name] += 1
+        if step is not None:
+            k = jax.random.fold_in(k, step)
         return k
 
     class _Fork:
@@ -322,9 +346,10 @@ class RNGStatesTracker:
         def __exit__(self, *exc):
             return False
 
-    def fork(self, name: str = _MODEL_PARALLEL_RNG):
-        """Context manager yielding a fresh subkey (reference ``fork:186``)."""
-        return self._Fork(self.key(name))
+    def fork(self, name: str = _MODEL_PARALLEL_RNG, step=None):
+        """Context manager yielding a fresh subkey (reference ``fork:186``).
+        See :meth:`key` for the jit caveat — pass ``step`` inside jit."""
+        return self._Fork(self.key(name, step=step))
 
 
 _RNG_TRACKER = RNGStatesTracker()

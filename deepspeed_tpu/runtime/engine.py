@@ -72,10 +72,7 @@ def _tree_cast(tree, dtype):
         else x, tree)
 
 
-def _global_norm(tree):
-    leaves = [jnp.sum(jnp.square(g.astype(jnp.float32)))
-              for g in jax.tree_util.tree_leaves(tree)]
-    return jnp.sqrt(sum(leaves)) if leaves else jnp.zeros((), jnp.float32)
+from deepspeed_tpu.runtime.utils import global_norm as _global_norm
 
 
 class DeepSpeedEngine:

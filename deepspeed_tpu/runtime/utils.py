@@ -1,0 +1,297 @@
+"""Shared runtime utilities (reference ``deepspeed/runtime/utils.py``).
+
+TPU-native re-design notes:
+- ``PartitionedTensor`` (ref ``:379``) — the pipe×MP activation-dedup
+  mechanism — becomes a thin wrapper over in-jit ``lax.all_gather`` when used
+  under ``shard_map`` (axis names replace process groups), and a pure
+  host-side scatter/gather when used eagerly. The CSR-rowptr meta encoding
+  (``to_meta``/``from_meta``, ref ``:458``) is kept verbatim so pipeline
+  stages can hand partitioned activations across the wire.
+- ``CheckOverflow`` (ref ``:41``) — inf/nan detection is a reduction over
+  the grad pytree; the MP-group MAX-allreduce (ref ``:92-99``) becomes a
+  ``lax.pmax`` over the named axis when called inside ``shard_map``; on
+  global (addressable) arrays the values are already global so no collective
+  is needed.
+- ``get_grad_norm``/``get_weight_norm`` (ref ``:154,212``) — pytree norms;
+  under GSPMD a global array's norm is already the model-parallel-correct
+  value, so the reference's "avoid double counting replicated params" rank-0
+  filter (ref ``:171-177``) is unnecessary by construction.
+- ``memory_status``/``see_memory_usage`` (ref ``:489,531``) — read TPU HBM
+  stats from ``device.memory_stats()`` and host RSS from ``resource``.
+"""
+
+import os
+import random
+from typing import Any, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+from deepspeed_tpu.utils.partition import (  # re-export (ref :282-378)
+    partition_balanced, partition_uniform, prefix_sum_inc)
+
+__all__ = [
+    "ensure_directory_exists", "set_random_seed", "CheckOverflow",
+    "get_grad_norm", "get_weight_norm", "global_norm",
+    "partition_uniform", "partition_balanced", "prefix_sum_inc",
+    "PartitionedTensor", "memory_status", "see_memory_usage", "call_to_str",
+]
+
+
+def ensure_directory_exists(filename: str):
+    """mkdir -p the parent of ``filename`` (ref ``:23``)."""
+    dirname = os.path.dirname(filename)
+    if dirname:
+        os.makedirs(dirname, exist_ok=True)
+
+
+def set_random_seed(seed: int):
+    """Seed python/numpy RNGs and return a JAX PRNG key (ref ``:33`` seeds
+    torch; JAX RNG is functional so the key is returned, not installed)."""
+    random.seed(seed)
+    np.random.seed(seed)
+    return jax.random.PRNGKey(seed)
+
+
+def _leaves(tree) -> List[jax.Array]:
+    return [x for x in jax.tree_util.tree_leaves(tree)
+            if hasattr(x, "dtype") and jnp.issubdtype(
+                jnp.asarray(x).dtype, jnp.inexact)]
+
+
+def _axis_reduce_max(flag: jax.Array, axis_names: Sequence[str]):
+    """MAX-reduce a boolean over named mesh axes when they are bound (i.e.
+    inside shard_map). Under plain jit on global arrays the axes are unbound
+    — the value is already global, so the reduction is skipped."""
+    for ax in axis_names:
+        if not isinstance(flag, jax.core.Tracer):
+            break  # concrete: nothing to reduce over
+        try:
+            flag = jax.lax.pmax(flag.astype(jnp.int32), ax) > 0
+        except NameError:  # unbound axis: plain jit over global arrays
+            break
+    return flag
+
+
+class CheckOverflow:
+    """Inf/nan detection across the grad pytree (ref ``:41``).
+
+    ``axis_names``: mesh axes to MAX-reduce the flag over when invoked
+    inside ``shard_map`` (the analogue of the reference's model-parallel /
+    world allreduce). On global arrays no reduction is needed.
+    """
+
+    def __init__(self, param_groups=None, mpu=None,
+                 zero_reduce_scatter: bool = False,
+                 axis_names: Sequence[str] = ()):
+        self.mpu = mpu
+        self.params = param_groups
+        self.zero_reduce_scatter = zero_reduce_scatter
+        self.axis_names = tuple(axis_names)
+
+    @staticmethod
+    def _has_inf_or_nan(x) -> jax.Array:
+        x = jnp.asarray(x)
+        return ~jnp.all(jnp.isfinite(x.astype(jnp.float32)))
+
+    def has_overflow(self, grads) -> jax.Array:
+        """Boolean (traced or concrete): any non-finite value in ``grads``,
+        reduced over ``axis_names`` when traced inside shard_map."""
+        leaves = _leaves(grads)
+        if not leaves:
+            return jnp.asarray(False)
+        flag = jnp.any(jnp.stack([self._has_inf_or_nan(g) for g in leaves]))
+        return _axis_reduce_max(flag, self.axis_names)
+
+    def check(self, param_groups=None):
+        groups = param_groups if param_groups is not None else self.params
+        assert groups is not None, \
+            "self.params and param_groups both cannot be none"
+        return self.has_overflow(groups)
+
+    def check_using_norm(self, norm_group, reduce_overflow: bool = True):
+        """-1 in a norm group signals overflow (ref ``:53``)."""
+        norms = jnp.stack([jnp.asarray(n, jnp.float32)
+                           for n in jax.tree_util.tree_leaves(norm_group)])
+        flag = jnp.any(norms == -1.0)
+        return _axis_reduce_max(flag, self.axis_names)
+
+
+def global_norm(tree, norm_type: float = 2.0) -> jax.Array:
+    """Norm over every inexact leaf of a pytree."""
+    leaves = _leaves(tree)
+    if not leaves:
+        return jnp.asarray(0.0, jnp.float32)
+    if norm_type == float("inf"):
+        return jnp.max(jnp.stack(
+            [jnp.max(jnp.abs(x.astype(jnp.float32))) for x in leaves]))
+    norm_type = float(norm_type)
+    total = sum(jnp.sum(jnp.abs(x.astype(jnp.float32)) ** norm_type)
+                for x in leaves)
+    return total ** (1.0 / norm_type)
+
+
+def _guard_norm(norm: jax.Array) -> jax.Array:
+    """Reference returns -1 for inf/nan norms (ref ``:205-208``)."""
+    bad = ~jnp.isfinite(norm)
+    return jnp.where(bad, -1.0, norm)
+
+
+def get_grad_norm(gradients, norm_type: float = 2.0,
+                  mpu=None) -> jax.Array:
+    """Grad norm with the reference's -1-on-overflow convention
+    (ref ``:154``). ``mpu`` accepted for API parity; under GSPMD the norm of
+    a global array is already aggregated across model-parallel shards."""
+    return _guard_norm(global_norm(gradients, norm_type))
+
+
+def get_weight_norm(parameters, norm_type: float = 2.0,
+                    mpu=None) -> jax.Array:
+    """Weight norm (ref ``:212``), same conventions as get_grad_norm."""
+    return _guard_norm(global_norm(parameters, norm_type))
+
+
+class PartitionedTensor:
+    """A tensor scattered 1/N over a group (ref ``:379``).
+
+    Two modes:
+    - **eager** (``axis_name=None``): operates on concrete arrays; ``full()``
+      reconstructs from the locally stored part plus ``parts`` handed in by
+      peers (single-controller: all parts are addressable).
+    - **in-jit** (``axis_name='model'`` inside ``shard_map``): the local part
+      is this shard's slice; ``full()`` is a ``lax.all_gather`` over the
+      named axis — the XLA-native form of the reference's
+      ``dist.all_gather`` (ref ``:449``).
+
+    Meta encoding kept from the reference (ref ``to_meta:458``):
+    ``[ndims, *shape, num_parts, rank, 0, part_1, ..., part_num_parts]``.
+    """
+
+    def __init__(self, tensor=None, num_parts: int = 1, rank: int = 0,
+                 axis_name: Optional[str] = None):
+        self.axis_name = axis_name
+        self.num_parts = num_parts
+        self.rank = rank
+        if tensor is not None:
+            self.orig_size = list(tensor.shape)
+            self.local_data, self.partition = self._partition_tensor(tensor)
+        else:
+            self.orig_size = []
+            self.local_data = None
+            self.partition = []
+
+    # -- construction ---------------------------------------------------- #
+    def _partition_tensor(self, tensor):
+        flat = jnp.ravel(tensor)
+        if self.axis_name is not None:
+            # in-jit: uniform padded slices so shapes are static
+            numel = flat.shape[0]
+            chunk = -(-numel // self.num_parts)
+            padded = jnp.pad(flat, (0, chunk * self.num_parts - numel))
+            idx = jax.lax.axis_index(self.axis_name)
+            local = jax.lax.dynamic_slice_in_dim(padded, idx * chunk, chunk)
+            partition = [min(i * chunk, numel)
+                         for i in range(self.num_parts + 1)]
+            return local, partition
+        partition = partition_uniform(flat.shape[0], self.num_parts)
+        start = partition[self.rank]
+        local = flat[start:partition[self.rank + 1]]
+        return local, partition
+
+    @classmethod
+    def from_meta(cls, meta, local_part, num_parts: Optional[int] = None,
+                  axis_name: Optional[str] = None):
+        """Rebuild from a meta vector + this rank's part (ref ``:392``)."""
+        meta = [int(v) for v in np.asarray(meta).tolist()]
+        ndims = meta[0]
+        obj = cls(tensor=None, axis_name=axis_name)
+        obj.orig_size = meta[1:1 + ndims]
+        rest = meta[1 + ndims:]
+        obj.num_parts = rest[0]
+        obj.rank = rest[1]
+        obj.partition = rest[2:]
+        obj.local_data = local_part
+        if num_parts is not None:
+            assert obj.num_parts == num_parts
+        return obj
+
+    # -- API -------------------------------------------------------------- #
+    def to_meta(self) -> np.ndarray:
+        meta = [len(self.orig_size)] + list(self.orig_size)
+        meta += [self.num_parts, self.rank]
+        meta += list(self.partition)
+        return np.asarray(meta, dtype=np.int64)
+
+    def full_size(self):
+        return tuple(self.orig_size)
+
+    def data(self):
+        return self.local_data
+
+    def full(self, parts: Optional[List[Any]] = None):
+        """Reconstruct the full tensor.
+
+        In-jit: all_gather over ``axis_name``. Eager: concatenate ``parts``
+        (or treat local_data as the whole thing when num_parts == 1).
+        """
+        numel = int(np.prod(self.orig_size)) if self.orig_size else 0
+        if self.axis_name is not None:
+            gathered = jax.lax.all_gather(self.local_data, self.axis_name,
+                                          tiled=True)
+            return gathered[:numel].reshape(self.full_size())
+        if parts is None:
+            assert self.num_parts == 1, \
+                "eager full() with num_parts>1 needs all peer parts"
+            parts = [self.local_data]
+        assert len(parts) == self.num_parts
+        flat = jnp.concatenate([jnp.ravel(p) for p in parts])
+        return flat[:numel].reshape(self.full_size())
+
+
+def memory_status(msg: str = "", print_rank: int = -1,
+                  reset_max: bool = False):
+    """Log accelerator memory stats (ref ``:489``). Returns the stats dict
+    of device 0 (bytes) or None when the backend exposes none."""
+    try:
+        dev = jax.local_devices()[0]
+        stats = dev.memory_stats() or {}
+    except Exception:
+        stats = {}
+    if stats:
+        in_use = stats.get("bytes_in_use", 0)
+        peak = stats.get("peak_bytes_in_use", 0)
+        limit = stats.get("bytes_limit", 0)
+        logger.info(
+            f"MEMSTATS {msg} device={dev.platform} "
+            f"current={in_use / 2**30:.3f}GB peak={peak / 2**30:.3f}GB "
+            f"limit={limit / 2**30:.3f}GB")
+    else:
+        logger.info(f"MEMSTATS {msg} (no device memory stats available)")
+    return stats or None
+
+
+def see_memory_usage(message: str = "", force: bool = True):
+    """Log device + host memory usage (ref ``:531``)."""
+    if not force:
+        return
+    memory_status(message)
+    try:
+        import resource
+        rss_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        logger.info(f"MEMSTATS {message} host max_rss={rss_kb / 2**20:.3f}GB")
+    except Exception:
+        pass
+
+
+def call_to_str(base: str, *args, **kwargs) -> str:
+    """Printable function-call string (ref ``:556``)."""
+    name = f"{base}("
+    if args:
+        name += ", ".join(repr(a) for a in args)
+        if kwargs:
+            name += ", "
+    if kwargs:
+        name += ", ".join(f"{k}={v!r}" for k, v in kwargs.items())
+    return name + ")"

@@ -1,0 +1,119 @@
+"""Runtime utils tests (mirror reference tests/unit/test_runtime_utils.py +
+test_partition.py: CheckOverflow, norms, PartitionedTensor round-trips incl.
+in-jit all_gather mode under shard_map)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deepspeed_tpu.runtime import utils as ds_utils
+
+
+def test_check_overflow_basic():
+    co = ds_utils.CheckOverflow()
+    good = {"a": jnp.ones((4,)), "b": jnp.zeros((2, 2))}
+    bad = {"a": jnp.ones((4,)), "b": jnp.array([1.0, jnp.inf])}
+    nan = {"a": jnp.array([jnp.nan])}
+    assert not bool(co.has_overflow(good))
+    assert bool(co.has_overflow(bad))
+    assert bool(co.has_overflow(nan))
+
+
+def test_check_overflow_via_norm():
+    co = ds_utils.CheckOverflow()
+    assert bool(co.check_using_norm([2.0, -1.0]))
+    assert not bool(co.check_using_norm([2.0, 3.0]))
+
+
+def test_check_overflow_in_jit_with_axis():
+    devs = np.array(jax.devices()[:4])
+    mesh = Mesh(devs, ("model",))
+    co = ds_utils.CheckOverflow(axis_names=("model",))
+
+    @jax.jit
+    def f(x):
+        def inner(xs):
+            return co.has_overflow({"g": xs})
+        return shard_map(inner, mesh=mesh, in_specs=P("model"),
+                         out_specs=P())(x)
+
+    x = np.ones((8,), np.float32)
+    assert not bool(f(x))
+    x[6] = np.inf  # lives on one shard only; pmax must propagate
+    assert bool(f(x))
+
+
+def test_grad_norm_conventions():
+    g = {"w": jnp.array([3.0, 4.0])}
+    np.testing.assert_allclose(ds_utils.get_grad_norm(g), 5.0, rtol=1e-6)
+    np.testing.assert_allclose(
+        ds_utils.get_grad_norm(g, norm_type=float("inf")), 4.0)
+    bad = {"w": jnp.array([jnp.inf])}
+    assert float(ds_utils.get_grad_norm(bad)) == -1.0
+    assert float(ds_utils.get_weight_norm(bad)) == -1.0
+
+
+def test_partitioned_tensor_eager_roundtrip():
+    x = jnp.arange(10.0)
+    parts = []
+    metas = []
+    for rank in range(3):
+        pt = ds_utils.PartitionedTensor(x, num_parts=3, rank=rank)
+        parts.append(pt.data())
+        metas.append(pt.to_meta())
+    # uneven split: partition_uniform boundaries
+    assert sum(p.shape[0] for p in parts) == 10
+    # reconstruct on the consumer side from meta + parts (ref from_meta:391)
+    pt0 = ds_utils.PartitionedTensor.from_meta(metas[1], parts[1])
+    full = pt0.full(parts=parts)
+    np.testing.assert_array_equal(np.asarray(full), np.asarray(x))
+    assert pt0.full_size() == (10,)
+    assert pt0.rank == 1 and pt0.num_parts == 3
+
+
+def test_partitioned_tensor_meta_encoding():
+    x = jnp.ones((4, 6))
+    pt = ds_utils.PartitionedTensor(x, num_parts=2, rank=0)
+    meta = pt.to_meta()
+    assert meta.dtype == np.int64
+    assert list(meta[:3]) == [2, 4, 6]  # ndims, shape
+
+
+def test_partitioned_tensor_in_jit_allgather():
+    devs = np.array(jax.devices()[:4])
+    mesh = Mesh(devs, ("model",))
+    x = jnp.arange(22.0)  # not divisible by 4: padded chunks
+
+    @jax.jit
+    def f(x):
+        def inner(x_full):
+            # every shard sees the full replicated tensor, partitions it,
+            # keeps its slice, then reconstructs via all_gather
+            pt = ds_utils.PartitionedTensor(x_full[0], num_parts=4,
+                                            axis_name="model")
+            return pt.full()[None]
+        return shard_map(inner, mesh=mesh, in_specs=P(None),
+                         out_specs=P(None), check_rep=False)(x[None])
+
+    np.testing.assert_array_equal(np.asarray(f(x))[0], np.asarray(x))
+
+
+def test_memory_status_and_see_memory_usage(caplog):
+    ds_utils.memory_status("probe")
+    ds_utils.see_memory_usage("probe", force=True)
+    ds_utils.see_memory_usage("skipped", force=False)
+
+
+def test_call_to_str():
+    assert ds_utils.call_to_str("f", 1, "a", k=2) == "f(1, 'a', k=2)"
+    assert ds_utils.call_to_str("g") == "g()"
+
+
+def test_set_random_seed_returns_key():
+    k = ds_utils.set_random_seed(7)
+    v1 = jax.random.normal(k, (3,))
+    v2 = jax.random.normal(ds_utils.set_random_seed(7), (3,))
+    np.testing.assert_array_equal(v1, v2)
