@@ -297,4 +297,13 @@ def build_optimizer(name: str, params_dict: Optional[dict]) -> Optimizer:
                    momentum=p.pop("momentum", 0.0),
                    weight_decay=p.pop("weight_decay", 0.0),
                    nesterov=p.pop("nesterov", False))
+    if name in ("onebitadam", "onebit_adam", "one_bit_adam"):
+        # (reference engine.py:544 selects ONEBIT_ADAM_OPTIMIZER)
+        from deepspeed_tpu.runtime.fp16.onebit_adam import OnebitAdam
+        return OnebitAdam(lr=p.pop("lr", 1e-3),
+                          freeze_step=p.pop("freeze_step", 100000),
+                          betas=tuple(p.pop("betas", (0.9, 0.999))),
+                          eps=p.pop("eps", 1e-8),
+                          weight_decay=p.pop("weight_decay", 0.0),
+                          cuda_aware=p.pop("cuda_aware", False))
     raise ValueError(f"Unknown optimizer: {name}")

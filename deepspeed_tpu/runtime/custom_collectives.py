@@ -1,0 +1,145 @@
+"""Compressed collectives for 1-bit Adam, TPU-native.
+
+Reference: ``deepspeed/runtime/custom_collectives.py`` (MPI gather/allgather,
+``gather_cuda:23`` / ``allgather_cuda:113``) + the compression math in
+``deepspeed/runtime/fp16/onebit_adam.py`` (``Compressed_Allreduce:104``:
+sign+scale with error feedback, cupy ``packbits``, 2-phase gather+allgather).
+
+TPU re-design: the whole compressed allreduce is ONE jit-traceable function
+running inside ``shard_map`` over a named mesh axis. The MPI side-channel
+disappears:
+
+- phase 1 "gather to chunk owners"  → ``lax.all_to_all``  (each rank ships
+  its packed sign chunk j to rank j) + ``lax.all_gather`` of the fp32 scales
+- phase 2 "allgather server chunks" → ``lax.all_gather`` of the re-packed
+  server chunk + server scales
+
+Payload on the wire is uint8-packed sign bits (32× smaller than fp32) plus
+one fp32 scale per chunk — the same ≤5× e2e communication-volume reduction
+the reference claims (BASELINE.md: 1-bit Adam row). Packing/unpacking is a
+reshape+dot that XLA vectorizes on the VPU; no Pallas needed.
+"""
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["pack_signs", "unpack_signs", "compressed_allreduce",
+           "CompressedAllreduceResult", "padded_numel", "server_chunk_size"]
+
+_BITS = 8
+_POWERS = 2 ** np.arange(_BITS - 1, -1, -1, dtype=np.uint8)  # MSB-first
+
+
+def pack_signs(x: jax.Array) -> jax.Array:
+    """Pack sign bits of ``x`` (flat, numel % 8 == 0) into uint8, MSB-first
+    (cupy.packbits convention, ref onebit_adam.py:97-100). bit=1 ⇔ x >= 0."""
+    bits = (x >= 0).astype(jnp.uint8).reshape(-1, _BITS)
+    return (bits * _POWERS).sum(axis=1).astype(jnp.uint8)
+
+
+def unpack_signs(packed: jax.Array, dtype=jnp.float32) -> jax.Array:
+    """Inverse of :func:`pack_signs`: uint8 → ±1 values (ref ``:167-173``
+    unpackbits then ``.add_(-0.5).mul_(2.0)``)."""
+    bits = (packed[:, None] & _POWERS) > 0
+    return jnp.where(bits, 1.0, -1.0).astype(dtype).reshape(-1)
+
+
+def padded_numel(numel: int, world_size: int, divider: int = _BITS) -> int:
+    """Corrected tensor size: numel rounded up so each of the world_size
+    server chunks is a multiple of ``divider`` bits
+    (ref onebit_adam.py:294-300 ``corrected_tensor_size``)."""
+    quantum = world_size * divider
+    return numel + (-numel) % quantum
+
+
+def server_chunk_size(numel: int, world_size: int) -> int:
+    return padded_numel(numel, world_size) // world_size
+
+
+class CompressedAllreduceResult(NamedTuple):
+    tensor: jax.Array        # averaged, decompressed (original shape)
+    worker_error: jax.Array  # updated worker error feedback (padded flat)
+    server_error: jax.Array  # updated server error feedback (chunk flat)
+
+
+def _sign_compress(x: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """sign+scale compression: returns (scale, signs ±1, new_error).
+    scale = ||x|| / sqrt(numel) (ref ``:123``); error = x - scale*sign."""
+    scale = jnp.linalg.norm(x) / np.sqrt(x.size)
+    signs = jnp.where(x >= 0, 1.0, -1.0).astype(x.dtype)
+    return scale, signs, x - scale * signs
+
+
+def compressed_allreduce(
+        buffer_m: jax.Array,
+        worker_error: jax.Array,
+        server_error: jax.Array,
+        axis_name: Optional[str] = None,
+        world_size: int = 1) -> CompressedAllreduceResult:
+    """Error-compensated 1-bit averaging allreduce
+    (ref ``Compressed_Allreduce:104``).
+
+    Call inside ``shard_map`` with ``axis_name`` bound (each rank passes its
+    own local ``buffer_m``); with ``world_size == 1`` / no axis it degrades
+    to local sign+scale compression with error feedback (useful for tests
+    and single-chip parity).
+
+    ``worker_error`` must have ``padded_numel(buffer_m.size, world_size)``
+    elements; ``server_error`` one server chunk.
+    """
+    orig_shape = buffer_m.shape
+    orig_size = int(np.prod(orig_shape))
+    flat = buffer_m.reshape(-1).astype(jnp.float32)
+    padded = worker_error.shape[0]
+    chunk = padded // world_size
+    assert padded == padded_numel(orig_size, world_size), \
+        f"worker_error size {padded} != padded_numel({orig_size}, {world_size})"
+    assert server_error.shape[0] == chunk
+
+    if padded != orig_size:
+        flat = jnp.concatenate(
+            [flat, jnp.zeros((padded - orig_size,), jnp.float32)])
+
+    # ---- worker-side compression with error feedback (ref :122-128) ----
+    compensated = flat + worker_error
+    worker_scale, signs, new_worker_error = _sign_compress(compensated)
+    packed = pack_signs(signs).reshape(world_size, chunk // _BITS)
+
+    if axis_name is None or world_size == 1:
+        assert world_size == 1, "axis_name is required when world_size > 1"
+        # degenerate single-rank path: the server sees exactly this worker
+        comp_server = signs * worker_scale + server_error
+        server_scale, s_signs, new_server_error = _sign_compress(comp_server)
+        out = (s_signs * server_scale)[:orig_size]
+        return CompressedAllreduceResult(
+            tensor=out.reshape(orig_shape),
+            worker_error=new_worker_error,
+            server_error=new_server_error)
+
+    # ---- phase 1: ship chunk j to rank j (ref gather_cuda:23) ----------
+    # all_to_all over leading axis: row j of the result came from rank j
+    recv_sign = jax.lax.all_to_all(packed, axis_name, split_axis=0,
+                                   concat_axis=0, tiled=False)
+    scales = jax.lax.all_gather(worker_scale, axis_name)  # (world,)
+
+    # ---- server-side: average contributions, recompress (ref :167-186) -
+    # recv_sign: (world, chunk/8) — contribution of every worker to MY chunk
+    unpacked = jax.vmap(lambda r: unpack_signs(r))(recv_sign)  # (world, chunk)
+    server_m = (unpacked * scales[:, None]).mean(axis=0)
+    comp_server = server_m + server_error
+    server_scale, s_signs, new_server_error = _sign_compress(comp_server)
+    server_packed = pack_signs(s_signs)
+
+    # ---- phase 2: allgather server chunks (ref allgather_cuda:113) -----
+    all_server_sign = jax.lax.all_gather(server_packed, axis_name)
+    all_server_scale = jax.lax.all_gather(server_scale, axis_name)
+    full = jax.vmap(lambda r, s: unpack_signs(r) * s)(
+        all_server_sign, all_server_scale).reshape(-1)
+
+    return CompressedAllreduceResult(
+        tensor=full[:orig_size].reshape(orig_shape),
+        worker_error=new_worker_error,
+        server_error=new_server_error)

@@ -165,6 +165,19 @@ class DeepSpeedEngine:
                                              self._config.optimizer_params)
         self.base_lr = getattr(self.optimizer, "lr", 1e-3)
 
+        # 1-bit Adam phase tracking (reference onebit_adam.py:369-372 flips
+        # adam_freeze_key python-side; here the phase is a static compile
+        # flag so XLA gets two clean programs). With dp > 1 the engine runs
+        # the WHOLE grad+update under shard_map over 'data' so each rank
+        # holds a local gradient and the compressed allreduce is the only
+        # cross-rank traffic in the compression phase (the reference
+        # disables dense backward allreduce at :369-372 for the same
+        # reason).
+        from deepspeed_tpu.runtime.fp16.onebit_adam import OnebitAdam
+        self._onebit = isinstance(self.optimizer, OnebitAdam)
+        self._onebit_compression = False
+        self._onebit_dist = False
+
         # -- lr scheduler --
         if lr_scheduler is not None:
             self.lr_scheduler = lr_scheduler
@@ -174,6 +187,17 @@ class DeepSpeedEngine:
 
         # -- zero stage / shardings --
         self.zero_stage = self._config.zero_optimization_stage
+        if self._onebit:
+            # reference parity: OnebitAdam is not a ZeRO-supported optimizer
+            # (zero/utils.py is_zero_supported_optimizer lists only
+            # Adam-family fused/CPU optimizers)
+            assert self.zero_stage == 0, \
+                "OneBitAdam does not compose with ZeRO (reference " \
+                "zero/utils.py is_zero_supported_optimizer); use stage 0"
+            if self.dp_world_size > 1:
+                self._onebit_dist = True
+                self.optimizer.axis_name = "data"
+                self.optimizer.world_size = self.dp_world_size
         self.param_specs = param_specs  # tensor-parallel PartitionSpecs
         master_params = _tree_cast(model_parameters, jnp.float32)
         if self.zero_stage >= 1:
@@ -192,17 +216,43 @@ class DeepSpeedEngine:
                 model_specs=None)
         else:
             self._opt_shardings = replicated_shardings(opt_state, self.mesh)
+        if self._onebit_dist:
+            # per-rank error-feedback state: leading (dp,) dim sharded over
+            # 'data' — each shard owns its own worker/server error
+            dp = self.dp_world_size
+            data_shd = NamedSharding(self.mesh, PartitionSpec("data"))
+            opt_state = opt_state._replace(
+                worker_error=jax.tree_util.tree_map(
+                    lambda e: jnp.zeros((dp,) + e.shape, e.dtype),
+                    opt_state.worker_error),
+                server_error=jax.tree_util.tree_map(
+                    lambda e: jnp.zeros((dp,) + e.shape, e.dtype),
+                    opt_state.server_error))
+            self._opt_shardings = self._opt_shardings._replace(
+                worker_error=jax.tree_util.tree_map(
+                    lambda _: data_shd, opt_state.worker_error),
+                server_error=jax.tree_util.tree_map(
+                    lambda _: data_shd, opt_state.server_error))
 
         self.gradient_accumulation_steps = self._config.gradient_accumulation_steps
         if self.gradient_accumulation_steps > 1:
-            accum = jax.tree_util.tree_map(
-                lambda p: jnp.zeros(p.shape, jnp.float32), params)
-            if self.zero_stage >= 2:
-                accum_shardings = zero_shardings(accum, self.mesh,
-                                                 stage=self.zero_stage,
-                                                 model_specs=param_specs)
+            if self._onebit_dist:
+                # stacked per-rank local-grad accumulators
+                dp = self.dp_world_size
+                accum = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros((dp,) + p.shape, jnp.float32), params)
+                accum_shardings = jax.tree_util.tree_map(
+                    lambda _: NamedSharding(self.mesh, PartitionSpec("data")),
+                    accum)
             else:
-                accum_shardings = replicated_shardings(accum, self.mesh)
+                accum = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), params)
+                if self.zero_stage >= 2:
+                    accum_shardings = zero_shardings(accum, self.mesh,
+                                                     stage=self.zero_stage,
+                                                     model_specs=param_specs)
+                else:
+                    accum_shardings = replicated_shardings(accum, self.mesh)
         else:
             accum, accum_shardings = (), ()
 
@@ -366,6 +416,75 @@ class DeepSpeedEngine:
         grads = _tree_cast(grads, jnp.float32)
         return loss, aux, grads
 
+    # -- 1-bit Adam distributed path --------------------------------------
+    def _compute_local_grads(self, params, batch, rng, scale):
+        """Per-data-shard gradients, stacked on a leading (dp,) axis sharded
+        over 'data'. Under shard_map XLA does NOT insert the dense grad
+        allreduce — each rank keeps its local gradient, which is what the
+        1-bit compressed momentum exchange needs (reference disables
+        enable_backward_allreduce, onebit_adam.py:369-372)."""
+        P = PartitionSpec
+        repl = lambda tree: jax.tree_util.tree_map(lambda _: P(), tree)
+
+        def inner(p, b, r, s):
+            r = jax.random.fold_in(r, jax.lax.axis_index("data"))
+            loss, _aux, g = self._compute_loss_and_grads(p, b, r, s)
+            loss = jax.lax.pmean(loss, "data")
+            return loss, jax.tree_util.tree_map(lambda x: x[None], g)
+
+        loss, grads = jax.shard_map(
+            inner, mesh=self.mesh,
+            in_specs=(repl(params),
+                      jax.tree_util.tree_map(lambda _: P("data"), batch),
+                      P(), P()),
+            out_specs=(P(),
+                       jax.tree_util.tree_map(lambda _: P("data"), params)),
+            check_vma=False)(params, batch, rng, scale)
+        return loss, None, grads
+
+    def _onebit_shard_update(self, params, opt_state, grads_stacked, lr):
+        """Run the OnebitAdam update inside shard_map over 'data': each rank
+        updates momentum with its local grad, then the compressed allreduce
+        (or warmup pmean) is the only cross-rank communication."""
+        P = PartitionSpec
+        repl = lambda tree: jax.tree_util.tree_map(lambda _: P(), tree)
+        data = lambda tree: jax.tree_util.tree_map(lambda _: P("data"), tree)
+        from deepspeed_tpu.runtime.fp16.onebit_adam import OnebitAdamState
+
+        def upd(p, m, v, step, we, se, g, lr_):
+            take0 = lambda tree: jax.tree_util.tree_map(
+                lambda x: x[0], tree)
+            st = OnebitAdamState(step=step, exp_avg=m, exp_avg_sq=v,
+                                 worker_error=take0(we),
+                                 server_error=take0(se))
+            new_p, new_st = self.optimizer.update(
+                take0(g), st, p, lr=lr_,
+                compression=self._onebit_compression)
+            lead = lambda tree: jax.tree_util.tree_map(
+                lambda x: x[None], tree)
+            return (new_p, new_st.exp_avg, new_st.exp_avg_sq, new_st.step,
+                    lead(new_st.worker_error), lead(new_st.server_error))
+
+        outs = jax.shard_map(
+            upd, mesh=self.mesh,
+            in_specs=(repl(params), repl(opt_state.exp_avg),
+                      repl(opt_state.exp_avg_sq), P(),
+                      data(opt_state.worker_error),
+                      data(opt_state.server_error),
+                      data(grads_stacked), P()),
+            out_specs=(repl(params), repl(opt_state.exp_avg),
+                       repl(opt_state.exp_avg_sq), P(),
+                       data(opt_state.worker_error),
+                       data(opt_state.server_error)),
+            check_vma=False)(
+            params, opt_state.exp_avg, opt_state.exp_avg_sq,
+            opt_state.step, opt_state.worker_error,
+            opt_state.server_error, grads_stacked, lr)
+        new_params, m, v, step, we, se = outs
+        return new_params, OnebitAdamState(
+            step=step, exp_avg=m, exp_avg_sq=v,
+            worker_error=we, server_error=se)
+
     def _apply_update(self, state: TrainState, grads) -> TrainState:
         """Optimizer boundary: unscale, clip, update, loss-scale bookkeeping.
         (reference stage2.py:1331 step / engine.py:865 _take_model_step)"""
@@ -378,7 +497,13 @@ class DeepSpeedEngine:
             overflow = jnp.zeros((), bool)
 
         if self.gradient_clipping > 0:
-            norm = _global_norm(grads)
+            if self._onebit_dist:
+                # stacked local grads: clip by the norm of the averaged
+                # gradient (what the dense path would see)
+                norm = _global_norm(jax.tree_util.tree_map(
+                    lambda g: g.mean(axis=0), grads))
+            else:
+                norm = _global_norm(grads)
             clip = jnp.minimum(1.0, self.gradient_clipping /
                                (norm + 1e-6))
             grads = jax.tree_util.tree_map(lambda g: g * clip, grads)
@@ -387,15 +512,27 @@ class DeepSpeedEngine:
 
         def do_update(operand):
             params, opt_state, g = operand
+            if self._onebit_dist:
+                return self._onebit_shard_update(params, opt_state, g, lr)
+            if self._onebit:
+                return self.optimizer.update(
+                    g, opt_state, params, lr=lr,
+                    compression=self._onebit_compression)
             return self.optimizer.update(g, opt_state, params, lr=lr)
 
         def skip_update(operand):
             params, opt_state, _ = operand
             return params, opt_state
 
-        new_params, new_opt = jax.lax.cond(
-            overflow, skip_update, do_update,
-            (state.params, state.opt_state, grads))
+        if self.fp16_enabled:
+            new_params, new_opt = jax.lax.cond(
+                overflow, skip_update, do_update,
+                (state.params, state.opt_state, grads))
+        else:
+            # overflow is statically False (bf16/fp32): no cond — keeps
+            # collectives (1-bit allreduce) out of conditional branches
+            new_params, new_opt = do_update(
+                (state.params, state.opt_state, grads))
 
         new_scale = self.loss_scaler.update(state.loss_scale, overflow)
         zero_accum = jax.tree_util.tree_map(jnp.zeros_like,
@@ -413,8 +550,12 @@ class DeepSpeedEngine:
     def _micro_step(self, state: TrainState, batch) -> Tuple[TrainState, Any]:
         """One fused micro-batch step: fwd + bwd + accumulate + maybe-apply."""
         rng, sub = jax.random.split(state.rng)
-        loss, aux, grads = self._compute_loss_and_grads(
-            state.params, batch, sub, state.loss_scale.scale)
+        if self._onebit_dist:
+            loss, aux, grads = self._compute_local_grads(
+                state.params, batch, sub, state.loss_scale.scale)
+        else:
+            loss, aux, grads = self._compute_loss_and_grads(
+                state.params, batch, sub, state.loss_scale.scale)
 
         if self.gradient_accumulation_steps > 1:
             accum = jax.tree_util.tree_map(jnp.add, state.accum_grads, grads)
@@ -454,8 +595,12 @@ class DeepSpeedEngine:
         if self._compiled_grad is None:
             def fwd(state, batch):
                 rng, sub = jax.random.split(state.rng)
-                loss, aux, grads = self._compute_loss_and_grads(
-                    state.params, batch, sub, state.loss_scale.scale)
+                if self._onebit_dist:
+                    loss, aux, grads = self._compute_local_grads(
+                        state.params, batch, sub, state.loss_scale.scale)
+                else:
+                    loss, aux, grads = self._compute_loss_and_grads(
+                        state.params, batch, sub, state.loss_scale.scale)
                 return loss, grads, rng
             self._compiled_grad = jax.jit(fwd)
         loss, grads, rng = self._compiled_grad(self.state, batch)
@@ -491,9 +636,28 @@ class DeepSpeedEngine:
             self.timers("backward").stop()
         return loss
 
+    def _maybe_switch_onebit_phase(self):
+        """Enter 1-bit compression once global_steps reaches freeze_step
+        (reference onebit_adam.py:369-372). Recompiles the step functions —
+        a one-time cost at the phase boundary."""
+        if not self._onebit or self._onebit_compression:
+            return  # phase is monotonic: once on, stay on (no per-step sync)
+        # _host_global_step over-counts vs the device value only by overflow
+        # skips, which don't occur pre-freeze in practice; using it avoids a
+        # device->host sync per step (see the host-mirror comment at init)
+        phase = self._host_global_step >= self.optimizer.freeze_step
+        if phase != self._onebit_compression:
+            self._onebit_compression = phase
+            self._compiled_micro_step = None
+            self._compiled_apply = None
+            self._compiled_grad = None
+            log_dist(f"OnebitAdam: compression phase = {phase} "
+                     f"(step {self.global_steps})", ranks=[0])
+
     def step(self):
         """Apply the optimizer at the accumulation boundary
         (reference engine.py:903)."""
+        self._maybe_switch_onebit_phase()
         if self.wall_clock_breakdown_enabled:
             self.timers("step").start()
         ga = self.gradient_accumulation_steps
@@ -539,6 +703,7 @@ class DeepSpeedEngine:
                     self.training_dataloader))
             data_iter = self._train_iter
 
+        self._maybe_switch_onebit_phase()
         step_fn = self._get_compiled_micro_step()
         self.tput_timer.start()
         total = None

@@ -98,11 +98,12 @@ class CheckOverflow:
 
     def has_overflow(self, grads) -> jax.Array:
         """Boolean (traced or concrete): any non-finite value in ``grads``,
-        reduced over ``axis_names`` when traced inside shard_map."""
-        leaves = _leaves(grads)
-        if not leaves:
-            return jnp.asarray(False)
-        flag = jnp.any(jnp.stack([self._has_inf_or_nan(g) for g in leaves]))
+        reduced over ``axis_names`` when traced inside shard_map. The leaf
+        scan delegates to the single shared implementation in
+        fp16/loss_scaler.py (what the engine uses)."""
+        from deepspeed_tpu.runtime.fp16.loss_scaler import has_overflow
+        flag = has_overflow(
+            [jnp.asarray(x) for x in _leaves(grads)])
         return _axis_reduce_max(flag, self.axis_names)
 
     def check(self, param_groups=None):

@@ -6,7 +6,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from deepspeed_tpu.runtime import utils as ds_utils
@@ -96,7 +96,7 @@ def test_partitioned_tensor_in_jit_allgather():
                                             axis_name="model")
             return pt.full()[None]
         return shard_map(inner, mesh=mesh, in_specs=P(None),
-                         out_specs=P(None), check_rep=False)(x[None])
+                         out_specs=P(None), check_vma=False)(x[None])
 
     np.testing.assert_array_equal(np.asarray(f(x))[0], np.asarray(x))
 
