@@ -338,6 +338,13 @@ class DeepSpeedEngine:
     def zero_optimization(self):
         return self.zero_stage > 0
 
+    def sparse_gradients_enabled(self):
+        """(reference engine.py:269) When enabled, embedding-style grads can
+        be exchanged in CSR form — see runtime/csr_tensor.csr_allreduce for
+        the shard_map collective; under plain GSPMD XLA already moves only
+        live shards."""
+        return self._config.sparse_gradients_enabled
+
     def loss_scale(self):
         return float(self.state.loss_scale.scale)
 
