@@ -1,0 +1,174 @@
+// Host-side vectorized Adam for ZeRO-Offload, TPU-native build.
+//
+// Re-implements the capability of the reference's csrc/adam/cpu_adam.cpp
+// (Adam_Optimizer::Step / Step_4 / Step_8: AVX512/AVX2 SIMD + OpenMP over
+// the fp32 master partition, with a fused cast+copy of updated params back
+// to the device dtype). Differences by design:
+//  - C API (extern "C") consumed via ctypes — no pybind11 in this image.
+//  - The device-bound output is bfloat16 (TPU parameter dtype), produced
+//    on the host by round-to-nearest-even truncation; the reference wrote
+//    fp16 via a CUDA kernel (custom_cuda_kernel.cu param_update_kernel).
+//  - Stateless bias correction: the step count is an argument and
+//    beta^t is computed per call, so the same optimizer handle can serve
+//    many parameter leaves (the reference tracks _betta1_t incrementally).
+//
+// Build: make -C csrc  →  libdstpu_adam.so
+
+#include <cmath>
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <unordered_map>
+
+#if defined(__AVX2__)
+#include <immintrin.h>
+#endif
+
+namespace {
+
+struct AdamConfig {
+    float alpha;
+    float beta1;
+    float beta2;
+    float eps;
+    float weight_decay;
+    int adamw_mode;      // 1: decoupled decay (AdamW), 0: L2 into grad
+    int bias_correction; // 1: apply 1/(1-beta^t) corrections
+};
+
+std::unordered_map<int, AdamConfig>& registry() {
+    static std::unordered_map<int, AdamConfig> r;
+    return r;
+}
+std::mutex reg_mu;
+
+inline uint16_t f32_to_bf16(float v) {
+    uint32_t bits;
+    std::memcpy(&bits, &v, sizeof(bits));
+    // round-to-nearest-even on the truncated mantissa
+    uint32_t rounding = 0x7fffu + ((bits >> 16) & 1u);
+    return static_cast<uint16_t>((bits + rounding) >> 16);
+}
+
+}  // namespace
+
+extern "C" {
+
+int ds_adam_create(int id, float alpha, float beta1, float beta2, float eps,
+                   float weight_decay, int adamw_mode, int bias_correction) {
+    std::lock_guard<std::mutex> lock(reg_mu);
+    registry()[id] = AdamConfig{alpha, beta1,         beta2,          eps,
+                                weight_decay, adamw_mode, bias_correction};
+    return 0;
+}
+
+int ds_adam_destroy(int id) {
+    std::lock_guard<std::mutex> lock(reg_mu);
+    return registry().erase(id) ? 0 : -1;
+}
+
+// One Adam step over a flat fp32 leaf. `step` is 1-based. When
+// `out_bf16` is non-null the updated params are also written there in
+// bfloat16 (the H2D payload for the TPU copy). Returns 0, or -1 for an
+// unknown optimizer id.
+int ds_adam_step(int id, long long step, float lr_in, float* params,
+                 const float* grads, float* exp_avg, float* exp_avg_sq,
+                 long long n, uint16_t* out_bf16) {
+    AdamConfig cfg;
+    {
+        std::lock_guard<std::mutex> lock(reg_mu);
+        auto it = registry().find(id);
+        if (it == registry().end()) return -1;
+        cfg = it->second;
+    }
+    const float lr = (lr_in > 0.f) ? lr_in : cfg.alpha;
+    const float b1 = cfg.beta1, b2 = cfg.beta2;
+    const float one_m_b1 = 1.f - b1, one_m_b2 = 1.f - b2;
+    float bc1 = 1.f, inv_sqrt_bc2 = 1.f;
+    if (cfg.bias_correction) {
+        bc1 = 1.f - std::pow(b1, static_cast<float>(step));
+        inv_sqrt_bc2 =
+            1.f / std::sqrt(1.f - std::pow(b2, static_cast<float>(step)));
+    }
+    const float step_size = -lr / bc1;
+    const float wd = cfg.weight_decay;
+    const int adamw = cfg.adamw_mode;
+    const float eps = cfg.eps;
+
+    long long vec_end = 0;
+
+#if defined(__AVX2__)
+    const __m256 v_b1 = _mm256_set1_ps(b1);
+    const __m256 v_b2 = _mm256_set1_ps(b2);
+    const __m256 v_1mb1 = _mm256_set1_ps(one_m_b1);
+    const __m256 v_1mb2 = _mm256_set1_ps(one_m_b2);
+    const __m256 v_eps = _mm256_set1_ps(eps);
+    const __m256 v_step = _mm256_set1_ps(step_size);
+    const __m256 v_isbc2 = _mm256_set1_ps(inv_sqrt_bc2);
+    const __m256 v_wd = _mm256_set1_ps(wd);
+    const __m256 v_neg_lr_wd = _mm256_set1_ps(-lr * wd);
+    vec_end = n - (n % 8);
+#pragma omp parallel for schedule(static)
+    for (long long i = 0; i < vec_end; i += 8) {
+        __m256 g = _mm256_loadu_ps(grads + i);
+        __m256 p = _mm256_loadu_ps(params + i);
+        __m256 m = _mm256_loadu_ps(exp_avg + i);
+        __m256 v = _mm256_loadu_ps(exp_avg_sq + i);
+
+        if (wd > 0.f && !adamw) g = _mm256_fmadd_ps(p, v_wd, g);
+
+        m = _mm256_mul_ps(m, v_b1);
+        m = _mm256_fmadd_ps(g, v_1mb1, m);
+        v = _mm256_mul_ps(v, v_b2);
+        v = _mm256_fmadd_ps(_mm256_mul_ps(g, g), v_1mb2, v);
+
+        __m256 denom =
+            _mm256_fmadd_ps(_mm256_sqrt_ps(v), v_isbc2, v_eps);
+        __m256 upd = _mm256_div_ps(m, denom);
+        if (wd > 0.f && adamw) p = _mm256_fmadd_ps(p, v_neg_lr_wd, p);
+        p = _mm256_fmadd_ps(upd, v_step, p);
+
+        _mm256_storeu_ps(params + i, p);
+        _mm256_storeu_ps(exp_avg + i, m);
+        _mm256_storeu_ps(exp_avg_sq + i, v);
+        if (out_bf16) {
+            alignas(32) float tmp[8];
+            _mm256_store_ps(tmp, p);
+            for (int k = 0; k < 8; ++k) out_bf16[i + k] = f32_to_bf16(tmp[k]);
+        }
+    }
+#endif
+
+    // scalar tail (and full path on non-AVX2 builds)
+#pragma omp parallel for schedule(static)
+    for (long long i = vec_end; i < n; ++i) {
+        float g = grads[i];
+        float p = params[i];
+        float m = exp_avg[i];
+        float v = exp_avg_sq[i];
+        if (wd > 0.f && !adamw) g += wd * p;
+        m = b1 * m + one_m_b1 * g;
+        v = b2 * v + one_m_b2 * g * g;
+        float denom = std::sqrt(v) * inv_sqrt_bc2 + eps;
+        float upd = m / denom;
+        if (wd > 0.f && adamw) p -= lr * wd * p;
+        p += step_size * upd;
+        params[i] = p;
+        exp_avg[i] = m;
+        exp_avg_sq[i] = v;
+        if (out_bf16) out_bf16[i] = f32_to_bf16(p);
+    }
+    return 0;
+}
+
+// simd width the build actually uses (for tests / introspection)
+int ds_adam_simd_width() {
+#if defined(__AVX2__)
+    return 8;
+#else
+    return 1;
+#endif
+}
+
+}  // extern "C"

@@ -1,0 +1,1 @@
+from deepspeed_tpu.ops.adam.cpu_adam import DeepSpeedCPUAdam

@@ -1,0 +1,197 @@
+"""ZeRO-Offload CPU Adam, Python side.
+
+Reference: ``deepspeed/ops/adam/cpu_adam.py:8`` ``DeepSpeedCPUAdam``
+(``create_adam`` on import ``:33``, ``step(fp16_param_groups=...)`` writing
+device params via a fused copy ``:67-74``). The native kernel is
+``csrc/adam/cpu_adam.cpp`` (AVX2+FMA+OpenMP), loaded via ctypes; if the
+shared library is missing it is built on demand with ``make -C csrc``, and a
+numpy fallback keeps the API functional on hosts without a toolchain.
+
+TPU integration: the optimizer owns host-resident fp32 master params +
+moments (numpy); ``step(grads)`` runs the SIMD update and returns the
+updated params as **bfloat16 bytes** ready for a single ``jax.device_put``
+H2D transfer — the analogue of the reference's overlapped fp16 copy-back
+(``csrc/adam/custom_cuda_kernel.cu``).
+"""
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Any, Optional
+
+import numpy as np
+
+__all__ = ["DeepSpeedCPUAdam", "load_library"]
+
+_LIB = None
+_LIB_LOCK = threading.Lock()
+_LIB_NAME = "libdstpu_adam.so"
+
+
+def _csrc_dir() -> str:
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.normpath(os.path.join(here, "..", "..", "..", "csrc"))
+
+
+def load_library(rebuild: bool = False):
+    """Load (building if needed) the native Adam library. Returns None when
+    neither a prebuilt .so nor a toolchain is available."""
+    global _LIB
+    with _LIB_LOCK:
+        if _LIB is not None and not rebuild:
+            return _LIB
+        so_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               _LIB_NAME)
+        if rebuild or not os.path.exists(so_path):
+            try:
+                subprocess.run(["make", "-C", _csrc_dir()], check=True,
+                               capture_output=True)
+            except Exception:
+                return None
+        try:
+            lib = ctypes.CDLL(so_path)
+        except OSError:
+            return None
+        lib.ds_adam_create.argtypes = [
+            ctypes.c_int, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ctypes.c_float, ctypes.c_float, ctypes.c_int, ctypes.c_int]
+        lib.ds_adam_step.argtypes = [
+            ctypes.c_int, ctypes.c_longlong, ctypes.c_float,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_longlong, ctypes.c_void_p]
+        lib.ds_adam_step.restype = ctypes.c_int
+        lib.ds_adam_simd_width.restype = ctypes.c_int
+        _LIB = lib
+        return _LIB
+
+
+def _fptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+class DeepSpeedCPUAdam:
+    """Host-side Adam over flat fp32 numpy leaves (reference
+    ``cpu_adam.py:8``). Functional contract: construct with the parameter
+    pytree (host copies are made), call :meth:`step` with the grad pytree
+    (numpy or JAX arrays), read back :attr:`master_params` or the bf16
+    output of ``step``.
+    """
+
+    _next_id = 0
+
+    def __init__(self, model_params: Any, lr: float = 1e-3,
+                 betas=(0.9, 0.999), eps: float = 1e-8,
+                 weight_decay: float = 0.0, bias_correction: bool = True,
+                 adamw_mode: bool = True, amsgrad: bool = False):
+        assert not amsgrad, "amsgrad not supported (reference cpu_adam.py:29)"
+        import jax  # local import: keep module importable without jax
+
+        self.lr = lr
+        self.betas = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.bias_correction = bias_correction
+        self.adamw_mode = adamw_mode
+
+        leaves, self._treedef = jax.tree_util.tree_flatten(model_params)
+        self._shapes = [np.shape(x) for x in leaves]
+        # explicit .copy(): np.asarray on a jax.Array aliases the device
+        # buffer read-only, and the native kernel writes through raw
+        # pointers — it must own its memory
+        self.master_params = [
+            np.array(x, dtype=np.float32, copy=True).ravel()
+            for x in leaves]
+        self.exp_avg = [np.zeros_like(p) for p in self.master_params]
+        self.exp_avg_sq = [np.zeros_like(p) for p in self.master_params]
+        self.step_count = 0
+
+        self.opt_id = DeepSpeedCPUAdam._next_id
+        DeepSpeedCPUAdam._next_id += 1
+        self._lib = load_library()
+        if self._lib is not None:
+            self._lib.ds_adam_create(
+                self.opt_id, ctypes.c_float(lr), ctypes.c_float(betas[0]),
+                ctypes.c_float(betas[1]), ctypes.c_float(eps),
+                ctypes.c_float(weight_decay), int(adamw_mode),
+                int(bias_correction))
+
+    @property
+    def uses_native_kernel(self) -> bool:
+        return self._lib is not None
+
+    def _step_numpy(self, i: int, g: np.ndarray, lr: float):
+        """Fallback mirror of the C++ kernel (also its test oracle)."""
+        b1, b2 = self.betas
+        p, m, v = self.master_params[i], self.exp_avg[i], self.exp_avg_sq[i]
+        if self.weight_decay > 0 and not self.adamw_mode:
+            g = g + self.weight_decay * p
+        np.multiply(m, b1, out=m)
+        m += (1 - b1) * g
+        np.multiply(v, b2, out=v)
+        v += (1 - b2) * g * g
+        if self.bias_correction:
+            bc1 = 1 - b1 ** self.step_count
+            inv_sqrt_bc2 = 1.0 / np.sqrt(1 - b2 ** self.step_count)
+        else:
+            bc1, inv_sqrt_bc2 = 1.0, 1.0
+        denom = np.sqrt(v) * inv_sqrt_bc2 + self.eps
+        if self.weight_decay > 0 and self.adamw_mode:
+            p -= lr * self.weight_decay * p
+        p -= (lr / bc1) * (m / denom)
+
+    def step(self, grads: Any, lr: Optional[float] = None,
+             bf16_out: bool = False):
+        """One Adam step over every leaf. Returns the updated parameter
+        pytree — bf16 numpy arrays when ``bf16_out`` (the H2D payload),
+        else fp32 views of the master copy."""
+        import jax
+        lr = self.lr if lr is None else float(lr)
+        self.step_count += 1
+        g_leaves = self._treedef.flatten_up_to(grads)
+        outs = []
+        for i, g in enumerate(g_leaves):
+            g = np.ascontiguousarray(
+                np.asarray(g, dtype=np.float32).ravel())
+            n = self.master_params[i].size
+            assert g.size == n, f"grad leaf {i}: {g.size} != {n}"
+            out16 = np.empty(n, np.uint16) if bf16_out else None
+            if self._lib is not None:
+                rc = self._lib.ds_adam_step(
+                    self.opt_id, self.step_count, ctypes.c_float(lr),
+                    _fptr(self.master_params[i]), _fptr(g),
+                    _fptr(self.exp_avg[i]), _fptr(self.exp_avg_sq[i]),
+                    n,
+                    out16.ctypes.data_as(ctypes.c_void_p)
+                    if out16 is not None else None)
+                assert rc == 0, f"native adam step failed rc={rc}"
+            else:
+                self._step_numpy(i, g, lr)
+                if out16 is not None:
+                    out16[:] = (
+                        self.master_params[i].view(np.uint32) >> 16
+                    ).astype(np.uint16)  # truncation fallback
+            if out16 is not None:
+                import ml_dtypes  # ships with jax
+                outs.append(out16.view(ml_dtypes.bfloat16)
+                            .reshape(self._shapes[i]))
+            else:
+                outs.append(self.master_params[i].reshape(self._shapes[i]))
+        return self._treedef.unflatten(outs)
+
+    # -- state I/O for checkpointing ------------------------------------ #
+    def state_dict(self):
+        return {"step": self.step_count,
+                "master_params": [p.copy() for p in self.master_params],
+                "exp_avg": [m.copy() for m in self.exp_avg],
+                "exp_avg_sq": [v.copy() for v in self.exp_avg_sq]}
+
+    def load_state_dict(self, sd):
+        self.step_count = int(sd["step"])
+        for dst, src in zip(self.master_params, sd["master_params"]):
+            np.copyto(dst, np.asarray(src).ravel())
+        for dst, src in zip(self.exp_avg, sd["exp_avg"]):
+            np.copyto(dst, np.asarray(src).ravel())
+        for dst, src in zip(self.exp_avg_sq, sd["exp_avg_sq"]):
+            np.copyto(dst, np.asarray(src).ravel())

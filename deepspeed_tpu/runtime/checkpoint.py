@@ -52,9 +52,15 @@ def save_tree(path: str, tree: Any) -> None:
     arrays = {}
     for k, v in named.items():
         if hasattr(v, "shape"):
-            arrays[k] = _to_host_global(v)
+            arr = _to_host_global(v)
         else:
-            arrays[k] = np.asarray(v)
+            arr = np.asarray(v)
+        # npz cannot round-trip ml_dtypes (bfloat16/fp8 — void-kind dtypes
+        # reload as raw |V bytes): store widened; load_tree's
+        # astype(leaf.dtype) narrows back on restore
+        if arr.dtype.kind == "V":
+            arr = arr.astype(np.float32)
+        arrays[k] = arr
     np.savez(path, **arrays)
 
 

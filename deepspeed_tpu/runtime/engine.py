@@ -158,7 +158,22 @@ class DeepSpeedEngine:
 
         # -- optimizer --
         self.client_optimizer = optimizer
-        if optimizer is not None:
+        # ZeRO-Offload (reference zero/stage2.py:334-350 cpu_offload path):
+        # fp32 master + moments live on the host, updated by the native
+        # C++ SIMD Adam (csrc/adam/cpu_adam.cpp); the device holds only
+        # compute-dtype params and grads.
+        self.zero_cpu_offload = bool(
+            self._config.zero_config.stage >= 1 and
+            self._config.zero_config.cpu_offload)
+        if self.zero_cpu_offload:
+            assert optimizer is None, \
+                "client optimizers are unsupported with cpu_offload"
+            name = (self._config.optimizer_name or "adam").lower()
+            assert "adam" in name, \
+                "ZeRO-Offload requires an Adam-family optimizer (the " \
+                "reference drives DeepSpeedCPUAdam, stage2.py:1418)"
+            self.optimizer = None  # built below, once master params exist
+        elif optimizer is not None:
             self.optimizer = optimizer
         else:
             self.optimizer = build_optimizer(self._config.optimizer_name,
@@ -208,14 +223,34 @@ class DeepSpeedEngine:
             self._param_shardings = replicated_shardings(
                 master_params, self.mesh, model_specs=param_specs)
 
-        params = master_params
-        opt_state = self.optimizer.init(params)
-        if self.zero_stage >= 1:
-            self._opt_shardings = zero_shardings(
-                opt_state, self.mesh, stage=self.zero_stage,
-                model_specs=None)
+        if self.zero_cpu_offload:
+            from deepspeed_tpu.ops.adam import DeepSpeedCPUAdam
+            p = dict(self._config.optimizer_params or {})
+            self.optimizer = DeepSpeedCPUAdam(
+                master_params,
+                lr=p.get("lr", 1e-3),
+                betas=tuple(p.get("betas", (0.9, 0.999))),
+                eps=p.get("eps", 1e-8),
+                weight_decay=p.get("weight_decay", 0.0),
+                adamw_mode=p.get("adam_w_mode", True),
+                bias_correction=p.get("bias_correction", True))
+            self.base_lr = self.optimizer.lr
+            # device params in compute dtype only — the HBM saving that IS
+            # ZeRO-Offload; fp32 master stays host-side in the optimizer
+            params = _tree_cast(master_params,
+                                self.compute_dtype or jnp.float32)
+            opt_state = ()
+            self._opt_shardings = ()
         else:
-            self._opt_shardings = replicated_shardings(opt_state, self.mesh)
+            params = master_params
+            opt_state = self.optimizer.init(params)
+            if self.zero_stage >= 1:
+                self._opt_shardings = zero_shardings(
+                    opt_state, self.mesh, stage=self.zero_stage,
+                    model_specs=None)
+            else:
+                self._opt_shardings = replicated_shardings(opt_state,
+                                                           self.mesh)
         if self._onebit_dist:
             # per-rank error-feedback state: leading (dp,) dim sharded over
             # 'data' — each shard owns its own worker/server error
@@ -235,7 +270,9 @@ class DeepSpeedEngine:
                     lambda _: data_shd, opt_state.server_error))
 
         self.gradient_accumulation_steps = self._config.gradient_accumulation_steps
-        if self.gradient_accumulation_steps > 1:
+        # offload always accumulates on device, then applies host-side at
+        # the boundary (one D2H of summed grads per optimizer step)
+        if self.gradient_accumulation_steps > 1 or self.zero_cpu_offload:
             if self._onebit_dist:
                 # stacked per-rank local-grad accumulators
                 dp = self.dp_world_size
@@ -564,16 +601,19 @@ class DeepSpeedEngine:
             loss, aux, grads = self._compute_loss_and_grads(
                 state.params, batch, sub, state.loss_scale.scale)
 
-        if self.gradient_accumulation_steps > 1:
+        if self.zero_cpu_offload or self.gradient_accumulation_steps > 1:
             accum = jax.tree_util.tree_map(jnp.add, state.accum_grads, grads)
             state = state._replace(accum_grads=accum, rng=rng,
                                    micro_step=state.micro_step + 1)
-            boundary = state.micro_step % self.gradient_accumulation_steps == 0
-            state = jax.lax.cond(
-                boundary,
-                lambda s: self._apply_update(s, s.accum_grads),
-                lambda s: s,
-                state)
+            if not self.zero_cpu_offload:
+                # offload applies host-side in _host_apply_update instead
+                boundary = (state.micro_step %
+                            self.gradient_accumulation_steps == 0)
+                state = jax.lax.cond(
+                    boundary,
+                    lambda s: self._apply_update(s, s.accum_grads),
+                    lambda s: s,
+                    state)
         else:
             state = state._replace(rng=rng,
                                    micro_step=state.micro_step + 1)
@@ -630,7 +670,7 @@ class DeepSpeedEngine:
             self.timers("backward").start()
         grads = self._cached_grads
         self._cached_grads = None
-        if self.gradient_accumulation_steps > 1:
+        if self.gradient_accumulation_steps > 1 or self.zero_cpu_offload:
             accum = jax.tree_util.tree_map(jnp.add, self.state.accum_grads,
                                            grads)
             self.state = self.state._replace(
@@ -642,6 +682,61 @@ class DeepSpeedEngine:
         if self.wall_clock_breakdown_enabled:
             self.timers("backward").stop()
         return loss
+
+    def _host_apply_update(self):
+        """ZeRO-Offload optimizer boundary: one D2H of the summed grads,
+        native C++ SIMD Adam on the host fp32 master, one H2D of the
+        updated compute-dtype params (reference stage2.py:1418-1431:
+        DeepSpeedCPUAdam.step + fp32→fp16 device copy)."""
+        from deepspeed_tpu.runtime.checkpoint import _to_host_global
+        accum = jax.tree_util.tree_map(_to_host_global,
+                                       self.state.accum_grads)
+        scale = float(self.state.loss_scale.scale)
+        inv = 1.0 / scale
+        grads = jax.tree_util.tree_map(
+            lambda g: np.asarray(g, np.float32) * inv, accum)
+
+        overflow = any(not np.all(np.isfinite(g))
+                       for g in jax.tree_util.tree_leaves(grads))
+        if not overflow:
+            if self.gradient_clipping > 0:
+                sq = sum(float(np.sum(g.astype(np.float64) ** 2))
+                         for g in jax.tree_util.tree_leaves(grads))
+                clip = min(1.0, self.gradient_clipping /
+                           (np.sqrt(sq) + 1e-6))
+                if clip < 1.0:
+                    grads = jax.tree_util.tree_map(
+                        lambda g: g * np.float32(clip), grads)
+            # device global_step excludes overflow-skipped steps (the host
+            # mirror doesn't); we already sync on loss_scale above, so the
+            # extra scalar fetch is free
+            lr = float(self._lr_at(self.state.global_step))
+            use_bf16 = self.compute_dtype == jnp.bfloat16
+            new_params = self.optimizer.step(grads, lr=lr,
+                                             bf16_out=use_bf16)
+            if not use_bf16:
+                dtype = self.compute_dtype or jnp.float32
+                new_params = jax.tree_util.tree_map(
+                    lambda p: p.astype(dtype), new_params)
+            device_params = jax.device_put(new_params,
+                                           self._param_shardings)
+        else:
+            device_params = self.state.params
+
+        new_scale = self.loss_scaler.update(
+            self.state.loss_scale, jnp.asarray(overflow))
+        zero_accum = jax.tree_util.tree_map(
+            lambda g: jnp.zeros(g.shape, g.dtype), self.state.accum_grads)
+        inc = 0 if overflow else 1
+        self.state = self.state._replace(
+            params=device_params,
+            accum_grads=jax.device_put(
+                zero_accum, self._state_shardings.accum_grads),
+            loss_scale=new_scale,
+            global_step=self.state.global_step + inc,
+            micro_step=jnp.zeros((), jnp.int32),
+            skipped_steps=self.state.skipped_steps + (1 - inc),
+        )
 
     def _maybe_switch_onebit_phase(self):
         """Enter 1-bit compression once global_steps reaches freeze_step
@@ -668,6 +763,15 @@ class DeepSpeedEngine:
         if self.wall_clock_breakdown_enabled:
             self.timers("step").start()
         ga = self.gradient_accumulation_steps
+        if self.zero_cpu_offload:
+            if self.is_gradient_accumulation_boundary():
+                self._host_apply_update()
+                self._host_global_step += 1
+                self._report_progress()
+            self._host_micro_step += 1
+            if self.wall_clock_breakdown_enabled:
+                self.timers("step").stop()
+            return
         if self._compiled_apply is None:
             if ga > 1:
                 # grads live inside the (donated) state as accum_grads
@@ -718,6 +822,8 @@ class DeepSpeedEngine:
             batch = next(data_iter)
             self.state, loss = step_fn(self.state, batch)
             total = loss if total is None else total + loss
+        if self.zero_cpu_offload:
+            self._host_apply_update()
         self.tput_timer.stop()
         mean_loss = total / self.gradient_accumulation_steps
         self._host_micro_step += self.gradient_accumulation_steps
@@ -761,6 +867,18 @@ class DeepSpeedEngine:
                 os.path.join(ckpt_dir, "optim_states.npz"),
                 {"opt_state": self.state.opt_state,
                  "loss_scale": self.state.loss_scale})
+            if self.zero_cpu_offload:
+                # host-resident fp32 master + moments (reference saves the
+                # fp32 partitions in zero_pp_rank files, engine.py:1409)
+                sd = self.optimizer.state_dict()
+                np.savez(os.path.join(ckpt_dir, "cpu_optim_states.npz"),
+                         step=sd["step"],
+                         **{f"mp_{i}": a for i, a in
+                            enumerate(sd["master_params"])},
+                         **{f"m_{i}": a for i, a in
+                            enumerate(sd["exp_avg"])},
+                         **{f"v_{i}": a for i, a in
+                            enumerate(sd["exp_avg_sq"])})
             meta = {
                 "global_step": int(self.state.global_step),
                 "micro_step": int(self.state.micro_step),
@@ -801,6 +919,33 @@ class DeepSpeedEngine:
                            "loss_scale": self._state_shardings.loss_scale})
             new_state = new_state._replace(opt_state=opt["opt_state"],
                                            loss_scale=opt["loss_scale"])
+            if self.zero_cpu_offload:
+                cpu_path = os.path.join(ckpt_dir, "cpu_optim_states.npz")
+                if not os.path.exists(cpu_path):
+                    # without the host master state the first offload step
+                    # would overwrite the loaded weights with init-time
+                    # params — fail loudly instead
+                    raise FileNotFoundError(
+                        f"{cpu_path} missing: checkpoint was not saved by "
+                        "a cpu_offload run. Re-save with offload enabled, "
+                        "or pass load_optimizer_states=False and accept a "
+                        "fresh optimizer (master params will be re-seeded "
+                        "from the loaded model weights).")
+                z = np.load(cpu_path)
+                n = len(self.optimizer.master_params)
+                self.optimizer.load_state_dict({
+                    "step": int(z["step"]),
+                    "master_params": [z[f"mp_{i}"] for i in range(n)],
+                    "exp_avg": [z[f"m_{i}"] for i in range(n)],
+                    "exp_avg_sq": [z[f"v_{i}"] for i in range(n)]})
+        elif self.zero_cpu_offload:
+            # fresh optimizer requested: re-seed the host master copy from
+            # the loaded weights so the next step starts from them
+            from deepspeed_tpu.runtime.checkpoint import _to_host_global
+            for dst, src in zip(self.optimizer.master_params,
+                                jax.tree_util.tree_leaves(params)):
+                np.copyto(dst, np.asarray(_to_host_global(src),
+                                          np.float32).ravel())
         meta = ckpt.read_meta(ckpt_dir)
         repl = self._state_shardings.global_step
         new_state = new_state._replace(
