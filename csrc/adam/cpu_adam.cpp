@@ -11,6 +11,10 @@
 //  - Stateless bias correction: the step count is an argument and
 //    beta^t is computed per call, so the same optimizer handle can serve
 //    many parameter leaves (the reference tracks _betta1_t incrementally).
+//  - Runtime SIMD dispatch: the file is compiled WITHOUT -mavx2; the AVX2
+//    path is a target("avx2,fma") multiversioned function selected via
+//    __builtin_cpu_supports, so the same .so is safe on any x86-64 host
+//    (the reference selects AVX512/AVX2 at compile time).
 //
 // Build: make -C csrc  →  libdstpu_adam.so
 
@@ -21,8 +25,11 @@
 #include <mutex>
 #include <unordered_map>
 
-#if defined(__AVX2__)
+#if defined(__x86_64__) || defined(_M_X64)
+#define DS_X86 1
 #include <immintrin.h>
+#else
+#define DS_X86 0
 #endif
 
 namespace {
@@ -35,6 +42,11 @@ struct AdamConfig {
     float weight_decay;
     int adamw_mode;      // 1: decoupled decay (AdamW), 0: L2 into grad
     int bias_correction; // 1: apply 1/(1-beta^t) corrections
+};
+
+struct StepScalars {
+    float lr, b1, b2, one_m_b1, one_m_b2, eps, step_size, inv_sqrt_bc2, wd;
+    int adamw;
 };
 
 std::unordered_map<int, AdamConfig>& registry() {
@@ -50,6 +62,82 @@ inline uint16_t f32_to_bf16(float v) {
     uint32_t rounding = 0x7fffu + ((bits >> 16) & 1u);
     return static_cast<uint16_t>((bits + rounding) >> 16);
 }
+
+inline void step_scalar_range(const StepScalars& s, float* params,
+                              const float* grads, float* exp_avg,
+                              float* exp_avg_sq, long long lo, long long hi,
+                              uint16_t* out_bf16) {
+    for (long long i = lo; i < hi; ++i) {
+        float g = grads[i];
+        float p = params[i];
+        float m = exp_avg[i];
+        float v = exp_avg_sq[i];
+        if (s.wd > 0.f && !s.adamw) g += s.wd * p;
+        m = s.b1 * m + s.one_m_b1 * g;
+        v = s.b2 * v + s.one_m_b2 * g * g;
+        float denom = std::sqrt(v) * s.inv_sqrt_bc2 + s.eps;
+        float upd = m / denom;
+        if (s.wd > 0.f && s.adamw) p -= s.lr * s.wd * p;
+        p += s.step_size * upd;
+        params[i] = p;
+        exp_avg[i] = m;
+        exp_avg_sq[i] = v;
+        if (out_bf16) out_bf16[i] = f32_to_bf16(p);
+    }
+}
+
+#if DS_X86
+__attribute__((target("avx2,fma")))
+void step_avx2(const StepScalars& s, float* params, const float* grads,
+               float* exp_avg, float* exp_avg_sq, long long n,
+               uint16_t* out_bf16) {
+    const __m256 v_b1 = _mm256_set1_ps(s.b1);
+    const __m256 v_b2 = _mm256_set1_ps(s.b2);
+    const __m256 v_1mb1 = _mm256_set1_ps(s.one_m_b1);
+    const __m256 v_1mb2 = _mm256_set1_ps(s.one_m_b2);
+    const __m256 v_eps = _mm256_set1_ps(s.eps);
+    const __m256 v_step = _mm256_set1_ps(s.step_size);
+    const __m256 v_isbc2 = _mm256_set1_ps(s.inv_sqrt_bc2);
+    const __m256 v_wd = _mm256_set1_ps(s.wd);
+    const __m256 v_neg_lr_wd = _mm256_set1_ps(-s.lr * s.wd);
+    const long long vec_end = n - (n % 8);
+#pragma omp parallel for schedule(static)
+    for (long long i = 0; i < vec_end; i += 8) {
+        __m256 g = _mm256_loadu_ps(grads + i);
+        __m256 p = _mm256_loadu_ps(params + i);
+        __m256 m = _mm256_loadu_ps(exp_avg + i);
+        __m256 v = _mm256_loadu_ps(exp_avg_sq + i);
+
+        if (s.wd > 0.f && !s.adamw) g = _mm256_fmadd_ps(p, v_wd, g);
+
+        m = _mm256_mul_ps(m, v_b1);
+        m = _mm256_fmadd_ps(g, v_1mb1, m);
+        v = _mm256_mul_ps(v, v_b2);
+        v = _mm256_fmadd_ps(_mm256_mul_ps(g, g), v_1mb2, v);
+
+        __m256 denom = _mm256_fmadd_ps(_mm256_sqrt_ps(v), v_isbc2, v_eps);
+        __m256 upd = _mm256_div_ps(m, denom);
+        if (s.wd > 0.f && s.adamw) p = _mm256_fmadd_ps(p, v_neg_lr_wd, p);
+        p = _mm256_fmadd_ps(upd, v_step, p);
+
+        _mm256_storeu_ps(params + i, p);
+        _mm256_storeu_ps(exp_avg + i, m);
+        _mm256_storeu_ps(exp_avg_sq + i, v);
+        if (out_bf16) {
+            alignas(32) float tmp[8];
+            _mm256_store_ps(tmp, p);
+            for (int k = 0; k < 8; ++k) out_bf16[i + k] = f32_to_bf16(tmp[k]);
+        }
+    }
+    step_scalar_range(s, params, grads, exp_avg, exp_avg_sq, vec_end, n,
+                      out_bf16);
+}
+
+bool cpu_has_avx2() {
+    __builtin_cpu_init();
+    return __builtin_cpu_supports("avx2") && __builtin_cpu_supports("fma");
+}
+#endif  // DS_X86
 
 }  // namespace
 
@@ -68,10 +156,12 @@ int ds_adam_destroy(int id) {
     return registry().erase(id) ? 0 : -1;
 }
 
-// One Adam step over a flat fp32 leaf. `step` is 1-based. When
-// `out_bf16` is non-null the updated params are also written there in
-// bfloat16 (the H2D payload for the TPU copy). Returns 0, or -1 for an
-// unknown optimizer id.
+// One Adam step over a flat fp32 leaf. `step` is 1-based. `lr_in` is the
+// learning rate to use; pass a NEGATIVE value to fall back to the
+// construction-time alpha (0 is a legitimate rate — warmup schedules start
+// there). When `out_bf16` is non-null the updated params are also written
+// there in bfloat16 (the H2D payload for the TPU copy). Returns 0, or -1
+// for an unknown optimizer id.
 int ds_adam_step(int id, long long step, float lr_in, float* params,
                  const float* grads, float* exp_avg, float* exp_avg_sq,
                  long long n, uint16_t* out_bf16) {
@@ -82,90 +172,39 @@ int ds_adam_step(int id, long long step, float lr_in, float* params,
         if (it == registry().end()) return -1;
         cfg = it->second;
     }
-    const float lr = (lr_in > 0.f) ? lr_in : cfg.alpha;
-    const float b1 = cfg.beta1, b2 = cfg.beta2;
-    const float one_m_b1 = 1.f - b1, one_m_b2 = 1.f - b2;
-    float bc1 = 1.f, inv_sqrt_bc2 = 1.f;
+    StepScalars s;
+    s.lr = (lr_in < 0.f) ? cfg.alpha : lr_in;
+    s.b1 = cfg.beta1;
+    s.b2 = cfg.beta2;
+    s.one_m_b1 = 1.f - s.b1;
+    s.one_m_b2 = 1.f - s.b2;
+    s.eps = cfg.eps;
+    s.wd = cfg.weight_decay;
+    s.adamw = cfg.adamw_mode;
+    float bc1 = 1.f;
+    s.inv_sqrt_bc2 = 1.f;
     if (cfg.bias_correction) {
-        bc1 = 1.f - std::pow(b1, static_cast<float>(step));
-        inv_sqrt_bc2 =
-            1.f / std::sqrt(1.f - std::pow(b2, static_cast<float>(step)));
+        bc1 = 1.f - std::pow(s.b1, static_cast<float>(step));
+        s.inv_sqrt_bc2 =
+            1.f / std::sqrt(1.f - std::pow(s.b2, static_cast<float>(step)));
     }
-    const float step_size = -lr / bc1;
-    const float wd = cfg.weight_decay;
-    const int adamw = cfg.adamw_mode;
-    const float eps = cfg.eps;
+    s.step_size = -s.lr / bc1;
 
-    long long vec_end = 0;
-
-#if defined(__AVX2__)
-    const __m256 v_b1 = _mm256_set1_ps(b1);
-    const __m256 v_b2 = _mm256_set1_ps(b2);
-    const __m256 v_1mb1 = _mm256_set1_ps(one_m_b1);
-    const __m256 v_1mb2 = _mm256_set1_ps(one_m_b2);
-    const __m256 v_eps = _mm256_set1_ps(eps);
-    const __m256 v_step = _mm256_set1_ps(step_size);
-    const __m256 v_isbc2 = _mm256_set1_ps(inv_sqrt_bc2);
-    const __m256 v_wd = _mm256_set1_ps(wd);
-    const __m256 v_neg_lr_wd = _mm256_set1_ps(-lr * wd);
-    vec_end = n - (n % 8);
-#pragma omp parallel for schedule(static)
-    for (long long i = 0; i < vec_end; i += 8) {
-        __m256 g = _mm256_loadu_ps(grads + i);
-        __m256 p = _mm256_loadu_ps(params + i);
-        __m256 m = _mm256_loadu_ps(exp_avg + i);
-        __m256 v = _mm256_loadu_ps(exp_avg_sq + i);
-
-        if (wd > 0.f && !adamw) g = _mm256_fmadd_ps(p, v_wd, g);
-
-        m = _mm256_mul_ps(m, v_b1);
-        m = _mm256_fmadd_ps(g, v_1mb1, m);
-        v = _mm256_mul_ps(v, v_b2);
-        v = _mm256_fmadd_ps(_mm256_mul_ps(g, g), v_1mb2, v);
-
-        __m256 denom =
-            _mm256_fmadd_ps(_mm256_sqrt_ps(v), v_isbc2, v_eps);
-        __m256 upd = _mm256_div_ps(m, denom);
-        if (wd > 0.f && adamw) p = _mm256_fmadd_ps(p, v_neg_lr_wd, p);
-        p = _mm256_fmadd_ps(upd, v_step, p);
-
-        _mm256_storeu_ps(params + i, p);
-        _mm256_storeu_ps(exp_avg + i, m);
-        _mm256_storeu_ps(exp_avg_sq + i, v);
-        if (out_bf16) {
-            alignas(32) float tmp[8];
-            _mm256_store_ps(tmp, p);
-            for (int k = 0; k < 8; ++k) out_bf16[i + k] = f32_to_bf16(tmp[k]);
-        }
+#if DS_X86
+    static const bool use_avx2 = cpu_has_avx2();
+    if (use_avx2) {
+        step_avx2(s, params, grads, exp_avg, exp_avg_sq, n, out_bf16);
+        return 0;
     }
 #endif
-
-    // scalar tail (and full path on non-AVX2 builds)
-#pragma omp parallel for schedule(static)
-    for (long long i = vec_end; i < n; ++i) {
-        float g = grads[i];
-        float p = params[i];
-        float m = exp_avg[i];
-        float v = exp_avg_sq[i];
-        if (wd > 0.f && !adamw) g += wd * p;
-        m = b1 * m + one_m_b1 * g;
-        v = b2 * v + one_m_b2 * g * g;
-        float denom = std::sqrt(v) * inv_sqrt_bc2 + eps;
-        float upd = m / denom;
-        if (wd > 0.f && adamw) p -= lr * wd * p;
-        p += step_size * upd;
-        params[i] = p;
-        exp_avg[i] = m;
-        exp_avg_sq[i] = v;
-        if (out_bf16) out_bf16[i] = f32_to_bf16(p);
-    }
+    step_scalar_range(s, params, grads, exp_avg, exp_avg_sq, 0, n, out_bf16);
     return 0;
 }
 
-// simd width the build actually uses (for tests / introspection)
+// simd width actually used at runtime (for tests / introspection)
 int ds_adam_simd_width() {
-#if defined(__AVX2__)
-    return 8;
+#if DS_X86
+    return cpu_has_avx2() ? 8 : 1;
 #else
     return 1;
 #endif

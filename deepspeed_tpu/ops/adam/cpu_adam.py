@@ -43,12 +43,15 @@ def load_library(rebuild: bool = False):
             return _LIB
         so_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                _LIB_NAME)
-        if rebuild or not os.path.exists(so_path):
-            try:
-                subprocess.run(["make", "-C", _csrc_dir()], check=True,
-                               capture_output=True)
-            except Exception:
-                return None
+        # always invoke make: it is a no-op when the .so is newer than the
+        # source, and it keeps an edited cpu_adam.cpp from being shadowed
+        # by a stale binary
+        try:
+            subprocess.run(["make", "-C", _csrc_dir()], check=True,
+                           capture_output=True)
+        except Exception:
+            if not os.path.exists(so_path):
+                return None  # no toolchain and no prebuilt library
         try:
             lib = ctypes.CDLL(so_path)
         except OSError:
@@ -169,9 +172,9 @@ class DeepSpeedCPUAdam:
             else:
                 self._step_numpy(i, g, lr)
                 if out16 is not None:
-                    out16[:] = (
-                        self.master_params[i].view(np.uint32) >> 16
-                    ).astype(np.uint16)  # truncation fallback
+                    import ml_dtypes
+                    out16[:] = self.master_params[i].astype(
+                        ml_dtypes.bfloat16).view(np.uint16)  # RNE, like C++
             if out16 is not None:
                 import ml_dtypes  # ships with jax
                 outs.append(out16.view(ml_dtypes.bfloat16)

@@ -169,9 +169,10 @@ class DeepSpeedEngine:
             assert optimizer is None, \
                 "client optimizers are unsupported with cpu_offload"
             name = (self._config.optimizer_name or "adam").lower()
-            assert "adam" in name, \
-                "ZeRO-Offload requires an Adam-family optimizer (the " \
-                "reference drives DeepSpeedCPUAdam, stage2.py:1418)"
+            assert "adam" in name and "onebit" not in name, \
+                "ZeRO-Offload requires a plain Adam-family optimizer (the " \
+                "reference drives DeepSpeedCPUAdam, stage2.py:1418); " \
+                "OnebitAdam does not compose with ZeRO/offload"
             self.optimizer = None  # built below, once master params exist
         elif optimizer is not None:
             self.optimizer = optimizer

@@ -1,0 +1,6 @@
+from deepspeed_tpu.runtime.fp16.loss_scaler import (
+    DynamicLossScaler, LossScaleState, StaticLossScaler, has_overflow)
+from deepspeed_tpu.runtime.fp16.fused_optimizer import (
+    FP16_Optimizer, FP16OptimizerState)
+from deepspeed_tpu.runtime.fp16.unfused_optimizer import FP16_UnfusedOptimizer
+from deepspeed_tpu.runtime.fp16.onebit_adam import OnebitAdam

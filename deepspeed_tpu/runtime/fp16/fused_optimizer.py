@@ -1,0 +1,226 @@
+"""fp16 optimizer wrapper: mixed precision without ZeRO.
+
+Reference: ``deepspeed/runtime/fp16/fused_optimizer.py:17`` ``FP16_Optimizer``
+(flat fp32 master copy per group, dynamic loss scaling, overflow skip,
+``step_fused_adam:133`` / ``step:191`` / ``backward:290`` /
+``unscale_and_clip_grads:270`` / elastic ``state_dict:350``).
+
+TPU re-design: the eager backward/step split collapses into one pure
+``update`` — unscale → overflow check → ``lax.cond``-guarded inner step on
+the fp32 master → fp16 copy-out → loss-scale bookkeeping — entirely
+jit-traceable. The class keeps the reference's OO surface (``backward``,
+``step``, ``state_dict``…) as a thin stateful facade over that pure
+function, so user code written against the reference keeps working while
+the engine (and tests) can call the pure path directly.
+"""
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.optimizers import Optimizer
+from deepspeed_tpu.runtime.fp16.loss_scaler import (
+    DynamicLossScaler, LossScaleState, StaticLossScaler, has_overflow)
+from deepspeed_tpu.utils.logging import logger
+
+__all__ = ["FP16_Optimizer", "FP16OptimizerState"]
+
+
+class FP16OptimizerState(NamedTuple):
+    master_params: Any          # fp32 copy (reference fp32_groups_flat)
+    inner_state: Any            # wrapped optimizer state
+    loss_scale: LossScaleState
+    overflow: jnp.ndarray       # bool: last step skipped?
+
+
+def _cast_like(tree, ref_tree):
+    return jax.tree_util.tree_map(
+        lambda x, r: x.astype(r.dtype), tree, ref_tree)
+
+
+class FP16_Optimizer:
+    """Wraps a basic optimizer with fp16 master-copy semantics
+    (reference ``fused_optimizer.py:17``)."""
+
+    def __init__(self,
+                 init_optimizer: Optimizer,
+                 static_loss_scale: float = 1.0,
+                 dynamic_loss_scale: bool = False,
+                 initial_dynamic_scale: float = 2 ** 32,
+                 dynamic_loss_args: Optional[dict] = None,
+                 verbose: bool = False,
+                 mpu=None,
+                 clip_grad: float = 0.0,
+                 fused_adam_legacy: bool = False):
+        self.optimizer = init_optimizer
+        self.clip_grad = clip_grad
+        self.mpu = mpu
+        self.verbose = verbose
+        if dynamic_loss_scale:
+            args = dict(dynamic_loss_args or {})
+            self.loss_scaler = DynamicLossScaler(
+                init_scale=args.get("init_scale", initial_dynamic_scale),
+                scale_factor=args.get("scale_factor", 2.0),
+                scale_window=args.get("scale_window", 1000),
+                min_scale=args.get("min_scale", 1.0),
+                delayed_shift=args.get("delayed_shift", 1))
+        else:
+            self.loss_scaler = StaticLossScaler(static_loss_scale)
+        # stateful-facade slots
+        self._state: Optional[FP16OptimizerState] = None
+        self._params_fp16 = None
+        self._pending_scaled_grads = None
+        self._lr = getattr(init_optimizer, "lr", 1e-3)
+
+    # ---------------- pure functional core ---------------------------- #
+    def init(self, params_fp16) -> FP16OptimizerState:
+        """Build state: fp32 master copy of the fp16 params (reference
+        ctor ``:60-77`` flattening to fp32), inner optimizer state on the
+        master."""
+        master = jax.tree_util.tree_map(
+            lambda p: jnp.asarray(p, jnp.float32), params_fp16)
+        return FP16OptimizerState(
+            master_params=master,
+            inner_state=self.optimizer.init(master),
+            loss_scale=self.loss_scaler.init(),
+            overflow=jnp.zeros((), bool))
+
+    def update(self, scaled_grads_fp16, state: FP16OptimizerState,
+               lr=None) -> Tuple[Any, FP16OptimizerState]:
+        """One optimizer boundary, jit-traceable (reference ``step:191``).
+        Takes grads of the *scaled* loss; returns (new fp16 params, state).
+        """
+        lr = self._lr if lr is None else lr
+        inv = 1.0 / state.loss_scale.scale
+        grads = jax.tree_util.tree_map(
+            lambda g: g.astype(jnp.float32) * inv, scaled_grads_fp16)
+        overflow = has_overflow(grads)
+
+        if self.clip_grad > 0:
+            sq = sum(jnp.sum(jnp.square(g))
+                     for g in jax.tree_util.tree_leaves(grads))
+            norm = jnp.sqrt(sq)
+            clip = jnp.minimum(1.0, self.clip_grad / (norm + 1e-6))
+            grads = jax.tree_util.tree_map(lambda g: g * clip, grads)
+
+        def do(operand):
+            master, inner, g = operand
+            return self.optimizer.update(g, inner, master, lr=lr)
+
+        def skip(operand):
+            master, inner, _ = operand
+            return master, inner
+
+        master, inner = jax.lax.cond(
+            overflow, skip, do,
+            (state.master_params, state.inner_state, grads))
+        new_scale = self.loss_scaler.update(state.loss_scale, overflow)
+        new_state = FP16OptimizerState(
+            master_params=master, inner_state=inner,
+            loss_scale=new_scale, overflow=overflow)
+        params_fp16 = jax.tree_util.tree_map(
+            lambda m: m.astype(jnp.float16), master)
+        return params_fp16, new_state
+
+    # ---------------- reference-style stateful facade ------------------ #
+    def bind(self, params_fp16):
+        """Attach concrete fp16 params to the facade."""
+        self._params_fp16 = params_fp16
+        self._state = self.init(params_fp16)
+        return self
+
+    def backward(self, loss, loss_fn=None, *loss_args):
+        """(reference ``backward:290``: scaled_loss.backward()). Functional
+        JAX has no implicit autograd tape — pass ``loss_fn(params) -> loss``
+        and the facade computes grads of ``loss_fn(p) * loss_scale``."""
+        assert self._state is not None, "call bind(params) first"
+        assert loss_fn is not None, \
+            "FP16_Optimizer.backward needs loss_fn (no autograd tape in JAX)"
+        scale = self._state.loss_scale.scale
+
+        def scaled(p):
+            return loss_fn(p, *loss_args) * scale
+
+        self._pending_scaled_grads = jax.grad(scaled)(self._params_fp16)
+        return loss
+
+    def step(self, closure=None):
+        """(reference ``step:191``) Returns True when the step was skipped
+        on overflow, mirroring the reference's skip reporting."""
+        assert self._pending_scaled_grads is not None, \
+            "step() must follow backward()"
+        self._params_fp16, self._state = self.update(
+            self._pending_scaled_grads, self._state, lr=self._lr)
+        self._pending_scaled_grads = None
+        skipped = bool(self._state.overflow)
+        if skipped and self.verbose:
+            logger.info(
+                f"[deepspeed_tpu] OVERFLOW! Skipping step, reducing loss "
+                f"scale to {float(self._state.loss_scale.scale)}")
+        return skipped
+
+    def zero_grad(self, set_grads_to_None: bool = True):
+        self._pending_scaled_grads = None
+
+    @property
+    def params(self):
+        return self._params_fp16
+
+    @property
+    def cur_scale(self):
+        assert self._state is not None
+        return float(self._state.loss_scale.scale)
+
+    # reference exposes loss_scale as a property (:338)
+    @property
+    def loss_scale(self):
+        return self.cur_scale
+
+    @property
+    def overflow(self):
+        assert self._state is not None
+        return bool(self._state.overflow)
+
+    def state_dict(self):
+        """(reference ``state_dict:350``) Host-side snapshot."""
+        assert self._state is not None
+        dev = jax.device_get
+        return {
+            "loss_scaler": dev(self._state.loss_scale),
+            "dynamic_loss_scale": isinstance(self.loss_scaler,
+                                             DynamicLossScaler) and
+            not isinstance(self.loss_scaler, StaticLossScaler),
+            "overflow": bool(self._state.overflow),
+            "fp32_groups_flat": dev(self._state.master_params),
+            "optimizer_state_dict": dev(self._state.inner_state),
+            "clip_grad": self.clip_grad,
+        }
+
+    def load_state_dict(self, sd, load_optimizer_states: bool = True):
+        """(reference ``load_state_dict:379``)"""
+        assert self._state is not None, "call bind(params) first"
+        master = jax.tree_util.tree_map(jnp.asarray,
+                                        sd["fp32_groups_flat"])
+        inner = (jax.tree_util.tree_map(jnp.asarray,
+                                        sd["optimizer_state_dict"])
+                 if load_optimizer_states else self._state.inner_state)
+        ls = sd["loss_scaler"]
+        scale_state = LossScaleState(*[jnp.asarray(x) for x in ls])
+        self._state = FP16OptimizerState(
+            master_params=master, inner_state=inner,
+            loss_scale=scale_state,
+            overflow=jnp.asarray(bool(sd.get("overflow", False))))
+        self._params_fp16 = jax.tree_util.tree_map(
+            lambda m: m.astype(jnp.float16), master)
+        self.clip_grad = sd.get("clip_grad", self.clip_grad)
+
+    def refresh_fp32_params(self):
+        """(reference ``refresh_fp32_params:375``) fp16 → fp32 master."""
+        self._state = self._state._replace(
+            master_params=jax.tree_util.tree_map(
+                lambda p: jnp.asarray(p, jnp.float32), self._params_fp16))
+
+    def __repr__(self):
+        return (f"FP16_Optimizer(inner={type(self.optimizer).__name__}, "
+                f"clip_grad={self.clip_grad})")

@@ -1,0 +1,21 @@
+"""fp16 wrapper, unfused variant (reference
+``deepspeed/runtime/fp16/unfused_optimizer.py:17`` ``FP16_UnfusedOptimizer``:
+per-tensor fp32 masters instead of flat groups, ``step_fused_lamb:118``).
+
+On TPU the fused/unfused distinction is moot — parameters are a pytree
+either way and XLA fuses the update chain — so this subclass exists for API
+parity and for LAMB-style wrapped optimizers (the reference routes LAMB
+through the unfused path). Numerics are identical to ``FP16_Optimizer``.
+"""
+
+from deepspeed_tpu.runtime.fp16.fused_optimizer import FP16_Optimizer
+
+__all__ = ["FP16_UnfusedOptimizer"]
+
+
+class FP16_UnfusedOptimizer(FP16_Optimizer):
+
+    def step_fused_lamb(self, closure=None):
+        """(reference ``step_fused_lamb:118``) — same pure update; the
+        wrapped optimizer is expected to be Lamb."""
+        return self.step(closure)

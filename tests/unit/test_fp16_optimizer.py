@@ -1,0 +1,132 @@
+"""FP16_Optimizer wrapper tests (mirror reference tests/unit/test_fp16.py's
+wrapper-level coverage: step skip on overflow, scale dynamics, parity with
+fp32 training, state round-trip)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.optimizers import Adam, Lamb
+from deepspeed_tpu.runtime.fp16.fused_optimizer import FP16_Optimizer
+from deepspeed_tpu.runtime.fp16.unfused_optimizer import FP16_UnfusedOptimizer
+
+
+def _quad_loss(target):
+    def loss_fn(p):
+        d = p["w"].astype(jnp.float32) - target
+        return jnp.sum(d * d)
+    return loss_fn
+
+
+def test_fp16_training_tracks_fp32():
+    target = jnp.asarray(np.random.RandomState(0).randn(8), jnp.float32)
+    loss_fn = _quad_loss(target)
+    p16 = {"w": jnp.zeros((8,), jnp.float16)}
+
+    fp16_opt = FP16_Optimizer(Adam(lr=0.05), static_loss_scale=128.0)
+    fp16_opt.bind(p16)
+
+    # fp32 oracle
+    oracle = Adam(lr=0.05)
+    p32 = {"w": jnp.zeros((8,), jnp.float32)}
+    st32 = oracle.init(p32)
+
+    for _ in range(50):
+        fp16_opt.backward(None, loss_fn)
+        skipped = fp16_opt.step()
+        assert not skipped
+        g = jax.grad(lambda p: loss_fn(p))(p32)
+        p32, st32 = oracle.update(g, st32, p32)
+    # fp16 path follows fp32 within half-precision tolerance
+    np.testing.assert_allclose(np.asarray(fp16_opt.params["w"], np.float32),
+                               np.asarray(p32["w"]), atol=2e-2)
+
+
+def test_overflow_skips_and_halves_scale():
+    opt = FP16_Optimizer(Adam(lr=0.1), dynamic_loss_scale=True,
+                         initial_dynamic_scale=2 ** 16)
+    p16 = {"w": jnp.ones((4,), jnp.float16)}
+    state = opt.init(p16)
+    w_before = np.asarray(state.master_params["w"]).copy()
+
+    bad = {"w": jnp.array([1.0, jnp.inf, 0.0, 0.0], jnp.float16)}
+    new_p, state = opt.update(bad, state)
+    assert bool(state.overflow)
+    np.testing.assert_array_equal(np.asarray(state.master_params["w"]),
+                                  w_before)  # step skipped
+    assert float(state.loss_scale.scale) == 2 ** 15  # halved
+
+    good = {"w": jnp.full((4,), 0.5, jnp.float16)}
+    new_p, state = opt.update(good, state)
+    assert not bool(state.overflow)
+    assert not np.allclose(np.asarray(state.master_params["w"]), w_before)
+
+
+def test_scale_growth_after_window():
+    opt = FP16_Optimizer(Adam(lr=0.01), dynamic_loss_scale=True,
+                         initial_dynamic_scale=4.0,
+                         dynamic_loss_args={"scale_window": 3})
+    p16 = {"w": jnp.ones((2,), jnp.float16)}
+    state = opt.init(p16)
+    g = {"w": jnp.full((2,), 0.1, jnp.float16)}
+    for i in range(3):
+        _, state = opt.update(g, state)
+    assert float(state.loss_scale.scale) == 8.0  # doubled after window
+
+
+def test_clip_grad():
+    opt = FP16_Optimizer(Adam(lr=1.0), static_loss_scale=1.0, clip_grad=0.5)
+    p16 = {"w": jnp.zeros((2,), jnp.float16)}
+    state = opt.init(p16)
+    huge = {"w": jnp.full((2,), 100.0, jnp.float16)}
+    new_p, state = opt.update(huge, state)
+    # with clipping the raw update magnitude stays bounded (Adam normalizes
+    # anyway; just confirm finite + step taken)
+    assert np.all(np.isfinite(np.asarray(new_p["w"], np.float32)))
+    assert not bool(state.overflow)
+
+
+def test_state_dict_roundtrip():
+    loss_fn = _quad_loss(jnp.arange(4.0))
+    opt = FP16_Optimizer(Adam(lr=0.05), dynamic_loss_scale=True)
+    opt.bind({"w": jnp.zeros((4,), jnp.float16)})
+    for _ in range(3):
+        opt.backward(None, loss_fn)
+        opt.step()
+    sd = opt.state_dict()
+    assert "fp32_groups_flat" in sd and sd["dynamic_loss_scale"]
+
+    opt2 = FP16_Optimizer(Adam(lr=0.05), dynamic_loss_scale=True)
+    opt2.bind({"w": jnp.zeros((4,), jnp.float16)})
+    opt2.load_state_dict(sd)
+    # identical continuation
+    for o in (opt, opt2):
+        o.backward(None, loss_fn)
+        o.step()
+    np.testing.assert_array_equal(
+        np.asarray(opt.params["w"], np.float32),
+        np.asarray(opt2.params["w"], np.float32))
+
+
+def test_unfused_lamb_variant():
+    loss_fn = _quad_loss(jnp.arange(6.0))
+    # nonzero start: LAMB's trust ratio scales with ||w||, so w=0 barely
+    # moves (correct LAMB behavior, not a wrapper property)
+    opt = FP16_UnfusedOptimizer(Lamb(lr=0.1), static_loss_scale=8.0)
+    opt.bind({"w": jnp.ones((6,), jnp.float16)})
+    l0 = float(loss_fn(opt.params))
+    for _ in range(60):
+        opt.backward(None, loss_fn)
+        opt.step_fused_lamb()
+    assert float(loss_fn(opt.params)) < 0.2 * l0
+
+
+def test_update_is_jittable():
+    opt = FP16_Optimizer(Adam(lr=0.05), dynamic_loss_scale=True)
+    state = opt.init({"w": jnp.zeros((4,), jnp.float16)})
+    upd = jax.jit(opt.update)
+    g = {"w": jnp.full((4,), 0.25, jnp.float16)}
+    p, state = upd(g, state)
+    p, state = upd(g, state)
+    assert p["w"].dtype == jnp.float16
