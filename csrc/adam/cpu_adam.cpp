@@ -67,6 +67,7 @@ inline void step_scalar_range(const StepScalars& s, float* params,
                               const float* grads, float* exp_avg,
                               float* exp_avg_sq, long long lo, long long hi,
                               uint16_t* out_bf16) {
+#pragma omp parallel for schedule(static)
     for (long long i = lo; i < hi; ++i) {
         float g = grads[i];
         float p = params[i];

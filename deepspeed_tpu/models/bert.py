@@ -88,8 +88,17 @@ def _ln(x, p, eps=1e-12):
 
 def bert_encoder(params, config: BertConfig, input_ids, attention_mask=None,
                  token_type_ids=None, rng=None, deterministic: bool = True,
-                 dtype=jnp.bfloat16, remat: bool = False):
-    """Sequence output (B, S, H). attention_mask: (B, S) with 1=keep."""
+                 dtype=jnp.bfloat16, remat: bool = False,
+                 sparsity_config=None):
+    """Sequence output (B, S, H). attention_mask: (B, S) with 1=keep.
+
+    ``sparsity_config``: a SparsityConfig — the layers' core attention is
+    swapped for block-sparse attention (what the reference's
+    SparseAttentionUtils module surgery achieves,
+    sparse_attention_utils.py:85); QKV/output projections and all other
+    params are reused unchanged. seq_len must be a multiple of the sparsity
+    block (use SparseAttentionUtils.pad_to_block_size).
+    """
     B, S = input_ids.shape
     lcfg = layer_config(config, training=not deterministic)
     pos = jnp.arange(S)[None, :]
@@ -104,16 +113,32 @@ def bert_encoder(params, config: BertConfig, input_ids, attention_mask=None,
         add_mask = ((1.0 - attention_mask[:, None, None, :].astype(
             jnp.float32)) * -1e9)
 
+    attention_fn = None
+    if sparsity_config is not None:
+        from deepspeed_tpu.ops.sparse_attention import SparseSelfAttention
+        # 'mul' mode: our (B, S) mask is 1=keep/0=pad — _to_additive turns
+        # zeros into -inf (the default 'add' mode would add the raw 1/0
+        # values as biases and leave padding unmasked)
+        sparse_attn = SparseSelfAttention(sparsity_config,
+                                          key_padding_mask_mode="mul")
+        kpm = attention_mask  # (B, S), 1=keep
+
+        def attention_fn(q, k, v, _add_mask):
+            return sparse_attn(q, k, v, key_padding_mask=kpm)
+
     fwd = transformer_layer_forward
     if remat:
+        # use_flash (6) and attention_fn (7) are static: plain callables,
+        # not pytrees
         fwd = jax.checkpoint(transformer_layer_forward,
-                             static_argnums=(1, 5, 6))
+                             static_argnums=(1, 5, 6, 7))
     for i in range(config.num_layers):
         if rng is not None:
             rng, r = jax.random.split(rng)
         else:
             r = None
-        x = fwd(params[f"layer_{i}"], lcfg, x, add_mask, r, deterministic)
+        x = fwd(params[f"layer_{i}"], lcfg, x, add_mask, r, deterministic,
+                True, attention_fn)
     return x
 
 

@@ -34,12 +34,13 @@ def _csrc_dir() -> str:
     return os.path.normpath(os.path.join(here, "..", "..", "..", "csrc"))
 
 
-def load_library(rebuild: bool = False):
-    """Load (building if needed) the native Adam library. Returns None when
-    neither a prebuilt .so nor a toolchain is available."""
+def load_library():
+    """Load the native Adam library, (re)building via make first — a no-op
+    when the .so is newer than the source. Returns None when neither a
+    prebuilt .so nor a toolchain is available."""
     global _LIB
     with _LIB_LOCK:
-        if _LIB is not None and not rebuild:
+        if _LIB is not None:
             return _LIB
         so_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                _LIB_NAME)

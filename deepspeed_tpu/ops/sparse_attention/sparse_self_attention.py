@@ -156,6 +156,45 @@ class SparseAttentionUtils:
         return out
 
     @staticmethod
+    def update_tokenizer_model_max_length(tokenizer, max_position: int):
+        """Bump a (HF-style) tokenizer's max length to the extended
+        position-table size (sparse_attention_utils.py:68)."""
+        tokenizer.model_max_length = max_position
+        if hasattr(tokenizer, "init_kwargs"):
+            tokenizer.init_kwargs["model_max_length"] = max_position
+        return tokenizer
+
+    @staticmethod
+    def replace_model_self_attention_with_sparse_self_attention(
+            params, config, max_position: Optional[int] = None,
+            sparsity_config=None):
+        """Functional analogue of the reference's module surgery
+        (sparse_attention_utils.py:85): returns ``(params, config,
+        encoder_fn)`` where ``encoder_fn(params, input_ids, ...)`` runs the
+        BERT encoder with block-sparse core attention, reusing the dense
+        QKV/output projection weights unchanged. Optionally extends the
+        position table to ``max_position`` first."""
+        import functools
+        from deepspeed_tpu.models.bert import bert_encoder
+        if sparsity_config is None:
+            sparsity_config = FixedSparsityConfig(
+                num_heads=getattr(config, "num_heads", 4))
+        if max_position is not None and \
+                max_position > config.max_position_embeddings:
+            params = SparseAttentionUtils.extend_position_embedding(
+                params, max_position)
+            config = config._replace(max_position_embeddings=max_position)
+        encoder_fn = functools.partial(bert_encoder, config=config,
+                                       sparsity_config=sparsity_config)
+        return params, config, encoder_fn
+
+    # reference-name alias (sparse_attention_utils.py:123 operates on one
+    # layer; with a pluggable attention_fn the per-layer and whole-model
+    # operations coincide)
+    replace_self_attention_layer_with_sparse_self_attention_layer = \
+        replace_model_self_attention_with_sparse_self_attention
+
+    @staticmethod
     def pad_to_block_size(block_size: int, input_ids, pad_token_id: int,
                           attention_mask=None, token_type_ids=None,
                           position_ids=None, labels=None,

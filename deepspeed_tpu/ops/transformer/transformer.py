@@ -137,11 +137,17 @@ def transformer_layer_forward(params: Dict[str, Any],
                               attention_mask=None,
                               rng=None,
                               deterministic: Optional[bool] = None,
-                              use_flash: bool = True):
+                              use_flash: bool = True,
+                              attention_fn=None):
     """One encoder/decoder layer (reference BertTransformerLayer::Forward,
     ds_transformer_cuda.cpp:153).
 
     hidden_states: (B, S, H); attention_mask: additive (B, 1, 1, S) or None.
+    ``attention_fn``: optional core-attention override with signature
+    ``(q, k, v, additive_mask) -> ctx`` on (B, heads, S, hd) tensors — the
+    hook SparseAttentionUtils uses to swap in block-sparse attention
+    (reference swaps the whole BertSelfAttention module instead,
+    sparse_attention_utils.py:123).
     Returns (B, S, H).
     """
     if deterministic is None:
@@ -169,7 +175,9 @@ def transformer_layer_forward(params: Dict[str, Any],
         v = v.reshape(B, S, heads, hd).transpose(0, 2, 1, 3)
         use_ref = ((config.attn_dropout_ratio > 0 and not deterministic)
                    or not use_flash)
-        if use_ref:
+        if attention_fn is not None:
+            ctx = attention_fn(q, k, v, attention_mask)
+        elif use_ref:
             sm_scale = 1.0 / np.sqrt(hd)
             s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                            k.astype(jnp.float32)) * sm_scale

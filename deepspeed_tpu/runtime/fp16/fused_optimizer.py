@@ -34,11 +34,6 @@ class FP16OptimizerState(NamedTuple):
     overflow: jnp.ndarray       # bool: last step skipped?
 
 
-def _cast_like(tree, ref_tree):
-    return jax.tree_util.tree_map(
-        lambda x, r: x.astype(r.dtype), tree, ref_tree)
-
-
 class FP16_Optimizer:
     """Wraps a basic optimizer with fp16 master-copy semantics
     (reference ``fused_optimizer.py:17``)."""

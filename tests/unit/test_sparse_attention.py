@@ -335,3 +335,92 @@ def test_extend_position_embedding():
                                np.asarray(params["pos_emb"]))
     np.testing.assert_allclose(np.asarray(out["pos_emb"][128:256]),
                                np.asarray(params["pos_emb"]))
+
+
+def test_replace_model_self_attention_surgery():
+    """Model surgery (reference sparse_attention_utils.py:85): swap the BERT
+    encoder's core attention for block-sparse, reusing dense weights; with a
+    dense sparsity layout the output must match the dense encoder."""
+    from deepspeed_tpu.models.bert import BertConfig, init_bert_params
+    from deepspeed_tpu.ops.sparse_attention import DenseSparsityConfig
+
+    cfg = BertConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                     num_heads=2, intermediate_size=64,
+                     max_position_embeddings=64,
+                     hidden_dropout=0.0, attn_dropout=0.0)
+    params = init_bert_params(cfg, jax.random.PRNGKey(0))
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 128, (2, 64)),
+                      jnp.int32)
+
+    from deepspeed_tpu.models.bert import bert_encoder
+    dense_out = bert_encoder(params, cfg, ids, dtype=jnp.float32)
+
+    sp, scfg, encoder_fn = \
+        SparseAttentionUtils.replace_model_self_attention_with_sparse_self_attention(
+            params, cfg,
+            sparsity_config=DenseSparsityConfig(num_heads=2, block=16))
+    sparse_out = encoder_fn(sp, input_ids=ids, dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(sparse_out, np.float32),
+                               np.asarray(dense_out, np.float32),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_surgery_extends_positions_and_runs_sparse():
+    from deepspeed_tpu.models.bert import BertConfig, init_bert_params
+    from deepspeed_tpu.ops.sparse_attention import FixedSparsityConfig
+
+    cfg = BertConfig(vocab_size=128, hidden_size=32, num_layers=1,
+                     num_heads=2, intermediate_size=64,
+                     max_position_embeddings=64,
+                     hidden_dropout=0.0, attn_dropout=0.0)
+    params = init_bert_params(cfg, jax.random.PRNGKey(0))
+    sp, scfg, encoder_fn = \
+        SparseAttentionUtils.replace_model_self_attention_with_sparse_self_attention(
+            params, cfg, max_position=256,
+            sparsity_config=FixedSparsityConfig(num_heads=2, block=16,
+                                                num_local_blocks=4))
+    assert scfg.max_position_embeddings == 256
+    assert sp["pos_emb"].shape[0] == 256
+    # 4x the original max length now runs (the 10x-longer-sequences claim
+    # mechanism, BASELINE.md sparse attention row)
+    ids = jnp.asarray(np.random.RandomState(1).randint(0, 128, (1, 256)),
+                      jnp.int32)
+    out = encoder_fn(sp, input_ids=ids, dtype=jnp.float32)
+    assert out.shape == (1, 256, 32)
+    assert np.isfinite(np.asarray(out, np.float32)).all()
+
+
+def test_update_tokenizer_model_max_length():
+    class Tok:
+        model_max_length = 512
+        init_kwargs = {}
+    tok = SparseAttentionUtils.update_tokenizer_model_max_length(Tok(), 2048)
+    assert tok.model_max_length == 2048
+    assert tok.init_kwargs["model_max_length"] == 2048
+
+
+def test_surgery_respects_key_padding():
+    """Padding tokens must not leak into sparse attention (mul-mode mask):
+    output at kept positions matches dense masked encoder."""
+    from deepspeed_tpu.models.bert import (BertConfig, bert_encoder,
+                                           init_bert_params)
+    from deepspeed_tpu.ops.sparse_attention import DenseSparsityConfig
+
+    cfg = BertConfig(vocab_size=128, hidden_size=32, num_layers=1,
+                     num_heads=2, intermediate_size=64,
+                     max_position_embeddings=64,
+                     hidden_dropout=0.0, attn_dropout=0.0)
+    params = init_bert_params(cfg, jax.random.PRNGKey(0))
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 128, (2, 64)),
+                      jnp.int32)
+    mask = jnp.ones((2, 64), jnp.int32).at[:, 40:].set(0)
+
+    dense = bert_encoder(params, cfg, ids, attention_mask=mask,
+                         dtype=jnp.float32)
+    sparse = bert_encoder(params, cfg, ids, attention_mask=mask,
+                          dtype=jnp.float32,
+                          sparsity_config=DenseSparsityConfig(num_heads=2,
+                                                              block=16))
+    np.testing.assert_allclose(np.asarray(sparse[:, :40], np.float32),
+                               np.asarray(dense[:, :40], np.float32),
+                               rtol=2e-2, atol=2e-2)
