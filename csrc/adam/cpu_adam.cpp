@@ -67,7 +67,9 @@ inline void step_scalar_range(const StepScalars& s, float* params,
                               const float* grads, float* exp_avg,
                               float* exp_avg_sq, long long lo, long long hi,
                               uint16_t* out_bf16) {
-#pragma omp parallel for schedule(static)
+    // if-clause: skip the fork/join for tiny ranges (e.g. the <8-element
+    // tail the AVX2 path hands us per leaf)
+#pragma omp parallel for schedule(static) if (hi - lo >= 4096)
     for (long long i = lo; i < hi; ++i) {
         float g = grads[i];
         float p = params[i];

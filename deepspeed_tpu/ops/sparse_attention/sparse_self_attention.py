@@ -174,7 +174,6 @@ class SparseAttentionUtils:
         BERT encoder with block-sparse core attention, reusing the dense
         QKV/output projection weights unchanged. Optionally extends the
         position table to ``max_position`` first."""
-        import functools
         from deepspeed_tpu.models.bert import bert_encoder
         if sparsity_config is None:
             sparsity_config = FixedSparsityConfig(
@@ -184,8 +183,12 @@ class SparseAttentionUtils:
             params = SparseAttentionUtils.extend_position_embedding(
                 params, max_position)
             config = config._replace(max_position_embeddings=max_position)
-        encoder_fn = functools.partial(bert_encoder, config=config,
-                                       sparsity_config=sparsity_config)
+        cfg = config
+
+        def encoder_fn(params, input_ids, **kw):
+            return bert_encoder(params, cfg, input_ids,
+                                sparsity_config=sparsity_config, **kw)
+
         return params, config, encoder_fn
 
     # reference-name alias (sparse_attention_utils.py:123 operates on one

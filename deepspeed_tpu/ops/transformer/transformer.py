@@ -130,6 +130,22 @@ def init_transformer_params(config: DeepSpeedTransformerConfig, key,
 from deepspeed_tpu.ops.functional import dropout as _dropout
 from deepspeed_tpu.ops.functional import layer_norm as _layer_norm
 
+_WARNED_NO_ATTN_DROPOUT = False
+
+
+def _warn_no_attn_dropout():
+    """Custom attention_fn paths (block-sparse) carry no attention dropout
+    — same as the reference's sparse swap, but say so once instead of
+    silently changing regularization."""
+    global _WARNED_NO_ATTN_DROPOUT
+    if not _WARNED_NO_ATTN_DROPOUT:
+        _WARNED_NO_ATTN_DROPOUT = True
+        from deepspeed_tpu.utils.logging import logger
+        logger.warning(
+            "attention_fn override active with attn_dropout > 0: custom "
+            "core attention (e.g. block-sparse) applies NO attention "
+            "dropout; hidden-dropout still applies")
+
 
 def transformer_layer_forward(params: Dict[str, Any],
                               config: DeepSpeedTransformerConfig,
@@ -176,6 +192,8 @@ def transformer_layer_forward(params: Dict[str, Any],
         use_ref = ((config.attn_dropout_ratio > 0 and not deterministic)
                    or not use_flash)
         if attention_fn is not None:
+            if config.attn_dropout_ratio > 0 and not deterministic:
+                _warn_no_attn_dropout()
             ctx = attention_fn(q, k, v, attention_mask)
         elif use_ref:
             sm_scale = 1.0 / np.sqrt(hd)

@@ -332,6 +332,15 @@ class DeepSpeedEngine:
                 training_data, collate_fn=collate_fn)
 
         # -- misc bookkeeping --
+        # tensorboard (reference engine.py:151-156; rank-0 only)
+        from deepspeed_tpu.utils.monitor import TensorBoardMonitor
+        self.monitor = TensorBoardMonitor(
+            enabled=self._config.tensorboard_enabled,
+            output_path=self._config.tensorboard_output_path,
+            job_name=self._config.tensorboard_job_name,
+            rank=jax.process_index())
+        self.summary_writer = self.monitor.writer  # reference attr name
+
         self.timers = SynchronizedWallClockTimer()
         self.tput_timer = ThroughputTimer(
             batch_size=self.train_micro_batch_size_per_gpu() *
@@ -769,6 +778,7 @@ class DeepSpeedEngine:
                 self._host_apply_update()
                 self._host_global_step += 1
                 self._report_progress()
+                self._write_monitor(self._cached_loss)
             self._host_micro_step += 1
             if self.wall_clock_breakdown_enabled:
                 self.timers("step").stop()
@@ -787,6 +797,7 @@ class DeepSpeedEngine:
                 self.state = self._compiled_apply(self.state)
                 self._host_global_step += 1
                 self._report_progress()
+                self._write_monitor(self._cached_loss)
         else:
             grads = getattr(self, "_pending_grads", None)
             assert grads is not None, "step() must follow backward()"
@@ -794,6 +805,7 @@ class DeepSpeedEngine:
             self.state = self._compiled_apply(self.state, grads)
             self._host_global_step += 1
             self._report_progress()
+            self._write_monitor(self._cached_loss)
         self._host_micro_step += 1
         if self.wall_clock_breakdown_enabled:
             self.timers("step").stop()
@@ -830,6 +842,7 @@ class DeepSpeedEngine:
         self._host_micro_step += self.gradient_accumulation_steps
         self._host_global_step += 1
         self._report_progress()
+        self._write_monitor(mean_loss)
         return mean_loss
 
     def eval_batch(self, batch):
@@ -842,6 +855,18 @@ class DeepSpeedEngine:
                 return out[0] if isinstance(out, tuple) else out
             self._compiled_eval = jax.jit(ev)
         return self._compiled_eval(self.state.params, batch, self.state.rng)
+
+    def _write_monitor(self, loss=None):
+        """reference engine.py:780-790/:922-936: loss/lr/scale scalars,
+        x-axis = cumulative samples (forces a loss sync; opt-in)."""
+        if not self.monitor.enabled:
+            return
+        samples = self._host_global_step * self.train_batch_size()
+        self.monitor.write_train_metrics(
+            loss=float(loss) if loss is not None else None,
+            lr=float(self._lr_at(self.state.global_step)),
+            loss_scale=self.loss_scale(),
+            samples=samples)
 
     def _report_progress(self):
         # gate on the host mirror: no device sync unless actually printing

@@ -163,6 +163,7 @@ class PipelineEngine(DeepSpeedEngine):
         self._host_micro_step += self.micro_batches
         self._host_global_step += 1
         self._report_progress()
+        self._write_monitor(loss)  # tensorboard (reference pipe :283-292)
         return loss
 
     def eval_batch(self, data_iter) -> jnp.ndarray:

@@ -1,0 +1,118 @@
+"""Training metrics monitor (tensorboard).
+
+Reference: the engine's tensorboardX integration
+(``deepspeed/runtime/engine.py:14,151-156,780-790,922-936``): rank 0 writes
+``Train/Samples/train_loss``, ``Train/Samples/lr``,
+``Train/Samples/loss_scale`` and per-timer scalars under
+``Train/Samples/<timer>``.
+
+TPU build: ``torch.utils.tensorboard`` (torch-cpu is in the image) when
+available; otherwise a JSONL event log with the same (tag, value, step)
+records so metrics are never silently dropped. Construction mirrors the
+reference's ``get_summary_writer`` naming scheme
+(``<base>/<job_name>_<host>`` under ``DLWS_JOB_ID``/``DLTS_JOB_ID`` when
+set).
+"""
+
+import json
+import os
+import socket
+from typing import Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+__all__ = ["TensorBoardMonitor", "get_summary_writer"]
+
+
+class _JsonlWriter:
+    """Fallback SummaryWriter look-alike: one JSON object per scalar."""
+
+    def __init__(self, log_dir: str):
+        os.makedirs(log_dir, exist_ok=True)
+        self._f = open(os.path.join(log_dir, "events.jsonl"), "a")
+
+    def add_scalar(self, tag, value, step):
+        self._f.write(json.dumps(
+            {"tag": tag, "value": float(value), "step": int(step)}) + "\n")
+
+    def flush(self):
+        self._f.flush()
+
+    def close(self):
+        self._f.close()
+
+
+def _make_writer(log_dir: str):
+    """torch SummaryWriter, or the JSONL fallback when unavailable."""
+    try:
+        from torch.utils.tensorboard import SummaryWriter
+        return SummaryWriter(log_dir=log_dir)
+    except Exception as e:
+        logger.warning(f"tensorboard unavailable ({e}); falling back to "
+                       f"JSONL event log in {log_dir}")
+        return _JsonlWriter(log_dir)
+
+
+def get_summary_writer(name: str = "DeepSpeedTPUJobName",
+                       base: str = os.path.join(os.path.expanduser("~"),
+                                                "tensorboard")):
+    """(reference ``engine.py:246-254``) Build a SummaryWriter under
+    ``<base>/<infra job id>/logs/<name>_<host>``."""
+    if "DLWS_JOB_ID" in os.environ:
+        infra_job_id = os.environ["DLWS_JOB_ID"]
+    elif "DLTS_JOB_ID" in os.environ:
+        infra_job_id = os.environ["DLTS_JOB_ID"]
+    else:
+        infra_job_id = "unknown-job-id"
+    summary_writer_dir_name = os.path.join(infra_job_id, "logs")
+    return _make_writer(os.path.join(base, summary_writer_dir_name,
+                                     name + "_" + socket.gethostname()))
+
+
+class TensorBoardMonitor:
+    """Engine-facing wrapper: no-ops unless enabled and on rank 0."""
+
+    def __init__(self, enabled: bool, output_path: Optional[str] = None,
+                 job_name: Optional[str] = None, rank: int = 0):
+        self.enabled = bool(enabled) and rank == 0
+        self.writer = None
+        if self.enabled:
+            if output_path:
+                self.writer = _make_writer(os.path.join(
+                    output_path, job_name or "DeepSpeedTPUJobName"))
+            else:
+                self.writer = get_summary_writer(
+                    name=job_name or "DeepSpeedTPUJobName")
+
+    def write_scalar(self, tag: str, value, step: int):
+        if self.writer is not None:
+            self.writer.add_scalar(tag, float(value), int(step))
+
+    def write_train_metrics(self, *, loss=None, lr=None, loss_scale=None,
+                            samples: int = 0):
+        """The reference's per-step scalars (engine.py:780-790, 922-936):
+        x-axis is cumulative sample count."""
+        if self.writer is None:
+            return
+        if loss is not None:
+            self.write_scalar("Train/Samples/train_loss", loss, samples)
+        if lr is not None:
+            self.write_scalar("Train/Samples/lr", lr, samples)
+        if loss_scale is not None:
+            self.write_scalar("Train/Samples/loss_scale", loss_scale,
+                              samples)
+        self.flush()
+
+    def write_timer_values(self, timer_values: dict, samples: int = 0):
+        """Per-timer milliseconds (engine.py:950-974 pattern)."""
+        for name, ms in timer_values.items():
+            self.write_scalar(f"Train/Samples/{name}", ms, samples)
+
+    def flush(self):
+        if self.writer is not None:
+            self.writer.flush()
+
+    def close(self):
+        if self.writer is not None:
+            self.writer.close()
+            self.writer = None
