@@ -36,6 +36,10 @@ def init_distributed(coordinator_address: Optional[str] = None,
         int(os.environ.get("DSTPU_NUM_PROCESSES", "0") or 0)
     pid = process_id if process_id is not None else \
         int(os.environ.get("DSTPU_PROCESS_ID", "-1") or -1)
+    if pid < 0 and os.environ.get("DSTPU_PROCESS_ID_FROM_MPI"):
+        # OpenMPIRunner path: identity comes from the MPI rank env
+        # (reference bootstraps ranks from mpi4py, engine.py:198 _mpi_check)
+        pid = int(os.environ.get("OMPI_COMM_WORLD_RANK", "-1") or -1)
 
     if coordinator and nprocs > 1 and pid >= 0:
         logger.info(f"jax.distributed.initialize(coordinator={coordinator}, "

@@ -1,0 +1,126 @@
+"""Multi-node transport runners for the ``dstpu`` launcher.
+
+Reference: ``deepspeed/launcher/multinode_runner.py`` (``PDSHRunner:35``,
+``OpenMPIRunner:78``, ``MVAPICHRunner:118``) — each wraps a remote-execution
+transport and renders the per-node command.
+
+TPU differences: one process per HOST (JAX is multi-controller; chips are
+local to the process), rendezvous via ``jax.distributed.initialize`` driven
+by ``DSTPU_COORDINATOR/NUM_PROCESSES/PROCESS_ID`` env (the reference wires
+RANK/WORLD_SIZE/MASTER_* per GPU process instead). MVAPICH (CUDA-specific)
+has no TPU analog; the MPI runner targets any mpirun.
+"""
+
+import os
+import shlex
+import shutil
+import sys
+from typing import Dict, List
+
+__all__ = ["MultiNodeRunner", "SSHRunner", "PDSHRunner", "OpenMPIRunner",
+           "make_runner"]
+
+
+class MultiNodeRunner:
+    """Base: renders the command that runs ``process_id`` on ``host``."""
+
+    name = "base"
+
+    def __init__(self, args, world_info: Dict[str, List[int]]):
+        self.args = args
+        self.world_info = world_info
+
+    def backend_exists(self) -> bool:
+        raise NotImplementedError
+
+    def _remote_shell_line(self, process_id: int, num_processes: int,
+                           coordinator: str,
+                           exports: Dict[str, str]) -> str:
+        env_parts = [f"{k}={shlex.quote(v)}"
+                     for k, v in sorted(exports.items())]
+        env_parts += [
+            f"DSTPU_COORDINATOR={coordinator}",
+            f"DSTPU_NUM_PROCESSES={num_processes}",
+            f"DSTPU_PROCESS_ID={process_id}",
+        ]
+        return (f"cd {shlex.quote(os.getcwd())} && "
+                + " ".join(env_parts)
+                + f" {shlex.quote(sys.executable)} -u "
+                + shlex.quote(self.args.user_script) + " "
+                + " ".join(map(shlex.quote, self.args.user_args)))
+
+    def get_cmd(self, host: str, process_id: int, num_processes: int,
+                coordinator: str, exports: Dict[str, str]) -> List[str]:
+        raise NotImplementedError
+
+
+class SSHRunner(MultiNodeRunner):
+    """Plain ssh per host (the default; the reference's pdsh minus the
+    fan-out dependency)."""
+
+    name = "ssh"
+
+    def backend_exists(self) -> bool:
+        return shutil.which("ssh") is not None
+
+    def get_cmd(self, host, process_id, num_processes, coordinator, exports):
+        line = self._remote_shell_line(process_id, num_processes,
+                                       coordinator, exports)
+        if host in ("localhost", "127.0.0.1"):
+            return ["/bin/sh", "-c", line]
+        return ["ssh", "-o", "StrictHostKeyChecking=no", host, line]
+
+
+class PDSHRunner(MultiNodeRunner):
+    """pdsh transport (reference ``PDSHRunner:35``). Note pdsh renders one
+    command per host here (per-host env differs), not one fan-out."""
+
+    name = "pdsh"
+
+    def backend_exists(self) -> bool:
+        return shutil.which("pdsh") is not None
+
+    def get_cmd(self, host, process_id, num_processes, coordinator, exports):
+        line = self._remote_shell_line(process_id, num_processes,
+                                       coordinator, exports)
+        if host in ("localhost", "127.0.0.1"):
+            return ["/bin/sh", "-c", line]
+        return ["pdsh", "-R", "ssh", "-w", host, line]
+
+
+class OpenMPIRunner(MultiNodeRunner):
+    """mpirun transport (reference ``OpenMPIRunner:78``): ONE command that
+    launches every process; per-process identity comes from
+    OMPI_COMM_WORLD_RANK, which init_distributed maps to DSTPU_PROCESS_ID
+    via the ``--use_mpi_rank`` shim env."""
+
+    name = "openmpi"
+
+    def backend_exists(self) -> bool:
+        return shutil.which("mpirun") is not None
+
+    def get_cmd_all(self, hosts: List[str], coordinator: str,
+                    exports: Dict[str, str]) -> List[str]:
+        cmd = ["mpirun", "-np", str(len(hosts)),
+               "--host", ",".join(hosts),
+               "--allow-run-as-root"]
+        for k, v in sorted(exports.items()):
+            cmd += ["-x", f"{k}={v}"]
+        cmd += ["-x", f"DSTPU_COORDINATOR={coordinator}",
+                "-x", f"DSTPU_NUM_PROCESSES={len(hosts)}",
+                "-x", "DSTPU_PROCESS_ID_FROM_MPI=1"]
+        cmd += [sys.executable, "-u", self.args.user_script]
+        cmd += self.args.user_args
+        return cmd
+
+    def get_cmd(self, host, process_id, num_processes, coordinator, exports):
+        raise RuntimeError("OpenMPIRunner launches all processes in one "
+                           "mpirun; use get_cmd_all")
+
+
+def make_runner(launcher: str, args, world_info) -> MultiNodeRunner:
+    runners = {"ssh": SSHRunner, "pdsh": PDSHRunner, "openmpi": OpenMPIRunner}
+    if launcher not in runners:
+        raise ValueError(f"unknown launcher {launcher!r}; "
+                         f"choose from {sorted(runners)}")
+    return runners[launcher](args, world_info)

@@ -52,8 +52,9 @@ def parse_args(args=None):
     parser.add_argument("--master_addr", type=str, default="",
                         help="Coordinator address (default: first host)")
     parser.add_argument("--launcher", type=str, default="ssh",
-                        choices=["ssh", "pdsh", "local"],
-                        help="Multi-node transport")
+                        choices=["ssh", "pdsh", "openmpi", "local"],
+                        help="Multi-node transport (reference supports "
+                             "pdsh/openmpi/mvapich, multinode_runner.py)")
     parser.add_argument("--force_multi", action="store_true",
                         help="Treat as multi-node even for one host")
     parser.add_argument("user_script", type=str,
@@ -189,29 +190,6 @@ def collect_env_exports() -> Dict[str, str]:
     return exports
 
 
-def build_host_cmd(host: str, process_id: int, num_processes: int,
-                   coordinator: str, args, exports: Dict[str, str],
-                   transport: str = "ssh") -> List[str]:
-    """Command that runs the user script on one host with jax.distributed
-    env; mirrors reference multinode_runner.py get_cmd methods."""
-    env_parts = [f"{k}={shlex.quote(v)}" for k, v in sorted(exports.items())]
-    env_parts += [
-        f"DSTPU_COORDINATOR={coordinator}",
-        f"DSTPU_NUM_PROCESSES={num_processes}",
-        f"DSTPU_PROCESS_ID={process_id}",
-    ]
-    remote_cmd = (f"cd {shlex.quote(os.getcwd())} && "
-                  + " ".join(env_parts)
-                  + f" {shlex.quote(sys.executable)} -u "
-                  + shlex.quote(args.user_script) + " "
-                  + " ".join(map(shlex.quote, args.user_args)))
-    if host in ("localhost", "127.0.0.1"):
-        return ["/bin/sh", "-c", remote_cmd]
-    if transport == "pdsh":
-        return ["pdsh", "-w", host, remote_cmd]
-    return ["ssh", "-o", "StrictHostKeyChecking=no", host, remote_cmd]
-
-
 def main(args=None):
     args = parse_args(args)
     resource_pool = fetch_hostfile(args.hostfile)
@@ -242,12 +220,29 @@ def main(args=None):
     exports = collect_env_exports()
     exports["DSTPU_WORLD_INFO"] = encode_world_info(active)
 
+    from deepspeed_tpu.launcher.multinode_runner import make_runner
+    runner = make_runner(args.launcher, args, active)
+    nonlocal_hosts = [h for h in hosts
+                      if h not in ("localhost", "127.0.0.1")]
+    # ssh/pdsh have a /bin/sh shortcut for local hosts; mpirun is needed
+    # even for a localhost-only pool
+    if (nonlocal_hosts or args.launcher == "openmpi") and \
+            not runner.backend_exists():
+        raise RuntimeError(
+            f"launcher backend '{args.launcher}' not found on PATH "
+            f"(hosts: {hosts})")
+
     procs = []
-    for pid, host in enumerate(hosts):
-        cmd = build_host_cmd(host, pid, len(hosts), coordinator, args,
-                             exports, transport=args.launcher)
-        logger.info(f"dstpu launching on {host}: process {pid}/{len(hosts)}")
+    if args.launcher == "openmpi":
+        cmd = runner.get_cmd_all(hosts, coordinator, exports)
+        logger.info(f"dstpu mpirun launch: {' '.join(cmd[:8])} ...")
         procs.append(subprocess.Popen(cmd))
+    else:
+        for pid, host in enumerate(hosts):
+            cmd = runner.get_cmd(host, pid, len(hosts), coordinator, exports)
+            logger.info(
+                f"dstpu launching on {host}: process {pid}/{len(hosts)}")
+            procs.append(subprocess.Popen(cmd))
     exit_code = 0
     for p in procs:
         p.wait()

@@ -10,7 +10,7 @@ setup(
                 "pipeline/3D parallelism, fused Pallas kernels, sparse "
                 "attention — DeepSpeed capabilities on JAX/XLA",
     packages=find_packages(include=["deepspeed_tpu", "deepspeed_tpu.*"]),
-    scripts=["bin/dstpu"],
+    scripts=["bin/dstpu", "bin/ds", "bin/dstpu_ssh"],
     python_requires=">=3.10",
     install_requires=["jax", "numpy"],
 )

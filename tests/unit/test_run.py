@@ -93,3 +93,77 @@ def test_parse_args_remainder():
     assert args.user_script == "train.py"
     assert args.user_args == ["--deepspeed_config", "ds.json"]
     assert args.launcher == "ssh"
+
+
+# ---------------------------------------------------------------------------
+# multinode runners (reference tests cover runner cmd construction implicitly
+# via test_run; here explicitly, mirroring multinode_runner.py:35/78)
+# ---------------------------------------------------------------------------
+
+def _args(script="train.py", user_args=("--x", "1")):
+    import argparse
+    ns = argparse.Namespace()
+    ns.user_script = script
+    ns.user_args = list(user_args)
+    return ns
+
+
+def test_ssh_runner_cmd():
+    from deepspeed_tpu.launcher.multinode_runner import SSHRunner
+    r = SSHRunner(_args(), {"worker-1": [0]})
+    cmd = r.get_cmd("worker-1", 1, 4, "worker-0:29500", {"PATH": "/usr/bin"})
+    assert cmd[0] == "ssh" and cmd[-2] == "worker-1"
+    line = cmd[-1]
+    assert "DSTPU_PROCESS_ID=1" in line
+    assert "DSTPU_NUM_PROCESSES=4" in line
+    assert "DSTPU_COORDINATOR=worker-0:29500" in line
+    assert "train.py --x 1" in line
+    # localhost shortcut: no ssh
+    local = r.get_cmd("localhost", 0, 4, "worker-0:29500", {})
+    assert local[0] == "/bin/sh"
+
+
+def test_pdsh_runner_cmd():
+    from deepspeed_tpu.launcher.multinode_runner import PDSHRunner
+    r = PDSHRunner(_args(), {})
+    cmd = r.get_cmd("worker-2", 2, 4, "c:1", {})
+    assert cmd[:4] == ["pdsh", "-R", "ssh", "-w"] and cmd[4] == "worker-2"
+
+
+def test_openmpi_runner_cmd():
+    from deepspeed_tpu.launcher.multinode_runner import OpenMPIRunner
+    r = OpenMPIRunner(_args(), {})
+    cmd = r.get_cmd_all(["a", "b", "c"], "a:29500", {"JAX_FOO": "1"})
+    assert cmd[0] == "mpirun" and "-np" in cmd and "3" in cmd
+    assert "--host" in cmd and "a,b,c" in cmd
+    assert "-x" in cmd and "DSTPU_PROCESS_ID_FROM_MPI=1" in cmd
+    import pytest
+    with pytest.raises(RuntimeError):
+        r.get_cmd("a", 0, 3, "a:29500", {})
+
+
+def test_make_runner_unknown():
+    import pytest
+    from deepspeed_tpu.launcher.multinode_runner import make_runner
+    with pytest.raises(ValueError):
+        make_runner("mvapich", _args(), {})
+
+
+def test_mpi_rank_env_mapping(monkeypatch):
+    """init_distributed must derive its process_id from OMPI_COMM_WORLD_RANK
+    when the openmpi launcher sets DSTPU_PROCESS_ID_FROM_MPI."""
+    import jax
+    import deepspeed_tpu.distributed as dist_mod
+    monkeypatch.setenv("DSTPU_COORDINATOR", "head:29500")
+    monkeypatch.setenv("DSTPU_NUM_PROCESSES", "4")
+    monkeypatch.setenv("DSTPU_PROCESS_ID_FROM_MPI", "1")
+    monkeypatch.setenv("OMPI_COMM_WORLD_RANK", "3")
+    monkeypatch.delenv("DSTPU_PROCESS_ID", raising=False)
+    calls = {}
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: calls.update(kw))
+    monkeypatch.setattr(dist_mod, "_initialized", False)
+    dist_mod.init_distributed()
+    assert calls == {"coordinator_address": "head:29500",
+                     "num_processes": 4, "process_id": 3}
+    monkeypatch.setattr(dist_mod, "_initialized", False)
