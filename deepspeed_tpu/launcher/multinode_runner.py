@@ -103,8 +103,13 @@ class OpenMPIRunner(MultiNodeRunner):
                     exports: Dict[str, str]) -> List[str]:
         cmd = ["mpirun", "-np", str(len(hosts)),
                "--host", ",".join(hosts),
-               "--allow-run-as-root"]
+               "--allow-run-as-root",
+               "-wdir", os.getcwd()]  # ssh/pdsh runners 'cd' instead
         for k, v in sorted(exports.items()):
+            if k == "DSTPU_PROCESS_ID":
+                # a stale per-rank id from the operator's shell would
+                # shadow OMPI_COMM_WORLD_RANK on every rank
+                continue
             cmd += ["-x", f"{k}={v}"]
         cmd += ["-x", f"DSTPU_COORDINATOR={coordinator}",
                 "-x", f"DSTPU_NUM_PROCESSES={len(hosts)}",

@@ -21,7 +21,6 @@ import argparse
 import base64
 import json
 import os
-import shlex
 import subprocess
 import sys
 from collections import OrderedDict

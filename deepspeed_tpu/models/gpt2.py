@@ -134,6 +134,54 @@ def _next_token_xent(logits, targets):
     return -jnp.mean(ll)
 
 
+def _tied_xent_chunked(x, wte, targets, dtype, chunk_tokens: int = 2048):
+    """Fused tied-LM-head + next-token cross entropy, chunked over tokens.
+
+    The naive path materializes fp32 logits (B·S, V) plus a log_softmax
+    copy — multi-GB of HBM traffic at V≈50k that makes the step
+    bandwidth-bound (and *worse* at larger batch). Here the head GEMM +
+    logsumexp run per token-chunk under ``jax.checkpoint``: peak extra
+    memory is one (chunk, V) fp32 tile and the backward recomputes it —
+    ~10% more MXU flops for a large cut in HBM traffic. The scan carries
+    only the scalar loss.
+    """
+    B, S, H = x.shape
+    n = B * S
+    xf = x.reshape(n, H)
+    tf = targets.reshape(n)
+    c = min(chunk_tokens, n)
+    # pad to a multiple of c (weight-masked) rather than shrinking the
+    # chunk — a prime n would otherwise degrade to c=1 and a scan of
+    # thousands of single-token GEMMs
+    pad = (-n) % c
+    wf = jnp.ones((n,), jnp.float32)
+    if pad:
+        xf = jnp.concatenate([xf, jnp.zeros((pad, H), xf.dtype)])
+        tf = jnp.concatenate([tf, jnp.zeros((pad,), tf.dtype)])
+        wf = jnp.concatenate([wf, jnp.zeros((pad,), jnp.float32)])
+    m = (n + pad) // c
+    wte_d = wte.astype(dtype)
+
+    def body(xs_c, ts_c, ws_c):
+        logits = jax.lax.dot_general(
+            xs_c.astype(dtype), wte_d, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # (c, V) fp32
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        picked = jnp.take_along_axis(logits, ts_c[:, None], axis=-1)[:, 0]
+        return ((lse - picked) * ws_c).sum()
+
+    body = jax.checkpoint(body)
+
+    def scan_body(acc, inp):
+        xs_c, ts_c, ws_c = inp
+        return acc + body(xs_c, ts_c, ws_c), None
+
+    total, _ = jax.lax.scan(
+        scan_body, jnp.zeros((), jnp.float32),
+        (xf.reshape(m, c, H), tf.reshape(m, c), wf.reshape(m, c)))
+    return total / n
+
+
 def gpt2_block(block_params, config: GPT2Config, x, rng, deterministic,
                dtype):
     B, S, h = x.shape
@@ -182,10 +230,10 @@ def gpt2_block(block_params, config: GPT2Config, x, rng, deterministic,
     return x
 
 
-def gpt2_forward(params, config: GPT2Config, input_ids, rng=None,
-                 deterministic: bool = True, dtype=jnp.bfloat16,
-                 remat: bool = False):
-    """Logits (B, S, vocab). Embedding output layer is tied to wte."""
+def _gpt2_trunk(params, config: GPT2Config, input_ids, rng=None,
+                deterministic: bool = True, dtype=jnp.bfloat16,
+                remat: bool = False):
+    """Final hidden states (B, S, H) after ln_f (no LM head)."""
     x = _embed(params["wte"], params["wpe"], input_ids, dtype)
     if rng is not None:
         rng, r_emb = jax.random.split(rng)
@@ -202,7 +250,15 @@ def gpt2_forward(params, config: GPT2Config, input_ids, rng=None,
             r = None
         x = block(params[f"h_{i}"], config, x, r, deterministic, dtype)
 
-    x = _layer_norm(x, params["ln_f"], config.layer_norm_eps)
+    return _layer_norm(x, params["ln_f"], config.layer_norm_eps)
+
+
+def gpt2_forward(params, config: GPT2Config, input_ids, rng=None,
+                 deterministic: bool = True, dtype=jnp.bfloat16,
+                 remat: bool = False):
+    """Logits (B, S, vocab). Embedding output layer is tied to wte."""
+    x = _gpt2_trunk(params, config, input_ids, rng=rng,
+                    deterministic=deterministic, dtype=dtype, remat=remat)
     return _tied_logits(x, params["wte"], dtype)
 
 
@@ -213,10 +269,12 @@ def gpt2_loss_fn(config: GPT2Config, dtype=jnp.bfloat16, remat: bool = False,
     def loss_fn(params, batch, rng):
         ids = batch["input_ids"]
         inputs, targets = ids[:, :-1], ids[:, 1:]
-        logits = gpt2_forward(params, config, inputs, rng=rng,
-                              deterministic=deterministic, dtype=dtype,
-                              remat=remat)
-        return _next_token_xent(logits, targets)
+        # run the trunk, then the fused chunked head+loss (skips the full
+        # (B,S,V) fp32 logits materialization of gpt2_forward)
+        x = _gpt2_trunk(params, config, inputs, rng=rng,
+                        deterministic=deterministic, dtype=dtype,
+                        remat=remat)
+        return _tied_xent_chunked(x, params["wte"], targets, dtype)
     return loss_fn
 
 

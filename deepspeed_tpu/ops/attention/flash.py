@@ -194,7 +194,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
 # pallas_call wrappers
 # --------------------------------------------------------------------- #
 def _largest_divisor_block(seq):
-    for b in (256, 128, 64, 32, 16):
+    # 512 first: measured on v5e (B=8,H=16,S=1024,D=64 fwd+bwd) 512/512 is
+    # ~1.2x faster than 256/256 and beats every mixed combination; smaller
+    # blocks only when the sequence doesn't divide
+    for b in (512, 256, 128, 64, 32, 16):
         if seq % b == 0:
             return b
     return seq
