@@ -1,0 +1,91 @@
+"""Megatron-GPT2 workload (BASELINE.md ladder items 3-4): GPT-2 345M with
+ZeRO-2 data parallelism, or GPT-2 with 3D (pipe x data x model) parallelism
+via the compiled SPMD pipeline. Recreates the reference's
+tests/model/Megatron_GPT2 harness workloads as native examples.
+
+    # 345M + ZeRO-2 (config ds_config_zero2.json)
+    python examples/megatron_gpt2/train.py --mode zero2
+
+    # 3D-parallel pipeline (config ds_config_3d.json; needs >=8 devices —
+    # on CPU: XLA_FLAGS=--xla_force_host_platform_device_count=8)
+    python examples/megatron_gpt2/train.py --mode 3d
+"""
+
+import argparse
+import json
+import os
+
+import jax
+import numpy as np
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models.gpt2 import (GPT2Config, count_params,
+                                       gpt2_loss_fn, gpt2_pipeline_spec,
+                                       init_gpt2_params)
+
+GPT2_345M = dict(vocab_size=50304, max_position_embeddings=1024,
+                 hidden_size=1024, num_layers=24, num_heads=16)
+GPT2_TINY = dict(vocab_size=512, max_position_embeddings=128,
+                 hidden_size=64, num_layers=4, num_heads=4)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    ds.add_config_arguments(parser)
+    parser.add_argument("--mode", choices=["zero2", "3d"], default="zero2")
+    parser.add_argument("--tiny", action="store_true",
+                        help="Tiny model for smoke runs")
+    parser.add_argument("--seq", type=int, default=0)
+    parser.add_argument("--steps", type=int, default=10)
+    args = parser.parse_args()
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    config = args.deepspeed_config or os.path.join(
+        here, f"ds_config_{args.mode}.json")
+    with open(config) as f:
+        config = json.load(f)
+
+    size = GPT2_TINY if args.tiny else GPT2_345M
+    cfg = GPT2Config(embd_dropout=0.0, attn_dropout=0.0, resid_dropout=0.0,
+                     **size)
+    seq = args.seq or min(cfg.max_position_embeddings, 1024)
+
+    rng = np.random.RandomState(0)
+    micro = config["train_micro_batch_size_per_gpu"]
+    ga = config.get("gradient_accumulation_steps", 1)
+
+    if args.mode == "zero2":
+        params = init_gpt2_params(cfg, jax.random.PRNGKey(0))
+        print(f"params: {count_params(params)/1e6:.0f}M")
+        loss_fn = gpt2_loss_fn(cfg, deterministic=True)
+        engine, *_ = ds.initialize(model=loss_fn, model_parameters=params,
+                                   config=config)
+        bs = engine.train_batch_size() // ga
+
+        def micro_batches():
+            while True:
+                yield {"input_ids": rng.randint(
+                    0, cfg.vocab_size, (bs, seq + 1)).astype(np.int32)}
+        it = micro_batches()
+    else:
+        stages = config["mesh"]["axes"]["pipe"]
+        spec = gpt2_pipeline_spec(cfg, num_stages=stages)
+        engine, *_ = ds.initialize(model=spec, config=config)
+        data_par = config["mesh"]["axes"].get("data", 1)
+        global_mb = micro * data_par
+
+        def micro_batches():
+            while True:
+                yield {"input_ids": rng.randint(
+                    0, cfg.vocab_size,
+                    (global_mb, seq + 1)).astype(np.int32)}
+        it = micro_batches()
+
+    for step in range(args.steps):
+        loss = engine.train_batch(it)
+        print(f"step {step}: lm loss {float(loss):.4f}")
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,51 @@
+"""Examples smoke tests: every workload in examples/ must run end-to-end on
+the CPU mesh (the reference's tests/model harnesses launched workloads via
+the CLI; these run them in-process for speed)."""
+
+import os
+import runpy
+import sys
+
+import pytest
+
+_ROOT = os.path.normpath(os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def _run(script, *args):
+    argv = [os.path.join(_ROOT, script), *args]
+    old = sys.argv
+    sys.argv = argv
+    try:
+        runpy.run_path(argv[0], run_name="__main__")
+    finally:
+        sys.argv = old
+
+
+def test_cifar_example(capsys):
+    _run("examples/cifar/train.py", "--steps", "6")
+    assert "done" in capsys.readouterr().out
+
+
+def test_bing_bert_example(capsys):
+    _run("examples/bing_bert/train.py", "--model", "tiny",
+         "--steps", "2", "--seq", "64")
+    assert "done" in capsys.readouterr().out
+
+
+def test_megatron_gpt2_zero2_example(capsys):
+    _run("examples/megatron_gpt2/train.py", "--mode", "zero2",
+         "--tiny", "--steps", "2", "--seq", "64")
+    out = capsys.readouterr().out
+    assert "done" in out and "lm loss" in out
+
+
+def test_megatron_gpt2_3d_example(capsys):
+    _run("examples/megatron_gpt2/train.py", "--mode", "3d",
+         "--tiny", "--steps", "2", "--seq", "32")
+    assert "done" in capsys.readouterr().out
+
+
+def test_onebit_adam_example(capsys):
+    _run("examples/onebit_adam/train.py", "--steps", "10", "--seq", "32")
+    out = capsys.readouterr().out
+    assert "done" in out and "[compressed]" in out and "[warmup]" in out
