@@ -201,19 +201,12 @@ def gpt2_block(block_params, config: GPT2Config, x, rng, deterministic,
     k = k.reshape(B, S, heads, hd).transpose(0, 2, 1, 3)
     v = v.reshape(B, S, heads, hd).transpose(0, 2, 1, 3)
     if config.attn_dropout > 0.0 and not deterministic and rng is not None:
-        # flash kernel has no in-kernel dropout yet: use the dense path so
-        # the configured attention dropout is actually applied
-        r1, r_attn = (jax.random.split(r1) if r1 is not None
-                      else (None, None))
-        sm_scale = 1.0 / np.sqrt(hd)
-        s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
-                       k.astype(jnp.float32)) * sm_scale
-        idx_q = jnp.arange(S)[:, None]
-        idx_k = jnp.arange(S)[None, :]
-        s = jnp.where(idx_q >= idx_k, s, -1e30)
-        p = jax.nn.softmax(s, axis=-1).astype(dtype)
-        p = _dropout(p, config.attn_dropout, r_attn, deterministic)
-        ctx = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+        # attention dropout runs inside the Pallas kernel (counter-based
+        # hash mask regenerated in fwd and bwd — no (S, S) mask in HBM)
+        r1, r_attn = jax.random.split(r1)
+        ctx = flash_attention(q, k, v, causal=True,
+                              dropout_rate=config.attn_dropout,
+                              dropout_rng=r_attn)
     else:
         ctx = flash_attention(q, k, v, causal=True)
     ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, h)

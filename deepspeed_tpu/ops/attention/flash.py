@@ -10,10 +10,17 @@ Design: online-softmax tiling. Grid = (batch*heads, Sq/block_q); each program
 streams K/V blocks through VMEM with running max/sum in fp32. Backward
 recomputes the score tiles (flash-style) in two passes (dq; dk+dv).
 
-Falls back to a jnp reference implementation off-TPU (same math, used as the
-numerics oracle in tests) or when attention dropout is active (in-kernel
-dropout not yet wired; the reference's attn_dropout_checkpoint knob maps to
-recompute policy instead).
+Attention dropout runs *inside* the kernel (reference: the fused
+softmax-dropout CUDA kernels, csrc/transformer/dropout_kernels.cu +
+softmax_kernels.cu): a counter-based hash PRNG keyed on
+(seed, batch*head, q_idx, k_idx) regenerates the identical keep-mask in the
+forward and both backward kernels without ever materializing an (S, S)
+mask. The softmax statistics (m, l, lse) stay un-dropped — dropout masks the
+normalized probabilities — so the flash backward's delta = rowsum(dO * O)
+identity still holds exactly.
+
+Falls back to a jnp reference implementation off-TPU (same math incl. the
+same hash mask, used as the numerics oracle in tests).
 """
 
 import functools
@@ -33,12 +40,59 @@ NEG_INF = -1e30
 
 
 # --------------------------------------------------------------------- #
+# counter-based dropout PRNG (shared by kernels and the jnp oracle)
+# --------------------------------------------------------------------- #
+def dropout_keep_mask(seed, bh, q_idx, k_idx, seq_k, rate):
+    """Stateless keep-mask for attention dropout.
+
+    One lowbias32-style integer hash per (seed, batch*head, q, k)
+    coordinate; pure jnp uint32 ops so the *identical* bits regenerate in
+    the forward kernel, both backward kernels (which tile the (Sq, Sk)
+    plane in different orders), interpret mode, and the dense oracle.
+    TPU-native replacement for the reference's stored dropout bitmask
+    (csrc/transformer/dropout_kernels.cu) — recompute beats storing O(S^2)
+    bits on HBM-bound hardware.
+
+    seed: uint32/int32 scalar; bh: scalar index; q_idx/k_idx: broadcastable
+    integer arrays; rate: static python float in (0, 1).
+    Returns a boolean array, True = keep.
+    """
+    del seq_k  # row coordinate gets its own mixing round — no linear
+    # q*seq_k+k counter, which would wrap (and alias rows) at seq >= 2^16
+
+    def mix(x):
+        x = (x ^ (x >> 16)) * jnp.uint32(0x7FEB352D)
+        x = (x ^ (x >> 15)) * jnp.uint32(0x846CA68B)
+        return x ^ (x >> 16)
+
+    row = mix(q_idx.astype(jnp.uint32)
+              ^ (jnp.uint32(bh) * jnp.uint32(0x9E3779B9))
+              ^ seed.astype(jnp.uint32))
+    x = mix(row ^ k_idx.astype(jnp.uint32))
+    keep_thresh = min(int(round((1.0 - rate) * 2.0**32)), 2**32 - 1)
+    return x < jnp.uint32(keep_thresh)
+
+
+def dropout_mask_reference(seed, b, h, sq, sk, rate):
+    """Materialized (B, H, Sq, Sk) keep-mask — the oracle view of what the
+    kernels regenerate tile-by-tile. Test/small-shape use only."""
+    bh = jnp.arange(b * h, dtype=jnp.uint32)[:, None, None]
+    q_idx = jnp.arange(sq, dtype=jnp.uint32)[None, :, None]
+    k_idx = jnp.arange(sk, dtype=jnp.uint32)[None, None, :]
+    keep = dropout_keep_mask(jnp.asarray(seed).reshape(()), bh, q_idx, k_idx,
+                             sk, rate)
+    return keep.reshape(b, h, sq, sk)
+
+
+# --------------------------------------------------------------------- #
 # reference (oracle / fallback) implementation
 # --------------------------------------------------------------------- #
 def attention_reference(q, k, v, mask=None, causal=False,
-                        sm_scale: Optional[float] = None):
+                        sm_scale: Optional[float] = None,
+                        dropout_rate: float = 0.0, dropout_seed=None):
     """Plain jnp attention. q,k,v: (B, H, S, D); mask: additive, broadcastable
-    to (B, H, Sq, Sk)."""
+    to (B, H, Sq, Sk). With dropout_rate > 0 applies the same hash keep-mask
+    the Pallas kernels use (seed: scalar)."""
     if sm_scale is None:
         sm_scale = 1.0 / np.sqrt(q.shape[-1])
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
@@ -51,6 +105,11 @@ def attention_reference(q, k, v, mask=None, causal=False,
         idx_k = jnp.arange(sk)[None, :]
         s = jnp.where(idx_q >= idx_k, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
+    if dropout_rate > 0.0:
+        b_, h_, sq_, sk_ = p.shape
+        keep = dropout_mask_reference(dropout_seed, b_, h_, sq_, sk_,
+                                      dropout_rate)
+        p = jnp.where(keep, p, 0.0) / (1.0 - dropout_rate)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)
                       ).astype(q.dtype)
 
@@ -58,8 +117,30 @@ def attention_reference(q, k, v, mask=None, causal=False,
 # --------------------------------------------------------------------- #
 # pallas kernels
 # --------------------------------------------------------------------- #
-def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, *,
-                sm_scale, block_k, causal, seq_k, block_q):
+def _tile_idx(q0, k0, block_q, block_k):
+    q_idx = q0 + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    k_idx = k0 + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    return q_idx, k_idx
+
+
+def _unpack_refs(refs, has_mask, has_seed, n_out):
+    """Kernel ref layout: q, k, v, [mask], [seed], *outs."""
+    q_ref, k_ref, v_ref = refs[:3]
+    i = 3
+    mask_ref = refs[i] if has_mask else None
+    i += int(has_mask)
+    seed_ref = refs[i] if has_seed else None
+    i += int(has_seed)
+    outs = refs[i:]
+    assert len(outs) == n_out, (len(refs), has_mask, has_seed, n_out)
+    return q_ref, k_ref, v_ref, mask_ref, seed_ref, outs
+
+
+def _fwd_kernel(*refs, sm_scale, block_k, causal, seq_k, block_q,
+                has_mask, dropout_rate):
+    q_ref, k_ref, v_ref, mask_ref, seed_ref, (o_ref, lse_ref) = \
+        _unpack_refs(refs, has_mask, dropout_rate > 0.0, 2)
+    bh = pl.program_id(0)
     qb = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * sm_scale          # (bq, d)
     d = q.shape[-1]
@@ -78,16 +159,21 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, *,
                                 preferred_element_type=jnp.float32)
         if mask_ref is not None:
             s += mask_ref[0, 0, pl.ds(i * block_k, block_k)][None, :]
+        if causal or dropout_rate > 0.0:
+            q_idx, k_idx = _tile_idx(qb * block_q, i * block_k,
+                                     block_q, block_k)
         if causal:
-            q_idx = qb * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_idx = i * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_idx >= k_idx, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         alpha = jnp.exp(m - m_new)
         p = jnp.exp(s - m_new[:, None])
+        # softmax stats (l, lse) use the un-dropped p; dropout masks only
+        # the PV accumulation (normalize-then-drop, like the reference)
         l_new = l * alpha + jnp.sum(p, axis=-1)
+        if dropout_rate > 0.0:
+            keep = dropout_keep_mask(seed_ref[0, 0], bh, q_idx, k_idx,
+                                     seq_k, dropout_rate)
+            p = jnp.where(keep, p, 0.0)
         acc_new = acc * alpha[:, None] + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -98,12 +184,19 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, *,
     acc0 = jnp.zeros((block_q, d), jnp.float32)
     m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
     l_safe = jnp.where(l == 0.0, 1.0, l)
-    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    out = acc / l_safe[:, None]
+    if dropout_rate > 0.0:
+        out = out * (1.0 / (1.0 - dropout_rate))
+    o_ref[0] = out.astype(o_ref.dtype)
     lse_ref[0, :, 0] = m + jnp.log(l_safe)
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, *, sm_scale, block_k, causal, seq_k, block_q):
+def _bwd_dq_kernel(*refs, sm_scale, block_k, causal, seq_k, block_q,
+                   has_mask, dropout_rate):
+    (q_ref, k_ref, v_ref, mask_ref, seed_ref,
+     (do_ref, lse_ref, delta_ref, dq_ref)) = \
+        _unpack_refs(refs, has_mask, dropout_rate > 0.0, 4)
+    bh = pl.program_id(0)
     qb = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * sm_scale
     do = do_ref[0].astype(jnp.float32)
@@ -123,15 +216,18 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
                                 preferred_element_type=jnp.float32)
         if mask_ref is not None:
             s += mask_ref[0, 0, pl.ds(i * block_k, block_k)][None, :]
+        if causal or dropout_rate > 0.0:
+            q_idx, k_idx = _tile_idx(qb * block_q, i * block_k,
+                                     block_q, block_k)
         if causal:
-            q_idx = qb * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_idx = i * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_idx >= k_idx, s, NEG_INF)
         p = jnp.exp(s - lse[:, None])                      # (bq, bk)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if dropout_rate > 0.0:
+            keep = dropout_keep_mask(seed_ref[0, 0], bh, q_idx, k_idx,
+                                     seq_k, dropout_rate)
+            dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_rate)), 0.0)
         ds = p * (dp - delta[:, None])
         return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
                                         preferred_element_type=jnp.float32)
@@ -141,9 +237,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
     dq_ref[0] = (dq * sm_scale).astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, sm_scale, block_q, causal, seq_q,
-                    block_k):
+def _bwd_dkv_kernel(*refs, sm_scale, block_q, causal, seq_q, seq_k, block_k,
+                    has_mask, dropout_rate):
+    (q_ref, k_ref, v_ref, mask_ref, seed_ref,
+     (do_ref, lse_ref, delta_ref, dk_ref, dv_ref)) = \
+        _unpack_refs(refs, has_mask, dropout_rate > 0.0, 5)
+    bh = pl.program_id(0)
     kb = pl.program_id(1)
     k = k_ref[0].astype(jnp.float32)                       # (bk, d)
     v = v_ref[0].astype(jnp.float32)
@@ -167,17 +266,24 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
                                 preferred_element_type=jnp.float32)
         if mask_ref is not None:
             s += mask_ref[0, 0, pl.ds(kb * block_k, block_k)][None, :]
+        if causal or dropout_rate > 0.0:
+            q_idx, k_idx = _tile_idx(i * block_q, kb * block_k,
+                                     block_q, block_k)
         if causal:
-            q_idx = i * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_idx = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_idx >= k_idx, s, NEG_INF)
         p = jnp.exp(s - lse[:, None])                      # (bq, bk)
-        dv_new = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
-                                          preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if dropout_rate > 0.0:
+            keep = dropout_keep_mask(seed_ref[0, 0], bh, q_idx, k_idx,
+                                     seq_k, dropout_rate)
+            inv_kp = 1.0 / (1.0 - dropout_rate)
+            pd = jnp.where(keep, p * inv_kp, 0.0)
+            dp = jnp.where(keep, dp * inv_kp, 0.0)
+        else:
+            pd = p
+        dv_new = dv + jax.lax.dot_general(pd, do, (((0,), (0,)), ((), ())),
+                                          preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None])
         dk_new = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
                                           preferred_element_type=jnp.float32)
@@ -207,7 +313,13 @@ def _pick_blocks(seq_q, seq_k):
     return _largest_divisor_block(seq_q), _largest_divisor_block(seq_k)
 
 
-def _flash_fwd(q, k, v, mask, causal, sm_scale, interpret):
+def _seed_spec():
+    # (1, 1) int32 seed broadcast to every program; tiny, lives in VMEM
+    return pl.BlockSpec((1, 1), lambda i, j: (0, 0))
+
+
+def _flash_fwd(q, k, v, mask, causal, sm_scale, interpret,
+               dropout_rate=0.0, seed=None):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     bq, bk = _pick_blocks(sq, sk)
@@ -217,7 +329,9 @@ def _flash_fwd(q, k, v, mask, causal, sm_scale, interpret):
     vr = v.reshape(b * h, sk, d)
 
     kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, block_k=bk,
-                               causal=causal, seq_k=sk, block_q=bq)
+                               causal=causal, seq_k=sk, block_q=bq,
+                               has_mask=mask is not None,
+                               dropout_rate=dropout_rate)
     in_specs = [
         pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
         pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
@@ -229,8 +343,9 @@ def _flash_fwd(q, k, v, mask, causal, sm_scale, interpret):
         maskr = mask.reshape(b, 1, sk)
         in_specs.append(pl.BlockSpec((1, 1, sk), lambda i, j: (i // h, 0, 0)))
         args.append(maskr)
-    else:
-        kernel = _nomask(kernel)
+    if dropout_rate > 0.0:
+        in_specs.append(_seed_spec())
+        args.append(seed.reshape(1, 1).astype(jnp.int32))
 
     out_shape = [
         jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
@@ -257,14 +372,9 @@ def _flash_fwd(q, k, v, mask, causal, sm_scale, interpret):
     return o.reshape(b, h, sq, d), lse.reshape(b, h, sq)
 
 
-def _nomask(kernel):
-    def k2(q_ref, k_ref, v_ref, *rest, **kw):
-        return kernel(q_ref, k_ref, v_ref, None, *rest, **kw)
-    return k2
-
-
-def _flash_bwd(res, g, causal, sm_scale, interpret):
-    q, k, v, mask, o, lse = res
+def _flash_bwd(res, g, causal, sm_scale, interpret,
+               dropout_rate=0.0):
+    q, k, v, mask, seed, o, lse = res
     b, h, sq, d = q.shape
     sk = k.shape[2]
     bq, bk = _pick_blocks(sq, sk)
@@ -282,10 +392,14 @@ def _flash_bwd(res, g, causal, sm_scale, interpret):
     common = [qr, kr, vr]
     if mask is not None:
         maskr = mask.reshape(b, 1, sk)
+    if dropout_rate > 0.0:
+        seedr = seed.reshape(1, 1).astype(jnp.int32)
 
     # ---- dq ----
     kernel = functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, block_k=bk,
-                               causal=causal, seq_k=sk, block_q=bq)
+                               causal=causal, seq_k=sk, block_q=bq,
+                               has_mask=mask is not None,
+                               dropout_rate=dropout_rate)
     in_specs = [
         pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),   # q
         pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),   # k
@@ -295,8 +409,9 @@ def _flash_bwd(res, g, causal, sm_scale, interpret):
     if mask is not None:
         in_specs.append(pl.BlockSpec((1, 1, sk), lambda i, j: (i // h, 0, 0)))
         args.append(maskr)
-    else:
-        kernel = _nomask_bwd_dq(kernel)
+    if dropout_rate > 0.0:
+        in_specs.append(_seed_spec())
+        args.append(seedr)
     in_specs += [
         pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),   # do
         pl.BlockSpec((1, bq, 1), lambda i, j: (i, j, 0)),   # lse
@@ -319,7 +434,9 @@ def _flash_bwd(res, g, causal, sm_scale, interpret):
 
     # ---- dk, dv ----
     kernel = functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, block_q=bq,
-                               causal=causal, seq_q=sq, block_k=bk)
+                               causal=causal, seq_q=sq, seq_k=sk, block_k=bk,
+                               has_mask=mask is not None,
+                               dropout_rate=dropout_rate)
     in_specs = [
         pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)),   # q (full)
         pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),   # k block
@@ -329,8 +446,9 @@ def _flash_bwd(res, g, causal, sm_scale, interpret):
     if mask is not None:
         in_specs.append(pl.BlockSpec((1, 1, sk), lambda i, j: (i // h, 0, 0)))
         args.append(maskr)
-    else:
-        kernel = _nomask_bwd_dkv(kernel)
+    if dropout_rate > 0.0:
+        in_specs.append(_seed_spec())
+        args.append(seedr)
     in_specs += [
         pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)),   # do (full)
         pl.BlockSpec((1, sq, 1), lambda i, j: (i, 0, 0)),   # lse (full)
@@ -360,20 +478,6 @@ def _flash_bwd(res, g, causal, sm_scale, interpret):
     return dq, dk, dv, dmask
 
 
-def _nomask_bwd_dq(kernel):
-    def k2(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref):
-        return kernel(q_ref, k_ref, v_ref, None, do_ref, lse_ref, delta_ref,
-                      dq_ref)
-    return k2
-
-
-def _nomask_bwd_dkv(kernel):
-    def k2(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref):
-        return kernel(q_ref, k_ref, v_ref, None, do_ref, lse_ref, delta_ref,
-                      dk_ref, dv_ref)
-    return k2
-
-
 # --------------------------------------------------------------------- #
 # public API
 # --------------------------------------------------------------------- #
@@ -384,65 +488,100 @@ def _use_pallas():
         return False
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash_attention(q, k, v, causal, sm_scale, interpret):
-    o, _ = _flash_fwd(q, k, v, None, causal, sm_scale, interpret)
+# seed rides as a traced (1,1) int32 arg (not static — a per-step seed must
+# not trigger recompilation); its cotangent is None, like segment_ids in
+# jax's reference flash kernels
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_attention(q, k, v, seed, causal, sm_scale, interpret, rate):
+    o, _ = _flash_fwd(q, k, v, None, causal, sm_scale, interpret,
+                      dropout_rate=rate, seed=seed)
     return o
 
 
-def _flash_attention_fwd(q, k, v, causal, sm_scale, interpret):
-    o, lse = _flash_fwd(q, k, v, None, causal, sm_scale, interpret)
-    return o, (q, k, v, None, o, lse)
+def _flash_attention_fwd(q, k, v, seed, causal, sm_scale, interpret, rate):
+    o, lse = _flash_fwd(q, k, v, None, causal, sm_scale, interpret,
+                        dropout_rate=rate, seed=seed)
+    return o, (q, k, v, None, seed, o, lse)
 
 
-def _flash_attention_bwd(causal, sm_scale, interpret, res, g):
-    dq, dk, dv, _ = _flash_bwd(res, g, causal, sm_scale, interpret)
-    return dq, dk, dv
+def _flash_attention_bwd(causal, sm_scale, interpret, rate, res, g):
+    dq, dk, dv, _ = _flash_bwd(res, g, causal, sm_scale, interpret,
+                               dropout_rate=rate)
+    return dq, dk, dv, None
 
 
 _flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def _flash_attention_masked(q, k, v, mask, causal, sm_scale, interpret):
-    o, _ = _flash_fwd(q, k, v, mask, causal, sm_scale, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash_attention_masked(q, k, v, mask, seed, causal, sm_scale, interpret,
+                            rate):
+    o, _ = _flash_fwd(q, k, v, mask, causal, sm_scale, interpret,
+                      dropout_rate=rate, seed=seed)
     return o
 
 
-def _flash_attention_masked_fwd(q, k, v, mask, causal, sm_scale, interpret):
-    o, lse = _flash_fwd(q, k, v, mask, causal, sm_scale, interpret)
-    return o, (q, k, v, mask, o, lse)
+def _flash_attention_masked_fwd(q, k, v, mask, seed, causal, sm_scale,
+                                interpret, rate):
+    o, lse = _flash_fwd(q, k, v, mask, causal, sm_scale, interpret,
+                        dropout_rate=rate, seed=seed)
+    return o, (q, k, v, mask, seed, o, lse)
 
 
-def _flash_attention_masked_bwd(causal, sm_scale, interpret, res, g):
-    return _flash_bwd(res, g, causal, sm_scale, interpret)
+def _flash_attention_masked_bwd(causal, sm_scale, interpret, rate, res, g):
+    dq, dk, dv, dmask = _flash_bwd(res, g, causal, sm_scale, interpret,
+                                   dropout_rate=rate)
+    return dq, dk, dv, dmask, None
 
 
 _flash_attention_masked.defvjp(_flash_attention_masked_fwd,
                                _flash_attention_masked_bwd)
 
 
+def dropout_seed_from_rng(rng):
+    """Derive the (1,1) int32 kernel seed from a jax PRNG key."""
+    return jax.random.randint(rng, (1, 1), minval=-(2**31), maxval=2**31 - 1,
+                              dtype=jnp.int32)
+
+
 def flash_attention(q, k, v, mask=None, causal: bool = False,
                     sm_scale: Optional[float] = None,
+                    dropout_rate: float = 0.0,
+                    dropout_rng=None,
                     interpret: Optional[bool] = None,
                     force_reference: bool = False):
-    """Flash attention with O(S) memory.
+    """Flash attention with O(S) memory and in-kernel attention dropout.
 
     q, k, v: (batch, heads, seq, head_dim).
     mask: optional *additive* key mask of shape (batch, 1, 1, seq_k)
     (BERT-style padding mask). For 2D masks use the reference path.
+    dropout_rate: attention-probability dropout (reference
+    attn_dropout_ratio); requires dropout_rng (a jax PRNG key) — pass
+    rate 0.0 / rng None for eval.
     """
     if sm_scale is None:
         sm_scale = 1.0 / np.sqrt(q.shape[-1])
     if interpret is None:
         interpret = not _use_pallas()
+    dropout_rate = float(dropout_rate)
+    if dropout_rate > 0.0:
+        assert dropout_rng is not None, \
+            "flash_attention: dropout_rate > 0 requires dropout_rng"
+        assert dropout_rate < 1.0, dropout_rate
+        seed = dropout_seed_from_rng(dropout_rng)
+    else:
+        seed = jnp.zeros((1, 1), jnp.int32)
     sq, sk = q.shape[2], k.shape[2]
     if force_reference or sq % 16 != 0 or sk % 16 != 0:
         return attention_reference(q, k, v, mask=mask, causal=causal,
-                                   sm_scale=sm_scale)
+                                   sm_scale=sm_scale,
+                                   dropout_rate=dropout_rate,
+                                   dropout_seed=seed.reshape(())
+                                   if dropout_rate > 0.0 else None)
     if mask is None:
-        return _flash_attention(q, k, v, causal, float(sm_scale), interpret)
+        return _flash_attention(q, k, v, seed, causal, float(sm_scale),
+                                interpret, dropout_rate)
     assert mask.ndim == 4 and mask.shape[1] == 1 and mask.shape[2] == 1, \
         f"flash path expects (B,1,1,Sk) additive mask, got {mask.shape}"
-    return _flash_attention_masked(q, k, v, mask, causal, float(sm_scale),
-                                   interpret)
+    return _flash_attention_masked(q, k, v, mask, seed, causal,
+                                   float(sm_scale), interpret, dropout_rate)

@@ -189,13 +189,14 @@ def transformer_layer_forward(params: Dict[str, Any],
         q = q.reshape(B, S, heads, hd).transpose(0, 2, 1, 3)
         k = k.reshape(B, S, heads, hd).transpose(0, 2, 1, 3)
         v = v.reshape(B, S, heads, hd).transpose(0, 2, 1, 3)
-        use_ref = ((config.attn_dropout_ratio > 0 and not deterministic)
-                   or not use_flash)
+        attn_drop = (config.attn_dropout_ratio
+                     if (config.attn_dropout_ratio > 0 and not deterministic
+                         and r_attn is not None) else 0.0)
         if attention_fn is not None:
-            if config.attn_dropout_ratio > 0 and not deterministic:
+            if attn_drop > 0:
                 _warn_no_attn_dropout()
             ctx = attention_fn(q, k, v, attention_mask)
-        elif use_ref:
+        elif not use_flash:
             sm_scale = 1.0 / np.sqrt(hd)
             s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                            k.astype(jnp.float32)) * sm_scale
@@ -205,7 +206,11 @@ def transformer_layer_forward(params: Dict[str, Any],
             p = _dropout(p, config.attn_dropout_ratio, r_attn, deterministic)
             ctx = jnp.einsum("bhqk,bhkd->bhqd", p, v)
         else:
-            ctx = flash_attention(q, k, v, mask=attention_mask)
+            # in-kernel attention dropout (reference: fused softmax-dropout
+            # kernels); mask regenerates in bwd from the same hash counter
+            ctx = flash_attention(q, k, v, mask=attention_mask,
+                                  dropout_rate=attn_drop,
+                                  dropout_rng=r_attn)
         ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, h)
         out = ctx @ params["ow"].astype(dtype) + params["ob"].astype(dtype)
         return _dropout(out, config.hidden_dropout_ratio, r_h1, deterministic)
