@@ -134,7 +134,8 @@ def _next_token_xent(logits, targets):
     return -jnp.mean(ll)
 
 
-def _tied_xent_chunked(x, wte, targets, dtype, chunk_tokens: int = 2048):
+def _tied_xent_chunked(x, wte, targets, dtype, chunk_tokens: int = 2048,
+                       mean: bool = True):
     """Fused tied-LM-head + next-token cross entropy, chunked over tokens.
 
     The naive path materializes fp32 logits (B·S, V) plus a log_softmax
@@ -179,7 +180,7 @@ def _tied_xent_chunked(x, wte, targets, dtype, chunk_tokens: int = 2048):
     total, _ = jax.lax.scan(
         scan_body, jnp.zeros((), jnp.float32),
         (xf.reshape(m, c, H), tf.reshape(m, c), wf.reshape(m, c)))
-    return total / n
+    return total / n if mean else total
 
 
 def gpt2_block(block_params, config: GPT2Config, x, rng, deterministic,
@@ -339,10 +340,23 @@ def gpt2_pipeline_spec(config: GPT2Config, num_stages: int,
         return out
 
     def post_apply(post_p, pre_p, act, micro):
+        # fused chunked head+xent: never materializes the (mb, S, V) fp32
+        # logits (the same head the non-pipelined loss uses; the naive
+        # full-logits path is exactly what it exists to avoid)
         targets = micro["input_ids"][:, 1:]
         x = _layer_norm(act, post_p["ln_f"], config.layer_norm_eps)
-        logits = _tied_logits(x, pre_p["wte"], _dtype_of(act))
-        return _next_token_xent(logits, targets)
+        return _tied_xent_chunked(x, pre_p["wte"], targets, _dtype_of(act))
+
+    def post_shard_apply(post_p, pre_p, act_slice, micro, start):
+        # sequence-chunk of the head for the cooperative pipeline head
+        # (spmd.py): positions [start, start+len) of the micro-batch;
+        # per-token xent decomposes, so a SUM over the slice is exact
+        length = act_slice.shape[1]
+        targets = jax.lax.dynamic_slice_in_dim(
+            micro["input_ids"], start + 1, length, axis=1)
+        x = _layer_norm(act_slice, post_p["ln_f"], config.layer_norm_eps)
+        return _tied_xent_chunked(x, pre_p["wte"], targets,
+                                  _dtype_of(act_slice), mean=False)
 
     block_specs = gpt2_param_specs(config)["h_0"]
     # stacked stage leaves carry (lps, ...) — shift TP specs right one dim
@@ -355,4 +369,5 @@ def gpt2_pipeline_spec(config: GPT2Config, num_stages: int,
         post_apply=post_apply, num_stages=num_stages,
         pre_specs={"wte": P("model", None), "wpe": P()},
         stage_specs=stage_specs,
-        post_specs={"ln_f": {"w": P(), "b": P()}})
+        post_specs={"ln_f": {"w": P(), "b": P()}},
+        post_shard_apply=post_shard_apply)

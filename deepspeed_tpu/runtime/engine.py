@@ -450,7 +450,19 @@ class DeepSpeedEngine:
         return _tree_cast(params, self.compute_dtype)
 
     def _compute_loss_and_grads(self, params, batch, rng, scale):
-        """value_and_grad of the (scaled) loss in the compute dtype."""
+        """value_and_grad of the (scaled) loss in the compute dtype.
+
+        Pipelined models bypass autodiff: the 1F1B executor
+        (runtime/pipe/spmd.py build_pipeline_grad_fn) returns explicit
+        fp32 grads with the loss-scale folded in, attached as
+        ``loss_fn.grad_fn``."""
+        explicit_grad = getattr(self._loss_fn, "grad_fn", None)
+        if explicit_grad is not None:
+            loss, grads = explicit_grad(
+                params, batch, rng,
+                scale / self.gradient_accumulation_steps)
+            return loss, None, grads
+
         def scaled_loss_fn(p):
             cp = self._cast_for_loss(p)
             if self._loss_takes_rng:

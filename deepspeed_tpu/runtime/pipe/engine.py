@@ -35,8 +35,8 @@ from deepspeed_tpu.parallel.mesh import axis_size
 from deepspeed_tpu.runtime.engine import DeepSpeedEngine, TrainState
 from deepspeed_tpu.runtime.pipe.module import PipelineModule
 from deepspeed_tpu.runtime.pipe.spmd import (
-    PipelineSpec, build_pipeline_loss_fn, microbatch_sharding,
-    module_pipeline_spec, pipeline_param_specs)
+    PipelineSpec, build_pipeline_grad_fn, build_pipeline_loss_fn,
+    microbatch_sharding, module_pipeline_spec, pipeline_param_specs)
 from deepspeed_tpu.utils.logging import log_dist
 
 
@@ -112,6 +112,12 @@ class PipelineEngine(DeepSpeedEngine):
         loss_fn = build_pipeline_loss_fn(
             self.pipeline_spec, probe_mesh, num_micro=self.micro_batches,
             remat=raw.get("pipeline", {}).get("activation_checkpoint", True),
+            compute_dtype=compute_dtype)
+        # training runs the explicit 1F1B executor (O(S) activation memory,
+        # grads computed in-schedule); the forward-only wavefront above
+        # remains for eval_batch
+        loss_fn.grad_fn = build_pipeline_grad_fn(
+            self.pipeline_spec, probe_mesh, num_micro=self.micro_batches,
             compute_dtype=compute_dtype)
 
         super().__init__(model=loss_fn, model_parameters=params,

@@ -19,20 +19,45 @@ all stages, forward and backward — is ONE jitted program over a mesh with a
   yields the inverted-wavefront grad flow the reference implements manually
   (_exec_backward_pass / SendGrad / RecvGrad).
 
-Schedule realized: GPipe-style fill-drain with ``M + S - 1`` forward ticks
-followed by the transposed backward sweep; remat (``jax.checkpoint``) on
-the stage body keeps the activation footprint at one carry per tick, the
-same asymptotics as the reference's 1F1B + activation checkpointing. The
-instruction-stream view of this dataflow lives in runtime/pipe/schedule.py
-and is what the tests check the executor against.
+Two executors share this dataflow:
 
-Cost note (inherent to single-program SPMD): the pre/post functions
-(embedding, loss head) run redundantly on every pipe row with their
-results masked off except at the owning row. This buys compiler-scheduled
-overlap and zero host involvement; pre/post are small relative to S stage
-bodies for the deep models pipelining targets.
+- ``build_pipeline_loss_fn``: forward-only wavefront (M + S - 1 ticks) with
+  the loss head applied per tick to the wave exiting the last stage —
+  realizes InferenceSchedule; differentiable (autodiff transposes the
+  ppermute rotation into the reverse grad flow) for callers that want it.
+- ``build_pipeline_grad_fn``: the training path — an explicit 1F1B-style
+  schedule (reference TrainSchedule, runtime/pipe/schedule.py:182) as one
+  scan of M + 2S - 2 macro-ticks, each an unconditional forward sub-step
+  (stage s forwards micro u - s) plus backward sub-step (stage s backwards
+  micro u - (2S-2-s), recomputing its stage body under ``jax.vjp`` —
+  activation checkpointing, inherent). Each stage keeps a depth-(2S-1)
+  circular buffer of stage inputs, so peak activation memory is O(S),
+  independent of the accumulation depth M — the reference's 1F1B in-flight
+  bound (schedule.py:243 num_pipe_buffers). Gradients accumulate
+  explicitly in fp32 and are returned directly; the engine skips autodiff
+  for pipelined models.
+
+**Uniformity invariant (why there is no lax.cond here):** every collective
+— the two ppermute rotations, the head broadcast, and any GSPMD-inserted
+TP collective inside stage/pre/post bodies — must execute on every device
+on every tick. A branch whose predicate varies along 'pipe' (e.g. "am I
+the last stage") would send device cohorts into different collectives and
+deadlock (observed as a rendezvous hang on the CPU mesh; a real-TPU hang
+in the field). So validity is handled by ``where``-masks on data, never by
+skipping code. The cost is honest: fill/drain bubble is 2(S-1) ticks
+instead of the reference 1F1B's S-1 — the price of single-program SPMD —
+while utilization M/(M+2S-2) approaches 1 at pipelining's target depths.
+
+Head placement: the loss head would naively run (masked) on every pipe row
+— S redundant vocab-GEMMs per micro. When the spec provides
+``post_shard_apply`` (and seq %% S == 0), the last row's exiting
+activation is instead pipe-broadcast and each row computes a 1/S sequence
+chunk of the head (forward and backward), psum-reassembled: total head
+work is 1x per micro-batch, spread across the pipe as a
+sequence-parallel head.
 """
 
+from functools import partial
 from typing import Any, Callable, NamedTuple, Optional
 
 import jax
@@ -54,6 +79,14 @@ class PipelineSpec(NamedTuple):
     - ``post_apply(post_params, pre_params, act, micro_batch) -> scalar``:
       output layers + loss; receives ``pre_params`` so heads can tie to
       embedding weights (reference TiedLayerSpec, module.py:71).
+    - ``post_shard_apply(post_params, pre_params, act_slice, micro_batch,
+      start) -> loss_sum`` (optional): the same head on a contiguous
+      sequence slice ``act[:, start:start+chunk]``, returning the SUM of
+      per-token losses. When provided (and seq divides the stage count)
+      the executors compute the head cooperatively across pipe rows —
+      each row takes one sequence chunk — instead of redundantly on every
+      row. Only valid for losses that decompose per token given the micro
+      batch (next-token LM xent does).
     - ``*_specs``: optional PartitionSpec pytrees for tensor-parallel
       sharding of each group; stage specs are per-stacked-leaf *without*
       the leading pipe dim (it is prepended here).
@@ -66,12 +99,38 @@ class PipelineSpec(NamedTuple):
     pre_specs: Optional[Any] = None
     stage_specs: Optional[Any] = None
     post_specs: Optional[Any] = None
+    post_shard_apply: Optional[Callable] = None
 
 
 def _prepend_pipe(spec: Optional[P]) -> P:
     if spec is None:
         return P("pipe")
     return P("pipe", *tuple(spec))
+
+
+def _pipe_manual_axes(mesh: Mesh) -> frozenset:
+    return frozenset(a for a in ("pipe", "data") if a in mesh.axis_names)
+
+
+def _manual_only(p: P, manual_axes) -> P:
+    def keep(entry):
+        if entry is None:
+            return None
+        if isinstance(entry, (tuple, list)):
+            kept = tuple(a for a in entry if a in manual_axes)
+            return kept if kept else None
+        return entry if entry in manual_axes else None
+    return P(*(keep(e) for e in tuple(p)))
+
+
+def _head_mode(spec: "PipelineSpec", S: int, act_shape):
+    """(coop, chunk, ntok): cooperative sequence-sharded head is usable
+    when the spec provides post_shard_apply, the activation is (mb, seq,
+    ...) and seq divides into S chunks."""
+    if (spec.post_shard_apply is not None and len(act_shape) >= 2
+            and act_shape[1] % S == 0):
+        return True, act_shape[1] // S, act_shape[0] * act_shape[1]
+    return False, 0, 0
 
 
 def pipeline_param_specs(spec: PipelineSpec, params: Any) -> Any:
@@ -122,18 +181,8 @@ def build_pipeline_loss_fn(spec: PipelineSpec, mesh: Mesh, num_micro: int,
     # pipeline + data flow are hand-scheduled (manual axes); tensor/sequence
     # parallel axes stay in "auto" mode so GSPMD keeps doing TP inside each
     # stage body (specs naming auto axes must be filtered from in_specs)
-    manual_axes = frozenset(a for a in ("pipe", "data")
-                            if a in mesh.axis_names)
-
-    def manual_only(p: P) -> P:
-        def keep(entry):
-            if entry is None:
-                return None
-            if isinstance(entry, (tuple, list)):
-                kept = tuple(a for a in entry if a in manual_axes)
-                return kept if kept else None
-            return entry if entry in manual_axes else None
-        return P(*(keep(e) for e in tuple(p)))
+    manual_axes = _pipe_manual_axes(mesh)
+    manual_only = partial(_manual_only, manual_axes=manual_axes)
 
     def per_device(params, batch, rng):
         if compute_dtype is not None:
@@ -145,11 +194,19 @@ def build_pipeline_loss_fn(spec: PipelineSpec, mesh: Mesh, num_micro: int,
         # local slice of the stacked stage weights: (1, ...) -> (...)
         st_p = jax.tree_util.tree_map(lambda x: x[0], params["stages"])
 
+        # probe activation shape/dtype via the first micro-batch
+        micro0 = jax.tree_util.tree_map(lambda x: x[0], batch)
+        probe = jax.eval_shape(spec.pre_apply, pre_p, micro0, rng)
+        act_shape, act_dtype = probe.shape, probe.dtype
+        coop, chunk, ntok = _head_mode(spec, S, act_shape)
+
         def tick(carry, t):
-            act, outbuf = carry
+            act, loss_acc = carry
             in_idx = jnp.clip(t, 0, M - 1)
             micro = jax.tree_util.tree_map(lambda x: x[in_idx], batch)
-            # LoadMicroBatch + first-stage layers (masked to stage 0)
+            # LoadMicroBatch + first-stage layers (computed uniformly on
+            # every row — NO branch: pre may contain TP collectives —
+            # selected by where to stage 0).
             # disjoint fold-in domains mod (S+1): pre uses residue 0, stages
             # use residues 1..S — no dropout-mask key ever collides
             fresh = spec.pre_apply(pre_p, micro,
@@ -158,36 +215,40 @@ def build_pipeline_loss_fn(spec: PipelineSpec, mesh: Mesh, num_micro: int,
             # ForwardPass for every stage's current micro-batch
             r = jax.random.fold_in(rng, t * (S + 1) + s_idx + 1)
             out = stage_apply(st_p, act_in, r)
-            # collect the wave exiting the last stage (micro-batch t-(S-1))
+            # loss head on the wave exiting the last stage (micro t-(S-1)):
+            # cooperative sequence-sharded head when available, else the
+            # masked redundant head — always executed uniformly
             out_t = t - (S - 1)
             o_idx = jnp.clip(out_t, 0, M - 1)
-            cur = jax.lax.dynamic_index_in_dim(outbuf, o_idx, keepdims=True)
+            micro_out = jax.tree_util.tree_map(lambda x: x[o_idx], batch)
             valid = jnp.logical_and(out_t >= 0, out_t < M)
-            outbuf = jax.lax.dynamic_update_slice_in_dim(
-                outbuf, jnp.where(valid, out[None], cur), o_idx, axis=0)
+            if coop:
+                out_last = jax.lax.psum(
+                    jnp.where(s_idx == S - 1, out,
+                              jnp.zeros(act_shape, act_dtype)), "pipe")
+                start = s_idx * chunk
+                sl = jax.lax.dynamic_slice_in_dim(out_last, start, chunk, 1)
+                lsum = spec.post_shard_apply(post_p, pre_p, sl, micro_out,
+                                             start)
+                loss_m = jnp.where(valid, lsum.astype(jnp.float32), 0.0)
+            else:
+                lm = spec.post_apply(post_p, pre_p, out, micro_out)
+                loss_m = jnp.where(
+                    jnp.logical_and(valid, s_idx == S - 1),
+                    lm.astype(jnp.float32), 0.0)
             # SendActivation/RecvActivation: rotate stage s -> s+1
             act = jax.lax.ppermute(
                 out, "pipe", [(i, (i + 1) % S) for i in range(S)])
-            return (act, outbuf), None
+            return (act, loss_acc + loss_m), None
 
-        # probe activation shape/dtype via the first micro-batch
-        micro0 = jax.tree_util.tree_map(lambda x: x[0], batch)
-        probe = jax.eval_shape(spec.pre_apply, pre_p, micro0, rng)
-        act0 = jnp.zeros(probe.shape, probe.dtype)
-        outbuf0 = jnp.zeros((M,) + probe.shape, probe.dtype)
+        act0 = jnp.zeros(act_shape, act_dtype)
+        (_, loss_sum), _ = jax.lax.scan(
+            tick, (act0, jnp.zeros((), jnp.float32)), jnp.arange(M + S - 1))
 
-        (_, outbuf), _ = jax.lax.scan(
-            tick, (act0, outbuf0), jnp.arange(M + S - 1))
-
-        # output layers + loss over all M collected micro-batches at once
-        # (batched: better MXU shapes than per-tick heads)
-        losses = jax.vmap(
-            lambda a, mb: spec.post_apply(post_p, pre_p, a, mb),
-            in_axes=(0, 0))(outbuf, batch)
-        # _aggregate_total_loss (reference pipe/engine.py:374): select the
-        # last stage's mean, share it with every stage/DP rank
-        local = jnp.where(s_idx == S - 1, jnp.mean(losses), 0.0)
-        total = jax.lax.psum(local, "pipe")
+        # _aggregate_total_loss (reference pipe/engine.py:374): psum shares
+        # the per-row partial losses with every stage, pmean averages DP
+        denom = M * ntok if coop else M
+        total = jax.lax.psum(loss_sum, "pipe") / denom
         if "data" in manual_axes:
             total = jax.lax.pmean(total, "data")
         return total
@@ -213,6 +274,212 @@ def build_pipeline_loss_fn(spec: PipelineSpec, mesh: Mesh, num_micro: int,
 
     loss_fn.owns_cast = compute_dtype is not None
     return loss_fn
+
+
+def build_pipeline_grad_fn(spec: PipelineSpec, mesh: Mesh, num_micro: int,
+                           compute_dtype=None) -> Callable:
+    """Return ``grad_fn(params, batch, rng, scale) -> (loss, grads)``
+    executing a 1F1B-style pipeline schedule (reference TrainSchedule,
+    runtime/pipe/schedule.py:182) as one compiled scan.
+
+    Timing (0-indexed stage s of S, micro m of M): macro-tick u of
+    M + 2S - 2 runs, on EVERY row, one forward sub-step (stage s forwards
+    micro u - s) and one backward sub-step (stage s backwards micro
+    u - (2S-2-s), recomputing its stage body under ``jax.vjp``). Out-of-
+    range micros execute on garbage data and are ``where``-masked out —
+    never skipped, preserving the uniformity invariant (module docstring):
+    all collectives run on every device every tick. The last stage's
+    forward and backward of a micro coincide (in-flight depth 0), stage 0
+    holds the deepest window (2S-2); the circular stage-input buffer has
+    depth 2S-1, so peak activation memory is O(S), flat in M — the
+    reference's 1F1B in-flight bound (schedule.py:243 num_pipe_buffers).
+
+    Gradient semantics: returns ``d(mean_micro_loss * scale)/d(params)`` in
+    fp32 (accumulated across ticks in fp32; cross-stage grad messages
+    travel in the compute dtype like the reference's fp16 p2p grads).
+    Tied-weight grads (post head reading pre_p, reference TiedLayerSpec /
+    ReduceTiedGrads, pipe/engine.py:203) emerge from the head vjp plus
+    stage 0's embedding vjp, combined by a pipe-psum at the end. The loss
+    is the unscaled mean micro loss, pmean'd over data.
+    """
+    if "pipe" not in mesh.axis_names:
+        raise ValueError("pipeline execution requires a 'pipe' mesh axis")
+    S = spec.num_stages
+    M = num_micro
+    if axis_size(mesh, "pipe") != S:
+        raise ValueError(
+            f"mesh pipe axis {axis_size(mesh, 'pipe')} != num_stages {S}")
+
+    manual_axes = _pipe_manual_axes(mesh)
+    manual_only = partial(_manual_only, manual_axes=manual_axes)
+    B = 2 * S - 1   # circular buffer depth >= deepest in-flight window + 1
+
+    def per_device(params, batch, rng, scale):
+        if compute_dtype is not None:
+            params = jax.tree_util.tree_map(
+                lambda x: x.astype(compute_dtype)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
+        s_idx = jax.lax.axis_index("pipe")
+        pre_p, post_p = params["pre"], params["post"]
+        st_p = jax.tree_util.tree_map(lambda x: x[0], params["stages"])
+
+        micro0 = jax.tree_util.tree_map(lambda x: x[0], batch)
+        probe = jax.eval_shape(spec.pre_apply, pre_p, micro0, rng)
+        act_shape, act_dtype = probe.shape, probe.dtype
+        coop, chunk, ntok = _head_mode(spec, S, act_shape)
+        zeros_act = jnp.zeros(act_shape, act_dtype)
+
+        def key_pre(m):
+            return jax.random.fold_in(rng, m * (S + 1))
+
+        def key_stage(m):
+            return jax.random.fold_in(rng, m * (S + 1) + s_idx + 1)
+
+        f32_zeros = lambda tree: jax.tree_util.tree_map(
+            lambda x: jnp.zeros(x.shape, jnp.float32), tree)
+        acc_masked = lambda acc, g, valid: jax.tree_util.tree_map(
+            lambda a, x: a + jnp.where(valid, x.astype(jnp.float32), 0.0),
+            acc, g)
+
+        # loss cotangents: d(mean_over_micros * scale)
+        ct_sum = scale / (M * max(ntok, 1))    # per-token-sum head (coop)
+        ct_mean = scale / M                    # per-micro-mean head
+
+        def micro_at(m):
+            return jax.tree_util.tree_map(lambda x: x[m], batch)
+
+        def tick(carry, u):
+            fwd_msg, bwd_msg, buf, loss_acc, g_pre, g_st, g_post = carry
+
+            # ---------------- forward sub-step: micro u - s -------------
+            mf_raw = u - s_idx
+            mf = jnp.clip(mf_raw, 0, M - 1)
+            valid_f = jnp.logical_and(mf_raw >= 0, mf_raw < M)
+            micro_f = micro_at(mf)
+            fresh = spec.pre_apply(pre_p, micro_f, key_pre(mf))
+            act_in = jnp.where(s_idx == 0, fresh.astype(act_dtype), fwd_msg)
+            out = spec.stage_apply(st_p, act_in, key_stage(mf))
+            slot = mf % B
+            old = jax.lax.dynamic_index_in_dim(buf, slot, 0, keepdims=False)
+            buf = jax.lax.dynamic_update_index_in_dim(
+                buf, jnp.where(valid_f, act_in, old), slot, 0)
+
+            # ------------- head: micro u - (S-1), all rows --------------
+            # (the last stage's forward and backward of a micro coincide,
+            # so its head input is this tick's fresh `out`)
+            mh_raw = u - (S - 1)
+            mh = jnp.clip(mh_raw, 0, M - 1)
+            valid_h = jnp.logical_and(mh_raw >= 0, mh_raw < M)
+            micro_h = micro_at(mh)
+            if coop:
+                # sequence-sharded cooperative head: broadcast the exiting
+                # activation, each row computes (and differentiates) its
+                # 1/S sequence chunk — total head work 1x per micro
+                out_last = jax.lax.psum(
+                    jnp.where(s_idx == S - 1, out, zeros_act), "pipe")
+                start = s_idx * chunk
+                sl = jax.lax.dynamic_slice_in_dim(out_last, start, chunk, 1)
+                lsum, vjp_head = jax.vjp(
+                    lambda pp, prp, a: spec.post_shard_apply(
+                        pp, prp, a, micro_h, start), post_p, pre_p, sl)
+                gpo, gpr, d_sl = vjp_head(ct_sum.astype(lsum.dtype))
+                d_sl = jnp.where(valid_h, d_sl, 0.0).astype(act_dtype)
+                idx = (0, start) + (0,) * (len(act_shape) - 2)
+                d_out_head = jax.lax.psum(
+                    jax.lax.dynamic_update_slice(zeros_act, d_sl, idx),
+                    "pipe")
+                loss_add = jnp.where(valid_h, lsum.astype(jnp.float32), 0.0)
+                head_valid = valid_h
+            else:
+                # masked redundant head: every row computes post_apply on
+                # its own `out`; only the last row's input is meaningful
+                lmean, vjp_head = jax.vjp(
+                    lambda pp, prp, a: spec.post_apply(
+                        pp, prp, a, micro_h), post_p, pre_p, out)
+                gpo, gpr, d_out_head = vjp_head(ct_mean.astype(lmean.dtype))
+                sel = jnp.logical_and(valid_h, s_idx == S - 1)
+                loss_add = jnp.where(sel, lmean.astype(jnp.float32), 0.0)
+                head_valid = sel
+            g_post = acc_masked(g_post, gpo, head_valid)
+            g_pre = acc_masked(g_pre, gpr, head_valid)
+
+            # ------------- backward sub-step: micro u - (2S-2-s) --------
+            mb_raw = u - (2 * S - 2 - s_idx)
+            mb = jnp.clip(mb_raw, 0, M - 1)
+            valid_b = jnp.logical_and(mb_raw >= 0, mb_raw < M)
+            micro_b = micro_at(mb)
+            a_stored = jax.lax.dynamic_index_in_dim(
+                buf, mb % B, 0, keepdims=False)
+            kb = key_stage(mb)
+            _, vjp_stage = jax.vjp(
+                lambda sp, a: spec.stage_apply(sp, a, kb), st_p, a_stored)
+            g_out = jnp.where(s_idx == S - 1,
+                              d_out_head.astype(act_dtype), bwd_msg)
+            g_st_m, d_act = vjp_stage(g_out)
+            g_st = acc_masked(g_st, g_st_m, valid_b)
+
+            # embedding backward (BackwardPass reaching LoadMicroBatch's
+            # producer): executed by every row, input masked to stage 0
+            d_for_pre = jnp.where(
+                jnp.logical_and(s_idx == 0, valid_b), d_act, 0.0
+            ).astype(act_dtype)
+            _, vjp_pre = jax.vjp(
+                lambda pp: spec.pre_apply(pp, micro_b, key_pre(mb)
+                                          ).astype(act_dtype), pre_p)
+            g_pre = acc_masked(g_pre, vjp_pre(d_for_pre)[0], True)
+
+            # SendActivation (s -> s+1) and SendGrad (s -> s-1)
+            new_fwd = jax.lax.ppermute(
+                out, "pipe", [(i, (i + 1) % S) for i in range(S)])
+            new_bwd = jax.lax.ppermute(
+                jnp.where(valid_b, d_act, 0.0).astype(act_dtype),
+                "pipe", [(i, (i - 1) % S) for i in range(S)])
+            return (new_fwd, new_bwd, buf, loss_acc + loss_add,
+                    g_pre, g_st, g_post), None
+
+        buf0 = jnp.zeros((B,) + act_shape, act_dtype)
+        carry0 = (zeros_act, zeros_act, buf0, jnp.zeros((), jnp.float32),
+                  f32_zeros(pre_p), f32_zeros(st_p), f32_zeros(post_p))
+        (_, _, _, loss_sum, g_pre, g_st, g_post), _ = jax.lax.scan(
+            tick, carry0, jnp.arange(M + 2 * S - 2))
+
+        # ReduceTiedGrads + loss aggregation: pipe-psum combines the head
+        # chunks / embedding / tied contributions and replicates them
+        denom = M * ntok if coop else M
+        loss = jax.lax.psum(loss_sum, "pipe") / denom
+        g_pre = jax.lax.psum(g_pre, "pipe")
+        g_post = jax.lax.psum(g_post, "pipe")
+        if "data" in manual_axes:
+            loss = jax.lax.pmean(loss, "data")
+            g_pre = jax.lax.pmean(g_pre, "data")
+            g_post = jax.lax.pmean(g_post, "data")
+            g_st = jax.tree_util.tree_map(
+                lambda g: jax.lax.pmean(g, "data"), g_st)
+        g_stages = jax.tree_util.tree_map(lambda x: x[None], g_st)
+        return loss, {"pre": g_pre, "stages": g_stages, "post": g_post}
+
+    def grad_fn(params, batch, rng, scale):
+        full_specs = jax.tree_util.tree_map(
+            manual_only, pipeline_param_specs(spec, params),
+            is_leaf=lambda x: isinstance(x, P))
+        batch_specs = jax.tree_util.tree_map(
+            lambda _: P(None, "data") if "data" in mesh.axis_names else P(),
+            batch)
+        grad_specs = {
+            "pre": jax.tree_util.tree_map(lambda _: P(), params["pre"]),
+            "stages": full_specs["stages"],
+            "post": jax.tree_util.tree_map(lambda _: P(), params["post"]),
+        }
+        mapped = jax.shard_map(
+            per_device, mesh=mesh,
+            in_specs=(full_specs, batch_specs, P(), P()),
+            out_specs=(P(), grad_specs),
+            axis_names=manual_axes,
+            check_vma=False)
+        return mapped(params, batch, rng,
+                      jnp.asarray(scale, jnp.float32))
+
+    return grad_fn
 
 
 def microbatch_sharding(mesh: Mesh) -> NamedSharding:
