@@ -123,6 +123,55 @@ def _manual_only(p: P, manual_axes) -> P:
     return P(*(keep(e) for e in tuple(p)))
 
 
+def _psum_act(x, axis_name: str):
+    """psum of an activation-sized tensor inside the pipeline scan.
+
+    XLA@jax-0.9.0 bug workaround: a *bfloat16* psum over a manual shard_map
+    axis inside lax.scan, with an auto (GSPMD) axis present in the mesh,
+    aborts the SPMD partitioner with ``Invalid binary instruction opcode
+    copy`` (hlo_instruction.cc:1585). Summing in fp32 and casting back
+    partitions cleanly — and is numerically at least as good (the psum
+    accumulates in fp32).
+    """
+    if x.dtype == jnp.float32:
+        return jax.lax.psum(x, axis_name)
+    return jax.lax.psum(x.astype(jnp.float32), axis_name).astype(x.dtype)
+
+
+def seq_chunk_select(x, s_idx, S: int, axis: int = 1):
+    """Select sequence block ``s_idx`` of ``S`` equal chunks along ``axis``
+    WITHOUT a traced-start dynamic_slice: reshape (.., S*chunk, ..) ->
+    (.., S, chunk, ..) and contract with a one-hot of ``s_idx``.
+
+    Rationale: under shard_map with auto (GSPMD) axes present in the mesh,
+    traced-start dynamic-slice/update-slice on these activations trips an
+    XLA partitioner CHECK ("Invalid binary instruction opcode copy",
+    hlo_instruction.cc:1585, XLA@jax 0.9.0) while compiling the pipelined
+    step. The reshape + one-hot masked-sum form partitions cleanly and
+    costs one extra elementwise pass over the block — noise next to the
+    head GEMM it feeds.
+    """
+    shape = x.shape
+    chunk = shape[axis] // S
+    resh = x.reshape(shape[:axis] + (S, chunk) + shape[axis + 1:])
+    bshape = (1,) * axis + (S,) + (1,) * (resh.ndim - axis - 1)
+    onehot = (jax.lax.iota(jnp.int32, S) == s_idx).reshape(bshape)
+    return jnp.sum(jnp.where(onehot, resh, jnp.zeros((), resh.dtype)),
+                   axis=axis)
+
+
+def seq_chunk_scatter(chunk_val, s_idx, S: int, axis: int = 1):
+    """Inverse of :func:`seq_chunk_select`: embed a (.., chunk, ..) block
+    at position ``s_idx`` of ``S`` along ``axis``, zeros elsewhere —
+    again avoiding traced-index dynamic_update_slice (see select)."""
+    shape = chunk_val.shape
+    expanded = jnp.expand_dims(chunk_val, axis)
+    bshape = (1,) * axis + (S,) + (1,) * (expanded.ndim - axis - 1)
+    onehot = (jax.lax.iota(jnp.int32, S) == s_idx).reshape(bshape)
+    full = jnp.where(onehot, expanded, jnp.zeros((), chunk_val.dtype))
+    return full.reshape(shape[:axis] + (S * shape[axis],) + shape[axis + 1:])
+
+
 def _head_mode(spec: "PipelineSpec", S: int, act_shape):
     """(coop, chunk, ntok): cooperative sequence-sharded head is usable
     when the spec provides post_shard_apply, the activation is (mb, seq,
@@ -223,11 +272,11 @@ def build_pipeline_loss_fn(spec: PipelineSpec, mesh: Mesh, num_micro: int,
             micro_out = jax.tree_util.tree_map(lambda x: x[o_idx], batch)
             valid = jnp.logical_and(out_t >= 0, out_t < M)
             if coop:
-                out_last = jax.lax.psum(
+                out_last = _psum_act(
                     jnp.where(s_idx == S - 1, out,
                               jnp.zeros(act_shape, act_dtype)), "pipe")
                 start = s_idx * chunk
-                sl = jax.lax.dynamic_slice_in_dim(out_last, start, chunk, 1)
+                sl = seq_chunk_select(out_last, s_idx, S, axis=1)
                 lsum = spec.post_shard_apply(post_p, pre_p, sl, micro_out,
                                              start)
                 loss_m = jnp.where(valid, lsum.astype(jnp.float32), 0.0)
@@ -375,19 +424,17 @@ def build_pipeline_grad_fn(spec: PipelineSpec, mesh: Mesh, num_micro: int,
                 # sequence-sharded cooperative head: broadcast the exiting
                 # activation, each row computes (and differentiates) its
                 # 1/S sequence chunk — total head work 1x per micro
-                out_last = jax.lax.psum(
+                out_last = _psum_act(
                     jnp.where(s_idx == S - 1, out, zeros_act), "pipe")
                 start = s_idx * chunk
-                sl = jax.lax.dynamic_slice_in_dim(out_last, start, chunk, 1)
+                sl = seq_chunk_select(out_last, s_idx, S, axis=1)
                 lsum, vjp_head = jax.vjp(
                     lambda pp, prp, a: spec.post_shard_apply(
                         pp, prp, a, micro_h, start), post_p, pre_p, sl)
                 gpo, gpr, d_sl = vjp_head(ct_sum.astype(lsum.dtype))
                 d_sl = jnp.where(valid_h, d_sl, 0.0).astype(act_dtype)
-                idx = (0, start) + (0,) * (len(act_shape) - 2)
-                d_out_head = jax.lax.psum(
-                    jax.lax.dynamic_update_slice(zeros_act, d_sl, idx),
-                    "pipe")
+                d_out_head = _psum_act(
+                    seq_chunk_scatter(d_sl, s_idx, S, axis=1), "pipe")
                 loss_add = jnp.where(valid_h, lsum.astype(jnp.float32), 0.0)
                 head_valid = valid_h
             else:
