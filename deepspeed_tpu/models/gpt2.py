@@ -301,16 +301,26 @@ def gpt2_pipeline_spec(config: GPT2Config, num_stages: int,
     from deepspeed_tpu.runtime.pipe.spmd import PipelineSpec
 
     L = config.num_layers
-    if L % num_stages != 0:
-        raise ValueError(f"num_layers {L} must divide into {num_stages} "
-                         f"pipeline stages")
-    lps = L // num_stages
+    # uneven partitions supported: stages hold ceil(L/S) slots, short
+    # stages pad with zero blocks masked out in stage_apply (data-masked,
+    # never branched — reference parameters-balanced partitions,
+    # module.py:348, composed with the SPMD uniformity invariant)
+    lps = -(-L // num_stages)  # ceil
+    stage_counts = [min(lps, max(0, L - s * lps))
+                    for s in range(num_stages)]
+    if min(stage_counts) <= 0:
+        raise ValueError(f"num_layers {L} too few for {num_stages} stages "
+                         f"(an entire stage would be empty)")
+    even_stages = (L % num_stages == 0)
 
     def init(key):
         full = init_gpt2_params(config, key)
         per_stage = []
+        zero_block = jax.tree_util.tree_map(jnp.zeros_like, full["h_0"])
         for s in range(num_stages):
-            blocks = [full[f"h_{s * lps + j}"] for j in range(lps)]
+            blocks = [full[f"h_{s * lps + j}"]
+                      for j in range(stage_counts[s])]
+            blocks += [zero_block] * (lps - stage_counts[s])
             per_stage.append(jax.tree_util.tree_map(
                 lambda *xs: jnp.stack(xs), *blocks))
         stages = jax.tree_util.tree_map(
@@ -329,13 +339,20 @@ def gpt2_pipeline_spec(config: GPT2Config, num_stages: int,
             x = _dropout(x, config.embd_dropout, rng, deterministic)
         return x
 
+    counts_arr = jnp.asarray(stage_counts, jnp.int32)
+
     def stage_apply(st_p, act, rng):
-        # st_p leaves: (lps, ...) — scan the layer dim
+        # st_p leaves: (lps, ...) — scan the layer dim; padded slots of an
+        # uneven partition pass x through via where (uniform execution)
+        cnt = None if even_stages else \
+            counts_arr[jax.lax.axis_index("pipe")]
         def body(x, inp):
             j, lp = inp
             r = jax.random.fold_in(rng, j) if rng is not None else None
-            return gpt2_block(lp, config, x, r, deterministic,
-                              _dtype_of(act)), None
+            y = gpt2_block(lp, config, x, r, deterministic, _dtype_of(act))
+            if cnt is not None:
+                y = jnp.where(j < cnt, y, x)
+            return y, None
         out, _ = jax.lax.scan(body, act, (jnp.arange(lps), st_p))
         return out
 
@@ -350,10 +367,15 @@ def gpt2_pipeline_spec(config: GPT2Config, num_stages: int,
     def post_shard_apply(post_p, pre_p, act_slice, micro, start):
         # sequence-chunk of the head for the cooperative pipeline head
         # (spmd.py): positions [start, start+len) of the micro-batch;
-        # per-token xent decomposes, so a SUM over the slice is exact
+        # per-token xent decomposes, so a SUM over the slice is exact.
+        # Targets come via static shift + one-hot block select — a traced
+        # `start` dynamic_slice here trips the XLA partitioner under auto
+        # mesh axes (see spmd.seq_chunk_select).
+        from deepspeed_tpu.runtime.pipe.spmd import seq_chunk_select
         length = act_slice.shape[1]
-        targets = jax.lax.dynamic_slice_in_dim(
-            micro["input_ids"], start + 1, length, axis=1)
+        shifted = micro["input_ids"][:, 1:]            # (mb, seq) next-token
+        S = shifted.shape[1] // length
+        targets = seq_chunk_select(shifted, start // length, S, axis=1)
         x = _layer_norm(act_slice, post_p["ln_f"], config.layer_norm_eps)
         return _tied_xent_chunked(x, pre_p["wte"], targets,
                                   _dtype_of(act_slice), mean=False)

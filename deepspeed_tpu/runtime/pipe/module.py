@@ -294,19 +294,39 @@ class PipelineModule:
         return [self._params_for(params, i)
                 for i in self.stage_layers(stage_id)]
 
+    def stage_layer_counts(self) -> List[int]:
+        return [self.parts[s + 1] - self.parts[s]
+                for s in range(self.num_stages)]
+
     def stack_stage_params(self, params: Dict[str, Any]):
         """Stack per-stage param lists into leaves with a leading ``pipe``
-        dim: returns a pytree whose leaves have shape (num_stages, ...)."""
+        dim: returns a pytree whose leaves have shape (num_stages, ...).
+
+        Uneven partitions (``parameters``-balanced or L %% S != 0 —
+        reference module.py:348) are supported by padding shorter stages
+        with zero no-op layers up to the max stage depth; the padded slots
+        are skipped (data-masked, never branched) by ``stage_apply_fn``
+        using the static per-stage layer-count table.
+        """
         per_stage = [self.stage_params(params, s)
                      for s in range(self.num_stages)]
+        counts = [len(sp) for sp in per_stage]
+        max_n = max(counts)
+        if min(counts) != max_n:
+            tmpl = per_stage[counts.index(max_n)]
+            for sp in per_stage:
+                while len(sp) < max_n:
+                    sp.append(jax.tree_util.tree_map(
+                        jnp.zeros_like, tmpl[len(sp)]))
         ref = jax.tree_util.tree_structure(per_stage[0])
         shapes0 = [l.shape for l in jax.tree_util.tree_leaves(per_stage[0])]
         for s, sp in enumerate(per_stage[1:], start=1):
             if jax.tree_util.tree_structure(sp) != ref:
                 raise ValueError(
                     f"stage {s} params structure differs from stage 0 — "
-                    f"stages must be homogeneous to stack over the pipe "
-                    f"axis; move odd layers into PipelineSpec pre/post")
+                    f"stages must be homogeneous (same layer type) to "
+                    f"stack over the pipe axis; move odd layers into "
+                    f"PipelineSpec pre/post")
             shapes = [l.shape for l in jax.tree_util.tree_leaves(sp)]
             if shapes != shapes0:
                 raise ValueError(
@@ -316,14 +336,33 @@ class PipelineModule:
 
     def stage_apply_fn(self) -> Callable:
         """Returns ``f(stage_param_list, x, rng)`` applying one stage's
-        layers; identical code for every stage (required by SPMD)."""
-        lo, hi = self.parts[0], self.parts[1]
-        layers = self.layers[lo:hi]
+        layers; identical code for every stage (required by SPMD).
+
+        With an uneven partition each stage runs ``max(counts)`` layer
+        slots and masks padded slots by ``where`` on the stage's layer
+        count (looked up via ``lax.axis_index('pipe')`` — so the uneven
+        path only executes inside the pipeline shard_map). The uniformity
+        invariant (spmd.py) holds: every device executes every slot.
+        """
+        counts = self.stage_layer_counts()
+        max_n = max(counts)
+        even = min(counts) == max_n
+        # representative layer objects per slot, taken from a deepest
+        # stage (stages are homogeneous in layer type — checked at stack)
+        lo = self.parts[counts.index(max_n)]
+        layers = self.layers[lo:lo + max_n]
+        counts_arr = jnp.asarray(counts, jnp.int32)
 
         def apply(stage_params: List, x, rng=None):
+            cnt = None
+            if not even:
+                cnt = counts_arr[jax.lax.axis_index("pipe")]
             for j, layer in enumerate(layers):
                 r = jax.random.fold_in(rng, j) if rng is not None else None
-                x = _layer_apply(layer, stage_params[j], x, rng=r)
+                y = _layer_apply(layer, stage_params[j], x, rng=r)
+                if not even and j >= min(counts):
+                    y = jnp.where(j < cnt, y, x)
+                x = y
             return x
         return apply
 

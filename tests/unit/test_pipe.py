@@ -244,3 +244,53 @@ def test_gpt2_pipeline_matches_sequential():
     ref = np.mean([float(seq_fn(flat, {"input_ids": ids[m]}, rng))
                    for m in range(M)])
     np.testing.assert_allclose(pipe_loss, ref, rtol=2e-4)
+
+
+def test_uneven_partition_compiled_pipeline():
+    """7 layers over 2 stages (4+3): the compiled executor runs the padded
+    stage stack with masked no-op slots and matches the sequential-forward
+    baseline (reference parameters-balanced partitions, module.py:348)."""
+    module = ds.PipelineModule(
+        [ds.LayerSpec(Linear, H) for _ in range(7)],
+        num_stages=2, loss_fn=_mse, partition_method="uniform")
+    assert module.stage_layer_counts() == [4, 3]
+    params = module.init_params(jax.random.PRNGKey(0))
+
+    micros = _micro_batches(12, 4)
+    cfg = _pipe_config(mesh={"axes": {"pipe": 2, "data": 2}},
+                       gradient_accumulation_steps=2)
+    eng, *_ = ds.initialize(model=module, model_parameters=params,
+                            config=cfg)
+    pipe_losses = [float(eng.train_batch(iter(micros[2*i:2*i+2])))
+                   for i in range(3)]
+    assert all(np.isfinite(l) for l in pipe_losses)
+
+    base_losses = _baseline_losses(module, params, micros, steps=3, gas=2)
+    np.testing.assert_allclose(pipe_losses, base_losses[:3],
+                               rtol=5e-3, atol=1e-4)
+
+
+def test_uneven_gpt2_pipeline_spec():
+    """GPT-2 with L=3 layers over 2 stages trains through the compiled
+    pipeline (L % S != 0)."""
+    from deepspeed_tpu.models.gpt2 import GPT2Config, gpt2_pipeline_spec
+    cfg_m = GPT2Config(vocab_size=64, max_position_embeddings=32,
+                       hidden_size=32, num_layers=3, num_heads=2,
+                       embd_dropout=0.0, attn_dropout=0.0,
+                       resid_dropout=0.0)
+    spec = gpt2_pipeline_spec(cfg_m, num_stages=2)
+    config = {
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 2,
+        "bf16": {"enabled": True},
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "mesh": {"axes": {"pipe": 2, "data": 4, "model": 1}},
+    }
+    eng, *_ = ds.initialize(model=spec, config=config)
+    rng = np.random.RandomState(0)
+    losses = []
+    for _ in range(2):
+        micros = iter([{"input_ids": rng.randint(
+            0, 64, (8, 17)).astype(np.int32)} for _ in range(2)])
+        losses.append(float(eng.train_batch(micros)))
+    assert all(np.isfinite(l) for l in losses)
