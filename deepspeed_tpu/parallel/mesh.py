@@ -66,6 +66,12 @@ def build_mesh(axes: Optional[Dict[str, int]] = None,
         axes[unknown[0]] = n // known
 
     size = math.prod(axes.values())
+    if size < n and not unknown:
+        # explicit axes asking for fewer devices than exist: run on a
+        # subset — the elastic-resume case (reference reloads ZeRO state
+        # under a smaller dp world, stage2.py:1785-1793)
+        devices = list(devices)[:size]
+        n = size
     if size != n:
         raise ValueError(
             f"mesh axes {axes} require {size} devices but {n} are available")

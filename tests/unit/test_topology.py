@@ -118,8 +118,15 @@ class TestMesh:
         assert axis_size(mesh, "data") == jax.device_count() // 2
 
     def test_mismatch_raises(self):
+        # more devices than exist: error
         with pytest.raises(ValueError):
-            build_mesh({"data": 3})
+            build_mesh({"data": 16})
+
+    def test_explicit_subset_allowed(self):
+        # explicit axes smaller than the device count run on a subset —
+        # the elastic-resume case (dp=8 checkpoint loaded under dp=3)
+        mesh = build_mesh({"data": 3})
+        assert mesh.shape["data"] == 3
 
     def test_mesh_from_topology(self):
         topo = PipeDataParallelTopology(num_pp=2, num_dp=4)
