@@ -81,13 +81,17 @@ class CSRTensor:
 # in-jit fixed-capacity path
 # ---------------------------------------------------------------------------
 
-def dense_to_csr(dense: jax.Array, capacity: int
-                 ) -> Tuple[jax.Array, jax.Array]:
+def dense_to_csr(dense: jax.Array, capacity: int, with_overflow: bool = False):
     """Extract up to ``capacity`` nonzero rows, jit-friendly (static
     shapes). Returns ``(indices (capacity,), values (capacity, dim))``;
     unused slots have ``index == rows`` (dropped on densify).
 
     Capacity bound for an embedding grad: number of tokens in the batch.
+    That bound holds for pure lookup (gather) embeddings; it does NOT hold
+    for tied embeddings that also receive dense head gradients. With
+    ``with_overflow=True`` a third return value flags ``nonzero rows >
+    capacity`` — rows beyond capacity are silently dropped, so callers
+    must surface this (the engine checks it at the boundary).
     """
     rows = dense.shape[0]
     nonzero = jnp.any(dense != 0, axis=1)
@@ -96,6 +100,9 @@ def dense_to_csr(dense: jax.Array, capacity: int
     idx = jnp.where(nonzero[order], order, rows)[:capacity]
     safe = jnp.minimum(idx, rows - 1)
     vals = jnp.where((idx < rows)[:, None], dense[safe], 0.0)
+    if with_overflow:
+        overflow = jnp.sum(nonzero) > capacity
+        return idx.astype(jnp.int32), vals, overflow
     return idx.astype(jnp.int32), vals
 
 
