@@ -69,6 +69,11 @@ class PipelineEngine(DeepSpeedEngine):
                              "config['mesh']['axes']")
         dp = axis_size(probe_mesh, "data")
         resolved = DeepSpeedConfig(raw, world_size=dp)
+        if resolved.zero_optimization_stage >= 3:
+            raise ValueError(
+                "ZeRO stage 3 does not compose with pipeline parallelism "
+                "(the pipeline executor owns its param lifecycle; stage "
+                "<= 2 shards optimizer/gradient state over 'data')")
         self.micro_batches = resolved.gradient_accumulation_steps
         self._true_train_batch_size = resolved.train_batch_size
 

@@ -15,11 +15,16 @@ all-gather of updated params where the next forward needs them — scheduled
 with overlap by XLA's latency-hiding scheduler (the reference's
 ``overlap_comm`` stream juggling, stage2.py:291-294, for free).
 
-Stage map (reference zero/constants.py:28-40):
+Stage map (reference zero/constants.py:28-40 caps at 2; stage 3 is a
+TPU-native extension here):
 - stage 0: everything replicated (plain DP)
 - stage 1: optimizer state + fp32 master sharded (stage1.py sub-partitions)
 - stage 2: + gradient accumulator sharded (stage2.py grad partitioning)
-- stage 3: + a param-sharded forward; see runtime/zero/stage3.py
+- stage 3: same persistent shardings as stage 2, but the engine skips the
+  up-front compute-dtype cast (engine._cast_for_loss), so no replicated
+  full-parameter transient is ever materialized: weights are gathered +
+  cast at their use sites, per layer, and rematerialized blocks re-gather
+  in backward — the param-sharded-forward lifecycle as a GSPMD schedule.
 """
 
 from typing import Any, Optional

@@ -1,0 +1,102 @@
+"""ZeRO stage 3 (TPU-native extension; the reference caps at stage 2,
+zero/constants.py:28-40): persistent state sharded like stage 2, and NO
+replicated full-parameter transient — the engine skips the up-front
+compute-dtype cast so weights are gathered + cast at use sites."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models.gpt2 import (GPT2Config, gpt2_loss_fn,
+                                       init_gpt2_params)
+
+
+def _cfg(stage, **over):
+    c = {
+        "train_micro_batch_size_per_gpu": 1,
+        "steps_per_print": 1000,
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": stage},
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+    }
+    c.update(over)
+    return c
+
+
+MODEL = GPT2Config(vocab_size=2048, max_position_embeddings=64,
+                   hidden_size=128, num_layers=4, num_heads=4,
+                   embd_dropout=0.0, attn_dropout=0.0, resid_dropout=0.0)
+
+
+def _engine(stage, seed=0):
+    params = init_gpt2_params(MODEL, jax.random.PRNGKey(seed))
+    loss_fn = gpt2_loss_fn(MODEL, deterministic=True, remat=True)
+    engine, *_ = ds.initialize(model=loss_fn, model_parameters=params,
+                               config=_cfg(stage))
+    return engine
+
+
+def _batches(n, bs=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return [{"input_ids": rng.randint(0, MODEL.vocab_size,
+                                      (bs, 33)).astype(np.int32)}
+            for _ in range(n)]
+
+
+def test_stage3_accepted_and_state_sharded():
+    e = _engine(3)
+    assert e.zero_stage == 3
+    # persistent master params sharded 1/dp over 'data' (like stage 2)
+    wte = e.state.params["wte"]
+    local = wte.addressable_shards[0].data.shape
+    assert np.prod(local) == np.prod(wte.shape) // 8
+
+
+def test_stage3_matches_stage2_trajectory():
+    """Only the cast LOCATION differs: stage 3 computes e.g. layernorm
+    stats from fp32 weights where stage 2 pre-rounded to bf16 — same
+    update math, sub-1e-4 numeric drift."""
+    e3, e2 = _engine(3, seed=1), _engine(2, seed=1)
+    for b in _batches(3, seed=2):
+        l3 = float(e3.train_batch(iter([b])))
+        l2 = float(e2.train_batch(iter([b])))
+        np.testing.assert_allclose(l3, l2, rtol=1e-4)
+    # Adam normalizes grads, so cast-order rounding walks individual
+    # params apart at ~lr scale per step; the trajectory-level invariant
+    # is the per-step loss match above plus a small relative RMS drift
+    num = den = 0.0
+    for a, b in zip(jax.tree_util.tree_leaves(e3.state.params),
+                    jax.tree_util.tree_leaves(e2.state.params)):
+        a, b = np.asarray(a, np.float64), np.asarray(b, np.float64)
+        num += float(np.sum((a - b) ** 2))
+        den += float(np.sum(b ** 2))
+    assert np.sqrt(num / den) < 1e-2, np.sqrt(num / den)
+
+
+def test_stage3_lower_temp_memory_than_stage2():
+    """The stage-3 step must compile to strictly less XLA temp memory than
+    stage 2 (no full bf16 param copy). Uses the compiler's own memory
+    analysis — the honest 8-device-mesh proxy for peak HBM."""
+    e3, e2 = _engine(3), _engine(2)
+    b = _batches(1)[0]
+    sizes = {}
+    for name, e in (("s3", e3), ("s2", e2)):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        batch = jax.device_put(
+            b, NamedSharding(e.mesh, P("data")))
+        step = e._get_compiled_micro_step()
+        ma = step.lower(e.state, batch).compile().memory_analysis()
+        if ma is None or not hasattr(ma, "temp_size_in_bytes"):
+            pytest.skip("backend provides no memory analysis")
+        sizes[name] = ma.temp_size_in_bytes
+    assert sizes["s3"] < sizes["s2"], sizes
+
+
+def test_stage3_rejected_with_pipeline():
+    from deepspeed_tpu.models.gpt2 import gpt2_pipeline_spec
+    spec = gpt2_pipeline_spec(MODEL, num_stages=2)
+    with pytest.raises(ValueError, match="stage 3"):
+        ds.initialize(model=spec, config=_cfg(
+            3, mesh={"axes": {"pipe": 2, "data": 4, "model": 1}}))
