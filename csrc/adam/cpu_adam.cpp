@@ -58,6 +58,13 @@ std::mutex reg_mu;
 inline uint16_t f32_to_bf16(float v) {
     uint32_t bits;
     std::memcpy(&bits, &v, sizeof(bits));
+    // NaN guard: the rounding add below can carry through the mantissa
+    // into the exponent, turning a NaN into +/-Inf (masking a diverged
+    // state as a huge-but-finite weight). Return a quiet NaN preserving
+    // the sign instead.
+    if ((bits & 0x7fffffffu) > 0x7f800000u) {
+        return static_cast<uint16_t>((bits >> 16) | 0x0040u);
+    }
     // round-to-nearest-even on the truncated mantissa
     uint32_t rounding = 0x7fffu + ((bits >> 16) & 1u);
     return static_cast<uint16_t>((bits + rounding) >> 16);

@@ -44,15 +44,30 @@ def load_library():
             return _LIB
         so_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                _LIB_NAME)
-        # always invoke make: it is a no-op when the .so is newer than the
-        # source, and it keeps an edited cpu_adam.cpp from being shadowed
-        # by a stale binary
-        try:
-            subprocess.run(["make", "-C", _csrc_dir()], check=True,
-                           capture_output=True)
-        except Exception:
-            if not os.path.exists(so_path):
-                return None  # no toolchain and no prebuilt library
+        # rebuild only when the .so is missing or older than the source, and
+        # serialize concurrent builders (multi-host launcher / parallel
+        # pytest on a shared filesystem) with an exclusive lock file so no
+        # process ever dlopens a half-written binary
+        src_path = os.path.join(_csrc_dir(), "adam", "cpu_adam.cpp")
+        stale = (not os.path.exists(so_path) or
+                 (os.path.exists(src_path) and
+                  os.path.getmtime(src_path) > os.path.getmtime(so_path)))
+        if stale:
+            lock_path = so_path + ".buildlock"
+            try:
+                import fcntl
+                with open(lock_path, "w") as lockf:
+                    fcntl.flock(lockf, fcntl.LOCK_EX)
+                    # another process may have finished while we waited
+                    if (not os.path.exists(so_path) or
+                            (os.path.exists(src_path) and
+                             os.path.getmtime(src_path) >
+                             os.path.getmtime(so_path))):
+                        subprocess.run(["make", "-C", _csrc_dir()],
+                                       check=True, capture_output=True)
+            except Exception:
+                if not os.path.exists(so_path):
+                    return None  # no toolchain and no prebuilt library
         try:
             lib = ctypes.CDLL(so_path)
         except OSError:
@@ -67,6 +82,8 @@ def load_library():
             ctypes.c_longlong, ctypes.c_void_p]
         lib.ds_adam_step.restype = ctypes.c_int
         lib.ds_adam_simd_width.restype = ctypes.c_int
+        lib.ds_adam_destroy.argtypes = [ctypes.c_int]
+        lib.ds_adam_destroy.restype = ctypes.c_int
         _LIB = lib
         return _LIB
 
@@ -120,6 +137,10 @@ class DeepSpeedCPUAdam:
                 ctypes.c_float(betas[1]), ctypes.c_float(eps),
                 ctypes.c_float(weight_decay), int(adamw_mode),
                 int(bias_correction))
+            # free the native registry entry when this optimizer dies
+            # (one config leaked per instance otherwise)
+            import weakref
+            weakref.finalize(self, self._lib.ds_adam_destroy, self.opt_id)
 
     @property
     def uses_native_kernel(self) -> bool:
