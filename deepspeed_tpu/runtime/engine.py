@@ -194,6 +194,17 @@ class DeepSpeedEngine:
         self.zero_cpu_offload = bool(
             self._config.zero_config.stage >= 1 and
             self._config.zero_config.cpu_offload)
+        # overlap_comm + cpu_offload: host Adam overlaps the next window's
+        # device compute (one-window-delayed updates; reference overlaps
+        # D2H/H2D on side streams, stage2.py:291-294)
+        self._offload_overlap = bool(
+            self.zero_cpu_offload and self._config.zero_config.overlap_comm)
+        self._offload_pending = None
+        self._offload_pool = None
+        if self._offload_overlap:
+            from concurrent.futures import ThreadPoolExecutor
+            self._offload_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="ds-offload")
         if self.zero_cpu_offload:
             assert optimizer is None, \
                 "client optimizers are unsupported with cpu_offload"
@@ -853,11 +864,12 @@ class DeepSpeedEngine:
             self.timers("backward").stop()
         return loss
 
-    def _host_apply_update(self):
-        """ZeRO-Offload optimizer boundary: one D2H of the summed grads,
-        native C++ SIMD Adam on the host fp32 master, one H2D of the
-        updated compute-dtype params (reference stage2.py:1418-1431:
-        DeepSpeedCPUAdam.step + fp32→fp16 device copy)."""
+    # -- ZeRO-Offload boundary, split so the host Adam can overlap the
+    # -- next window's device compute (reference overlaps D2H/H2D on side
+    # -- streams, stage2.py:291-294 + async copy in csrc/adam/cpu_adam.cpp)
+    def _host_grad_snapshot(self):
+        """D2H of the summed, unscaled fp32 grads; then reset the device
+        accumulator so the next window can start immediately."""
         from deepspeed_tpu.runtime.checkpoint import _to_host_global
         accum = jax.tree_util.tree_map(_to_host_global,
                                        self.state.accum_grads)
@@ -865,48 +877,86 @@ class DeepSpeedEngine:
         inv = 1.0 / scale
         grads = jax.tree_util.tree_map(
             lambda g: np.asarray(g, np.float32) * inv, accum)
-
-        overflow = any(not np.all(np.isfinite(g))
-                       for g in jax.tree_util.tree_leaves(grads))
-        if not overflow:
-            if self.gradient_clipping > 0:
-                sq = sum(float(np.sum(g.astype(np.float64) ** 2))
-                         for g in jax.tree_util.tree_leaves(grads))
-                clip = min(1.0, self.gradient_clipping /
-                           (np.sqrt(sq) + 1e-6))
-                if clip < 1.0:
-                    grads = jax.tree_util.tree_map(
-                        lambda g: g * np.float32(clip), grads)
-            # device global_step excludes overflow-skipped steps (the host
-            # mirror doesn't); we already sync on loss_scale above, so the
-            # extra scalar fetch is free
-            lr = float(self._lr_at(self.state.global_step))
-            use_bf16 = self.compute_dtype == jnp.bfloat16
-            new_params = self.optimizer.step(grads, lr=lr,
-                                             bf16_out=use_bf16)
-            if not use_bf16:
-                dtype = self.compute_dtype or jnp.float32
-                new_params = jax.tree_util.tree_map(
-                    lambda p: p.astype(dtype), new_params)
-            device_params = jax.device_put(new_params,
-                                           self._param_shardings)
-        else:
-            device_params = self.state.params
-
-        new_scale = self.loss_scaler.update(
-            self.state.loss_scale, jnp.asarray(overflow))
         zero_accum = jax.tree_util.tree_map(
             lambda g: jnp.zeros(g.shape, g.dtype), self.state.accum_grads)
+        self.state = self.state._replace(
+            accum_grads=jax.device_put(
+                zero_accum, self._state_shardings.accum_grads),
+            micro_step=jnp.zeros((), jnp.int32))
+        return grads
+
+    def _host_optimize(self, grads, lr):
+        """Overflow check + clip + native C++ SIMD Adam on the host fp32
+        master (reference stage2.py:1418-1431 DeepSpeedCPUAdam.step).
+        Thread-safe w.r.t. device work: touches only host state."""
+        overflow = any(not np.all(np.isfinite(g))
+                       for g in jax.tree_util.tree_leaves(grads))
+        if overflow:
+            return None, True
+        if self.gradient_clipping > 0:
+            sq = sum(float(np.sum(g.astype(np.float64) ** 2))
+                     for g in jax.tree_util.tree_leaves(grads))
+            clip = min(1.0, self.gradient_clipping /
+                       (np.sqrt(sq) + 1e-6))
+            if clip < 1.0:
+                grads = jax.tree_util.tree_map(
+                    lambda g: g * np.float32(clip), grads)
+        use_bf16 = self.compute_dtype == jnp.bfloat16
+        new_params = self.optimizer.step(grads, lr=lr, bf16_out=use_bf16)
+        if not use_bf16:
+            dtype = self.compute_dtype or jnp.float32
+            new_params = jax.tree_util.tree_map(
+                lambda p: p.astype(dtype), new_params)
+        return new_params, False
+
+    def _apply_host_result(self, new_params, overflow):
+        """H2D of the updated compute-dtype params + counter/scale
+        bookkeeping (reference's fp32->fp16 device copy)."""
+        if overflow:
+            device_params = self.state.params
+        else:
+            device_params = jax.device_put(new_params,
+                                           self._param_shardings)
+        new_scale = self.loss_scaler.update(
+            self.state.loss_scale, jnp.asarray(overflow))
         inc = 0 if overflow else 1
         self.state = self.state._replace(
             params=device_params,
-            accum_grads=jax.device_put(
-                zero_accum, self._state_shardings.accum_grads),
             loss_scale=new_scale,
             global_step=self.state.global_step + inc,
-            micro_step=jnp.zeros((), jnp.int32),
             skipped_steps=self.state.skipped_steps + (1 - inc),
         )
+
+    def _host_apply_update(self):
+        """Synchronous ZeRO-Offload boundary: snapshot -> Adam -> H2D."""
+        grads = self._host_grad_snapshot()
+        lr = float(self._lr_at(self.state.global_step))
+        new_params, overflow = self._host_optimize(grads, lr)
+        self._apply_host_result(new_params, overflow)
+
+    def _host_apply_update_overlapped(self):
+        """Overlapped boundary (zero_optimization.overlap_comm): apply the
+        PREVIOUS window's pending update, snapshot this window's grads,
+        and hand them to the worker thread — the host Adam then runs
+        concurrently with the next window's device compute. Updates are
+        one window delayed (window k+1 computes with params_{k-1}); call
+        :meth:`synchronize` (or save/eval, which do) to drain."""
+        self._offload_drain()
+        grads = self._host_grad_snapshot()
+        lr = float(self._lr_at(self.state.global_step))
+        self._offload_pending = self._offload_pool.submit(
+            self._host_optimize, grads, lr)
+
+    def _offload_drain(self):
+        if getattr(self, "_offload_pending", None) is not None:
+            new_params, overflow = self._offload_pending.result()
+            self._offload_pending = None
+            self._apply_host_result(new_params, overflow)
+
+    def synchronize(self):
+        """Apply any in-flight overlapped offload update (no-op
+        otherwise). Call before reading params outside the engine."""
+        self._offload_drain()
 
     def _maybe_switch_onebit_phase(self):
         """Enter 1-bit compression once global_steps reaches freeze_step
@@ -941,7 +991,10 @@ class DeepSpeedEngine:
         ga = self.gradient_accumulation_steps
         if self.zero_cpu_offload:
             if self.is_gradient_accumulation_boundary():
-                self._host_apply_update()
+                if self._offload_overlap:
+                    self._host_apply_update_overlapped()
+                else:
+                    self._host_apply_update()
                 self._host_global_step += 1
                 self._report_progress()
                 self._write_monitor(self._cached_loss)
@@ -1008,7 +1061,10 @@ class DeepSpeedEngine:
                 loss = out
             total = loss if total is None else total + loss
         if self.zero_cpu_offload:
-            self._host_apply_update()
+            if self._offload_overlap:
+                self._host_apply_update_overlapped()
+            else:
+                self._host_apply_update()
         self.tput_timer.stop()
         mean_loss = total / self.gradient_accumulation_steps
         self._host_micro_step += self.gradient_accumulation_steps
@@ -1020,6 +1076,7 @@ class DeepSpeedEngine:
 
     def eval_batch(self, batch):
         """Loss without grads/update."""
+        self._offload_drain()
         if not hasattr(self, "_compiled_eval"):
             def ev(params, batch, rng):
                 cp = self._cast_for_loss(params)
@@ -1055,6 +1112,7 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------ #
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
                         client_state: Optional[Dict] = None):
+        self._offload_drain()
         if tag is None:
             tag = f"global_step{int(self.state.global_step)}"
         ckpt_dir = os.path.join(save_dir, tag)
@@ -1105,6 +1163,7 @@ class DeepSpeedEngine:
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
                         load_optimizer_states: bool = True,
                         load_lr_scheduler_states: bool = True):
+        self._offload_drain()
         if tag is None:
             tag = ckpt.read_latest(load_dir)
             if tag is None:

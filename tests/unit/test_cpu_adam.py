@@ -155,3 +155,79 @@ def test_engine_offload_checkpoint_roundtrip(tmp_path):
         l1 = engine.train_batch(iter(batches[i:i + 2]))
         l2 = engine2.train_batch(iter(batches[i:i + 2]))
         np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+
+
+# --------------------------------------------------------------------- #
+# overlapped offload (zero_optimization.overlap_comm): host Adam runs
+# concurrently with the next window's device compute, updates delayed by
+# one window (reference stream overlap, stage2.py:291-294)
+# --------------------------------------------------------------------- #
+
+def test_engine_offload_overlap_one_window_delay():
+    """After 2 overlapped windows, device params must equal a synchronous
+    engine's params after 1 window on the same data — the defining
+    one-window-delay semantics."""
+    from tests.unit.simple_model import (init_simple_params, simple_loss_fn,
+                                         random_batches)
+    params = init_simple_params(jax.random.PRNGKey(0), hidden_dim=8)
+    batches = random_batches(4, 4, 8, seed=3)
+
+    eo, *_ = ds.initialize(
+        model=simple_loss_fn, model_parameters=params,
+        config=_offload_config(
+            zero_optimization={"stage": 2, "cpu_offload": True,
+                               "overlap_comm": True}))
+    assert eo._offload_overlap
+    es, *_ = ds.initialize(model=simple_loss_fn, model_parameters=params,
+                           config=_offload_config())
+
+    eo.train_batch(iter(batches[0:2]))   # window 1: update pending
+    for a, b in zip(jax.tree_util.tree_leaves(eo.state.params),
+                    jax.tree_util.tree_leaves(params)):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b), rtol=1e-6)
+
+    eo.train_batch(iter(batches[2:4]))   # window 2: applies window-1 update
+    es.train_batch(iter(batches[0:2]))   # sync engine: one window
+    for a, b in zip(jax.tree_util.tree_leaves(eo.state.params),
+                    jax.tree_util.tree_leaves(es.state.params)):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32), rtol=1e-6)
+
+
+def test_engine_offload_overlap_synchronize_and_converge():
+    from tests.unit.simple_model import (init_simple_params, simple_loss_fn,
+                                         random_batches)
+    params = init_simple_params(jax.random.PRNGKey(0), hidden_dim=8)
+    engine, *_ = ds.initialize(
+        model=simple_loss_fn, model_parameters=params,
+        config=_offload_config(
+            zero_optimization={"stage": 2, "cpu_offload": True,
+                               "overlap_comm": True}))
+    batches = random_batches(16, 4, 8, seed=0)
+    losses = []
+    for i in range(0, 16, 2):
+        losses.append(float(engine.train_batch(iter(batches[i:i + 2]))))
+    engine.synchronize()
+    assert engine._offload_pending is None
+    assert engine.global_steps == 8  # every window's update applied
+    assert losses[-1] < losses[0]
+
+
+def test_engine_offload_overlap_checkpoint_drains(tmp_path):
+    """save_checkpoint must apply the in-flight update first, so a resume
+    sees the drained state."""
+    from tests.unit.simple_model import (init_simple_params, simple_loss_fn,
+                                         random_batches)
+    params = init_simple_params(jax.random.PRNGKey(0), hidden_dim=8)
+    engine, *_ = ds.initialize(
+        model=simple_loss_fn, model_parameters=params,
+        config=_offload_config(
+            zero_optimization={"stage": 2, "cpu_offload": True,
+                               "overlap_comm": True}))
+    batches = random_batches(2, 4, 8, seed=5)
+    engine.train_batch(iter(batches))
+    assert engine._offload_pending is not None
+    engine.save_checkpoint(str(tmp_path))
+    assert engine._offload_pending is None
+    assert engine.global_steps == 1
