@@ -299,18 +299,33 @@ def _bwd_dkv_kernel(*refs, sm_scale, block_q, causal, seq_q, seq_k, block_k,
 # --------------------------------------------------------------------- #
 # pallas_call wrappers
 # --------------------------------------------------------------------- #
-def _largest_divisor_block(seq):
+def _largest_divisor_block(seq, cap=512):
     # 512 first: measured on v5e (B=8,H=16,S=1024,D=64 fwd+bwd) 512/512 is
     # ~1.2x faster than 256/256 and beats every mixed combination; smaller
     # blocks only when the sequence doesn't divide
     for b in (512, 256, 128, 64, 32, 16):
-        if seq % b == 0:
+        if b <= cap and seq % b == 0:
             return b
-    return seq
+    return min(seq, cap)
+
+
+def _block_cap(seq):
+    # long sequences must shrink blocks: the kernels keep full K/V for the
+    # (batch, head) program in VMEM, so the per-program fp32 scratch
+    # (bq x bk scores + bq x d accumulators) has to fit in what's left of
+    # the ~16MB scoped budget. 512-blocks overflow at S=8192 (observed
+    # v5e: 16.5M > 16M scoped vmem on the bwd); 256 fits through 16k.
+    if seq >= 16384:
+        return 64
+    if seq >= 8192:
+        return 256
+    return 512
 
 
 def _pick_blocks(seq_q, seq_k):
-    return _largest_divisor_block(seq_q), _largest_divisor_block(seq_k)
+    cap = _block_cap(max(seq_q, seq_k))
+    return (_largest_divisor_block(seq_q, cap),
+            _largest_divisor_block(seq_k, cap))
 
 
 def _seed_spec():
