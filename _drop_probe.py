@@ -1,0 +1,34 @@
+import time, json, numpy as np
+import jax, jax.numpy as jnp
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2Config, count_params, gpt2_loss_fn, init_gpt2_params
+from jax.sharding import NamedSharding, PartitionSpec
+
+def run(embd, attn, resid, steps=8):
+    cfg = GPT2Config(vocab_size=50304, max_position_embeddings=1024,
+                     hidden_size=1024, num_layers=24, num_heads=16,
+                     embd_dropout=embd, attn_dropout=attn, resid_dropout=resid)
+    params = init_gpt2_params(cfg, jax.random.PRNGKey(0))
+    det = (embd == attn == resid == 0.0)
+    loss_fn = gpt2_loss_fn(cfg, dtype=jnp.bfloat16, deterministic=det)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=loss_fn, model_parameters=params,
+        config={"train_micro_batch_size_per_gpu": 8,
+                "gradient_accumulation_steps": 1,
+                "bf16": {"enabled": True}, "steps_per_print": 10**9,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-4}}})
+    ids = np.random.RandomState(0).randint(0, cfg.vocab_size, (8, 1025)).astype(np.int32)
+    b = {"input_ids": jax.device_put(ids, NamedSharding(engine.mesh, PartitionSpec()))}
+    loss = engine.train_batch(iter([b])); np.asarray(loss)
+    best = None
+    for _ in range(2):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = engine.train_batch(iter([b]))
+        np.asarray(loss)
+        w = (time.perf_counter()-t0)/steps
+        best = w if best is None else min(best, w)
+    return best*1e3
+
+for name, e, a, r in [("none",0,0,0), ("attn_only",0,0.1,0), ("resid_only",0,0,0.1), ("embd_only",0.1,0,0), ("all",0.1,0.1,0.1)]:
+    print(f"{name}: {run(e,a,r):.1f} ms/step", flush=True)

@@ -281,6 +281,20 @@ def get_memory_breakdown(param_dict):
                             C.MEMORY_BREAKDOWN_DEFAULT)
 
 
+def get_profiler_config(param_dict):
+    """TPU-native profiling: jax.profiler trace window (SURVEY.md §5)."""
+    sub = param_dict.get(C.PROFILER, {})
+    return {
+        "enabled": sub.get(C.PROFILER_ENABLED, C.PROFILER_ENABLED_DEFAULT),
+        "output_path": sub.get(C.PROFILER_OUTPUT_PATH,
+                               C.PROFILER_OUTPUT_PATH_DEFAULT),
+        "start_step": sub.get(C.PROFILER_START_STEP,
+                              C.PROFILER_START_STEP_DEFAULT),
+        "num_steps": sub.get(C.PROFILER_NUM_STEPS,
+                             C.PROFILER_NUM_STEPS_DEFAULT),
+    }
+
+
 def get_tensorboard_enabled(param_dict):
     if C.TENSORBOARD in param_dict:
         return get_scalar_param(param_dict[C.TENSORBOARD], C.TENSORBOARD_ENABLED,
@@ -369,6 +383,7 @@ class DeepSpeedConfig:
         self.scheduler_params = get_scheduler_params(param_dict)
 
         self.wall_clock_breakdown = get_wall_clock_breakdown(param_dict)
+        self.profiler_config = get_profiler_config(param_dict)
         self.memory_breakdown = get_memory_breakdown(param_dict)
         self.tensorboard_enabled = get_tensorboard_enabled(param_dict)
         self.tensorboard_output_path = get_tensorboard_output_path(param_dict)

@@ -131,6 +131,27 @@ WALL_CLOCK_BREAKDOWN_DEFAULT = False
 MEMORY_BREAKDOWN = "memory_breakdown"
 MEMORY_BREAKDOWN_DEFAULT = False
 
+#############################################
+# Profiler (TPU-native: jax.profiler trace capture; SURVEY.md §5 —
+# the reference's wall_clock_breakdown/timers ladder, plus XLA traces)
+#
+# "profiler": {
+#   "enabled": false,
+#   "output_path": "/tmp/jax-trace",
+#   "start_step": 2,        # skip compile steps
+#   "num_steps": 3
+# }
+#############################################
+PROFILER = "profiler"
+PROFILER_ENABLED = "enabled"
+PROFILER_ENABLED_DEFAULT = False
+PROFILER_OUTPUT_PATH = "output_path"
+PROFILER_OUTPUT_PATH_DEFAULT = "/tmp/deepspeed_tpu_trace"
+PROFILER_START_STEP = "start_step"
+PROFILER_START_STEP_DEFAULT = 2
+PROFILER_NUM_STEPS = "num_steps"
+PROFILER_NUM_STEPS_DEFAULT = 3
+
 TENSORBOARD = "tensorboard"
 TENSORBOARD_ENABLED = "enabled"
 TENSORBOARD_ENABLED_DEFAULT = False

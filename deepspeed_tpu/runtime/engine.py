@@ -24,6 +24,7 @@ flax module + criterion to this contract.)
 """
 
 import inspect
+import time
 import os
 from functools import partial
 from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
@@ -388,6 +389,12 @@ class DeepSpeedEngine:
             num_workers=self.dp_world_size,
             steps_per_output=self._config.steps_per_print)
         self.wall_clock_breakdown_enabled = self._config.wall_clock_breakdown
+        # jax.profiler trace window (config 'profiler' section; the
+        # reference's analog is the wall_clock_breakdown timer ladder —
+        # on TPU the XLA trace is the actionable artifact, SURVEY.md §5)
+        self._profiler_cfg = self._config.profiler_config
+        self._profiler_active = False
+        self._last_step_time_ms = None
 
         # -- sparse (CSR) embedding gradients (reference engine.py:181-187
         # converts nn.Embedding grads; exchange at :1088-1139). With no
@@ -1049,8 +1056,10 @@ class DeepSpeedEngine:
             data_iter = self._train_iter
 
         self._maybe_switch_onebit_phase()
+        self._maybe_profile_step()
         step_fn = self._get_compiled_micro_step()
         self.tput_timer.start()
+        _t_step0 = time.perf_counter()
         total = None
         for _ in range(self.gradient_accumulation_steps):
             batch = next(data_iter)
@@ -1066,6 +1075,7 @@ class DeepSpeedEngine:
             else:
                 self._host_apply_update()
         self.tput_timer.stop()
+        self._last_step_time_ms = (time.perf_counter() - _t_step0) * 1e3
         mean_loss = total / self.gradient_accumulation_steps
         self._host_micro_step += self.gradient_accumulation_steps
         self._host_global_step += 1
@@ -1086,6 +1096,25 @@ class DeepSpeedEngine:
             self._compiled_eval = jax.jit(ev)
         return self._compiled_eval(self.state.params, batch, self.state.rng)
 
+    def _maybe_profile_step(self):
+        """Start/stop a jax.profiler trace window around the configured
+        steps. The captured trace (tensorboard-viewable) is the TPU
+        analog of the reference's per-phase CUDA timers."""
+        if not self._profiler_cfg["enabled"]:
+            return
+        step = self._host_global_step
+        start = self._profiler_cfg["start_step"]
+        stop = start + self._profiler_cfg["num_steps"]
+        if not self._profiler_active and step == start:
+            jax.profiler.start_trace(self._profiler_cfg["output_path"])
+            self._profiler_active = True
+            log_dist(f"profiler: trace started at step {step} -> "
+                     f"{self._profiler_cfg['output_path']}", ranks=[0])
+        elif self._profiler_active and step >= stop:
+            jax.profiler.stop_trace()
+            self._profiler_active = False
+            log_dist(f"profiler: trace stopped at step {step}", ranks=[0])
+
     def _write_monitor(self, loss=None):
         """reference engine.py:780-790/:922-936: loss/lr/scale scalars,
         x-axis = cumulative samples (forces a loss sync; opt-in)."""
@@ -1097,6 +1126,9 @@ class DeepSpeedEngine:
             lr=float(self._lr_at(self.state.global_step)),
             loss_scale=self.loss_scale(),
             samples=samples)
+        if self._last_step_time_ms is not None:
+            self.monitor.write_timer_values(
+                {"step_time_ms": self._last_step_time_ms}, samples)
 
     def _report_progress(self):
         # gate on the host mirror: no device sync unless actually printing

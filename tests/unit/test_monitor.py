@@ -88,3 +88,42 @@ def test_engine_unfused_path_writes(tmp_path):
         engine.step()
     engine.monitor.close()
     assert glob.glob(str(tmp_path / "unfused" / "*"))
+
+
+def test_profiler_trace_window(tmp_path):
+    """The configured jax.profiler window starts/stops around the given
+    steps and leaves a trace on disk."""
+    import os
+    import deepspeed_tpu as ds
+    from tests.unit.simple_model import (init_simple_params, simple_loss_fn,
+                                         random_batches)
+    out = str(tmp_path / "trace")
+    params = init_simple_params(jax.random.PRNGKey(0), hidden_dim=8)
+    engine, *_ = ds.initialize(
+        model=simple_loss_fn, model_parameters=params,
+        config={"train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "profiler": {"enabled": True, "output_path": out,
+                             "start_step": 1, "num_steps": 2}})
+    batches = random_batches(5, 16, 8)
+    for b in batches:
+        engine.train_batch(iter([b]))
+    assert not engine._profiler_active
+    assert os.path.isdir(out) and any(os.scandir(out))
+
+
+def test_step_time_scalar_written(tmp_path):
+    import deepspeed_tpu as ds
+    from tests.unit.simple_model import (init_simple_params, simple_loss_fn,
+                                         random_batches)
+    params = init_simple_params(jax.random.PRNGKey(0), hidden_dim=8)
+    engine, *_ = ds.initialize(
+        model=simple_loss_fn, model_parameters=params,
+        config={"train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "tensorboard": {"enabled": True,
+                                "output_path": str(tmp_path)}})
+    for b in random_batches(2, 16, 8):
+        engine.train_batch(iter([b]))
+    assert engine._last_step_time_ms is not None
+    assert engine._last_step_time_ms > 0
