@@ -1,0 +1,5 @@
+from deepspeed_tpu.ops.attention.flash import (attention_reference,
+                                               flash_attention)
+from deepspeed_tpu.ops.attention.ring import ring_attention
+
+__all__ = ["attention_reference", "flash_attention", "ring_attention"]

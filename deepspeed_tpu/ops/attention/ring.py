@@ -1,0 +1,207 @@
+"""Ring attention — sequence/context parallelism over a mesh axis.
+
+Long-context support beyond the reference snapshot (whose only answer is
+block-sparse attention, docs/_posts/2020-09-09-sparse-attention.md): the
+sequence dimension is sharded over the ``seq`` mesh axis and K/V chunks
+rotate around the ring via ``lax.ppermute`` (ICI neighbor exchange), while
+each device's Q stays resident. Per visiting chunk the local Pallas flash
+kernel (ops/attention/flash.py) produces a normalized partial output plus
+its log-sum-exp; partials combine exactly with online-softmax reweighting,
+so the result is bitwise the same attention math at 1/P sequence memory
+per device — attention over sequences no single chip could hold.
+
+Algorithm (RingAttention, arXiv:2310.01889, re-derived on the flash
+kernel's (o, lse) interface — no kernel changes needed):
+
+forward, P = ring size, idx = my shard index, step j holds chunk
+``src = (idx - j) mod P``:
+- j = 0: the diagonal chunk (src == idx): local causal flash.
+- j > 0: non-causal flash against the visiting chunk; for causal
+  attention a chunk from the future (src > idx) is discarded by masking
+  its combine weight — computed uniformly on every device, so the
+  ppermute stays uniform (same invariant as the pipeline executor,
+  runtime/pipe/spmd.py).
+- combine: running (o, lse) with logaddexp reweighting in fp32.
+
+backward re-runs the ring: dq accumulates locally; (dk, dv) for the
+visiting chunk accumulate in buffers that rotate *with* k/v and arrive
+back at their owner after the full cycle. Each per-chunk backward calls
+the flash backward with the TOTAL lse/delta, which is exactly the
+decomposition ds = p * (dp - delta) with p = exp(s - lse_total).
+
+Causal cost note: the plain ring computes all P chunks and discards the
+future ones (~2x the minimal causal work, like the unbalanced ring in the
+paper); the zigzag load-balanced schedule is a follow-up optimization.
+
+Dropout: each chunk derives a distinct seed (seed ^ mix(src)) so the
+in-kernel counter-based mask never repeats across chunks and regenerates
+identically in forward and backward.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.ops.attention.flash import (
+    _flash_bwd, _flash_fwd, _use_pallas, dropout_seed_from_rng)
+
+NEG_BIG = -1e30
+VALID_THRESH = -1e29
+
+
+def _chunk_seed(seed, src):
+    # distinct per-chunk dropout stream; int32 wraparound is fine
+    return seed + (src * jnp.int32(-1640531527))  # 2654435761 as int32
+
+
+def _combine(o_acc, lse_acc, o_j, lse_j):
+    """Exact online-softmax merge of normalized partials (fp32)."""
+    lse_new = jnp.maximum(lse_acc, lse_j) + jnp.log1p(
+        jnp.exp(-jnp.abs(lse_acc - lse_j)))
+    w_acc = jnp.where(lse_acc <= VALID_THRESH, 0.0,
+                      jnp.exp(lse_acc - lse_new))
+    w_j = jnp.where(lse_j <= VALID_THRESH, 0.0, jnp.exp(lse_j - lse_new))
+    o_new = o_acc * w_acc[..., None] + o_j.astype(jnp.float32) * \
+        w_j[..., None]
+    lse_new = jnp.where(
+        jnp.logical_and(lse_acc <= VALID_THRESH, lse_j <= VALID_THRESH),
+        NEG_BIG, lse_new)
+    return o_new, lse_new
+
+
+def _rot(x, axis_name, P, shift=1):
+    return jax.lax.ppermute(x, axis_name,
+                            [(i, (i + shift) % P) for i in range(P)])
+
+
+def _ring_fwd_impl(q, k, v, seed, axis_name, causal, sm_scale, interpret,
+                   rate):
+    P = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+
+    # step 0: diagonal chunk, local causal (or plain) flash
+    o0, lse0 = _flash_fwd(q, k, v, None, causal, sm_scale, interpret,
+                          dropout_rate=rate,
+                          seed=_chunk_seed(seed, idx) if rate > 0.0 else seed)
+    o_acc = o0.astype(jnp.float32)
+    lse_acc = lse0
+
+    def step(carry, j):
+        k_cur, v_cur, o_acc, lse_acc = carry
+        k_cur = _rot(k_cur, axis_name, P)
+        v_cur = _rot(v_cur, axis_name, P)
+        src = (idx - j) % P
+        sj = _chunk_seed(seed, src) if rate > 0.0 else seed
+        o_j, lse_j = _flash_fwd(q, k_cur, v_cur, None, False, sm_scale,
+                                interpret, dropout_rate=rate, seed=sj)
+        if causal:
+            valid = src < idx          # strictly-past chunk
+            lse_j = jnp.where(valid, lse_j, NEG_BIG)
+        o_acc, lse_acc = _combine(o_acc, lse_acc, o_j, lse_j)
+        return (k_cur, v_cur, o_acc, lse_acc), None
+
+    if P > 1:
+        (_, _, o_acc, lse_acc), _ = jax.lax.scan(
+            step, (k, v, o_acc, lse_acc), jnp.arange(1, P))
+    return o_acc.astype(q.dtype), lse_acc
+
+
+def _ring_bwd_impl(res, do, axis_name, causal, sm_scale, interpret, rate):
+    q, k, v, seed, o, lse = res
+    P = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+
+    # diagonal chunk
+    dq, dk0, dv0, _ = _flash_bwd(
+        (q, k, v, None,
+         _chunk_seed(seed, idx) if rate > 0.0 else seed, o, lse),
+        do, causal, sm_scale, interpret, dropout_rate=rate)
+    dq = dq.astype(jnp.float32)
+    dk_acc = dk0.astype(jnp.float32)
+    dv_acc = dv0.astype(jnp.float32)
+
+    def step(carry, j):
+        k_cur, v_cur, dk_cur, dv_cur, dq = carry
+        # rotate k/v and their grad accumulators together
+        k_cur = _rot(k_cur, axis_name, P)
+        v_cur = _rot(v_cur, axis_name, P)
+        dk_cur = _rot(dk_cur, axis_name, P)
+        dv_cur = _rot(dv_cur, axis_name, P)
+        src = (idx - j) % P
+        sj = _chunk_seed(seed, src) if rate > 0.0 else seed
+        dq_j, dk_j, dv_j, _ = _flash_bwd(
+            (q, k_cur, v_cur, None, sj, o, lse), do, False, sm_scale,
+            interpret, dropout_rate=rate)
+        if causal:
+            valid = (src < idx).astype(jnp.float32)
+            dq_j = dq_j * valid
+            dk_j = dk_j * valid
+            dv_j = dv_j * valid
+        dq = dq + dq_j.astype(jnp.float32)
+        dk_cur = dk_cur + dk_j.astype(jnp.float32)
+        dv_cur = dv_cur + dv_j.astype(jnp.float32)
+        return (k_cur, v_cur, dk_cur, dv_cur, dq), None
+
+    if P > 1:
+        (k_l, v_l, dk_acc, dv_acc, dq), _ = jax.lax.scan(
+            step, (k, v, dk_acc, dv_acc, dq), jnp.arange(1, P))
+        # one final rotation completes the cycle: each (dk, dv) buffer
+        # returns to the device owning that chunk
+        dk_acc = _rot(dk_acc, axis_name, P)
+        dv_acc = _rot(dv_acc, axis_name, P)
+    return dq.astype(q.dtype), dk_acc.astype(k.dtype), \
+        dv_acc.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _ring_attention(q, k, v, seed, axis_name, causal, sm_scale, interpret,
+                    rate):
+    o, _ = _ring_fwd_impl(q, k, v, seed, axis_name, causal, sm_scale,
+                          interpret, rate)
+    return o
+
+
+def _ring_attention_fwd(q, k, v, seed, axis_name, causal, sm_scale,
+                        interpret, rate):
+    o, lse = _ring_fwd_impl(q, k, v, seed, axis_name, causal, sm_scale,
+                            interpret, rate)
+    return o, (q, k, v, seed, o, lse)
+
+
+def _ring_attention_bwd(axis_name, causal, sm_scale, interpret, rate, res,
+                        g):
+    dq, dk, dv = _ring_bwd_impl(res, g, axis_name, causal, sm_scale,
+                                interpret, rate)
+    return dq, dk, dv, None
+
+
+_ring_attention.defvjp(_ring_attention_fwd, _ring_attention_bwd)
+
+
+def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = False,
+                   sm_scale: Optional[float] = None,
+                   dropout_rate: float = 0.0, dropout_rng=None,
+                   interpret: Optional[bool] = None):
+    """Sequence-parallel flash attention over ``axis_name``.
+
+    Call INSIDE ``shard_map`` with ``axis_name`` manual; q/k/v are this
+    device's sequence shard, shape (batch, heads, seq_local, head_dim)
+    with identical seq_local on every shard (global seq = P * seq_local,
+    shard i owning positions [i*seq_local, (i+1)*seq_local)).
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / np.sqrt(q.shape[-1])
+    if interpret is None:
+        interpret = not _use_pallas()
+    dropout_rate = float(dropout_rate)
+    if dropout_rate > 0.0:
+        assert dropout_rng is not None, \
+            "ring_attention: dropout_rate > 0 requires dropout_rng"
+        seed = dropout_seed_from_rng(dropout_rng)
+    else:
+        seed = jnp.zeros((1, 1), jnp.int32)
+    return _ring_attention(q, k, v, seed, axis_name, causal,
+                           float(sm_scale), interpret, dropout_rate)
