@@ -1,0 +1,32 @@
+"""Platform forcing for subprocess-launched workloads.
+
+Some environments preload an accelerator plugin at interpreter start, so
+the ``JAX_PLATFORMS`` env var alone arrives too late to steer backend
+selection; the working recipe (tests/conftest.py) is to set
+``jax.config.update("jax_platforms", ...)`` before the first jax use.
+This helper applies the same recipe from environment variables so
+CLI-launched training scripts (tests/model harnesses, the launcher) can
+force a platform:
+
+- ``DSTPU_PLATFORM``      : e.g. ``cpu`` — force the jax platform
+- ``DSTPU_HOST_DEVICES``  : N — with cpu, provision N host devices
+                            (``--xla_force_host_platform_device_count``)
+
+Call before any jax computation (importing jax is fine; initializing its
+backend is not).
+"""
+
+import os
+
+
+def apply_platform_env() -> None:
+    plat = os.environ.get("DSTPU_PLATFORM")
+    if not plat:
+        return
+    n = os.environ.get("DSTPU_HOST_DEVICES")
+    if n:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") +
+            f" --xla_force_host_platform_device_count={int(n)}")
+    import jax
+    jax.config.update("jax_platforms", plat)

@@ -13,6 +13,10 @@ import json
 import os
 
 import jax
+
+from deepspeed_tpu.utils.platform import apply_platform_env
+
+apply_platform_env()  # honor DSTPU_PLATFORM/DSTPU_HOST_DEVICES (CLI tests)
 import jax.numpy as jnp
 import numpy as np
 

@@ -16,6 +16,10 @@ import json
 import os
 
 import jax
+
+from deepspeed_tpu.utils.platform import apply_platform_env
+
+apply_platform_env()  # honor DSTPU_PLATFORM/DSTPU_HOST_DEVICES (CLI tests)
 import numpy as np
 
 import deepspeed_tpu as ds
@@ -37,6 +41,11 @@ def main():
                         help="Tiny model for smoke runs")
     parser.add_argument("--seq", type=int, default=0)
     parser.add_argument("--steps", type=int, default=10)
+    parser.add_argument("--save_dir", type=str, default=None,
+                        help="save a checkpoint every --save_interval steps")
+    parser.add_argument("--save_interval", type=int, default=0)
+    parser.add_argument("--load_dir", type=str, default=None,
+                        help="resume from the latest checkpoint here")
     args = parser.parse_args()
 
     here = os.path.dirname(os.path.abspath(__file__))
@@ -81,9 +90,24 @@ def main():
                     (global_mb, seq + 1)).astype(np.int32)}
         it = micro_batches()
 
-    for step in range(args.steps):
+    start_step = 0
+    if args.load_dir:
+        path, _ = engine.load_checkpoint(args.load_dir)
+        if path is not None:
+            start_step = engine.global_steps
+            print(f"resumed from {path} at step {start_step}")
+            # deterministic data stream: fast-forward past consumed micros
+            per_step = getattr(engine, "micro_batches",
+                               engine.gradient_accumulation_steps)
+            for _ in range(start_step * per_step):
+                next(it)
+
+    for step in range(start_step, args.steps):
         loss = engine.train_batch(it)
         print(f"step {step}: lm loss {float(loss):.4f}")
+        if args.save_dir and args.save_interval and \
+                (step + 1) % args.save_interval == 0:
+            engine.save_checkpoint(args.save_dir)
     print("done")
 
 

@@ -1,0 +1,88 @@
+"""Model-level functional harness (reference tests/model/Megatron_GPT2/
+run_func_test.py): launch the actual CLI workload as a subprocess, grep
+the LM loss from its stdout, and compare baseline-vs-feature runs —
+the end-to-end tier the unit suite cannot cover in-process."""
+
+import os
+import re
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_ROOT = os.path.normpath(os.path.join(os.path.dirname(__file__), "..", ".."))
+_TRAIN = os.path.join(_ROOT, "examples", "megatron_gpt2", "train.py")
+
+
+def _launch(*args, timeout=900):
+    """Run the training CLI on a forced 8-device CPU mesh; return stdout."""
+    env = dict(os.environ)
+    env.update({"DSTPU_PLATFORM": "cpu", "DSTPU_HOST_DEVICES": "8",
+                "PYTHONPATH": _ROOT + os.pathsep + env.get("PYTHONPATH", "")})
+    proc = subprocess.run(
+        [sys.executable, _TRAIN, *args], env=env, cwd=_ROOT,
+        capture_output=True, text=True, timeout=timeout)
+    assert proc.returncode == 0, \
+        f"CLI run failed:\nSTDOUT:{proc.stdout[-2000:]}\n" \
+        f"STDERR:{proc.stderr[-2000:]}"
+    return proc.stdout
+
+
+def grep_loss(stdout):
+    """(reference run_func_test.py grep_loss_from_file:20-36)"""
+    return [float(m) for m in
+            re.findall(r"lm loss ([0-9.]+)", stdout)]
+
+
+def _config_arg(tmp_path, name, cfg):
+    import json
+    p = tmp_path / name
+    p.write_text(json.dumps(cfg))
+    return str(p)
+
+
+BASE = {
+    "train_micro_batch_size_per_gpu": 2,
+    "gradient_accumulation_steps": 1,
+    "steps_per_print": 1000,
+    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+}
+
+
+@pytest.mark.parametrize("feature", [
+    {"zero_optimization": {"stage": 1}},
+    {"zero_optimization": {"stage": 2}},
+], ids=["zero1", "zero2"])
+def test_zero_matches_baseline_loss(tmp_path, feature):
+    """ZeRO sharding must not change the math: CLI loss trajectories of
+    the feature run match the stage-0 baseline (reference
+    run_func_test.py baseline-vs-feature comparison)."""
+    base_cfg = _config_arg(tmp_path, "base.json", BASE)
+    feat_cfg = _config_arg(tmp_path, "feat.json", {**BASE, **feature})
+    out_b = _launch("--mode", "zero2", "--tiny", "--steps", "4",
+                    "--seq", "64", "--deepspeed_config", base_cfg)
+    out_f = _launch("--mode", "zero2", "--tiny", "--steps", "4",
+                    "--seq", "64", "--deepspeed_config", feat_cfg)
+    lb, lf = grep_loss(out_b), grep_loss(out_f)
+    assert len(lb) == 4 and len(lf) == 4
+    np.testing.assert_allclose(lb, lf, rtol=1e-4)
+
+
+def test_checkpoint_resume_matches_straight_run(tmp_path):
+    """(reference run_checkpoint_test.py): train 2 steps + save, resume
+    for 2 more; the resumed losses must equal steps 2-3 of an unbroken
+    4-step run."""
+    cfg = _config_arg(tmp_path, "cfg.json", BASE)
+    save = str(tmp_path / "ckpt")
+    straight = grep_loss(_launch(
+        "--mode", "zero2", "--tiny", "--steps", "4", "--seq", "64",
+        "--deepspeed_config", cfg))
+    _launch("--mode", "zero2", "--tiny", "--steps", "2", "--seq", "64",
+            "--deepspeed_config", cfg,
+            "--save_dir", save, "--save_interval", "2")
+    resumed = grep_loss(_launch(
+        "--mode", "zero2", "--tiny", "--steps", "4", "--seq", "64",
+        "--deepspeed_config", cfg, "--load_dir", save))
+    assert len(straight) == 4 and len(resumed) == 2
+    np.testing.assert_allclose(resumed, straight[2:], rtol=1e-4)
