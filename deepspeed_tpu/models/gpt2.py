@@ -184,7 +184,10 @@ def _tied_xent_chunked(x, wte, targets, dtype, chunk_tokens: int = 2048,
 
 
 def gpt2_block(block_params, config: GPT2Config, x, rng, deterministic,
-               dtype):
+               dtype, attention_fn=None):
+    """One pre-LN transformer block. ``attention_fn(q, k, v, rate, rng)``
+    optionally replaces causal flash attention (e.g. ring attention for
+    sequence parallelism)."""
     B, S, h = x.shape
     heads = config.num_heads
     hd = h // heads
@@ -201,12 +204,18 @@ def gpt2_block(block_params, config: GPT2Config, x, rng, deterministic,
     q = q.reshape(B, S, heads, hd).transpose(0, 2, 1, 3)
     k = k.reshape(B, S, heads, hd).transpose(0, 2, 1, 3)
     v = v.reshape(B, S, heads, hd).transpose(0, 2, 1, 3)
-    if config.attn_dropout > 0.0 and not deterministic and rng is not None:
+    drop = (config.attn_dropout
+            if not deterministic and rng is not None else 0.0)
+    if drop > 0.0:
+        r1, r_attn = jax.random.split(r1)
+    else:
+        r_attn = None
+    if attention_fn is not None:
+        ctx = attention_fn(q, k, v, drop, r_attn)
+    elif drop > 0.0:
         # attention dropout runs inside the Pallas kernel (counter-based
         # hash mask regenerated in fwd and bwd — no (S, S) mask in HBM)
-        r1, r_attn = jax.random.split(r1)
-        ctx = flash_attention(q, k, v, causal=True,
-                              dropout_rate=config.attn_dropout,
+        ctx = flash_attention(q, k, v, causal=True, dropout_rate=drop,
                               dropout_rng=r_attn)
     else:
         ctx = flash_attention(q, k, v, causal=True)
@@ -269,6 +278,91 @@ def gpt2_loss_fn(config: GPT2Config, dtype=jnp.bfloat16, remat: bool = False,
                         deterministic=deterministic, dtype=dtype,
                         remat=remat)
         return _tied_xent_chunked(x, params["wte"], targets, dtype)
+    return loss_fn
+
+
+def gpt2_sp_loss_fn(config: GPT2Config, mesh, dtype=jnp.bfloat16,
+                    remat: bool = False, deterministic: bool = False):
+    """Sequence-parallel (context-parallel) GPT-2 loss over the ``seq``
+    mesh axis — long-context training beyond one chip's activation
+    memory (a TPU-native extension past the reference's block-sparse
+    answer; SURVEY §5 long-context).
+
+    Every activation tensor lives sharded (B, S/P, H) on its sequence
+    shard: embeddings, LN, and MLP are token-local; attention crosses
+    shards through :func:`deepspeed_tpu.ops.attention.ring.ring_attention`
+    (K/V rotating over ICI); the chunked tied-head loss sums per-shard
+    and psums in fp32. Engine-contract: batch = {'input_ids': (B, S+1)}
+    with S divisible by the seq-axis size; batch rows shard over 'data'
+    if present.
+    """
+    from deepspeed_tpu.ops.attention.ring import ring_attention
+    from deepspeed_tpu.parallel.mesh import axis_size
+    if "seq" not in mesh.axis_names:
+        raise ValueError("gpt2_sp_loss_fn requires a 'seq' mesh axis")
+    Pn = axis_size(mesh, "seq")
+    manual = frozenset(a for a in ("seq", "data") if a in mesh.axis_names)
+
+    def attention_fn(q, k, v, rate, rng):
+        return ring_attention(q, k, v, axis_name="seq", causal=True,
+                              dropout_rate=rate, dropout_rng=rng)
+
+    block = gpt2_block
+    if remat:
+        block = jax.checkpoint(gpt2_block, static_argnums=(1, 4, 5, 6))
+
+    def per_device(params, batch, rng):
+        idx = jax.lax.axis_index("seq")
+        ids = batch["input_ids"]                   # (B_l, S+1) replicated
+        S = ids.shape[1] - 1
+        assert S % Pn == 0, (S, Pn)
+        sl = S // Pn
+        # this shard's token window [idx*sl, idx*sl+sl] (+1 for targets)
+        win = jax.lax.dynamic_slice_in_dim(ids, idx * sl, sl + 1, axis=1)
+        inputs, targets = win[:, :-1], win[:, 1:]
+        pos_emb = jax.lax.dynamic_slice_in_dim(params["wpe"], idx * sl,
+                                               sl, axis=0)
+        x = (params["wte"][inputs] + pos_emb[None]).astype(dtype)
+        if rng is not None and not deterministic:
+            rng = jax.random.fold_in(rng, 0)
+            rng, r_emb = jax.random.split(rng)
+            # per-shard stream for the token-local dropouts
+            x = _dropout(x, config.embd_dropout,
+                         jax.random.fold_in(r_emb, idx), deterministic)
+        for i in range(config.num_layers):
+            if rng is not None and not deterministic:
+                rng, r = jax.random.split(rng)
+                r = jax.random.fold_in(r, idx)
+            else:
+                r = None
+            x = block(params[f"h_{i}"], config, x, r, deterministic, dtype,
+                      attention_fn)
+        x = _layer_norm(x, params["ln_f"], config.layer_norm_eps)
+        local = _tied_xent_chunked(x, params["wte"], targets, dtype,
+                                   mean=False)
+        # fp32 psums only (bf16 psum trips the XLA partitioner when auto
+        # axes share the mesh — see runtime/pipe/spmd._psum_act)
+        total = jax.lax.psum(local.astype(jnp.float32), "seq")
+        if "data" in manual:
+            total = jax.lax.pmean(total, "data")
+        B = ids.shape[0]
+        return total / (B * S)
+
+    PS = P
+    def loss_fn(params, batch, rng):
+        param_specs = jax.tree_util.tree_map(lambda _: PS(), params)
+        batch_specs = jax.tree_util.tree_map(
+            lambda _: PS("data") if "data" in manual else PS(), batch)
+        return jax.shard_map(
+            per_device, mesh=mesh,
+            in_specs=(param_specs, batch_specs, PS()),
+            out_specs=PS(), axis_names=manual,
+            check_vma=False)(params, batch, rng)
+
+    # fp32 master params flow in directly; every weight is cast at its use
+    # site, so the shard_map-transposed gradient psums stay fp32 (the
+    # engine skips its up-front cast — same policy as ZeRO stage 3)
+    loss_fn.owns_cast = True
     return loss_fn
 
 

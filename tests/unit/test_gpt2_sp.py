@@ -1,0 +1,73 @@
+"""Sequence-parallel GPT-2 (ring attention over the 'seq' mesh axis):
+loss and training parity against the dense single-shard model."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models.gpt2 import (GPT2Config, gpt2_loss_fn,
+                                       gpt2_sp_loss_fn, init_gpt2_params)
+from deepspeed_tpu.parallel.mesh import build_mesh
+
+CFG = GPT2Config(vocab_size=128, max_position_embeddings=64,
+                 hidden_size=32, num_layers=2, num_heads=2,
+                 embd_dropout=0.0, attn_dropout=0.0, resid_dropout=0.0)
+
+
+def _batch(bs=4, S=64, seed=0):
+    rng = np.random.RandomState(seed)
+    return {"input_ids": rng.randint(0, CFG.vocab_size,
+                                     (bs, S + 1)).astype(np.int32)}
+
+
+@pytest.mark.parametrize("axes", [{"seq": 8}, {"seq": 4, "data": 2}])
+def test_sp_loss_matches_dense(axes):
+    mesh = build_mesh(axes)
+    params = init_gpt2_params(CFG, jax.random.PRNGKey(0))
+    sp = gpt2_sp_loss_fn(CFG, mesh, dtype=jnp.float32, deterministic=True)
+    dense = gpt2_loss_fn(CFG, dtype=jnp.float32, deterministic=True)
+    b = _batch()
+    rng = jax.random.PRNGKey(1)
+    l_sp = float(jax.jit(sp)(params, b, rng))
+    l_d = float(jax.jit(dense)(params, b, rng))
+    np.testing.assert_allclose(l_sp, l_d, rtol=2e-5)
+
+
+def test_sp_grads_match_dense():
+    mesh = build_mesh({"seq": 4, "data": 2})
+    params = init_gpt2_params(CFG, jax.random.PRNGKey(0))
+    sp = gpt2_sp_loss_fn(CFG, mesh, dtype=jnp.float32, deterministic=True)
+    dense = gpt2_loss_fn(CFG, dtype=jnp.float32, deterministic=True)
+    b = _batch(seed=3)
+    rng = jax.random.PRNGKey(1)
+    g_sp = jax.jit(jax.grad(lambda p: sp(p, b, rng)))(params)
+    g_d = jax.jit(jax.grad(lambda p: dense(p, b, rng)))(params)
+    for (pa, a), (_, d) in zip(
+            jax.tree_util.tree_flatten_with_path(g_sp)[0],
+            jax.tree_util.tree_flatten_with_path(g_d)[0]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(d),
+                                   rtol=1e-4, atol=1e-5,
+                                   err_msg=str(pa))
+
+
+def test_sp_trains_through_engine():
+    """End to end: the engine trains the SP loss on a seq x data mesh
+    (bf16, ZeRO-2) and the loss decreases."""
+    mesh_axes = {"seq": 4, "data": 2}
+    mesh = build_mesh(mesh_axes)
+    params = init_gpt2_params(CFG, jax.random.PRNGKey(0))
+    sp = gpt2_sp_loss_fn(CFG, mesh, dtype=jnp.bfloat16, deterministic=True)
+    engine, *_ = ds.initialize(
+        model=sp, model_parameters=params,
+        config={"train_micro_batch_size_per_gpu": 2,
+                "bf16": {"enabled": True},
+                "zero_optimization": {"stage": 2},
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "mesh": {"axes": mesh_axes}})
+    losses = []
+    for i in range(6):
+        losses.append(float(engine.train_batch(iter([_batch(seed=i)]))))
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]
