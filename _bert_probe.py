@@ -1,0 +1,30 @@
+import time, numpy as np, jax
+import deepspeed_tpu
+from deepspeed_tpu.models.bert import BERT_LARGE, bert_mlm_loss_fn, init_bert_params
+from jax.sharding import NamedSharding, PartitionSpec
+
+def run(batch, steps=8):
+    params = init_bert_params(BERT_LARGE, jax.random.PRNGKey(0))
+    loss_fn = bert_mlm_loss_fn(BERT_LARGE, deterministic=False)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=loss_fn, model_parameters=params,
+        config={"train_micro_batch_size_per_gpu": batch,
+                "bf16": {"enabled": True}, "steps_per_print": 10**9,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-4}}})
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, BERT_LARGE.vocab_size, (batch, 128)).astype(np.int32)
+    labels = np.where(rng.rand(batch, 128) < 0.15, ids, -100).astype(np.int32)
+    shd = NamedSharding(engine.mesh, PartitionSpec())
+    b = {"input_ids": jax.device_put(ids, shd), "labels": jax.device_put(labels, shd)}
+    loss = engine.train_batch(iter([b])); np.asarray(loss)
+    best = None
+    for _ in range(2):
+        t0 = time.perf_counter()
+        for _ in range(steps): loss = engine.train_batch(iter([b]))
+        np.asarray(loss)
+        w = (time.perf_counter()-t0)/steps
+        best = min(best, w) if best else w
+    print(f"batch={batch}: {batch/best:.1f} samples/s ({best*1e3:.1f} ms/step)", flush=True)
+
+for bs in (32, 64, 128):
+    run(bs)

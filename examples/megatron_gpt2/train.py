@@ -25,7 +25,7 @@ import numpy as np
 import deepspeed_tpu as ds
 from deepspeed_tpu.models.gpt2 import (GPT2Config, count_params,
                                        gpt2_loss_fn, gpt2_pipeline_spec,
-                                       init_gpt2_params)
+                                       gpt2_sp_loss_fn, init_gpt2_params)
 
 GPT2_345M = dict(vocab_size=50304, max_position_embeddings=1024,
                  hidden_size=1024, num_layers=24, num_heads=16)
@@ -36,7 +36,8 @@ GPT2_TINY = dict(vocab_size=512, max_position_embeddings=128,
 def main():
     parser = argparse.ArgumentParser()
     ds.add_config_arguments(parser)
-    parser.add_argument("--mode", choices=["zero2", "3d"], default="zero2")
+    parser.add_argument("--mode", choices=["zero2", "3d", "sp"],
+                        default="zero2")
     parser.add_argument("--tiny", action="store_true",
                         help="Tiny model for smoke runs")
     parser.add_argument("--seq", type=int, default=0)
@@ -63,7 +64,26 @@ def main():
     micro = config["train_micro_batch_size_per_gpu"]
     ga = config.get("gradient_accumulation_steps", 1)
 
-    if args.mode == "zero2":
+    if args.mode == "sp":
+        # sequence/context parallelism: ring attention over the 'seq'
+        # mesh axis — each device holds a (B, S/P, H) activation shard
+        from deepspeed_tpu.parallel.mesh import build_mesh
+        mesh = build_mesh(config["mesh"]["axes"])
+        params = init_gpt2_params(cfg, jax.random.PRNGKey(0))
+        print(f"params: {count_params(params)/1e6:.0f}M")
+        loss_fn = gpt2_sp_loss_fn(cfg, mesh, deterministic=True)
+        engine, *_ = ds.initialize(model=loss_fn, model_parameters=params,
+                                   config=config)
+        bs = micro * config["mesh"]["axes"].get("data", 1)
+        seq_par = config["mesh"]["axes"]["seq"]
+        assert seq % seq_par == 0, (seq, seq_par)
+
+        def micro_batches():
+            while True:
+                yield {"input_ids": rng.randint(
+                    0, cfg.vocab_size, (bs, seq + 1)).astype(np.int32)}
+        it = micro_batches()
+    elif args.mode == "zero2":
         params = init_gpt2_params(cfg, jax.random.PRNGKey(0))
         print(f"params: {count_params(params)/1e6:.0f}M")
         loss_fn = gpt2_loss_fn(cfg, deterministic=True)

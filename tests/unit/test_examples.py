@@ -49,3 +49,10 @@ def test_onebit_adam_example(capsys):
     _run("examples/onebit_adam/train.py", "--steps", "10", "--seq", "32")
     out = capsys.readouterr().out
     assert "done" in out and "[compressed]" in out and "[warmup]" in out
+
+
+def test_megatron_gpt2_sp_example(capsys):
+    _run("examples/megatron_gpt2/train.py", "--mode", "sp",
+         "--tiny", "--steps", "2", "--seq", "64")
+    out = capsys.readouterr().out
+    assert "done" in out and "lm loss" in out
