@@ -142,7 +142,11 @@ def _fwd_kernel(*refs, sm_scale, block_k, causal, seq_k, block_q,
         _unpack_refs(refs, has_mask, dropout_rate > 0.0, 2)
     bh = pl.program_id(0)
     qb = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * sm_scale          # (bq, d)
+    # MXU fast path: bf16 operands, fp32 accumulation — converting K/V to
+    # fp32 both halves the MXU rate and makes Mosaic keep full fp32 K/V
+    # copies in VMEM (the S>=8k scoped-vmem blowup). Scale is applied to
+    # the fp32 scores instead of Q (mathematically identical).
+    q = q_ref[0]                                          # (bq, d) bf16
     d = q.shape[-1]
 
     if causal:
@@ -153,10 +157,11 @@ def _fwd_kernel(*refs, sm_scale, block_k, causal, seq_k, block_q,
 
     def body(i, carry):
         m, l, acc = carry
-        k = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[0, pl.ds(i * block_k, block_k), :]
+        v = v_ref[0, pl.ds(i * block_k, block_k), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
+        s = s * sm_scale
         if mask_ref is not None:
             s += mask_ref[0, 0, pl.ds(i * block_k, block_k)][None, :]
         if causal or dropout_rate > 0.0:
@@ -175,7 +180,7 @@ def _fwd_kernel(*refs, sm_scale, block_k, causal, seq_k, block_q,
                                      seq_k, dropout_rate)
             p = jnp.where(keep, p, 0.0)
         acc_new = acc * alpha[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
@@ -198,8 +203,8 @@ def _bwd_dq_kernel(*refs, sm_scale, block_k, causal, seq_k, block_q,
         _unpack_refs(refs, has_mask, dropout_rate > 0.0, 4)
     bh = pl.program_id(0)
     qb = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * sm_scale
-    do = do_ref[0].astype(jnp.float32)
+    q = q_ref[0]                                           # (bq, d) bf16
+    do = do_ref[0]
     lse = lse_ref[0, :, 0]
     delta = delta_ref[0, :, 0]
     d = q.shape[-1]
@@ -210,10 +215,11 @@ def _bwd_dq_kernel(*refs, sm_scale, block_k, causal, seq_k, block_q,
         num_kb = seq_k // block_k
 
     def body(i, dq):
-        k = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[0, pl.ds(i * block_k, block_k), :]
+        v = v_ref[0, pl.ds(i * block_k, block_k), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
+        s = s * sm_scale
         if mask_ref is not None:
             s += mask_ref[0, 0, pl.ds(i * block_k, block_k)][None, :]
         if causal or dropout_rate > 0.0:
@@ -229,8 +235,9 @@ def _bwd_dq_kernel(*refs, sm_scale, block_k, causal, seq_k, block_q,
                                      seq_k, dropout_rate)
             dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_rate)), 0.0)
         ds = p * (dp - delta[:, None])
-        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
-                                        preferred_element_type=jnp.float32)
+        return dq + jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
     dq = jax.lax.fori_loop(0, num_kb, body, jnp.zeros((block_q, d),
                                                       jnp.float32))
@@ -244,8 +251,8 @@ def _bwd_dkv_kernel(*refs, sm_scale, block_q, causal, seq_q, seq_k, block_k,
         _unpack_refs(refs, has_mask, dropout_rate > 0.0, 5)
     bh = pl.program_id(0)
     kb = pl.program_id(1)
-    k = k_ref[0].astype(jnp.float32)                       # (bk, d)
-    v = v_ref[0].astype(jnp.float32)
+    k = k_ref[0]                                           # (bk, d) bf16
+    v = v_ref[0]
     d = k.shape[-1]
 
     if causal:
@@ -257,13 +264,13 @@ def _bwd_dkv_kernel(*refs, sm_scale, block_q, causal, seq_q, seq_k, block_k,
 
     def body(i, carry):
         dk, dv = carry
-        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32) \
-            * sm_scale
-        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        q = q_ref[0, pl.ds(i * block_q, block_q), :]
+        do = do_ref[0, pl.ds(i * block_q, block_q), :]
         lse = lse_ref[0, pl.ds(i * block_q, block_q), 0]
         delta = delta_ref[0, pl.ds(i * block_q, block_q), 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
+        s = s * sm_scale
         if mask_ref is not None:
             s += mask_ref[0, 0, pl.ds(kb * block_k, block_k)][None, :]
         if causal or dropout_rate > 0.0:
@@ -282,17 +289,20 @@ def _bwd_dkv_kernel(*refs, sm_scale, block_q, causal, seq_q, seq_k, block_k,
             dp = jnp.where(keep, dp * inv_kp, 0.0)
         else:
             pd = p
-        dv_new = dv + jax.lax.dot_general(pd, do, (((0,), (0,)), ((), ())),
-                                          preferred_element_type=jnp.float32)
+        dv_new = dv + jax.lax.dot_general(
+            pd.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None])
-        dk_new = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
-                                          preferred_element_type=jnp.float32)
+        dk_new = dk + jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         return dk_new, dv_new
 
     dk0 = jnp.zeros((k.shape[0], d), jnp.float32)
     dv0 = jnp.zeros((k.shape[0], d), jnp.float32)
     dk, dv = jax.lax.fori_loop(first_qb, num_qb, body, (dk0, dv0))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
+    # dk carries the sm_scale factor (scores were scaled post-dot)
+    dk_ref[0] = (dk * sm_scale).astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 

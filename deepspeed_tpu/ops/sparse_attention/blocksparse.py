@@ -189,11 +189,15 @@ def _bs_fwd_kernel(trow_ref, tcol_ref, tfirst_ref, tlast_ref, tvalid_ref,
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    q = q_ref[0].astype(jnp.float32) * sm_scale          # (block, D)
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
+    # MXU fast path: bf16 operands / fp32 accumulation (fp32 converts
+    # both halve the MXU rate and bloat VMEM); scale applies to the
+    # fp32 scores post-dot
+    q = q_ref[0]                                         # (block, D)
+    k = k_ref[0]
+    v = v_ref[0]
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)
+    s = s * sm_scale
     s += kpm_ref[0, 0, 0, :][None, :]
     if am_ref is not None:
         s += am_ref[0, 0]
@@ -208,7 +212,7 @@ def _bs_fwd_kernel(trow_ref, tcol_ref, tfirst_ref, tlast_ref, tvalid_ref,
     m_scr[:, 0] = m_new
     l_scr[:, 0] = l * alpha + jnp.sum(p, axis=-1)
     acc_scr[...] = acc_scr[...] * alpha[:, None] + jax.lax.dot_general(
-        p, v, (((1,), (0,)), ((), ())),
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
 
     @pl.when(tlast_ref[t] == 1)
@@ -228,14 +232,15 @@ def _bs_dq_kernel(trow_ref, tcol_ref, tfirst_ref, tlast_ref, tvalid_ref,
     def _init():
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
-    q = q_ref[0].astype(jnp.float32) * sm_scale
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    do = do_ref[0]
     lse = lse_ref[0, :, 0]
     delta = delta_ref[0, :, 0]
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)
+    s = s * sm_scale
     s += kpm_ref[0, 0, 0, :][None, :]
     if am_ref is not None:
         s += am_ref[0, 0]
@@ -244,8 +249,9 @@ def _bs_dq_kernel(trow_ref, tcol_ref, tfirst_ref, tlast_ref, tvalid_ref,
     dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
     ds = p * (dp - delta[:, None])
-    dq_scr[...] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
-                                       preferred_element_type=jnp.float32)
+    dq_scr[...] += jax.lax.dot_general(
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
 
     @pl.when(tlast_ref[t] == 1)
     def _finalize():
@@ -262,30 +268,34 @@ def _bs_dkv_kernel(crow_ref, ccol_ref, cfirst_ref, clast_ref, cvalid_ref,
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    k = k_ref[0].astype(jnp.float32)                     # (block, D)
-    v = v_ref[0].astype(jnp.float32)
-    q = q_ref[0].astype(jnp.float32) * sm_scale
-    do = do_ref[0].astype(jnp.float32)
+    k = k_ref[0]                                         # (block, D)
+    v = v_ref[0]
+    q = q_ref[0]
+    do = do_ref[0]
     lse = lse_ref[0, :, 0]
     delta = delta_ref[0, :, 0]
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)
+    s = s * sm_scale
     s += kpm_ref[0, 0, 0, :][None, :]
     if am_ref is not None:
         s += am_ref[0, 0]
     s = jnp.where(cvalid_ref[t] == 1, s, NEG_INF)
     p = jnp.where(s > VALID_THRESH, jnp.exp(s - lse[:, None]), 0.0)
-    dv_scr[...] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
-                                       preferred_element_type=jnp.float32)
+    dv_scr[...] += jax.lax.dot_general(
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
     dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
     ds = p * (dp - delta[:, None])
-    dk_scr[...] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
-                                       preferred_element_type=jnp.float32)
+    dk_scr[...] += jax.lax.dot_general(
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
 
     @pl.when(clast_ref[t] == 1)
     def _finalize():
-        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        # dk carries sm_scale (scores were scaled post-dot)
+        dk_ref[0] = (dk_scr[...] * sm_scale).astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
