@@ -166,11 +166,15 @@ class PipelineEngine(DeepSpeedEngine):
                     self.training_dataloader))
             data_iter = self._train_iter
 
+        self._maybe_profile_step()
         batch = self._stack_micro_batches(data_iter)
         step_fn = self._get_compiled_micro_step()
         self.tput_timer.start()
+        import time as _time
+        _t0 = _time.perf_counter()
         self.state, loss = step_fn(self.state, batch)
         self.tput_timer.stop()
+        self._last_step_time_ms = (_time.perf_counter() - _t0) * 1e3
         self._host_micro_step += self.micro_batches
         self._host_global_step += 1
         self._report_progress()
