@@ -280,3 +280,14 @@ class TestTransformerLayer:
         layer = DeepSpeedTransformerLayer(cfg, initial_params=params)
         out = layer(x, deterministic=True)
         assert out.shape == x.shape
+
+
+def test_flash_block_cap_scales_with_seq():
+    """Long sequences must use smaller blocks: 512-wide fp32 scratch
+    overflows the ~16MB scoped VMEM at S>=8192 (observed on v5e)."""
+    from deepspeed_tpu.ops.attention.flash import _pick_blocks
+    assert _pick_blocks(1024, 1024) == (512, 512)
+    bq, bk = _pick_blocks(8192, 8192)
+    assert max(bq, bk) <= 256
+    bq, bk = _pick_blocks(16384, 16384)
+    assert max(bq, bk) <= 128
