@@ -71,3 +71,16 @@ def test_sp_trains_through_engine():
         losses.append(float(engine.train_batch(iter([_batch(seed=i)]))))
     assert all(np.isfinite(l) for l in losses)
     assert losses[-1] < losses[0]
+
+
+def test_sp_with_auto_model_axis_present():
+    """A size-1 auto 'model' axis in the mesh must not break the SP path
+    (regression guard for the XLA bf16-psum partitioner abort class)."""
+    mesh = build_mesh({"seq": 4, "data": 2, "model": 1})
+    params = init_gpt2_params(CFG, jax.random.PRNGKey(0))
+    sp = gpt2_sp_loss_fn(CFG, mesh, dtype=jnp.bfloat16, deterministic=True)
+    b = _batch()
+    rng = jax.random.PRNGKey(1)
+    g = jax.jit(jax.grad(lambda p: sp(p, b, rng)))(params)
+    assert all(np.all(np.isfinite(np.asarray(x)))
+               for x in jax.tree_util.tree_leaves(g))
