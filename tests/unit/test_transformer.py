@@ -291,3 +291,76 @@ def test_flash_block_cap_scales_with_seq():
     assert max(bq, bk) <= 256
     bq, bk = _pick_blocks(16384, 16384)
     assert max(bq, bk) <= 128
+
+
+class TestTransformerLayerGrid:
+    """Shape / precision / variant grid vs the unfused oracle — the
+    reference ran DeepSpeedTransformerLayer across a (batch, seq, hidden,
+    heads) x fp16 x pre-LN grid (tests/unit/test_cuda_forward.py /
+    test_cuda_backward.py); this is the TPU analog."""
+
+    def _mk(self, batch, seq, hidden, heads, pre_ln, fp32):
+        from deepspeed_tpu.ops.transformer.transformer import (
+            DeepSpeedTransformerConfig, init_transformer_params)
+        cfg = DeepSpeedTransformerConfig(
+            batch_size=batch, max_seq_length=seq, hidden_size=hidden,
+            intermediate_size=4 * hidden, heads=heads,
+            attn_dropout_ratio=0.0, hidden_dropout_ratio=0.0,
+            num_hidden_layers=2, initializer_range=0.02,
+            pre_layer_norm=pre_ln, bf16=not fp32, training=False)
+        params = init_transformer_params(cfg, jax.random.PRNGKey(2))
+        rng = np.random.RandomState(batch + seq)
+        x = jnp.asarray(rng.randn(batch, seq, hidden) * 0.5, jnp.float32)
+        return cfg, params, x
+
+    @pytest.mark.parametrize("batch,seq,hidden,heads", [
+        (1, 16, 32, 2),      # irregular small seq -> reference fallback
+        (3, 64, 96, 3),      # odd batch/heads
+        (2, 128, 64, 4),
+        (8, 32, 128, 8),
+        (1, 256, 64, 2),
+    ])
+    @pytest.mark.parametrize("pre_ln", [True, False])
+    def test_forward_grid(self, batch, seq, hidden, heads, pre_ln):
+        from deepspeed_tpu.ops.transformer.transformer import (
+            transformer_layer_forward)
+        cfg, params, x = self._mk(batch, seq, hidden, heads, pre_ln, True)
+        ref = transformer_layer_forward(params, cfg, x, rng=None,
+                                        deterministic=True, use_flash=False)
+        out = transformer_layer_forward(params, cfg, x, deterministic=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=3e-5, rtol=3e-5)
+
+    @pytest.mark.parametrize("batch,seq,hidden,heads", [
+        (2, 64, 64, 4), (1, 128, 96, 3),
+    ])
+    @pytest.mark.parametrize("pre_ln", [True, False])
+    def test_backward_grid(self, batch, seq, hidden, heads, pre_ln):
+        from deepspeed_tpu.ops.transformer.transformer import (
+            transformer_layer_forward)
+        cfg, params, x = self._mk(batch, seq, hidden, heads, pre_ln, True)
+
+        def loss(p, flash):
+            return jnp.sum(transformer_layer_forward(
+                p, cfg, x, deterministic=True, use_flash=flash) ** 2)
+
+        gf = jax.grad(lambda p: loss(p, True))(params)
+        gr = jax.grad(lambda p: loss(p, False))(params)
+        for (pa, a), (_, b) in zip(
+                jax.tree_util.tree_flatten_with_path(gf)[0],
+                jax.tree_util.tree_flatten_with_path(gr)[0]):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-3, rtol=5e-3,
+                                       err_msg=str(pa))
+
+    def test_bf16_config_close_to_fp32(self):
+        from deepspeed_tpu.ops.transformer.transformer import (
+            transformer_layer_forward)
+        cfg16, params, x = self._mk(2, 64, 64, 4, True, False)
+        cfg32, _, _ = self._mk(2, 64, 64, 4, True, True)
+        o16 = transformer_layer_forward(params, cfg16, x,
+                                        deterministic=True)
+        o32 = transformer_layer_forward(params, cfg32, x,
+                                        deterministic=True)
+        np.testing.assert_allclose(np.asarray(o16, np.float32),
+                                   np.asarray(o32), atol=5e-2, rtol=5e-2)
