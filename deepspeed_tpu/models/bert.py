@@ -78,6 +78,35 @@ def init_bert_params(config: BertConfig, key) -> Dict[str, Any]:
     return params
 
 
+def bert_param_specs(config: BertConfig):
+    """Megatron-style tensor-parallel PartitionSpecs over the 'model'
+    axis for the BERT family (column-parallel qkv/inter, row-parallel
+    out/output; embeddings vocab-sharded) — pass as
+    ``deepspeed_tpu.initialize(param_specs=...)``. Mirrors
+    models/gpt2.gpt2_param_specs; the reference delegated this to the
+    client's Megatron mpu (SURVEY §2.3 TP row)."""
+    from jax.sharding import PartitionSpec as P
+    layer = {
+        "qkvw": P(None, "model"), "qkvb": P("model"),
+        "ow": P("model", None), "ob": P(),
+        "attn_nw": P(), "attn_nb": P(),
+        "inter_w": P(None, "model"), "inter_b": P("model"),
+        "output_w": P("model", None), "output_b": P(),
+        "norm_w": P(), "norm_b": P(),
+    }
+    specs = {
+        "tok_emb": P("model", None),
+        "pos_emb": P(), "type_emb": P(),
+        "emb_ln": {"w": P(), "b": P()},
+        "mlm_dense": {"w": P(), "b": P()},
+        "mlm_ln": {"w": P(), "b": P()},
+        "mlm_bias": P("model"),
+    }
+    for i in range(config.num_layers):
+        specs[f"layer_{i}"] = layer
+    return specs
+
+
 from deepspeed_tpu.ops.functional import (
     layer_norm as _ln_wb, matmul_bf16_accum_fp32)
 
