@@ -328,3 +328,19 @@ def test_pipeline_memory_flat_in_accumulation_depth():
         temps[M] = ma.temp_size_in_bytes
     # allow small constant slack; forbid O(M) growth
     assert temps[16] <= temps[2] * 1.25, temps
+
+
+def test_pipeline_fp16_loss_scaling():
+    """fp16 + pipeline: the 1F1B executor's explicit grads flow through
+    the engine's dynamic loss scaling (overflow skip machinery)."""
+    module = _make_module(num_stages=4)
+    eng, *_ = ds.initialize(
+        model=module,
+        model_parameters=module.init_params(jax.random.PRNGKey(0)),
+        config=_pipe_config(fp16={"enabled": True,
+                                  "initial_scale_power": 8}))
+    it = iter(_micro_batches(16, global_mb=4))
+    losses = [float(eng.train_batch(it)) for _ in range(4)]
+    assert all(np.isfinite(l) for l in losses)
+    assert eng.loss_scale() > 0
+    assert losses[-1] < losses[0]
