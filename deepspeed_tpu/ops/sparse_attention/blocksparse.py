@@ -312,6 +312,10 @@ def _drop_am(kernel, n_before):
 # --------------------------------------------------------------------- #
 _FN_CACHE = {}
 
+# row-run DMA kernels (blocksparse_v2.py) for the no-attn-mask path;
+# flip off to fall back to the per-triple v1 kernels
+USE_SPLASH_V2 = True
+
 
 def _use_pallas():
     try:
@@ -332,6 +336,29 @@ def _sparse_attention_fn(layout: np.ndarray, block: int, sm_scale: float,
         return _FN_CACHE[key]
 
     H, nq, nk = layout.shape
+    if not has_am and USE_SPLASH_V2:
+        # row-run kernels: one program per block row, K/V streamed by
+        # DMA (blocksparse_v2.py) — ~row-degree x fewer program launches
+        from deepspeed_tpu.ops.sparse_attention.blocksparse_v2 import (
+            build_v2_impls)
+        fwd2, bwd2 = build_v2_impls(layout, block, sm_scale, interpret)
+
+        @jax.custom_vjp
+        def f2(q, k, v, kpm):
+            return fwd2(q, k, v, kpm, None)[0]
+
+        def f2_fwd(q, k, v, kpm):
+            o, lse = fwd2(q, k, v, kpm, None)
+            return o, (q, k, v, kpm, o, lse)
+
+        def f2_bwd(res, g):
+            q, k, v, kpm, o, lse = res
+            dq, dk, dv = bwd2(q, k, v, kpm, None, o, lse, g)
+            return dq, dk, dv, jnp.zeros_like(kpm)
+
+        f2.defvjp(f2_fwd, f2_bwd)
+        _FN_CACHE[key] = f2
+        return f2
     rt = build_triples(layout)                            # row-major walk
     ct = build_triples(np.ascontiguousarray(layout.transpose(0, 2, 1)))
     T = rt[0].shape[0]
