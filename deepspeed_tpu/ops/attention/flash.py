@@ -136,8 +136,27 @@ def _unpack_refs(refs, has_mask, has_seed, n_out):
     return q_ref, k_ref, v_ref, mask_ref, seed_ref, outs
 
 
+def _stream_kv_start(k_ref, v_ref, kbuf, vbuf, ksem, vsem, i, block_k):
+    slot = jax.lax.rem(i, 2)
+    pltpu.make_async_copy(k_ref.at[0, pl.ds(i * block_k, block_k), :],
+                          kbuf.at[slot], ksem.at[slot]).start()
+    pltpu.make_async_copy(v_ref.at[0, pl.ds(i * block_k, block_k), :],
+                          vbuf.at[slot], vsem.at[slot]).start()
+
+
+def _stream_kv_wait(k_ref, v_ref, kbuf, vbuf, ksem, vsem, i, block_k):
+    slot = jax.lax.rem(i, 2)
+    pltpu.make_async_copy(k_ref.at[0, pl.ds(i * block_k, block_k), :],
+                          kbuf.at[slot], ksem.at[slot]).wait()
+    pltpu.make_async_copy(v_ref.at[0, pl.ds(i * block_k, block_k), :],
+                          vbuf.at[slot], vsem.at[slot]).wait()
+    return kbuf[slot], vbuf[slot]
+
+
 def _fwd_kernel(*refs, sm_scale, block_k, causal, seq_k, block_q,
-                has_mask, dropout_rate):
+                has_mask, dropout_rate, stream=False):
+    if stream:
+        refs, (kbuf, vbuf, ksem, vsem) = refs[:-4], refs[-4:]
     q_ref, k_ref, v_ref, mask_ref, seed_ref, (o_ref, lse_ref) = \
         _unpack_refs(refs, has_mask, dropout_rate > 0.0, 2)
     bh = pl.program_id(0)
@@ -155,10 +174,24 @@ def _fwd_kernel(*refs, sm_scale, block_k, causal, seq_k, block_q,
     else:
         num_kb = seq_k // block_k
 
+    if stream:
+        @pl.when(num_kb > 0)
+        def _prologue():
+            _stream_kv_start(k_ref, v_ref, kbuf, vbuf, ksem, vsem, 0,
+                             block_k)
+
     def body(i, carry):
         m, l, acc = carry
-        k = k_ref[0, pl.ds(i * block_k, block_k), :]
-        v = v_ref[0, pl.ds(i * block_k, block_k), :]
+        if stream:
+            @pl.when(i + 1 < num_kb)
+            def _prefetch_next():
+                _stream_kv_start(k_ref, v_ref, kbuf, vbuf, ksem, vsem,
+                                 i + 1, block_k)
+            k, v = _stream_kv_wait(k_ref, v_ref, kbuf, vbuf, ksem, vsem,
+                                   i, block_k)
+        else:
+            k = k_ref[0, pl.ds(i * block_k, block_k), :]
+            v = v_ref[0, pl.ds(i * block_k, block_k), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s = s * sm_scale
@@ -197,7 +230,9 @@ def _fwd_kernel(*refs, sm_scale, block_k, causal, seq_k, block_q,
 
 
 def _bwd_dq_kernel(*refs, sm_scale, block_k, causal, seq_k, block_q,
-                   has_mask, dropout_rate):
+                   has_mask, dropout_rate, stream=False):
+    if stream:
+        refs, (kbuf, vbuf, ksem, vsem) = refs[:-4], refs[-4:]
     (q_ref, k_ref, v_ref, mask_ref, seed_ref,
      (do_ref, lse_ref, delta_ref, dq_ref)) = \
         _unpack_refs(refs, has_mask, dropout_rate > 0.0, 4)
@@ -214,9 +249,23 @@ def _bwd_dq_kernel(*refs, sm_scale, block_k, causal, seq_k, block_q,
     else:
         num_kb = seq_k // block_k
 
+    if stream:
+        @pl.when(num_kb > 0)
+        def _prologue():
+            _stream_kv_start(k_ref, v_ref, kbuf, vbuf, ksem, vsem, 0,
+                             block_k)
+
     def body(i, dq):
-        k = k_ref[0, pl.ds(i * block_k, block_k), :]
-        v = v_ref[0, pl.ds(i * block_k, block_k), :]
+        if stream:
+            @pl.when(i + 1 < num_kb)
+            def _prefetch_next():
+                _stream_kv_start(k_ref, v_ref, kbuf, vbuf, ksem, vsem,
+                                 i + 1, block_k)
+            k, v = _stream_kv_wait(k_ref, v_ref, kbuf, vbuf, ksem, vsem,
+                                   i, block_k)
+        else:
+            k = k_ref[0, pl.ds(i * block_k, block_k), :]
+            v = v_ref[0, pl.ds(i * block_k, block_k), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s = s * sm_scale
@@ -245,7 +294,9 @@ def _bwd_dq_kernel(*refs, sm_scale, block_k, causal, seq_k, block_q,
 
 
 def _bwd_dkv_kernel(*refs, sm_scale, block_q, causal, seq_q, seq_k, block_k,
-                    has_mask, dropout_rate):
+                    has_mask, dropout_rate, stream=False):
+    if stream:
+        refs, (qbuf, dobuf, qsem, dosem) = refs[:-4], refs[-4:]
     (q_ref, k_ref, v_ref, mask_ref, seed_ref,
      (do_ref, lse_ref, delta_ref, dk_ref, dv_ref)) = \
         _unpack_refs(refs, has_mask, dropout_rate > 0.0, 5)
@@ -262,10 +313,24 @@ def _bwd_dkv_kernel(*refs, sm_scale, block_q, causal, seq_q, seq_k, block_k,
         first_qb = 0
     num_qb = seq_q // block_q
 
+    if stream:
+        @pl.when(num_qb > first_qb)
+        def _prologue():
+            _stream_kv_start(q_ref, do_ref, qbuf, dobuf, qsem, dosem,
+                             first_qb, block_q)
+
     def body(i, carry):
         dk, dv = carry
-        q = q_ref[0, pl.ds(i * block_q, block_q), :]
-        do = do_ref[0, pl.ds(i * block_q, block_q), :]
+        if stream:
+            @pl.when(i + 1 < num_qb)
+            def _prefetch_next():
+                _stream_kv_start(q_ref, do_ref, qbuf, dobuf, qsem, dosem,
+                                 i + 1, block_q)
+            q, do = _stream_kv_wait(q_ref, do_ref, qbuf, dobuf, qsem,
+                                    dosem, i, block_q)
+        else:
+            q = q_ref[0, pl.ds(i * block_q, block_q), :]
+            do = do_ref[0, pl.ds(i * block_q, block_q), :]
         lse = lse_ref[0, pl.ds(i * block_q, block_q), 0]
         delta = delta_ref[0, pl.ds(i * block_q, block_q), 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
@@ -319,21 +384,31 @@ def _largest_divisor_block(seq, cap=512):
     return min(seq, cap)
 
 
-def _block_cap(seq):
-    # long sequences must shrink blocks: the kernels keep full K/V for the
-    # (batch, head) program in VMEM, so the per-program fp32 scratch
-    # (bq x bk scores + bq x d accumulators) has to fit in what's left of
-    # the ~16MB scoped budget. 512-blocks overflow at S=8192 (observed
-    # v5e: 16.5M > 16M scoped vmem on the bwd); 256 fits through 16k.
-    if seq >= 16384:
-        return 64
+# beyond this sequence length the kernels stream K/V (or q/do in the dkv
+# pass) from HBM through double-buffered DMA tiles instead of keeping the
+# full per-head arrays resident in VMEM — unbounded S at 2 tiles of VMEM
+STREAM_THRESHOLD = 8192
+
+
+def _use_stream(seq_q, seq_k):
+    return max(seq_q, seq_k) >= STREAM_THRESHOLD
+
+
+def _block_cap(seq, stream):
+    # resident mode keeps full K/V per (batch, head) program in VMEM, so
+    # 512-blocks overflow the ~16MB scoped budget at S=8192 (observed
+    # v5e: 16.5M > 16M on the bwd); streaming mode holds only 2 tiles,
+    # so the big MXU-friendly blocks stay legal at any S
+    if stream:
+        return 512
     if seq >= 8192:
         return 256
     return 512
 
 
 def _pick_blocks(seq_q, seq_k):
-    cap = _block_cap(max(seq_q, seq_k))
+    stream = _use_stream(seq_q, seq_k)
+    cap = _block_cap(max(seq_q, seq_k), stream)
     return (_largest_divisor_block(seq_q, cap),
             _largest_divisor_block(seq_k, cap))
 
@@ -353,14 +428,19 @@ def _flash_fwd(q, k, v, mask, causal, sm_scale, interpret,
     kr = k.reshape(b * h, sk, d)
     vr = v.reshape(b * h, sk, d)
 
+    stream = _use_stream(sq, sk)
     kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, block_k=bk,
                                causal=causal, seq_k=sk, block_q=bq,
                                has_mask=mask is not None,
-                               dropout_rate=dropout_rate)
+                               dropout_rate=dropout_rate, stream=stream)
+    kv_space = pl.ANY if stream else None
+    kv_spec = (pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0),
+                            memory_space=pl.ANY) if stream else
+               pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)))
     in_specs = [
         pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
-        pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
-        pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
+        kv_spec,
+        kv_spec,
     ]
     args = [qr, kr, vr]
     if mask is not None:
@@ -381,6 +461,14 @@ def _flash_fwd(q, k, v, mask, causal, sm_scale, interpret,
         pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
         pl.BlockSpec((1, bq, 1), lambda i, j: (i, j, 0)),
     ]
+    scratch_shapes = []
+    if stream:
+        scratch_shapes = [
+            pltpu.VMEM((2, bk, d), k.dtype),
+            pltpu.VMEM((2, bk, d), v.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ]
     compiler_params = None
     if pltpu is not None and not interpret:
         compiler_params = pltpu.CompilerParams(
@@ -391,6 +479,7 @@ def _flash_fwd(q, k, v, mask, causal, sm_scale, interpret,
         in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
+        scratch_shapes=scratch_shapes,
         interpret=interpret,
         compiler_params=compiler_params,
     )(*args)
@@ -421,14 +510,18 @@ def _flash_bwd(res, g, causal, sm_scale, interpret,
         seedr = seed.reshape(1, 1).astype(jnp.int32)
 
     # ---- dq ----
+    stream = _use_stream(sq, sk)
     kernel = functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, block_k=bk,
                                causal=causal, seq_k=sk, block_q=bq,
                                has_mask=mask is not None,
-                               dropout_rate=dropout_rate)
+                               dropout_rate=dropout_rate, stream=stream)
+    kv_spec = (pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0),
+                            memory_space=pl.ANY) if stream else
+               pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)))
     in_specs = [
         pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),   # q
-        pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),   # k
-        pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),   # v
+        kv_spec,                                            # k
+        kv_spec,                                            # v
     ]
     args = list(common)
     if mask is not None:
@@ -443,6 +536,14 @@ def _flash_bwd(res, g, causal, sm_scale, interpret,
         pl.BlockSpec((1, bq, 1), lambda i, j: (i, j, 0)),   # delta
     ]
     args += [dor, lser, deltar]
+    scratch_shapes = []
+    if stream:
+        scratch_shapes = [
+            pltpu.VMEM((2, bk, d), k.dtype),
+            pltpu.VMEM((2, bk, d), v.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ]
     compiler_params = None
     if pltpu is not None and not interpret:
         compiler_params = pltpu.CompilerParams(
@@ -453,6 +554,7 @@ def _flash_bwd(res, g, causal, sm_scale, interpret,
         in_specs=in_specs,
         out_specs=pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        scratch_shapes=scratch_shapes,
         interpret=interpret,
         compiler_params=compiler_params,
     )(*args)
@@ -461,9 +563,12 @@ def _flash_bwd(res, g, causal, sm_scale, interpret,
     kernel = functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, block_q=bq,
                                causal=causal, seq_q=sq, seq_k=sk, block_k=bk,
                                has_mask=mask is not None,
-                               dropout_rate=dropout_rate)
+                               dropout_rate=dropout_rate, stream=stream)
+    q_spec = (pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0),
+                           memory_space=pl.ANY) if stream else
+              pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)))
     in_specs = [
-        pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)),   # q (full)
+        q_spec,                                             # q (full)
         pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),   # k block
         pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),   # v block
     ]
@@ -475,11 +580,19 @@ def _flash_bwd(res, g, causal, sm_scale, interpret,
         in_specs.append(_seed_spec())
         args.append(seedr)
     in_specs += [
-        pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)),   # do (full)
+        q_spec,                                             # do (full)
         pl.BlockSpec((1, sq, 1), lambda i, j: (i, 0, 0)),   # lse (full)
         pl.BlockSpec((1, sq, 1), lambda i, j: (i, 0, 0)),   # delta (full)
     ]
     args += [dor, lser, deltar]
+    scratch_shapes = []
+    if stream:
+        scratch_shapes = [
+            pltpu.VMEM((2, bq, d), q.dtype),
+            pltpu.VMEM((2, bq, d), do.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ]
     dk, dv = pl.pallas_call(
         kernel,
         grid=(b * h, sk // bk),
@@ -492,6 +605,7 @@ def _flash_bwd(res, g, causal, sm_scale, interpret,
             jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
             jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
         ],
+        scratch_shapes=scratch_shapes,
         interpret=interpret,
         compiler_params=compiler_params,
     )(*args)
