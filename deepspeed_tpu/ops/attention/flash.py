@@ -37,6 +37,7 @@ except ImportError:  # pragma: no cover
     pltpu = None
 
 NEG_INF = -1e30
+_WARNED_IRREGULAR_FALLBACK = False
 
 
 # --------------------------------------------------------------------- #
@@ -712,6 +713,16 @@ def flash_attention(q, k, v, mask=None, causal: bool = False,
         seed = jnp.zeros((1, 1), jnp.int32)
     sq, sk = q.shape[2], k.shape[2]
     if force_reference or sq % 16 != 0 or sk % 16 != 0:
+        if not force_reference and max(sq, sk) > 2048:
+            global _WARNED_IRREGULAR_FALLBACK
+            if not _WARNED_IRREGULAR_FALLBACK:
+                _WARNED_IRREGULAR_FALLBACK = True
+                import warnings
+                warnings.warn(
+                    f"flash_attention: seq ({sq}, {sk}) not divisible by "
+                    "16 — falling back to the O(S^2)-memory dense "
+                    "reference path. Pad the sequence to a multiple of "
+                    "16 to use the Pallas kernel.", stacklevel=2)
         return attention_reference(q, k, v, mask=mask, causal=causal,
                                    sm_scale=sm_scale,
                                    dropout_rate=dropout_rate,
