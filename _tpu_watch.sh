@@ -1,0 +1,19 @@
+#!/bin/bash
+cd /root/repo
+for i in $(seq 1 40); do
+  if timeout 120 python -c "
+import jax, numpy as np, jax.numpy as jnp
+np.asarray(jax.jit(lambda: jnp.ones(1))())
+print('TPU_UP')
+" 2>/dev/null | grep -q TPU_UP; then
+    echo "TPU back at attempt $i: $(date)"
+    timeout 2400 python _profile_attn.py > /tmp/profile_attn.log 2>&1
+    echo "profile done rc=$?"
+    timeout 2400 python bench.py > /tmp/bench3.log 2>&1
+    echo "bench done rc=$?"
+    exit 0
+  fi
+  sleep 240
+done
+echo "TPU never returned"
+exit 1

@@ -281,6 +281,17 @@ def get_memory_breakdown(param_dict):
                             C.MEMORY_BREAKDOWN_DEFAULT)
 
 
+def get_compressed_allreduce_config(param_dict):
+    """int8 block-quantized DP gradient exchange (TPU-native extension)."""
+    sub = param_dict.get(C.COMPRESSED_ALLREDUCE, {})
+    return {
+        "enabled": sub.get(C.COMPRESSED_ALLREDUCE_ENABLED,
+                           C.COMPRESSED_ALLREDUCE_ENABLED_DEFAULT),
+        "block": sub.get(C.COMPRESSED_ALLREDUCE_BLOCK,
+                         C.COMPRESSED_ALLREDUCE_BLOCK_DEFAULT),
+    }
+
+
 def get_profiler_config(param_dict):
     """TPU-native profiling: jax.profiler trace window (SURVEY.md §5)."""
     sub = param_dict.get(C.PROFILER, {})
@@ -384,6 +395,8 @@ class DeepSpeedConfig:
 
         self.wall_clock_breakdown = get_wall_clock_breakdown(param_dict)
         self.profiler_config = get_profiler_config(param_dict)
+        self.compressed_allreduce_config = \
+            get_compressed_allreduce_config(param_dict)
         self.memory_breakdown = get_memory_breakdown(param_dict)
         self.tensorboard_enabled = get_tensorboard_enabled(param_dict)
         self.tensorboard_output_path = get_tensorboard_output_path(param_dict)

@@ -132,6 +132,18 @@ MEMORY_BREAKDOWN = "memory_breakdown"
 MEMORY_BREAKDOWN_DEFAULT = False
 
 #############################################
+# Quantized (int8) gradient allreduce — TPU-native extension
+# (ZeRO++-style comm compression; see runtime/quantized_collectives.py)
+#
+# "compressed_allreduce": {"enabled": false, "block": 256}
+#############################################
+COMPRESSED_ALLREDUCE = "compressed_allreduce"
+COMPRESSED_ALLREDUCE_ENABLED = "enabled"
+COMPRESSED_ALLREDUCE_ENABLED_DEFAULT = False
+COMPRESSED_ALLREDUCE_BLOCK = "block"
+COMPRESSED_ALLREDUCE_BLOCK_DEFAULT = 256
+
+#############################################
 # Profiler (TPU-native: jax.profiler trace capture; SURVEY.md §5 —
 # the reference's wall_clock_breakdown/timers ladder, plus XLA traces)
 #

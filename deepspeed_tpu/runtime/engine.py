@@ -416,6 +416,19 @@ class DeepSpeedEngine:
         self._csr_overflow = None     # device flag from the last micro step
         self._csr_overflow_logged = False
 
+        # int8 block-quantized DP grad exchange (TPU-native extension;
+        # ZeRO++-style — runtime/quantized_collectives.py). Exclusive
+        # with the 1-bit and CSR manual paths.
+        cac = self._config.compressed_allreduce_config
+        self._quant_allreduce = bool(
+            cac["enabled"] and self.dp_world_size > 1
+            and not self._onebit and not self._sparse_grad_paths)
+        self._quant_block = int(cac["block"])
+        if cac["enabled"] and not self._quant_allreduce:
+            logger.warning(
+                "compressed_allreduce ignored (needs dp > 1 and no "
+                "1-bit/sparse gradient path)")
+
         self._compiled_micro_step = None
         self._compiled_grad = None
         self._compiled_apply = None
@@ -634,6 +647,40 @@ class DeepSpeedEngine:
                 "'embedding' leaf also receives dense gradients (e.g. a "
                 "tied LM head). Disable sparse_gradients for this model.")
 
+    # -- int8 quantized allreduce path ------------------------------------
+    def _compute_quantized_grads(self, params, batch, rng, scale):
+        """Backward under shard_map over 'data' with the int8 block-
+        quantized gradient exchange (runtime/quantized_collectives.py) —
+        ~3.7x less DP wire traffic than fp32 grads. Leaves smaller than
+        one quantization block ship dense (pmean)."""
+        from deepspeed_tpu.runtime.quantized_collectives import (
+            quantized_allreduce_mean)
+        P = PartitionSpec
+        repl = lambda tree: jax.tree_util.tree_map(lambda _: P(), tree)
+        block = self._quant_block
+
+        def inner(p, b, r, s):
+            r = jax.random.fold_in(r, jax.lax.axis_index("data"))
+            loss, _aux, g = self._compute_loss_and_grads(p, b, r, s)
+            loss = jax.lax.pmean(loss, "data")
+
+            def exchange(grad):
+                if grad.size < block:
+                    return jax.lax.pmean(grad, "data")
+                return quantized_allreduce_mean(grad, "data", block)
+
+            g = jax.tree_util.tree_map(exchange, g)
+            return loss, g
+
+        loss, grads = jax.shard_map(
+            inner, mesh=self.mesh,
+            in_specs=(repl(params),
+                      jax.tree_util.tree_map(lambda _: P("data"), batch),
+                      P(), P()),
+            out_specs=(P(), repl(params)),
+            check_vma=False)(params, batch, rng, scale)
+        return loss, None, grads
+
     # -- 1-bit Adam distributed path --------------------------------------
     def _compute_local_grads(self, params, batch, rng, scale):
         """Per-data-shard gradients, stacked on a leading (dp,) axis sharded
@@ -776,6 +823,9 @@ class DeepSpeedEngine:
                 state.params, batch, sub, state.loss_scale.scale)
         elif self._sparse_grad_paths:
             loss, csr_ovf, grads = self._compute_sparse_grads(
+                state.params, batch, sub, state.loss_scale.scale)
+        elif self._quant_allreduce:
+            loss, aux, grads = self._compute_quantized_grads(
                 state.params, batch, sub, state.loss_scale.scale)
         else:
             loss, aux, grads = self._compute_loss_and_grads(

@@ -1,0 +1,76 @@
+"""Block-quantized gradient allreduce — ZeRO++-style comm compression.
+
+TPU-native extension past the reference snapshot (whose only compressed
+collective is 1-bit Adam's sign exchange): data-parallel gradients are
+exchanged as int8 with per-block fp32 scales (~3.7x less ICI/DCN traffic
+than fp32, ~1.9x vs bf16), the pattern of ZeRO++'s quantized gradient
+collectives (arXiv:2306.10209) and EQuARX (arXiv:2506.17615) re-expressed
+as in-jit XLA collectives:
+
+    quantize(local grad) -> all_gather(int8 + scales) over 'data'
+    -> dequantize + mean locally on every rank
+
+Summation happens in fp32 AFTER dequantization (int8 sums would
+overflow), which is exactly EQuARX's "quantize the wire, not the math".
+Quantization is symmetric per block of 256 values (absmax scaling,
+round-to-nearest): unbiased up to rounding, error bounded by
+absmax/127 per element.
+"""
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["quantize_blockwise", "dequantize_blockwise",
+           "quantized_allreduce_mean", "wire_bytes"]
+
+DEFAULT_BLOCK = 256
+
+
+def _pad_to(x, m):
+    pad = (-x.shape[0]) % m
+    if pad:
+        x = jnp.concatenate([x, jnp.zeros((pad,), x.dtype)])
+    return x, pad
+
+
+def quantize_blockwise(x: jax.Array, block: int = DEFAULT_BLOCK
+                       ) -> Tuple[jax.Array, jax.Array, int]:
+    """Flatten + symmetric int8 quantization per block of ``block``
+    values. Returns (q (nb, block) int8, scales (nb,) fp32, orig_size)."""
+    n = x.size
+    flat, _ = _pad_to(x.reshape(-1).astype(jnp.float32), block)
+    blocks = flat.reshape(-1, block)
+    absmax = jnp.max(jnp.abs(blocks), axis=1)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(blocks / scale[:, None]), -127, 127
+                 ).astype(jnp.int8)
+    return q, scale.astype(jnp.float32), n
+
+
+def dequantize_blockwise(q: jax.Array, scale: jax.Array, n: int,
+                         shape=None) -> jax.Array:
+    out = (q.astype(jnp.float32) * scale[:, None]).reshape(-1)[:n]
+    return out.reshape(shape) if shape is not None else out
+
+
+def quantized_allreduce_mean(grad: jax.Array, axis_name: str,
+                             block: int = DEFAULT_BLOCK) -> jax.Array:
+    """Mean-allreduce ``grad`` across ``axis_name`` shipping int8 + block
+    scales on the wire. Call inside shard_map; every rank returns the
+    identical fp32 mean."""
+    q, scale, n = quantize_blockwise(grad, block)
+    q_all = jax.lax.all_gather(q, axis_name)            # (W, nb, block)
+    s_all = jax.lax.all_gather(scale, axis_name)        # (W, nb)
+    W = q_all.shape[0]
+    deq = q_all.astype(jnp.float32) * s_all[:, :, None]
+    mean = jnp.sum(deq, axis=0) / W
+    return mean.reshape(-1)[:n].reshape(grad.shape).astype(grad.dtype)
+
+
+def wire_bytes(n: int, block: int = DEFAULT_BLOCK,
+               dense_dtype_bytes: int = 4) -> Tuple[int, int]:
+    """(quantized, dense) per-leg payload bytes for n elements."""
+    nb = -(-n // block)
+    return nb * block * 1 + nb * 4, n * dense_dtype_bytes

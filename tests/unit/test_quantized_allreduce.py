@@ -1,0 +1,68 @@
+"""int8 block-quantized gradient allreduce (TPU-native extension;
+ZeRO++-style comm compression, runtime/quantized_collectives.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.parallel.mesh import build_mesh
+from deepspeed_tpu.runtime.quantized_collectives import (
+    dequantize_blockwise, quantize_blockwise, quantized_allreduce_mean,
+    wire_bytes)
+
+
+def test_quantize_roundtrip_error_bound():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(1000).astype(np.float32) * 3)
+    q, s, n = quantize_blockwise(x, block=256)
+    y = dequantize_blockwise(q, s, n)
+    # per-element error <= absmax_of_block / 127 (half-step rounding x2)
+    err = np.abs(np.asarray(y) - np.asarray(x))
+    bound = np.abs(np.asarray(x)).max() / 127 + 1e-7
+    assert err.max() <= bound
+
+
+def test_allreduce_mean_matches_dense_within_quant_error():
+    mesh = build_mesh({"data": 8})
+    rng = np.random.RandomState(1)
+    g = jnp.asarray(rng.randn(8, 512).astype(np.float32))
+
+    def inner(x):
+        return quantized_allreduce_mean(x[0], "data")
+
+    out = jax.jit(jax.shard_map(
+        inner, mesh=mesh, in_specs=(P("data"),), out_specs=P(),
+        check_vma=False))(g)
+    dense = np.asarray(g).mean(axis=0)
+    np.testing.assert_allclose(np.asarray(out), dense, atol=0.05)
+
+
+def test_wire_volume():
+    qb, db = wire_bytes(1_000_000)
+    assert db / qb > 3.5  # ~3.7x less traffic than fp32
+
+
+def test_engine_trains_and_converges():
+    from tests.unit.simple_model import (init_simple_params, simple_loss_fn,
+                                         random_batches)
+    params = init_simple_params(jax.random.PRNGKey(0), hidden_dim=8)
+    eq, *_ = ds.initialize(
+        model=simple_loss_fn, model_parameters=params,
+        config={"train_micro_batch_size_per_gpu": 4,
+                "compressed_allreduce": {"enabled": True},
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}}})
+    assert eq._quant_allreduce
+    ed, *_ = ds.initialize(
+        model=simple_loss_fn, model_parameters=params,
+        config={"train_micro_batch_size_per_gpu": 4,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}}})
+    lq, ld = [], []
+    for i in range(10):
+        b = random_batches(1, 32, 8, seed=i)[0]
+        lq.append(float(eq.train_batch(iter([b]))))
+        ld.append(float(ed.train_batch(iter([b]))))
+    assert lq[-1] < lq[0]                       # converges
+    np.testing.assert_allclose(lq, ld, rtol=0.2)  # tracks the dense run
