@@ -664,10 +664,25 @@ class DeepSpeedEngine:
             loss, _aux, g = self._compute_loss_and_grads(p, b, r, s)
             loss = jax.lax.pmean(loss, "data")
 
+            # fp16 overflow sentinel: quantization destroys inf/nan (the
+            # absmax scale goes inf -> q garbage), so detect nonfinite
+            # BEFORE the exchange and re-poison the result, keeping the
+            # engine's has_overflow skip-step machinery working
+            ovf = jnp.zeros((), bool)
+            if self.fp16_enabled:
+                for leaf in jax.tree_util.tree_leaves(g):
+                    ovf = jnp.logical_or(
+                        ovf, jnp.any(~jnp.isfinite(leaf)))
+                ovf = jax.lax.pmax(ovf.astype(jnp.int32),
+                                   "data").astype(bool)
+
             def exchange(grad):
                 if grad.size < block:
                     return jax.lax.pmean(grad, "data")
-                return quantized_allreduce_mean(grad, "data", block)
+                out = quantized_allreduce_mean(grad, "data", block)
+                if self.fp16_enabled:
+                    out = jnp.where(ovf, jnp.nan, out)
+                return out
 
             g = jax.tree_util.tree_map(exchange, g)
             return loss, g

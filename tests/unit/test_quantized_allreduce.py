@@ -66,3 +66,31 @@ def test_engine_trains_and_converges():
         ld.append(float(ed.train_batch(iter([b]))))
     assert lq[-1] < lq[0]                       # converges
     np.testing.assert_allclose(lq, ld, rtol=0.2)  # tracks the dense run
+
+
+def test_fp16_overflow_survives_quantization():
+    """An fp16 overflow (inf grads) must still trip the skip-step
+    machinery — quantization alone would launder inf into garbage."""
+    from tests.unit.simple_model import init_simple_params, random_batches
+
+    def exploding_loss(params, batch):
+        x = batch["x"] * 1e4  # fp16 overflow in the first matmul
+        for i in range(len(params)):
+            layer = params[f"layer_{i}"]
+            x = x @ layer["w"].astype(x.dtype) + layer["b"].astype(x.dtype)
+        return jnp.mean(x.astype(jnp.float32) ** 2)
+
+    params = init_simple_params(jax.random.PRNGKey(0), hidden_dim=8)
+    e, *_ = ds.initialize(
+        model=exploding_loss, model_parameters=params,
+        config={"train_micro_batch_size_per_gpu": 4,
+                "compressed_allreduce": {"enabled": True},
+                "fp16": {"enabled": True, "initial_scale_power": 20},
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}}})
+    b = random_batches(1, 32, 8)[0]
+    before = jax.tree_util.tree_map(np.asarray, e.state.params)
+    e.train_batch(iter([b]))
+    assert e.skipped_steps >= 1          # overflow detected -> skipped
+    for a, c in zip(jax.tree_util.tree_leaves(before),
+                    jax.tree_util.tree_leaves(e.state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
