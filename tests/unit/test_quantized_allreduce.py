@@ -94,3 +94,22 @@ def test_fp16_overflow_survives_quantization():
     for a, c in zip(jax.tree_util.tree_leaves(before),
                     jax.tree_util.tree_leaves(e.state.params)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+
+
+def test_quantized_composes_with_zero2_and_accumulation():
+    from tests.unit.simple_model import (init_simple_params, simple_loss_fn,
+                                         random_batches)
+    params = init_simple_params(jax.random.PRNGKey(0), hidden_dim=8)
+    e, *_ = ds.initialize(
+        model=simple_loss_fn, model_parameters=params,
+        config={"train_micro_batch_size_per_gpu": 4,
+                "gradient_accumulation_steps": 2,
+                "compressed_allreduce": {"enabled": True},
+                "zero_optimization": {"stage": 2},
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}}})
+    losses = []
+    for i in range(4):
+        bs = random_batches(2, 32, 8, seed=i)
+        losses.append(float(e.train_batch(iter(bs))))
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]
