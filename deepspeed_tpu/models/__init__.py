@@ -4,4 +4,4 @@ from deepspeed_tpu.models.gpt2 import (
     init_gpt2_params, count_params)
 from deepspeed_tpu.models.bert import (
     BertConfig, BERT_BASE, BERT_LARGE, bert_encoder, bert_mlm_loss_fn,
-    bert_param_specs, init_bert_params)
+    bert_mlm_sp_loss_fn, bert_param_specs, init_bert_params)

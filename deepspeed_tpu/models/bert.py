@@ -37,9 +37,11 @@ BERT_LARGE = BertConfig(hidden_size=1024, num_layers=24, num_heads=16,
                         intermediate_size=4096)
 
 
-def layer_config(config: BertConfig, training: bool = True
-                 ) -> DeepSpeedTransformerConfig:
+def layer_config(config: BertConfig, training: bool = True,
+                 dtype=jnp.bfloat16) -> DeepSpeedTransformerConfig:
     return DeepSpeedTransformerConfig(
+        bf16=(dtype == jnp.bfloat16),
+        fp16=(dtype == jnp.float16),
         hidden_size=config.hidden_size,
         intermediate_size=config.intermediate_size,
         heads=config.num_heads,
@@ -129,7 +131,7 @@ def bert_encoder(params, config: BertConfig, input_ids, attention_mask=None,
     block (use SparseAttentionUtils.pad_to_block_size).
     """
     B, S = input_ids.shape
-    lcfg = layer_config(config, training=not deterministic)
+    lcfg = layer_config(config, training=not deterministic, dtype=dtype)
     pos = jnp.arange(S)[None, :]
     tt = token_type_ids if token_type_ids is not None else \
         jnp.zeros_like(input_ids)
@@ -169,6 +171,97 @@ def bert_encoder(params, config: BertConfig, input_ids, attention_mask=None,
         x = fwd(params[f"layer_{i}"], lcfg, x, add_mask, r, deterministic,
                 True, attention_fn)
     return x
+
+
+def bert_mlm_sp_loss_fn(config: BertConfig, mesh, dtype=jnp.bfloat16,
+                        deterministic: bool = False):
+    """Sequence-parallel BERT MLM over the ``seq`` mesh axis: every
+    activation lives (B, S/P, H) on its shard; bidirectional ring
+    attention (ops/attention/ring.py — no causal waste) crosses shards
+    with the padding mask riding alongside its K/V chunk; the MLM head
+    and masked-token loss are token-local with fp32 psums for the global
+    sum/count. Engine contract: batch = {'input_ids', 'labels',
+    'attention_mask'?} each (B, S), S divisible by the seq-axis size.
+    """
+    from deepspeed_tpu.ops.attention.ring import ring_attention
+    from deepspeed_tpu.parallel.mesh import axis_size
+    from jax.sharding import PartitionSpec as PS
+    if "seq" not in mesh.axis_names:
+        raise ValueError("bert_mlm_sp_loss_fn requires a 'seq' mesh axis")
+    Pn = axis_size(mesh, "seq")
+    manual = frozenset(a for a in ("seq", "data") if a in mesh.axis_names)
+    lcfg = layer_config(config, training=not deterministic, dtype=dtype)
+
+    def per_device(params, batch, rng):
+        idx = jax.lax.axis_index("seq")
+        ids_full = batch["input_ids"]              # (B_l, S) replicated/seq
+        B, S = ids_full.shape
+        assert S % Pn == 0, (S, Pn)
+        sl = S // Pn
+        sl_ids = jax.lax.dynamic_slice_in_dim(ids_full, idx * sl, sl, 1)
+        labels = jax.lax.dynamic_slice_in_dim(batch["labels"], idx * sl,
+                                              sl, 1)
+        am_full = batch.get("attention_mask")
+        if am_full is not None:
+            am_l = jax.lax.dynamic_slice_in_dim(am_full, idx * sl, sl, 1)
+            kpm = ((1.0 - am_l[:, None, None, :].astype(jnp.float32))
+                   * -1e9)                          # additive (B,1,1,sl)
+        else:
+            kpm = None
+        pos = idx * sl + jnp.arange(sl)
+        x = (params["tok_emb"][sl_ids] +
+             jax.lax.dynamic_slice_in_dim(params["pos_emb"], idx * sl, sl,
+                                          0)[None] +
+             params["type_emb"][jnp.zeros_like(sl_ids)])
+        x = _ln(x, params["emb_ln"]).astype(dtype)
+        del pos
+
+        def attention_fn(q, k, v, _add_mask):
+            return ring_attention(q, k, v, axis_name="seq", causal=False,
+                                  key_padding_mask=kpm)
+
+        for i in range(config.num_layers):
+            if rng is not None and not deterministic:
+                rng, r = jax.random.split(rng)
+                r = jax.random.fold_in(r, idx)
+            else:
+                r = None
+            x = transformer_layer_forward(params[f"layer_{i}"], lcfg, x,
+                                          None, r, deterministic, True,
+                                          attention_fn)
+        mh = x @ params["mlm_dense"]["w"].astype(dtype) + \
+            params["mlm_dense"]["b"].astype(dtype)
+        mh = jax.nn.gelu(mh, approximate=False)
+        mh = _ln(mh, params["mlm_ln"])
+        logits = matmul_bf16_accum_fp32(mh, params["tok_emb"]) + \
+            params["mlm_bias"]
+        mask = (labels != -100)
+        safe = jnp.where(mask, labels, 0)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        # fp32 psums only (bf16 psum trips the XLA partitioner with auto
+        # axes in the mesh — runtime/pipe/spmd._psum_act). Sum AND count
+        # reduce over every manual axis before the division: dividing
+        # per-data-shard and averaging would weight shards with fewer
+        # masked tokens more (mean-of-means != global masked mean).
+        axes = tuple(sorted(manual))
+        total = jax.lax.psum(
+            jnp.sum(jnp.where(mask, ll, 0.0)).astype(jnp.float32), axes)
+        count = jax.lax.psum(jnp.sum(mask).astype(jnp.float32), axes)
+        return -total / jnp.maximum(count, 1.0)
+
+    def loss_fn(params, batch, rng):
+        param_specs = jax.tree_util.tree_map(lambda _: PS(), params)
+        batch_specs = jax.tree_util.tree_map(
+            lambda _: PS("data") if "data" in manual else PS(), batch)
+        return jax.shard_map(
+            per_device, mesh=mesh,
+            in_specs=(param_specs, batch_specs, PS()),
+            out_specs=PS(), axis_names=manual,
+            check_vma=False)(params, batch, rng)
+
+    loss_fn.owns_cast = True   # per-use casts; grad psums stay fp32
+    return loss_fn
 
 
 def bert_mlm_loss_fn(config: BertConfig, dtype=jnp.bfloat16,

@@ -77,46 +77,48 @@ def _rot(x, axis_name, P, shift=1):
                             [(i, (i + shift) % P) for i in range(P)])
 
 
-def _ring_fwd_impl(q, k, v, seed, axis_name, causal, sm_scale, interpret,
-                   rate):
+def _ring_fwd_impl(q, k, v, kpm, seed, axis_name, causal, sm_scale,
+                   interpret, rate):
     P = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
 
     # step 0: diagonal chunk, local causal (or plain) flash
-    o0, lse0 = _flash_fwd(q, k, v, None, causal, sm_scale, interpret,
+    o0, lse0 = _flash_fwd(q, k, v, kpm, causal, sm_scale, interpret,
                           dropout_rate=rate,
                           seed=_chunk_seed(seed, idx) if rate > 0.0 else seed)
     o_acc = o0.astype(jnp.float32)
     lse_acc = lse0
 
     def step(carry, j):
-        k_cur, v_cur, o_acc, lse_acc = carry
+        k_cur, v_cur, kpm_cur, o_acc, lse_acc = carry
         k_cur = _rot(k_cur, axis_name, P)
         v_cur = _rot(v_cur, axis_name, P)
+        if kpm_cur is not None:
+            kpm_cur = _rot(kpm_cur, axis_name, P)
         src = (idx - j) % P
         sj = _chunk_seed(seed, src) if rate > 0.0 else seed
-        o_j, lse_j = _flash_fwd(q, k_cur, v_cur, None, False, sm_scale,
+        o_j, lse_j = _flash_fwd(q, k_cur, v_cur, kpm_cur, False, sm_scale,
                                 interpret, dropout_rate=rate, seed=sj)
         if causal:
             valid = src < idx          # strictly-past chunk
             lse_j = jnp.where(valid, lse_j, NEG_BIG)
         o_acc, lse_acc = _combine(o_acc, lse_acc, o_j, lse_j)
-        return (k_cur, v_cur, o_acc, lse_acc), None
+        return (k_cur, v_cur, kpm_cur, o_acc, lse_acc), None
 
     if P > 1:
-        (_, _, o_acc, lse_acc), _ = jax.lax.scan(
-            step, (k, v, o_acc, lse_acc), jnp.arange(1, P))
+        (_, _, _, o_acc, lse_acc), _ = jax.lax.scan(
+            step, (k, v, kpm, o_acc, lse_acc), jnp.arange(1, P))
     return o_acc.astype(q.dtype), lse_acc
 
 
 def _ring_bwd_impl(res, do, axis_name, causal, sm_scale, interpret, rate):
-    q, k, v, seed, o, lse = res
+    q, k, v, kpm, seed, o, lse = res
     P = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
 
     # diagonal chunk
     dq, dk0, dv0, _ = _flash_bwd(
-        (q, k, v, None,
+        (q, k, v, kpm,
          _chunk_seed(seed, idx) if rate > 0.0 else seed, o, lse),
         do, causal, sm_scale, interpret, dropout_rate=rate)
     dq = dq.astype(jnp.float32)
@@ -124,16 +126,18 @@ def _ring_bwd_impl(res, do, axis_name, causal, sm_scale, interpret, rate):
     dv_acc = dv0.astype(jnp.float32)
 
     def step(carry, j):
-        k_cur, v_cur, dk_cur, dv_cur, dq = carry
-        # rotate k/v and their grad accumulators together
+        k_cur, v_cur, kpm_cur, dk_cur, dv_cur, dq = carry
+        # rotate k/v (+ their key mask) and grad accumulators together
         k_cur = _rot(k_cur, axis_name, P)
         v_cur = _rot(v_cur, axis_name, P)
+        if kpm_cur is not None:
+            kpm_cur = _rot(kpm_cur, axis_name, P)
         dk_cur = _rot(dk_cur, axis_name, P)
         dv_cur = _rot(dv_cur, axis_name, P)
         src = (idx - j) % P
         sj = _chunk_seed(seed, src) if rate > 0.0 else seed
         dq_j, dk_j, dv_j, _ = _flash_bwd(
-            (q, k_cur, v_cur, None, sj, o, lse), do, False, sm_scale,
+            (q, k_cur, v_cur, kpm_cur, sj, o, lse), do, False, sm_scale,
             interpret, dropout_rate=rate)
         if causal:
             valid = (src < idx).astype(jnp.float32)
@@ -143,11 +147,11 @@ def _ring_bwd_impl(res, do, axis_name, causal, sm_scale, interpret, rate):
         dq = dq + dq_j.astype(jnp.float32)
         dk_cur = dk_cur + dk_j.astype(jnp.float32)
         dv_cur = dv_cur + dv_j.astype(jnp.float32)
-        return (k_cur, v_cur, dk_cur, dv_cur, dq), None
+        return (k_cur, v_cur, kpm_cur, dk_cur, dv_cur, dq), None
 
     if P > 1:
-        (k_l, v_l, dk_acc, dv_acc, dq), _ = jax.lax.scan(
-            step, (k, v, dk_acc, dv_acc, dq), jnp.arange(1, P))
+        (_, _, _, dk_acc, dv_acc, dq), _ = jax.lax.scan(
+            step, (k, v, kpm, dk_acc, dv_acc, dq), jnp.arange(1, P))
         # one final rotation completes the cycle: each (dk, dv) buffer
         # returns to the device owning that chunk
         dk_acc = _rot(dk_acc, axis_name, P)
@@ -156,26 +160,28 @@ def _ring_bwd_impl(res, do, axis_name, causal, sm_scale, interpret, rate):
         dv_acc.astype(v.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _ring_attention(q, k, v, seed, axis_name, causal, sm_scale, interpret,
-                    rate):
-    o, _ = _ring_fwd_impl(q, k, v, seed, axis_name, causal, sm_scale,
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _ring_attention(q, k, v, seed, has_kpm, axis_name, causal, sm_scale,
+                    interpret, rate):
+    kpm, seed = seed if has_kpm else (None, seed)
+    o, _ = _ring_fwd_impl(q, k, v, kpm, seed, axis_name, causal, sm_scale,
                           interpret, rate)
     return o
 
 
-def _ring_attention_fwd(q, k, v, seed, axis_name, causal, sm_scale,
-                        interpret, rate):
-    o, lse = _ring_fwd_impl(q, k, v, seed, axis_name, causal, sm_scale,
-                            interpret, rate)
-    return o, (q, k, v, seed, o, lse)
+def _ring_attention_fwd(q, k, v, seed, has_kpm, axis_name, causal,
+                        sm_scale, interpret, rate):
+    kpm, seed = seed if has_kpm else (None, seed)
+    o, lse = _ring_fwd_impl(q, k, v, kpm, seed, axis_name, causal,
+                            sm_scale, interpret, rate)
+    return o, (q, k, v, kpm, seed, o, lse)
 
 
-def _ring_attention_bwd(axis_name, causal, sm_scale, interpret, rate, res,
-                        g):
+def _ring_attention_bwd(has_kpm, axis_name, causal, sm_scale, interpret,
+                        rate, res, g):
     dq, dk, dv = _ring_bwd_impl(res, g, axis_name, causal, sm_scale,
                                 interpret, rate)
-    return dq, dk, dv, None
+    return dq, dk, dv, ((None, None) if has_kpm else None)
 
 
 _ring_attention.defvjp(_ring_attention_fwd, _ring_attention_bwd)
@@ -184,6 +190,7 @@ _ring_attention.defvjp(_ring_attention_fwd, _ring_attention_bwd)
 def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = False,
                    sm_scale: Optional[float] = None,
                    dropout_rate: float = 0.0, dropout_rng=None,
+                   key_padding_mask=None,
                    interpret: Optional[bool] = None):
     """Sequence-parallel flash attention over ``axis_name``.
 
@@ -191,6 +198,10 @@ def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = False,
     device's sequence shard, shape (batch, heads, seq_local, head_dim)
     with identical seq_local on every shard (global seq = P * seq_local,
     shard i owning positions [i*seq_local, (i+1)*seq_local)).
+
+    ``key_padding_mask``: optional *additive* (B, 1, 1, seq_local) mask
+    for this shard's keys (BERT padding); it rotates around the ring
+    with its K/V chunk.
     """
     if sm_scale is None:
         sm_scale = 1.0 / np.sqrt(q.shape[-1])
@@ -203,5 +214,9 @@ def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = False,
         seed = dropout_seed_from_rng(dropout_rng)
     else:
         seed = jnp.zeros((1, 1), jnp.int32)
-    return _ring_attention(q, k, v, seed, axis_name, causal,
+    if key_padding_mask is not None:
+        return _ring_attention(q, k, v, (key_padding_mask, seed), True,
+                               axis_name, causal, float(sm_scale),
+                               interpret, dropout_rate)
+    return _ring_attention(q, k, v, seed, False, axis_name, causal,
                            float(sm_scale), interpret, dropout_rate)

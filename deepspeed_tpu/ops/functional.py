@@ -29,11 +29,43 @@ def dropout(x, rate: float, rng, deterministic: bool):
     return jnp.where(mask, x / keep, jnp.zeros_like(x))
 
 
+@jax.custom_vjp
 def matmul_bf16_accum_fp32(x, w_t):
     """x @ w_t.T with bf16-cast operands and fp32 accumulation — the MXU
-    fast path for vocab-size projections. w_t: (vocab, hidden)."""
+    fast path for vocab-size projections. w_t: (vocab, hidden).
+
+    Custom VJP: autodiff's transposed dots would otherwise inherit bf16
+    OUTPUTS (each partial dw rounded to bf16 before accumulation), making
+    head gradients ~0.4% grouping-dependent — observed as a
+    sequence-parallel vs dense mismatch. The backward dots here keep bf16
+    operands but fp32 accumulation and fp32 results.
+    """
     dtype = x.dtype if x.dtype in (jnp.bfloat16, jnp.float16) else jnp.bfloat16
     return jax.lax.dot_general(
         x.astype(dtype), w_t.astype(dtype),
         (((x.ndim - 1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)
+
+
+def _mm_bf16_fwd(x, w_t):
+    return matmul_bf16_accum_fp32(x, w_t), (x, w_t)
+
+
+def _mm_bf16_bwd(res, g):
+    x, w_t = res
+    dtype = x.dtype if x.dtype in (jnp.bfloat16, jnp.float16) else jnp.bfloat16
+    gb = g.astype(dtype)
+    # dx = g @ w_t  (contract vocab), fp32 accumulation
+    dx = jax.lax.dot_general(
+        gb, w_t.astype(dtype), (((g.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(x.dtype)
+    # dw_t = g^T @ x (contract tokens), fp32 accumulation
+    xb = x.astype(dtype).reshape(-1, x.shape[-1])
+    gf = gb.reshape(-1, g.shape[-1])
+    dw = jax.lax.dot_general(
+        gf, xb, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(w_t.dtype)
+    return dx, dw
+
+
+matmul_bf16_accum_fp32.defvjp(_mm_bf16_fwd, _mm_bf16_bwd)
