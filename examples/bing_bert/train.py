@@ -44,6 +44,8 @@ def main():
     ds.add_config_arguments(parser)
     parser.add_argument("--model", choices=["tiny", "base", "large"],
                         default="base")
+    parser.add_argument("--mode", choices=["dense", "sp"], default="dense",
+                        help="sp: sequence-parallel over the 'seq' mesh axis")
     parser.add_argument("--seq", type=int, default=128)
     parser.add_argument("--steps", type=int, default=20)
     args = parser.parse_args()
@@ -63,7 +65,13 @@ def main():
         config = json.load(f)
 
     params = init_bert_params(cfg, jax.random.PRNGKey(0))
-    loss_fn = bert_mlm_loss_fn(cfg)
+    if args.mode == "sp":
+        from deepspeed_tpu.models.bert import bert_mlm_sp_loss_fn
+        from deepspeed_tpu.parallel.mesh import build_mesh
+        mesh = build_mesh(config["mesh"]["axes"])
+        loss_fn = bert_mlm_sp_loss_fn(cfg, mesh)
+    else:
+        loss_fn = bert_mlm_loss_fn(cfg)
     engine, _, _, _ = ds.initialize(model=loss_fn, model_parameters=params,
                                     config=config)
     bs = engine.train_batch_size()

@@ -56,3 +56,21 @@ def test_megatron_gpt2_sp_example(capsys):
          "--tiny", "--steps", "2", "--seq", "64")
     out = capsys.readouterr().out
     assert "done" in out and "lm loss" in out
+
+
+def test_bing_bert_sp_example(capsys):
+    import json as _json
+    import tempfile
+    cfg = {
+        "train_micro_batch_size_per_gpu": 2,
+        "steps_per_print": 1,
+        "bf16": {"enabled": True},
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+        "mesh": {"axes": {"seq": 4, "data": 2}},
+    }
+    with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                     delete=False) as f:
+        _json.dump(cfg, f)
+    _run("examples/bing_bert/train.py", "--model", "tiny", "--mode", "sp",
+         "--steps", "2", "--seq", "64", "--deepspeed_config", f.name)
+    assert "done" in capsys.readouterr().out
