@@ -146,11 +146,22 @@ def bench_sparse_attention(on_tpu, rtt):
         return best
 
     t_dense = timed(dense_loss)
-    t_sparse = timed(sparse_loss)
+    try:
+        t_sparse = timed(sparse_loss)
+        kernel = "v2"
+    except Exception:
+        # first real-TPU exposure of the v2 DMA kernels — fall back to
+        # the proven per-triple kernels rather than losing the row
+        from deepspeed_tpu.ops.sparse_attention import blocksparse as bs
+        bs.USE_SPLASH_V2 = False
+        bs._FN_CACHE.clear()
+        t_sparse = timed(sparse_loss)
+        kernel = "v1-fallback"
     speedup = t_dense / t_sparse
     _emit("sparse_attention_speedup_s8k", round(speedup, 3),
           "dense_time_over_sparse_time", round(speedup / 6.3, 4),
           {"seq": S, "heads": H, "block": block, "window_blocks": win,
+           "kernel": kernel,
            "dense_ms": round(t_dense * 1000, 2),
            "sparse_ms": round(t_sparse * 1000, 2)})
 
