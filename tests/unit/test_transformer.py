@@ -295,17 +295,19 @@ def test_flash_block_policy_scales_with_seq():
     assert _pick_blocks(32768, 32768) == (512, 512)
 
 
-def test_flash_streaming_matches_resident():
-    """Force streaming at a small S: outputs and grads must bitwise-match
-    the resident path (same math, different K/V residency)."""
+@pytest.mark.parametrize("S,causal", [(64, True), (96, True), (96, False)])
+def test_flash_streaming_matches_resident(S, causal):
+    """Force streaming at a small S: outputs and grads must match the
+    resident path (same math, different K/V residency). S=96 uses
+    32-blocks -> 3-deep DMA loops incl. the causal ragged bounds."""
     from deepspeed_tpu.ops.attention import flash as F
     key = jax.random.PRNGKey(0)
     q, k, v = (jax.random.normal(jax.random.fold_in(key, i),
-                                 (1, 2, 64, 16), jnp.float32)
+                                 (1, 2, S, 16), jnp.float32)
                for i in range(3))
 
     def loss(q, k, v):
-        return jnp.sum(F.flash_attention(q, k, v, causal=True)
+        return jnp.sum(F.flash_attention(q, k, v, causal=causal)
                        .astype(jnp.float32) ** 2)
 
     g_res = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
