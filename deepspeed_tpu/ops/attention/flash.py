@@ -7,8 +7,14 @@ launch_attn_softmax → PV) — but O(S) memory instead of materializing the
 reference gets from block-sparse attention (and more).
 
 Design: online-softmax tiling. Grid = (batch*heads, Sq/block_q); each program
-streams K/V blocks through VMEM with running max/sum in fp32. Backward
-recomputes the score tiles (flash-style) in two passes (dq; dk+dv).
+walks K/V blocks with running max/sum in fp32. Backward recomputes the
+score tiles (flash-style) in two passes (dq; dk+dv). All dots take bf16
+operands with fp32 accumulation (MXU fast path; fp32 converts would halve
+the MXU rate and bloat VMEM). Below STREAM_THRESHOLD the per-head K/V
+arrays are VMEM-resident; at/above it they stay in HBM and (block, D)
+tiles stream through double-buffered async-copy DMA — 2 tiles of VMEM
+per stream at any sequence length (S=16k+ trains where the resident
+design could not compile).
 
 Attention dropout runs *inside* the kernel (reference: the fused
 softmax-dropout CUDA kernels, csrc/transformer/dropout_kernels.cu +
