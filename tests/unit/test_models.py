@@ -165,3 +165,20 @@ def test_bert_tensor_parallel_training():
         losses[name] = [float(e.train_batch(iter([batch])))
                         for _ in range(3)]
     np.testing.assert_allclose(losses["tp"], losses["dp"], rtol=1e-4)
+
+
+def test_hash_dropout_statistics():
+    """The counter-hash dropout keeps ~keep_prob of elements, scales by
+    1/keep, and is deterministic per key."""
+    from deepspeed_tpu.ops.functional import dropout
+    x = jnp.ones((512, 512), jnp.float32)
+    key = jax.random.PRNGKey(3)
+    y1 = np.asarray(dropout(x, 0.3, key, False))
+    y2 = np.asarray(dropout(x, 0.3, key, False))
+    np.testing.assert_array_equal(y1, y2)
+    kept = (y1 != 0).mean()
+    assert abs(kept - 0.7) < 0.01
+    np.testing.assert_allclose(y1[y1 != 0], 1.0 / 0.7, rtol=1e-6)
+    # different key -> different mask
+    y3 = np.asarray(dropout(x, 0.3, jax.random.PRNGKey(4), False))
+    assert (y1 != y3).any()
