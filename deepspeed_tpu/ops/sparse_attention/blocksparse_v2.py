@@ -84,14 +84,15 @@ def _stream_wait(refs_bufs_sems, cols_ref, base, i, block):
 # forward: one program per block row
 # --------------------------------------------------------------------- #
 def _v2_fwd_kernel(rows_ref, offs_ref, cnts_ref, cols_ref,
-                   q_ref, k_hbm, v_hbm, kpm_ref, o_ref, lse_ref,
-                   kbuf, vbuf, ksem, vsem, *, sm_scale, block):
+                   q_ref, k_hbm, v_hbm, kpm_hbm, o_ref, lse_ref,
+                   kbuf, vbuf, mbuf, ksem, vsem, msem, *, sm_scale, block):
     r = pl.program_id(1)
     n = cnts_ref[r]
     base = offs_ref[r]
     q = q_ref[0]                                       # (block, D)
     d = q.shape[-1]
-    streams = ((k_hbm, kbuf, ksem), (v_hbm, vbuf, vsem))
+    streams = ((k_hbm, kbuf, ksem), (v_hbm, vbuf, vsem),
+               (kpm_hbm, mbuf, msem))
 
     @pl.when(n > 0)
     def _prologue():
@@ -104,11 +105,11 @@ def _v2_fwd_kernel(rows_ref, offs_ref, cnts_ref, cols_ref,
         def _prefetch_next():
             _stream_start(streams, cols_ref, base, i + 1, block)
 
-        c, (k, v) = _stream_wait(streams, cols_ref, base, i, block)
+        c, (k, v, kpm) = _stream_wait(streams, cols_ref, base, i, block)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s = s * sm_scale
-        s += kpm_ref[0, c, 0, :][None, :]
+        s += kpm[:, 0][None, :]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         p = jnp.where(s > VALID_THRESH, jnp.exp(s - m_new[:, None]), 0.0)
         alpha = jnp.exp(m - m_new)
@@ -131,8 +132,9 @@ def _v2_fwd_kernel(rows_ref, offs_ref, cnts_ref, cols_ref,
 # dq: same row-run walk
 # --------------------------------------------------------------------- #
 def _v2_dq_kernel(rows_ref, offs_ref, cnts_ref, cols_ref,
-                  q_ref, k_hbm, v_hbm, kpm_ref, do_ref, lse_ref, delta_ref,
-                  dq_ref, kbuf, vbuf, ksem, vsem, *, sm_scale, block):
+                  q_ref, k_hbm, v_hbm, kpm_hbm, do_ref, lse_ref, delta_ref,
+                  dq_ref, kbuf, vbuf, mbuf, ksem, vsem, msem,
+                  *, sm_scale, block):
     r = pl.program_id(1)
     n = cnts_ref[r]
     base = offs_ref[r]
@@ -141,7 +143,8 @@ def _v2_dq_kernel(rows_ref, offs_ref, cnts_ref, cols_ref,
     lse = lse_ref[0, :, 0]
     delta = delta_ref[0, :, 0]
     d = q.shape[-1]
-    streams = ((k_hbm, kbuf, ksem), (v_hbm, vbuf, vsem))
+    streams = ((k_hbm, kbuf, ksem), (v_hbm, vbuf, vsem),
+               (kpm_hbm, mbuf, msem))
 
     @pl.when(n > 0)
     def _prologue():
@@ -152,11 +155,11 @@ def _v2_dq_kernel(rows_ref, offs_ref, cnts_ref, cols_ref,
         def _prefetch_next():
             _stream_start(streams, cols_ref, base, i + 1, block)
 
-        c, (k, v) = _stream_wait(streams, cols_ref, base, i, block)
+        c, (k, v, kpm) = _stream_wait(streams, cols_ref, base, i, block)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s = s * sm_scale
-        s += kpm_ref[0, c, 0, :][None, :]
+        s += kpm[:, 0][None, :]
         p = jnp.where(s > VALID_THRESH, jnp.exp(s - lse[:, None]), 0.0)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -266,6 +269,7 @@ def build_v2_impls(layout: np.ndarray, block: int, sm_scale: float,
         qr = q.reshape(B * H, S, D)
         kr = k.reshape(B * H, S, D)
         vr = v.reshape(B * H, S, D)
+        kpmr = kpm.reshape(B, S, 1)    # (B, nk, 1, block) -> DMA-sliceable
         kernel = functools.partial(_v2_fwd_kernel, sm_scale=sm_scale,
                                    block=block)
         grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -283,8 +287,8 @@ def build_v2_impls(layout: np.ndarray, block: int, sm_scale: float,
                              lambda i, r, rw, *_: (i * H + rw[r] // nq,
                                                    0, 0),
                              memory_space=pl.ANY),
-                pl.BlockSpec((1, nk, 1, block),
-                             lambda i, r, *_: (i, 0, 0, 0)),
+                pl.BlockSpec((1, S, 1), lambda i, r, *_: (i, 0, 0),
+                             memory_space=pl.ANY),
             ],
             out_specs=[
                 pl.BlockSpec((1, block, D),
@@ -297,6 +301,8 @@ def build_v2_impls(layout: np.ndarray, block: int, sm_scale: float,
             scratch_shapes=[
                 pltpu.VMEM((2, block, D), k.dtype),
                 pltpu.VMEM((2, block, D), v.dtype),
+                pltpu.VMEM((2, block, 1), jnp.float32),
+                pltpu.SemaphoreType.DMA((2,)),
                 pltpu.SemaphoreType.DMA((2,)),
                 pltpu.SemaphoreType.DMA((2,)),
             ])
@@ -309,7 +315,7 @@ def build_v2_impls(layout: np.ndarray, block: int, sm_scale: float,
             ],
             interpret=interpret,
             compiler_params=compiler_params,
-        )(*(jnp.asarray(x) for x in rr), qr, kr, vr, kpm)
+        )(*(jnp.asarray(x) for x in rr), qr, kr, vr, kpmr)
         return o.reshape(B, H, S, D), lse
 
     def bwd_impl(q, k, v, kpm, am, o, lse, g):
@@ -319,6 +325,7 @@ def build_v2_impls(layout: np.ndarray, block: int, sm_scale: float,
         kr = k.reshape(B * H, S, D)
         vr = v.reshape(B * H, S, D)
         dor = g.reshape(B * H, S, D)
+        kpmr = kpm.reshape(B, S, 1)
         delta = jnp.sum(dor.astype(jnp.float32) *
                         o.reshape(B * H, S, D).astype(jnp.float32),
                         axis=-1, keepdims=True)           # (B*H, S, 1)
@@ -341,8 +348,8 @@ def build_v2_impls(layout: np.ndarray, block: int, sm_scale: float,
                              lambda i, r, rw, *_: (i * H + rw[r] // nq,
                                                    0, 0),
                              memory_space=pl.ANY),
-                pl.BlockSpec((1, nk, 1, block),
-                             lambda i, r, *_: (i, 0, 0, 0)),
+                pl.BlockSpec((1, S, 1), lambda i, r, *_: (i, 0, 0),
+                             memory_space=pl.ANY),
                 pl.BlockSpec((1, block, D),
                              lambda i, r, rw, *_: (i * H + rw[r] // nq,
                                                    rw[r] % nq, 0)),
@@ -359,6 +366,8 @@ def build_v2_impls(layout: np.ndarray, block: int, sm_scale: float,
             scratch_shapes=[
                 pltpu.VMEM((2, block, D), k.dtype),
                 pltpu.VMEM((2, block, D), v.dtype),
+                pltpu.VMEM((2, block, 1), jnp.float32),
+                pltpu.SemaphoreType.DMA((2,)),
                 pltpu.SemaphoreType.DMA((2,)),
                 pltpu.SemaphoreType.DMA((2,)),
             ])
@@ -368,7 +377,7 @@ def build_v2_impls(layout: np.ndarray, block: int, sm_scale: float,
             out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
             interpret=interpret,
             compiler_params=compiler_params,
-        )(*(jnp.asarray(x) for x in rr), qr, kr, vr, kpm, dor, lse, delta)
+        )(*(jnp.asarray(x) for x in rr), qr, kr, vr, kpmr, dor, lse, delta)
 
         # ---- dk, dv (column runs) ----
         kernel = functools.partial(_v2_dkv_kernel, sm_scale=sm_scale,
