@@ -11,10 +11,13 @@ walks K/V blocks with running max/sum in fp32. Backward recomputes the
 score tiles (flash-style) in two passes (dq; dk+dv). All dots take bf16
 operands with fp32 accumulation (MXU fast path; fp32 converts would halve
 the MXU rate and bloat VMEM). Below STREAM_THRESHOLD the per-head K/V
-arrays are VMEM-resident; at/above it they stay in HBM and (block, D)
-tiles stream through double-buffered async-copy DMA — 2 tiles of VMEM
-per stream at any sequence length (S=16k+ trains where the resident
-design could not compile).
+arrays are VMEM-resident; at/above it they stay in HBM pre-tiled and
+TRANSPOSED as (row, n_blocks, D, block) and (D, block) tiles stream
+through double-buffered async-copy DMA — 2 tiles of VMEM per stream at
+any sequence length (S=16k+ trains where the resident design could not
+compile). Tiles are transposed because Mosaic requires DMA lane dims to
+be 128-aligned, which the block width is and head_dim often is not; the
+kernels contract the transposed tiles directly.
 
 Attention dropout runs *inside* the kernel (reference: the fused
 softmax-dropout CUDA kernels, csrc/transformer/dropout_kernels.cu +
@@ -44,6 +47,7 @@ except ImportError:  # pragma: no cover
 
 NEG_INF = -1e30
 _WARNED_IRREGULAR_FALLBACK = False
+_WARNED_IRREGULAR_STREAM = False
 
 
 # --------------------------------------------------------------------- #
@@ -143,20 +147,36 @@ def _unpack_refs(refs, has_mask, has_seed, n_out):
     return q_ref, k_ref, v_ref, mask_ref, seed_ref, outs
 
 
-def _stream_kv_start(k_ref, v_ref, kbuf, vbuf, ksem, vsem, i, block_k):
-    slot = jax.lax.rem(i, 2)
-    pltpu.make_async_copy(k_ref.at[0, pl.ds(i * block_k, block_k), :],
-                          kbuf.at[slot], ksem.at[slot]).start()
-    pltpu.make_async_copy(v_ref.at[0, pl.ds(i * block_k, block_k), :],
-                          vbuf.at[slot], vsem.at[slot]).start()
+def _stream_layout(x, block):
+    # the one place that defines the streamed-operand HBM layout the
+    # kernel-side DMA (_stream_kv_start) depends on:
+    # (rows, S, D) -> (rows, n_blocks, D, block), transposed per block
+    rows, s, d = x.shape
+    return x.reshape(rows, s // block, block, d).swapaxes(2, 3)
 
 
-def _stream_kv_wait(k_ref, v_ref, kbuf, vbuf, ksem, vsem, i, block_k):
+def _stream_kv_start(k_ref, v_ref, kbuf, vbuf, ksem, vsem, i, row):
+    # k_ref/v_ref are FULL (b*h, n_blocks, D, block) arrays pinned to HBM,
+    # stored TRANSPOSED per block. TPU Pallas requires non-VMEM refs
+    # unblocked (trivial index map), so the program's row is selected here
+    # in the DMA, not via BlockSpec; and Mosaic requires every DMA slice
+    # lane dim to be a multiple of 128 — head_dim 64 can never be the lane
+    # dim of a streamed tile, but the 128/256/512-wide block can. The
+    # kernels contract against the transposed tiles directly (the MXU
+    # takes either operand orientation).
     slot = jax.lax.rem(i, 2)
-    pltpu.make_async_copy(k_ref.at[0, pl.ds(i * block_k, block_k), :],
-                          kbuf.at[slot], ksem.at[slot]).wait()
-    pltpu.make_async_copy(v_ref.at[0, pl.ds(i * block_k, block_k), :],
-                          vbuf.at[slot], vsem.at[slot]).wait()
+    pltpu.make_async_copy(k_ref.at[row, i], kbuf.at[slot],
+                          ksem.at[slot]).start()
+    pltpu.make_async_copy(v_ref.at[row, i], vbuf.at[slot],
+                          vsem.at[slot]).start()
+
+
+def _stream_kv_wait(k_ref, v_ref, kbuf, vbuf, ksem, vsem, i, row):
+    slot = jax.lax.rem(i, 2)
+    pltpu.make_async_copy(k_ref.at[row, i], kbuf.at[slot],
+                          ksem.at[slot]).wait()
+    pltpu.make_async_copy(v_ref.at[row, i], vbuf.at[slot],
+                          vsem.at[slot]).wait()
     return kbuf[slot], vbuf[slot]
 
 
@@ -184,8 +204,7 @@ def _fwd_kernel(*refs, sm_scale, block_k, causal, seq_k, block_q,
     if stream:
         @pl.when(num_kb > 0)
         def _prologue():
-            _stream_kv_start(k_ref, v_ref, kbuf, vbuf, ksem, vsem, 0,
-                             block_k)
+            _stream_kv_start(k_ref, v_ref, kbuf, vbuf, ksem, vsem, 0, bh)
 
     def body(i, carry):
         m, l, acc = carry
@@ -193,14 +212,16 @@ def _fwd_kernel(*refs, sm_scale, block_k, causal, seq_k, block_q,
             @pl.when(i + 1 < num_kb)
             def _prefetch_next():
                 _stream_kv_start(k_ref, v_ref, kbuf, vbuf, ksem, vsem,
-                                 i + 1, block_k)
+                                 i + 1, bh)
+            # streamed tiles arrive transposed: k, v are (D, block)
             k, v = _stream_kv_wait(k_ref, v_ref, kbuf, vbuf, ksem, vsem,
-                                   i, block_k)
+                                   i, bh)
         else:
             k = k_ref[0, pl.ds(i * block_k, block_k), :]
             v = v_ref[0, pl.ds(i * block_k, block_k), :]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (0 if stream else 1,)), ((), ())),
+            preferred_element_type=jnp.float32)
         s = s * sm_scale
         if mask_ref is not None:
             s += mask_ref[0, 0, pl.ds(i * block_k, block_k)][None, :]
@@ -220,7 +241,7 @@ def _fwd_kernel(*refs, sm_scale, block_k, causal, seq_k, block_q,
                                      seq_k, dropout_rate)
             p = jnp.where(keep, p, 0.0)
         acc_new = acc * alpha[:, None] + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (1 if stream else 0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
@@ -259,22 +280,23 @@ def _bwd_dq_kernel(*refs, sm_scale, block_k, causal, seq_k, block_q,
     if stream:
         @pl.when(num_kb > 0)
         def _prologue():
-            _stream_kv_start(k_ref, v_ref, kbuf, vbuf, ksem, vsem, 0,
-                             block_k)
+            _stream_kv_start(k_ref, v_ref, kbuf, vbuf, ksem, vsem, 0, bh)
 
     def body(i, dq):
         if stream:
             @pl.when(i + 1 < num_kb)
             def _prefetch_next():
                 _stream_kv_start(k_ref, v_ref, kbuf, vbuf, ksem, vsem,
-                                 i + 1, block_k)
+                                 i + 1, bh)
+            # streamed tiles arrive transposed: k, v are (D, block)
             k, v = _stream_kv_wait(k_ref, v_ref, kbuf, vbuf, ksem, vsem,
-                                   i, block_k)
+                                   i, bh)
         else:
             k = k_ref[0, pl.ds(i * block_k, block_k), :]
             v = v_ref[0, pl.ds(i * block_k, block_k), :]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (0 if stream else 1,)), ((), ())),
+            preferred_element_type=jnp.float32)
         s = s * sm_scale
         if mask_ref is not None:
             s += mask_ref[0, 0, pl.ds(i * block_k, block_k)][None, :]
@@ -284,15 +306,16 @@ def _bwd_dq_kernel(*refs, sm_scale, block_k, causal, seq_k, block_q,
         if causal:
             s = jnp.where(q_idx >= k_idx, s, NEG_INF)
         p = jnp.exp(s - lse[:, None])                      # (bq, bk)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (0 if stream else 1,)), ((), ())),
+            preferred_element_type=jnp.float32)
         if dropout_rate > 0.0:
             keep = dropout_keep_mask(seed_ref[0, 0], bh, q_idx, k_idx,
                                      seq_k, dropout_rate)
             dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_rate)), 0.0)
         ds = p * (dp - delta[:, None])
         return dq + jax.lax.dot_general(
-            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (1 if stream else 0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     dq = jax.lax.fori_loop(0, num_kb, body, jnp.zeros((block_q, d),
@@ -324,7 +347,7 @@ def _bwd_dkv_kernel(*refs, sm_scale, block_q, causal, seq_q, seq_k, block_k,
         @pl.when(num_qb > first_qb)
         def _prologue():
             _stream_kv_start(q_ref, do_ref, qbuf, dobuf, qsem, dosem,
-                             first_qb, block_q)
+                             first_qb, bh)
 
     def body(i, carry):
         dk, dv = carry
@@ -332,16 +355,18 @@ def _bwd_dkv_kernel(*refs, sm_scale, block_q, causal, seq_q, seq_k, block_k,
             @pl.when(i + 1 < num_qb)
             def _prefetch_next():
                 _stream_kv_start(q_ref, do_ref, qbuf, dobuf, qsem, dosem,
-                                 i + 1, block_q)
+                                 i + 1, bh)
+            # streamed tiles arrive transposed: q, do are (D, block_q)
             q, do = _stream_kv_wait(q_ref, do_ref, qbuf, dobuf, qsem,
-                                    dosem, i, block_q)
+                                    dosem, i, bh)
         else:
             q = q_ref[0, pl.ds(i * block_q, block_q), :]
             do = do_ref[0, pl.ds(i * block_q, block_q), :]
         lse = lse_ref[0, pl.ds(i * block_q, block_q), 0]
         delta = delta_ref[0, pl.ds(i * block_q, block_q), 0]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((0 if stream else 1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # (bq, bk)
         s = s * sm_scale
         if mask_ref is not None:
             s += mask_ref[0, 0, pl.ds(kb * block_k, block_k)][None, :]
@@ -351,8 +376,9 @@ def _bwd_dkv_kernel(*refs, sm_scale, block_q, causal, seq_q, seq_k, block_k,
         if causal:
             s = jnp.where(q_idx >= k_idx, s, NEG_INF)
         p = jnp.exp(s - lse[:, None])                      # (bq, bk)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((0 if stream else 1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # (bq, bk)
         if dropout_rate > 0.0:
             keep = dropout_keep_mask(seed_ref[0, 0], bh, q_idx, k_idx,
                                      seq_k, dropout_rate)
@@ -362,12 +388,14 @@ def _bwd_dkv_kernel(*refs, sm_scale, block_q, causal, seq_q, seq_k, block_k,
         else:
             pd = p
         dv_new = dv + jax.lax.dot_general(
-            pd.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            pd.astype(do.dtype), do,
+            (((0,), (1 if stream else 0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # (bk, D)
         ds = p * (dp - delta[:, None])
         dk_new = dk + jax.lax.dot_general(
-            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            ds.astype(q.dtype), q,
+            (((0,), (1 if stream else 0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # (bk, D)
         return dk_new, dv_new
 
     dk0 = jnp.zeros((k.shape[0], d), jnp.float32)
@@ -398,6 +426,25 @@ STREAM_THRESHOLD = 8192
 
 
 def _use_stream(seq_q, seq_k):
+    # streamed tiles put the block width in the DMA lane dim, which Mosaic
+    # requires to be a multiple of 128 — both seqs must 128-divide so
+    # _largest_divisor_block picks 128/256/512 blocks; irregular long
+    # sequences stay on the resident path with tiny blocks (much slower,
+    # and may exceed scoped VMEM at S>=16k — flash_attention warns)
+    if seq_q % 128 != 0 or seq_k % 128 != 0:
+        if max(seq_q, seq_k) >= STREAM_THRESHOLD:
+            global _WARNED_IRREGULAR_STREAM
+            if not _WARNED_IRREGULAR_STREAM:
+                _WARNED_IRREGULAR_STREAM = True
+                import warnings
+                warnings.warn(
+                    f"flash_attention: seq ({seq_q}, {seq_k}) >= "
+                    f"{STREAM_THRESHOLD} but not divisible by 128 — the "
+                    "DMA-streaming kernel needs 128-multiple sequences, "
+                    "so K/V stay VMEM-resident with small blocks (slow, "
+                    "and may fail to compile at S>=16k). Pad the "
+                    "sequence to a multiple of 128.", stacklevel=3)
+        return False
     return max(seq_q, seq_k) >= STREAM_THRESHOLD
 
 
@@ -440,10 +487,16 @@ def _flash_fwd(q, k, v, mask, causal, sm_scale, interpret,
                                causal=causal, seq_k=sk, block_q=bq,
                                has_mask=mask is not None,
                                dropout_rate=dropout_rate, stream=stream)
-    kv_space = pl.ANY if stream else None
-    kv_spec = (pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0),
-                            memory_space=pl.ANY) if stream else
-               pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)))
+    if stream:
+        # streamed operands live unblocked in HBM pre-tiled TRANSPOSED
+        # to (row, n_blocks, D, block) so each DMA moves whole trailing
+        # (D, block) tiles — non-VMEM refs need a trivial index map, and
+        # a partial slice of the lane-padded D dim would be illegal
+        kr = _stream_layout(kr, bk)
+        vr = _stream_layout(vr, bk)
+        kv_spec = pl.BlockSpec(memory_space=pltpu.HBM)
+    else:
+        kv_spec = pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0))
     in_specs = [
         pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
         kv_spec,
@@ -471,15 +524,19 @@ def _flash_fwd(q, k, v, mask, causal, sm_scale, interpret,
     scratch_shapes = []
     if stream:
         scratch_shapes = [
-            pltpu.VMEM((2, bk, d), k.dtype),
-            pltpu.VMEM((2, bk, d), v.dtype),
+            pltpu.VMEM((2, d, bk), k.dtype),
+            pltpu.VMEM((2, d, bk), v.dtype),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
         ]
     compiler_params = None
     if pltpu is not None and not interpret:
         compiler_params = pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary"))
+            dimension_semantics=("parallel", "arbitrary"),
+            # streaming: XLA stack-allocates one full blocked operand in
+            # VMEM at S>=16k; the 16MB default cap is a compiler soft
+            # limit, v5e VMEM is 128MB (observed: S=16k bwd needs 33MB)
+            **({"vmem_limit_bytes": 100 * 1024 * 1024} if stream else {}))
     o, lse = pl.pallas_call(
         kernel,
         grid=(b * h, sq // bq),
@@ -522,15 +579,17 @@ def _flash_bwd(res, g, causal, sm_scale, interpret,
                                causal=causal, seq_k=sk, block_q=bq,
                                has_mask=mask is not None,
                                dropout_rate=dropout_rate, stream=stream)
-    kv_spec = (pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0),
-                            memory_space=pl.ANY) if stream else
-               pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)))
+    if stream:
+        kv_spec = pl.BlockSpec(memory_space=pltpu.HBM)
+        args = [qr, _stream_layout(kr, bk), _stream_layout(vr, bk)]
+    else:
+        kv_spec = pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0))
+        args = list(common)
     in_specs = [
         pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),   # q
         kv_spec,                                            # k
         kv_spec,                                            # v
     ]
-    args = list(common)
     if mask is not None:
         in_specs.append(pl.BlockSpec((1, 1, sk), lambda i, j: (i // h, 0, 0)))
         args.append(maskr)
@@ -546,15 +605,19 @@ def _flash_bwd(res, g, causal, sm_scale, interpret,
     scratch_shapes = []
     if stream:
         scratch_shapes = [
-            pltpu.VMEM((2, bk, d), k.dtype),
-            pltpu.VMEM((2, bk, d), v.dtype),
+            pltpu.VMEM((2, d, bk), k.dtype),
+            pltpu.VMEM((2, d, bk), v.dtype),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
         ]
     compiler_params = None
     if pltpu is not None and not interpret:
         compiler_params = pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary"))
+            dimension_semantics=("parallel", "arbitrary"),
+            # streaming: XLA stack-allocates one full blocked operand in
+            # VMEM at S>=16k; the 16MB default cap is a compiler soft
+            # limit, v5e VMEM is 128MB (observed: S=16k bwd needs 33MB)
+            **({"vmem_limit_bytes": 100 * 1024 * 1024} if stream else {}))
     dq = pl.pallas_call(
         kernel,
         grid=(b * h, sq // bq),
@@ -571,15 +634,19 @@ def _flash_bwd(res, g, causal, sm_scale, interpret,
                                causal=causal, seq_q=sq, seq_k=sk, block_k=bk,
                                has_mask=mask is not None,
                                dropout_rate=dropout_rate, stream=stream)
-    q_spec = (pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0),
-                           memory_space=pl.ANY) if stream else
-              pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)))
+    if stream:
+        q_spec = pl.BlockSpec(memory_space=pltpu.HBM)
+        qr_s = _stream_layout(qr, bq)
+        dor_s = _stream_layout(dor, bq)
+        args = [qr_s, kr, vr]
+    else:
+        q_spec = pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0))
+        args = list(common)
     in_specs = [
         q_spec,                                             # q (full)
         pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),   # k block
         pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),   # v block
     ]
-    args = list(common)
     if mask is not None:
         in_specs.append(pl.BlockSpec((1, 1, sk), lambda i, j: (i // h, 0, 0)))
         args.append(maskr)
@@ -591,12 +658,12 @@ def _flash_bwd(res, g, causal, sm_scale, interpret,
         pl.BlockSpec((1, sq, 1), lambda i, j: (i, 0, 0)),   # lse (full)
         pl.BlockSpec((1, sq, 1), lambda i, j: (i, 0, 0)),   # delta (full)
     ]
-    args += [dor, lser, deltar]
+    args += [dor_s if stream else dor, lser, deltar]
     scratch_shapes = []
     if stream:
         scratch_shapes = [
-            pltpu.VMEM((2, bq, d), q.dtype),
-            pltpu.VMEM((2, bq, d), do.dtype),
+            pltpu.VMEM((2, d, bq), q.dtype),
+            pltpu.VMEM((2, d, bq), do.dtype),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
         ]

@@ -291,15 +291,24 @@ def test_flash_block_policy_scales_with_seq():
     assert _pick_blocks(1024, 1024) == (512, 512)
     assert not _use_stream(4096, 4096)
     assert _use_stream(8192, 8192)
+    # streamed tiles put the block width in the DMA lane dim (must be a
+    # 128-multiple): irregular long seqs stay resident
+    assert not _use_stream(8192 + 16, 8192 + 16)
     assert _pick_blocks(8192, 8192) == (512, 512)
     assert _pick_blocks(32768, 32768) == (512, 512)
 
 
-@pytest.mark.parametrize("S,causal", [(64, True), (96, True), (96, False)])
+@pytest.mark.parametrize("S,causal",
+                         [(128, True), (384, True), (384, False)])
 def test_flash_streaming_matches_resident(S, causal):
     """Force streaming at a small S: outputs and grads must match the
-    resident path (same math, different K/V residency). S=96 uses
-    32-blocks -> 3-deep DMA loops incl. the causal ragged bounds."""
+    resident path. S=384 uses 128-blocks -> 3-deep DMA loops incl. the
+    causal ragged bounds (streaming requires 128-multiple seqs: the block
+    width is the DMA lane dim). Streamed tiles are stored transposed (D, block)
+    — Mosaic requires DMA lane dims to be 128-aligned, which head_dim 64
+    never is — so the dots contract in a different order than the
+    resident path: allow a few-ulp fp32 reassociation tolerance (a real
+    indexing bug shows up as O(1) diffs, not 1e-6)."""
     from deepspeed_tpu.ops.attention import flash as F
     key = jax.random.PRNGKey(0)
     q, k, v = (jax.random.normal(jax.random.fold_in(key, i),
@@ -319,7 +328,7 @@ def test_flash_streaming_matches_resident(S, causal):
         F.STREAM_THRESHOLD = old
     for a, b in zip(g_res, g_str):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=1e-6, atol=1e-6)
+                                   rtol=1e-5, atol=1e-5)
 
 
 class TestTransformerLayerGrid:
