@@ -425,6 +425,17 @@ def _largest_divisor_block(seq, cap=512):
 STREAM_THRESHOLD = 8192
 
 
+def _compiler_params(interpret, stream):
+    if pltpu is None or interpret:
+        return None
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "arbitrary"),
+        # streaming: XLA stack-allocates one full blocked operand in VMEM
+        # at S>=16k; the 16MB default cap is a compiler soft limit, v5e
+        # VMEM is 128MB (observed: S=16k bwd needs 33MB)
+        **({"vmem_limit_bytes": 100 * 1024 * 1024} if stream else {}))
+
+
 def _use_stream(seq_q, seq_k):
     # streamed tiles put the block width in the DMA lane dim, which Mosaic
     # requires to be a multiple of 128 — both seqs must 128-divide so
@@ -529,14 +540,7 @@ def _flash_fwd(q, k, v, mask, causal, sm_scale, interpret,
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
         ]
-    compiler_params = None
-    if pltpu is not None and not interpret:
-        compiler_params = pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary"),
-            # streaming: XLA stack-allocates one full blocked operand in
-            # VMEM at S>=16k; the 16MB default cap is a compiler soft
-            # limit, v5e VMEM is 128MB (observed: S=16k bwd needs 33MB)
-            **({"vmem_limit_bytes": 100 * 1024 * 1024} if stream else {}))
+    compiler_params = _compiler_params(interpret, stream)
     o, lse = pl.pallas_call(
         kernel,
         grid=(b * h, sq // bq),
@@ -610,14 +614,7 @@ def _flash_bwd(res, g, causal, sm_scale, interpret,
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
         ]
-    compiler_params = None
-    if pltpu is not None and not interpret:
-        compiler_params = pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary"),
-            # streaming: XLA stack-allocates one full blocked operand in
-            # VMEM at S>=16k; the 16MB default cap is a compiler soft
-            # limit, v5e VMEM is 128MB (observed: S=16k bwd needs 33MB)
-            **({"vmem_limit_bytes": 100 * 1024 * 1024} if stream else {}))
+    compiler_params = _compiler_params(interpret, stream)
     dq = pl.pallas_call(
         kernel,
         grid=(b * h, sq // bq),

@@ -336,9 +336,12 @@ def _sparse_attention_fn(layout: np.ndarray, block: int, sm_scale: float,
         return _FN_CACHE[key]
 
     H, nq, nk = layout.shape
-    if not has_am and USE_SPLASH_V2:
+    if not has_am and USE_SPLASH_V2 and (interpret or block % 128 == 0):
         # row-run kernels: one program per block row, K/V streamed by
-        # DMA (blocksparse_v2.py) — ~row-degree x fewer program launches
+        # DMA (blocksparse_v2.py) — ~row-degree x fewer program launches.
+        # Compiled mode needs 128-multiple blocks: the streamed (D, block)
+        # tile puts the block width in the DMA lane dim, which Mosaic
+        # requires to be 128-aligned; smaller blocks use the v1 kernels
         from deepspeed_tpu.ops.sparse_attention.blocksparse_v2 import (
             build_v2_impls)
         fwd2, bwd2 = build_v2_impls(layout, block, sm_scale, interpret)

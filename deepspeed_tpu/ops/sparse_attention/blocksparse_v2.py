@@ -7,14 +7,20 @@ per-program launch overhead dominates and the kernel loses to dense
 flash despite doing ~1/3 the FLOPs.
 
 v2 launches one program per nonzero block-ROW and walks the row's
-column blocks with an inner ``fori_loop``; K/V stay in HBM
-(``memory_space=ANY``) and each (block, D) tile is fetched by a
-double-buffered ``pltpu.make_async_copy`` DMA driven by a
-scalar-prefetched CSR column list — program count drops by the average
-row degree (~10x), the online-softmax state lives in loop registers
-(no cross-program scratch carry), and VMEM holds only 2 tiles per
-stream regardless of S. The dkv pass mirrors it column-major with CSC
-metadata (q/do streamed, k/v resident).
+column blocks with an inner ``fori_loop``. K/V stay in HBM, pre-tiled
+and TRANSPOSED as (rows, n_blocks, D, block) — Mosaic requires manual
+DMA slices to be lane-128-aligned, which the 128+-wide block is and
+head_dim often is not — and each (D, block) tile is fetched by a
+double-buffered ``pltpu.make_async_copy`` driven by a scalar-prefetched
+CSR column list (the program's row is selected inside the DMA: non-VMEM
+refs must be unblocked with a trivial index map). Program count drops by
+the average row degree (~10x), the online-softmax state lives in loop
+registers, and VMEM holds 2 tiles per stream regardless of S. Small
+per-row vectors (key-padding mask, lse, delta) are NOT DMA-streamed —
+their (block, 1) tiles can never be lane-aligned — they ride as
+VMEM-resident (1, 1, S) blocked refs (≤256KB at S=16k) sliced in-kernel
+at 128-aligned offsets. The dkv pass mirrors the walk column-major with
+CSC metadata (q/do streamed transposed, k/v resident).
 
 Same math as v1 (bf16 MXU operands / fp32 accumulation, scale post-dot,
 exact-zero structurally-masked probabilities); used for the
@@ -28,6 +34,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
+
+from deepspeed_tpu.ops.attention.flash import _stream_layout
 
 try:
     from jax.experimental.pallas import tpu as pltpu
@@ -57,25 +65,25 @@ def build_row_runs(layout: np.ndarray) -> Tuple[np.ndarray, ...]:
             np.asarray(cols if cols else [0], np.int32))
 
 
-def _dma(src_hbm, c, block, buf, slot, sem):
-    return pltpu.make_async_copy(
-        src_hbm.at[0, pl.ds(c * block, block), :], buf.at[slot],
-        sem.at[slot])
+def _dma(src_hbm, c, row, buf, slot, sem):
+    # src_hbm: full (rows, n_blocks, D, block) in HBM; whole-tile copy
+    return pltpu.make_async_copy(src_hbm.at[row, c], buf.at[slot],
+                                 sem.at[slot])
 
 
-def _stream_start(refs_bufs_sems, cols_ref, base, i, block):
+def _stream_start(refs_bufs_sems, cols_ref, base, i, row):
     c = cols_ref[base + i]
     slot = jax.lax.rem(i, 2)
     for src, buf, sem in refs_bufs_sems:
-        _dma(src, c, block, buf, slot, sem).start()
+        _dma(src, c, row, buf, slot, sem).start()
 
 
-def _stream_wait(refs_bufs_sems, cols_ref, base, i, block):
+def _stream_wait(refs_bufs_sems, cols_ref, base, i, row):
     c = cols_ref[base + i]
     slot = jax.lax.rem(i, 2)
     out = []
     for src, buf, sem in refs_bufs_sems:
-        _dma(src, c, block, buf, slot, sem).wait()
+        _dma(src, c, row, buf, slot, sem).wait()
         out.append(buf[slot])
     return c, out
 
@@ -84,38 +92,39 @@ def _stream_wait(refs_bufs_sems, cols_ref, base, i, block):
 # forward: one program per block row
 # --------------------------------------------------------------------- #
 def _v2_fwd_kernel(rows_ref, offs_ref, cnts_ref, cols_ref,
-                   q_ref, k_hbm, v_hbm, kpm_hbm, o_ref, lse_ref,
-                   kbuf, vbuf, mbuf, ksem, vsem, msem, *, sm_scale, block):
+                   q_ref, k_hbm, v_hbm, kpm_ref, o_ref, lse_ref,
+                   kbuf, vbuf, ksem, vsem, *, sm_scale, block, heads, nq):
     r = pl.program_id(1)
     n = cnts_ref[r]
     base = offs_ref[r]
+    bh = pl.program_id(0) * heads + rows_ref[r] // nq
     q = q_ref[0]                                       # (block, D)
     d = q.shape[-1]
-    streams = ((k_hbm, kbuf, ksem), (v_hbm, vbuf, vsem),
-               (kpm_hbm, mbuf, msem))
+    streams = ((k_hbm, kbuf, ksem), (v_hbm, vbuf, vsem))
 
     @pl.when(n > 0)
     def _prologue():
-        _stream_start(streams, cols_ref, base, 0, block)
+        _stream_start(streams, cols_ref, base, 0, bh)
 
     def body(i, carry):
         m, l, acc = carry
 
         @pl.when(i + 1 < n)
         def _prefetch_next():
-            _stream_start(streams, cols_ref, base, i + 1, block)
+            _stream_start(streams, cols_ref, base, i + 1, bh)
 
-        c, (k, v, kpm) = _stream_wait(streams, cols_ref, base, i, block)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+        # streamed tiles arrive transposed: k, v are (D, block)
+        c, (k, v) = _stream_wait(streams, cols_ref, base, i, bh)
+        s = jax.lax.dot_general(q, k, (((1,), (0,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s = s * sm_scale
-        s += kpm[:, 0][None, :]
+        s += kpm_ref[0, 0, pl.ds(c * block, block)][None, :]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         p = jnp.where(s > VALID_THRESH, jnp.exp(s - m_new[:, None]), 0.0)
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1)
         acc_new = acc * alpha[:, None] + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
@@ -132,40 +141,41 @@ def _v2_fwd_kernel(rows_ref, offs_ref, cnts_ref, cols_ref,
 # dq: same row-run walk
 # --------------------------------------------------------------------- #
 def _v2_dq_kernel(rows_ref, offs_ref, cnts_ref, cols_ref,
-                  q_ref, k_hbm, v_hbm, kpm_hbm, do_ref, lse_ref, delta_ref,
-                  dq_ref, kbuf, vbuf, mbuf, ksem, vsem, msem,
-                  *, sm_scale, block):
+                  q_ref, k_hbm, v_hbm, kpm_ref, do_ref, lse_ref, delta_ref,
+                  dq_ref, kbuf, vbuf, ksem, vsem,
+                  *, sm_scale, block, heads, nq):
     r = pl.program_id(1)
     n = cnts_ref[r]
     base = offs_ref[r]
+    bh = pl.program_id(0) * heads + rows_ref[r] // nq
     q = q_ref[0]
     do = do_ref[0]
     lse = lse_ref[0, :, 0]
     delta = delta_ref[0, :, 0]
     d = q.shape[-1]
-    streams = ((k_hbm, kbuf, ksem), (v_hbm, vbuf, vsem),
-               (kpm_hbm, mbuf, msem))
+    streams = ((k_hbm, kbuf, ksem), (v_hbm, vbuf, vsem))
 
     @pl.when(n > 0)
     def _prologue():
-        _stream_start(streams, cols_ref, base, 0, block)
+        _stream_start(streams, cols_ref, base, 0, bh)
 
     def body(i, dq):
         @pl.when(i + 1 < n)
         def _prefetch_next():
-            _stream_start(streams, cols_ref, base, i + 1, block)
+            _stream_start(streams, cols_ref, base, i + 1, bh)
 
-        c, (k, v, kpm) = _stream_wait(streams, cols_ref, base, i, block)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+        # streamed tiles arrive transposed: k, v are (D, block)
+        c, (k, v) = _stream_wait(streams, cols_ref, base, i, bh)
+        s = jax.lax.dot_general(q, k, (((1,), (0,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s = s * sm_scale
-        s += kpm[:, 0][None, :]
+        s += kpm_ref[0, 0, pl.ds(c * block, block)][None, :]
         p = jnp.where(s > VALID_THRESH, jnp.exp(s - lse[:, None]), 0.0)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+        dp = jax.lax.dot_general(do, v, (((1,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None])
         return dq + jax.lax.dot_general(
-            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     dq = jax.lax.fori_loop(0, n, body, jnp.zeros((block, d), jnp.float32))
@@ -176,68 +186,48 @@ def _v2_dq_kernel(rows_ref, offs_ref, cnts_ref, cols_ref,
 # dk/dv: one program per block column, streaming q/do
 # --------------------------------------------------------------------- #
 def _v2_dkv_kernel(crows_ref, coffs_ref, ccnts_ref, crowids_ref,
-                   k_ref, v_ref, kpm_ref, q_hbm, do_hbm, lse_hbm, delta_hbm,
-                   dk_ref, dv_ref, qbuf, dobuf, ldbuf, qsem, dosem, ldsem,
-                   *, sm_scale, block):
+                   k_ref, v_ref, kpm_ref, q_hbm, do_hbm, lse_ref, delta_ref,
+                   dk_ref, dv_ref, qbuf, dobuf, qsem, dosem,
+                   *, sm_scale, block, heads, nk):
     t = pl.program_id(1)
     n = ccnts_ref[t]
     base = coffs_ref[t]
+    bh = pl.program_id(0) * heads + crows_ref[t] // nk
     k = k_ref[0]                                       # (block, D)
     v = v_ref[0]
     d = k.shape[-1]
     kpm_row = kpm_ref[0, 0, 0, :]                      # this col's mask
     streams = ((q_hbm, qbuf, qsem), (do_hbm, dobuf, dosem))
 
-    def start_ld(i, slot):
-        rq = crowids_ref[base + i]
-        pltpu.make_async_copy(
-            lse_hbm.at[0, pl.ds(rq * block, block), :],
-            ldbuf.at[slot, 0], ldsem.at[slot, 0]).start()
-        pltpu.make_async_copy(
-            delta_hbm.at[0, pl.ds(rq * block, block), :],
-            ldbuf.at[slot, 1], ldsem.at[slot, 1]).start()
-
-    def wait_ld(i, slot):
-        rq = crowids_ref[base + i]
-        pltpu.make_async_copy(
-            lse_hbm.at[0, pl.ds(rq * block, block), :],
-            ldbuf.at[slot, 0], ldsem.at[slot, 0]).wait()
-        pltpu.make_async_copy(
-            delta_hbm.at[0, pl.ds(rq * block, block), :],
-            ldbuf.at[slot, 1], ldsem.at[slot, 1]).wait()
-
     @pl.when(n > 0)
     def _prologue():
-        _stream_start(streams, crowids_ref, base, 0, block)
-        start_ld(0, 0)
+        _stream_start(streams, crowids_ref, base, 0, bh)
 
     def body(i, carry):
         dk, dv = carry
-        slot = jax.lax.rem(i, 2)
 
         @pl.when(i + 1 < n)
         def _prefetch_next():
-            _stream_start(streams, crowids_ref, base, i + 1, block)
-            start_ld(i + 1, jax.lax.rem(i + 1, 2))
+            _stream_start(streams, crowids_ref, base, i + 1, bh)
 
-        _, (q, do) = _stream_wait(streams, crowids_ref, base, i, block)
-        wait_ld(i, slot)
-        lse = ldbuf[slot, 0, :, 0]
-        delta = ldbuf[slot, 1, :, 0]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+        # streamed tiles arrive transposed: q, do are (D, block)
+        rq, (q, do) = _stream_wait(streams, crowids_ref, base, i, bh)
+        lse = lse_ref[0, 0, pl.ds(rq * block, block)]
+        delta = delta_ref[0, 0, pl.ds(rq * block, block)]
+        s = jax.lax.dot_general(q, k, (((0,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        s = s * sm_scale
+        s = s * sm_scale                               # (bq, bk)
         s += kpm_row[None, :]
         p = jnp.where(s > VALID_THRESH, jnp.exp(s - lse[:, None]), 0.0)
         dv_new = dv + jax.lax.dot_general(
-            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # (bk, D)
+        dp = jax.lax.dot_general(do, v, (((0,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None])
         dk_new = dk + jax.lax.dot_general(
-            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            ds.astype(q.dtype), q, (((0,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # (bk, D)
         return dk_new, dv_new
 
     z = jnp.zeros((block, d), jnp.float32)
@@ -261,17 +251,22 @@ def build_v2_impls(layout: np.ndarray, block: int, sm_scale: float,
     compiler_params = None
     if pltpu is not None and not interpret:
         compiler_params = pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary"))
+            dimension_semantics=("parallel", "arbitrary"),
+            # unblocked HBM operands can make XLA stack-allocate a full
+            # array in VMEM; the 16MB cap is a compiler soft limit
+            # (v5e VMEM is 128MB) — same rationale as flash streaming
+            vmem_limit_bytes=100 * 1024 * 1024)
+    hbm_spec = pl.BlockSpec(memory_space=pltpu.HBM)
 
     def fwd_impl(q, k, v, kpm, am):
         assert am is None
         B, _, S, D = q.shape
         qr = q.reshape(B * H, S, D)
-        kr = k.reshape(B * H, S, D)
-        vr = v.reshape(B * H, S, D)
-        kpmr = kpm.reshape(B, S, 1)    # (B, nk, 1, block) -> DMA-sliceable
+        kr = _stream_layout(k.reshape(B * H, S, D), block)
+        vr = _stream_layout(v.reshape(B * H, S, D), block)
+        kpmr = kpm.reshape(B, 1, S)   # VMEM-resident, sliced in-kernel
         kernel = functools.partial(_v2_fwd_kernel, sm_scale=sm_scale,
-                                   block=block)
+                                   block=block, heads=H, nq=nq)
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=4,
             grid=(B, R),
@@ -279,16 +274,9 @@ def build_v2_impls(layout: np.ndarray, block: int, sm_scale: float,
                 pl.BlockSpec((1, block, D),
                              lambda i, r, rw, *_: (i * H + rw[r] // nq,
                                                    rw[r] % nq, 0)),
-                pl.BlockSpec((1, S, D),
-                             lambda i, r, rw, *_: (i * H + rw[r] // nq,
-                                                   0, 0),
-                             memory_space=pl.ANY),
-                pl.BlockSpec((1, S, D),
-                             lambda i, r, rw, *_: (i * H + rw[r] // nq,
-                                                   0, 0),
-                             memory_space=pl.ANY),
-                pl.BlockSpec((1, S, 1), lambda i, r, *_: (i, 0, 0),
-                             memory_space=pl.ANY),
+                hbm_spec,
+                hbm_spec,
+                pl.BlockSpec((1, 1, S), lambda i, r, *_: (i, 0, 0)),
             ],
             out_specs=[
                 pl.BlockSpec((1, block, D),
@@ -299,10 +287,8 @@ def build_v2_impls(layout: np.ndarray, block: int, sm_scale: float,
                                                    rw[r] % nq, 0)),
             ],
             scratch_shapes=[
-                pltpu.VMEM((2, block, D), k.dtype),
-                pltpu.VMEM((2, block, D), v.dtype),
-                pltpu.VMEM((2, block, 1), jnp.float32),
-                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.VMEM((2, D, block), k.dtype),
+                pltpu.VMEM((2, D, block), v.dtype),
                 pltpu.SemaphoreType.DMA((2,)),
                 pltpu.SemaphoreType.DMA((2,)),
             ])
@@ -325,14 +311,14 @@ def build_v2_impls(layout: np.ndarray, block: int, sm_scale: float,
         kr = k.reshape(B * H, S, D)
         vr = v.reshape(B * H, S, D)
         dor = g.reshape(B * H, S, D)
-        kpmr = kpm.reshape(B, S, 1)
+        kpmr = kpm.reshape(B, 1, S)
         delta = jnp.sum(dor.astype(jnp.float32) *
                         o.reshape(B * H, S, D).astype(jnp.float32),
                         axis=-1, keepdims=True)           # (B*H, S, 1)
 
         # ---- dq (row runs) ----
         kernel = functools.partial(_v2_dq_kernel, sm_scale=sm_scale,
-                                   block=block)
+                                   block=block, heads=H, nq=nq)
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=4,
             grid=(B, R),
@@ -340,16 +326,9 @@ def build_v2_impls(layout: np.ndarray, block: int, sm_scale: float,
                 pl.BlockSpec((1, block, D),
                              lambda i, r, rw, *_: (i * H + rw[r] // nq,
                                                    rw[r] % nq, 0)),
-                pl.BlockSpec((1, S, D),
-                             lambda i, r, rw, *_: (i * H + rw[r] // nq,
-                                                   0, 0),
-                             memory_space=pl.ANY),
-                pl.BlockSpec((1, S, D),
-                             lambda i, r, rw, *_: (i * H + rw[r] // nq,
-                                                   0, 0),
-                             memory_space=pl.ANY),
-                pl.BlockSpec((1, S, 1), lambda i, r, *_: (i, 0, 0),
-                             memory_space=pl.ANY),
+                hbm_spec,
+                hbm_spec,
+                pl.BlockSpec((1, 1, S), lambda i, r, *_: (i, 0, 0)),
                 pl.BlockSpec((1, block, D),
                              lambda i, r, rw, *_: (i * H + rw[r] // nq,
                                                    rw[r] % nq, 0)),
@@ -364,10 +343,8 @@ def build_v2_impls(layout: np.ndarray, block: int, sm_scale: float,
                 (1, block, D),
                 lambda i, r, rw, *_: (i * H + rw[r] // nq, rw[r] % nq, 0)),
             scratch_shapes=[
-                pltpu.VMEM((2, block, D), k.dtype),
-                pltpu.VMEM((2, block, D), v.dtype),
-                pltpu.VMEM((2, block, 1), jnp.float32),
-                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.VMEM((2, D, block), k.dtype),
+                pltpu.VMEM((2, D, block), v.dtype),
                 pltpu.SemaphoreType.DMA((2,)),
                 pltpu.SemaphoreType.DMA((2,)),
             ])
@@ -377,11 +354,15 @@ def build_v2_impls(layout: np.ndarray, block: int, sm_scale: float,
             out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
             interpret=interpret,
             compiler_params=compiler_params,
-        )(*(jnp.asarray(x) for x in rr), qr, kr, vr, kpmr, dor, lse, delta)
+        )(*(jnp.asarray(x) for x in rr), qr,
+          _stream_layout(kr, block), _stream_layout(vr, block),
+          kpmr, dor, lse, delta)
 
         # ---- dk, dv (column runs) ----
         kernel = functools.partial(_v2_dkv_kernel, sm_scale=sm_scale,
-                                   block=block)
+                                   block=block, heads=H, nk=nk)
+        lser = lse.reshape(B * H, 1, S)   # VMEM-resident per program
+        deltar = delta.reshape(B * H, 1, S)
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=4,
             grid=(B, C),
@@ -394,22 +375,14 @@ def build_v2_impls(layout: np.ndarray, block: int, sm_scale: float,
                                                    cw[t] % nk, 0)),
                 pl.BlockSpec((1, 1, 1, block),
                              lambda i, t, cw, *_: (i, cw[t] % nk, 0, 0)),
-                pl.BlockSpec((1, S, D),
+                hbm_spec,
+                hbm_spec,
+                pl.BlockSpec((1, 1, S),
                              lambda i, t, cw, *_: (i * H + cw[t] // nk,
-                                                   0, 0),
-                             memory_space=pl.ANY),
-                pl.BlockSpec((1, S, D),
+                                                   0, 0)),
+                pl.BlockSpec((1, 1, S),
                              lambda i, t, cw, *_: (i * H + cw[t] // nk,
-                                                   0, 0),
-                             memory_space=pl.ANY),
-                pl.BlockSpec((1, S, 1),
-                             lambda i, t, cw, *_: (i * H + cw[t] // nk,
-                                                   0, 0),
-                             memory_space=pl.ANY),
-                pl.BlockSpec((1, S, 1),
-                             lambda i, t, cw, *_: (i * H + cw[t] // nk,
-                                                   0, 0),
-                             memory_space=pl.ANY),
+                                                   0, 0)),
             ],
             out_specs=[
                 pl.BlockSpec((1, block, D),
@@ -420,12 +393,10 @@ def build_v2_impls(layout: np.ndarray, block: int, sm_scale: float,
                                                    cw[t] % nk, 0)),
             ],
             scratch_shapes=[
-                pltpu.VMEM((2, block, D), q.dtype),
-                pltpu.VMEM((2, block, D), g.dtype),
-                pltpu.VMEM((2, 2, block, 1), jnp.float32),
+                pltpu.VMEM((2, D, block), q.dtype),
+                pltpu.VMEM((2, D, block), g.dtype),
                 pltpu.SemaphoreType.DMA((2,)),
                 pltpu.SemaphoreType.DMA((2,)),
-                pltpu.SemaphoreType.DMA((2, 2)),
             ])
         dk, dv = pl.pallas_call(
             kernel,
@@ -436,7 +407,9 @@ def build_v2_impls(layout: np.ndarray, block: int, sm_scale: float,
             ],
             interpret=interpret,
             compiler_params=compiler_params,
-        )(*(jnp.asarray(x) for x in cr), kr, vr, kpm, qr, dor, lse, delta)
+        )(*(jnp.asarray(x) for x in cr), kr, vr, kpm,
+          _stream_layout(qr, block), _stream_layout(dor, block),
+          lser, deltar)
         return (dq.reshape(q.shape), dk.reshape(k.shape),
                 dv.reshape(v.shape))
 
