@@ -150,19 +150,33 @@ def bench_sparse_attention(on_tpu, rtt):
         t_sparse = timed(sparse_loss)
         kernel = "v2"
     except Exception:
-        # first real-TPU exposure of the v2 DMA kernels — fall back to
-        # the proven per-triple kernels rather than losing the row
+        # fall back to the per-triple v1 kernels rather than losing the row
         from deepspeed_tpu.ops.sparse_attention import blocksparse as bs
         bs.USE_SPLASH_V2 = False
         bs._FN_CACHE.clear()
         t_sparse = timed(sparse_loss)
         kernel = "v1-fallback"
-    speedup = t_dense / t_sparse
+    # the reference's 6.3x headline compares sparse vs its dense O(S^2)
+    # softmax attention (sparse-attention post :28-33) — mirror that
+    # methodology (vanilla = materialized-scores jnp path), and report
+    # the tougher sparse-vs-our-own-flash ratio alongside in detail
+    def vanilla_loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True,
+                                       force_reference=True)
+                       .astype(jnp.float32))
+
+    try:
+        t_vanilla = timed(vanilla_loss)
+    except Exception:
+        t_vanilla = None               # O(S^2) buffers may not fit
+    speedup = (t_vanilla / t_sparse) if t_vanilla else t_dense / t_sparse
     _emit("sparse_attention_speedup_s8k", round(speedup, 3),
           "dense_time_over_sparse_time", round(speedup / 6.3, 4),
           {"seq": S, "heads": H, "block": block, "window_blocks": win,
-           "kernel": kernel,
-           "dense_ms": round(t_dense * 1000, 2),
+           "kernel": kernel, "baseline": "vanilla" if t_vanilla else "flash",
+           "vanilla_ms": round(t_vanilla * 1000, 2) if t_vanilla else None,
+           "flash_ms": round(t_dense * 1000, 2),
+           "vs_flash": round(t_dense / t_sparse, 3),
            "sparse_ms": round(t_sparse * 1000, 2)})
 
 

@@ -315,6 +315,7 @@ _FN_CACHE = {}
 # row-run DMA kernels (blocksparse_v2.py) for the no-attn-mask path;
 # flip off to fall back to the per-triple v1 kernels
 USE_SPLASH_V2 = True
+_WARNED_V1_BLOCK = False
 
 
 def _use_pallas():
@@ -336,6 +337,18 @@ def _sparse_attention_fn(layout: np.ndarray, block: int, sm_scale: float,
         return _FN_CACHE[key]
 
     H, nq, nk = layout.shape
+    if (not has_am and USE_SPLASH_V2 and not interpret
+            and block % 128 != 0):
+        global _WARNED_V1_BLOCK
+        if not _WARNED_V1_BLOCK:
+            _WARNED_V1_BLOCK = True
+            import warnings
+            warnings.warn(
+                f"block_sparse_attention: block={block} is not a multiple "
+                "of 128, so the fast row-run (splash v2) kernels cannot "
+                "stream it by DMA on TPU — falling back to the per-triple "
+                "v1 kernels (~row-degree x more program launches). Use "
+                "block=128 for long-sequence performance.", stacklevel=3)
     if not has_am and USE_SPLASH_V2 and (interpret or block % 128 == 0):
         # row-run kernels: one program per block row, K/V streamed by
         # DMA (blocksparse_v2.py) — ~row-degree x fewer program launches.

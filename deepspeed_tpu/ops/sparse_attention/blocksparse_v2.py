@@ -35,7 +35,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
-from deepspeed_tpu.ops.attention.flash import _stream_layout
+from deepspeed_tpu.ops.attention.flash import (_compiler_params,
+                                               _stream_layout)
 
 try:
     from jax.experimental.pallas import tpu as pltpu
@@ -248,14 +249,7 @@ def build_v2_impls(layout: np.ndarray, block: int, sm_scale: float,
     cr = build_row_runs(np.ascontiguousarray(layout.transpose(0, 2, 1)))
     R = rr[0].shape[0]
     C = cr[0].shape[0]
-    compiler_params = None
-    if pltpu is not None and not interpret:
-        compiler_params = pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary"),
-            # unblocked HBM operands can make XLA stack-allocate a full
-            # array in VMEM; the 16MB cap is a compiler soft limit
-            # (v5e VMEM is 128MB) — same rationale as flash streaming
-            vmem_limit_bytes=100 * 1024 * 1024)
+    compiler_params = _compiler_params(interpret, stream=True)
     hbm_spec = pl.BlockSpec(memory_space=pltpu.HBM)
 
     def fwd_impl(q, k, v, kpm, am):
