@@ -3,9 +3,12 @@
 Metrics (BASELINE.md rows):
 - bert_large_samples_per_s : BERT-large fused-layer training @ seq 128
   (reference: 272 samples/s on 1x V100, fastest-bert post :38-40)
-- sparse_attention_speedup_s8k : block-sparse vs dense-flash attention
-  fwd+bwd wall time @ S=8192 (reference: up to 6.3x, sparse-attention
-  post :28-33)
+- sparse_attention_speedup_s8k : block-sparse vs dense O(S^2) softmax
+  attention fwd+bwd wall time @ S=8192 — the baseline the reference's
+  6.3x claim uses (sparse-attention post :28-33); the unit string names
+  the baseline actually measured (vanilla, or flash if the O(S^2)
+  buffers don't fit), and detail.vs_flash carries the tougher
+  sparse-vs-our-own-flash ratio
 - gpt2_train_mfu_dropout : the 345M step with the realistic pretraining
   config (attn/resid/embd dropout 0.1 — exercises the in-kernel Pallas
   dropout path)
@@ -158,20 +161,27 @@ def bench_sparse_attention(on_tpu, rtt):
         kernel = "v1-fallback"
     # the reference's 6.3x headline compares sparse vs its dense O(S^2)
     # softmax attention (sparse-attention post :28-33) — mirror that
-    # methodology (vanilla = materialized-scores jnp path), and report
-    # the tougher sparse-vs-our-own-flash ratio alongside in detail
+    # methodology with a bf16 materialized-scores path (the reference's
+    # dense kernels are fp16; bf16 keeps the S^2 buffers inside HBM at
+    # S=8192), and report sparse-vs-our-own-flash alongside in detail
     def vanilla_loss(q, k, v):
-        return jnp.sum(flash_attention(q, k, v, causal=True,
-                                       force_reference=True)
-                       .astype(jnp.float32))
+        sm = q.shape[-1] ** -0.5
+        s_ = jnp.einsum("bhqd,bhkd->bhqk", q, k) * sm
+        idx = jnp.arange(S)
+        s_ = jnp.where(idx[:, None] >= idx[None, :], s_, -1e30)
+        p = jax.nn.softmax(s_, axis=-1)
+        o = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+        return jnp.sum(o.astype(jnp.float32))
 
     try:
         t_vanilla = timed(vanilla_loss)
     except Exception:
         t_vanilla = None               # O(S^2) buffers may not fit
     speedup = (t_vanilla / t_sparse) if t_vanilla else t_dense / t_sparse
+    unit = ("vanilla_time_over_sparse_time" if t_vanilla
+            else "flash_time_over_sparse_time")
     _emit("sparse_attention_speedup_s8k", round(speedup, 3),
-          "dense_time_over_sparse_time", round(speedup / 6.3, 4),
+          unit, round(speedup / 6.3, 4),
           {"seq": S, "heads": H, "block": block, "window_blocks": win,
            "kernel": kernel, "baseline": "vanilla" if t_vanilla else "flash",
            "vanilla_ms": round(t_vanilla * 1000, 2) if t_vanilla else None,

@@ -337,8 +337,9 @@ def _sparse_attention_fn(layout: np.ndarray, block: int, sm_scale: float,
         return _FN_CACHE[key]
 
     H, nq, nk = layout.shape
-    if (not has_am and USE_SPLASH_V2 and not interpret
-            and block % 128 != 0):
+    use_v2 = not has_am and USE_SPLASH_V2 and (interpret or block % 128 == 0)
+    if not use_v2 and not has_am and USE_SPLASH_V2 and not interpret:
+        # v2 wanted but the block width can't be a DMA lane dim
         global _WARNED_V1_BLOCK
         if not _WARNED_V1_BLOCK:
             _WARNED_V1_BLOCK = True
@@ -349,7 +350,7 @@ def _sparse_attention_fn(layout: np.ndarray, block: int, sm_scale: float,
                 "stream it by DMA on TPU — falling back to the per-triple "
                 "v1 kernels (~row-degree x more program launches). Use "
                 "block=128 for long-sequence performance.", stacklevel=3)
-    if not has_am and USE_SPLASH_V2 and (interpret or block % 128 == 0):
+    if use_v2:
         # row-run kernels: one program per block row, K/V streamed by
         # DMA (blocksparse_v2.py) — ~row-degree x fewer program launches.
         # Compiled mode needs 128-multiple blocks: the streamed (D, block)

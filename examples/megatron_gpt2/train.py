@@ -29,6 +29,10 @@ from deepspeed_tpu.models.gpt2 import (GPT2Config, count_params,
 
 GPT2_345M = dict(vocab_size=50304, max_position_embeddings=1024,
                  hidden_size=1024, num_layers=24, num_heads=16)
+# GPT-2 XL (1.5B): the BASELINE ladder's 3D-parallel / ZeRO-Offload
+# scale point (reference megatron tutorial's 1.5B config)
+GPT2_XL = dict(vocab_size=50304, max_position_embeddings=1024,
+               hidden_size=1600, num_layers=48, num_heads=25)
 GPT2_TINY = dict(vocab_size=512, max_position_embeddings=128,
                  hidden_size=64, num_layers=4, num_heads=4)
 
@@ -36,10 +40,14 @@ GPT2_TINY = dict(vocab_size=512, max_position_embeddings=128,
 def main():
     parser = argparse.ArgumentParser()
     ds.add_config_arguments(parser)
-    parser.add_argument("--mode", choices=["zero2", "3d", "sp"],
+    parser.add_argument("--mode",
+                        choices=["zero2", "3d", "sp", "offload"],
                         default="zero2")
     parser.add_argument("--tiny", action="store_true",
                         help="Tiny model for smoke runs")
+    parser.add_argument("--size", choices=["tiny", "345m", "xl"],
+                        default=None,
+                        help="model size (xl = GPT-2 1.5B; --tiny wins)")
     parser.add_argument("--seq", type=int, default=0)
     parser.add_argument("--steps", type=int, default=10)
     parser.add_argument("--save_dir", type=str, default=None,
@@ -55,7 +63,8 @@ def main():
     with open(config) as f:
         config = json.load(f)
 
-    size = GPT2_TINY if args.tiny else GPT2_345M
+    sizes = {"tiny": GPT2_TINY, "345m": GPT2_345M, "xl": GPT2_XL}
+    size = GPT2_TINY if args.tiny else sizes[args.size or "345m"]
     cfg = GPT2Config(embd_dropout=0.0, attn_dropout=0.0, resid_dropout=0.0,
                      **size)
     seq = args.seq or min(cfg.max_position_embeddings, 1024)
@@ -83,7 +92,12 @@ def main():
                 yield {"input_ids": rng.randint(
                     0, cfg.vocab_size, (bs, seq + 1)).astype(np.int32)}
         it = micro_batches()
-    elif args.mode == "zero2":
+    elif args.mode in ("zero2", "offload"):
+        # offload: same data path; the config moves the fp32 master state
+        # + Adam to host memory (reference ZeRO-Offload: 13B on one GPU —
+        # here GPT-2 XL 1.5B trains on one v5e chip: bf16 params + grads
+        # in HBM, fp32 master + moments in host RAM, AVX2 host Adam
+        # overlapped under the next window's compute)
         params = init_gpt2_params(cfg, jax.random.PRNGKey(0))
         print(f"params: {count_params(params)/1e6:.0f}M")
         loss_fn = gpt2_loss_fn(cfg, deterministic=True)
