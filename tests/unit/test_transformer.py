@@ -298,6 +298,24 @@ def test_flash_block_policy_scales_with_seq():
     assert _pick_blocks(32768, 32768) == (512, 512)
 
 
+def _grads_match_streamed(loss, args, thresh=128, tol=1e-5):
+    """Grad parity harness: run `loss` grads on the resident path, then
+    with streaming forced via STREAM_THRESHOLD, and compare (few-ulp
+    fp32 reassociation tolerance — the streamed dots contract transposed
+    tiles in a different order)."""
+    from deepspeed_tpu.ops.attention import flash as F
+    g_res = jax.grad(loss, argnums=tuple(range(len(args))))(*args)
+    old = F.STREAM_THRESHOLD
+    try:
+        F.STREAM_THRESHOLD = thresh   # force streaming
+        g_str = jax.grad(loss, argnums=tuple(range(len(args))))(*args)
+    finally:
+        F.STREAM_THRESHOLD = old
+    for a, b in zip(g_res, g_str):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=tol, atol=tol)
+
+
 @pytest.mark.parametrize("S,causal",
                          [(128, True), (384, True), (384, False)])
 def test_flash_streaming_matches_resident(S, causal):
@@ -319,16 +337,48 @@ def test_flash_streaming_matches_resident(S, causal):
         return jnp.sum(F.flash_attention(q, k, v, causal=causal)
                        .astype(jnp.float32) ** 2)
 
-    g_res = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
-    old = F.STREAM_THRESHOLD
-    try:
-        F.STREAM_THRESHOLD = 32   # force streaming
-        g_str = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
-    finally:
-        F.STREAM_THRESHOLD = old
-    for a, b in zip(g_res, g_str):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=1e-5, atol=1e-5)
+    _grads_match_streamed(loss, (q, k, v))
+
+
+def test_flash_streaming_dropout_matches_resident():
+    """Streamed + in-kernel dropout: the counter-hash mask must
+    regenerate identically whether K/V are resident or DMA-streamed
+    (the tile walk order differs; the hash is coordinate-keyed)."""
+    from deepspeed_tpu.ops.attention import flash as F
+    key = jax.random.PRNGKey(2)
+    S = 256
+    q, k, v = (jax.random.normal(jax.random.fold_in(key, i),
+                                 (1, 2, S, 16), jnp.float32)
+               for i in range(3))
+    rng = jax.random.PRNGKey(5)
+
+    def loss(q, k, v):
+        return jnp.sum(F.flash_attention(
+            q, k, v, causal=True, dropout_rate=0.2, dropout_rng=rng)
+            .astype(jnp.float32) ** 2)
+
+    _grads_match_streamed(loss, (q, k, v))
+
+
+def test_flash_streaming_masked_matches_resident():
+    """Streamed + key-padding-mask path: the mask rides as a
+    VMEM-resident ref sliced at dynamic 128-aligned offsets while K/V
+    stream by DMA — exercise the combination (BERT long-seq shape)."""
+    from deepspeed_tpu.ops.attention import flash as F
+    key = jax.random.PRNGKey(1)
+    S = 384
+    q, k, v = (jax.random.normal(jax.random.fold_in(key, i),
+                                 (2, 2, S, 16), jnp.float32)
+               for i in range(3))
+    mrng = np.random.RandomState(7)
+    mask = jnp.asarray(
+        np.where(mrng.rand(2, 1, 1, S) > 0.25, 0.0, -1e9), jnp.float32)
+
+    def loss(q, k, v):
+        return jnp.sum(F.flash_attention(q, k, v, mask=mask)
+                       .astype(jnp.float32) ** 2)
+
+    _grads_match_streamed(loss, (q, k, v))
 
 
 class TestTransformerLayerGrid:
