@@ -1,0 +1,214 @@
+"""Mixture-of-Experts layer with expert parallelism (TPU-native).
+
+Beyond-reference extension (the DeepSpeed v0.3.0 snapshot has no MoE —
+SURVEY.md §2.3 "No MoE/expert parallelism"): completes the ep member of
+the tp/pp/dp/sp/ep parallelism family on the same named-mesh design as
+the rest of the framework.
+
+Design (GShard/Switch-style, XLA-first):
+- Static shapes end to end: top-k routing is expressed as one-hot
+  dispatch/combine tensors (T, E, C) — no dynamic gathers, no
+  data-dependent shapes, so the whole layer jits and shards cleanly.
+- Capacity: each expert owns C = ceil(top_k * T * capacity_factor / E)
+  slots; tokens beyond an expert's capacity are dropped for that expert
+  (their gate mass is simply lost, GShard semantics). Positions are
+  assigned in token order via cumsum — second choices queue behind all
+  first choices (GShard's priority rule).
+- Expert parallelism = GSPMD: the (E, C, H) expert tensors carry a
+  sharding constraint over the ``expert`` mesh axis; XLA inserts the
+  all_to_all between the token-sharded and expert-sharded layouts —
+  no hand-written collective, which is the named-axis analog of the
+  reference's NCCL groups.
+- Aux losses ride with the output: Switch load-balance loss
+  (E * sum_e f_e * p_e) and router z-loss (mean logsumexp^2), both fp32.
+"""
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass
+class MoEConfig:
+    hidden_size: int
+    intermediate_size: int
+    num_experts: int
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    load_balance_coef: float = 1e-2
+    router_z_coef: float = 1e-3
+
+    def __post_init__(self):
+        assert self.top_k in (1, 2), self.top_k
+        assert self.num_experts >= self.top_k, (self.num_experts,
+                                                self.top_k)
+
+
+def init_moe_params(config: MoEConfig, key, dtype=jnp.float32):
+    """{"router": (H, E), "wi": (E, H, F), "wo": (E, F, H)}."""
+    kr, ki, ko = jax.random.split(key, 3)
+    h, f, e = (config.hidden_size, config.intermediate_size,
+               config.num_experts)
+    return {
+        "router": (jax.random.normal(kr, (h, e)) * 0.02).astype(dtype),
+        "wi": (jax.random.normal(ki, (e, h, f)) * 0.02).astype(dtype),
+        "wo": (jax.random.normal(ko, (e, f, h)) * 0.02).astype(dtype),
+    }
+
+
+def expert_capacity(config: MoEConfig, num_tokens: int) -> int:
+    c = int(np.ceil(config.top_k * num_tokens * config.capacity_factor
+                    / config.num_experts))
+    return max(c, 1)
+
+
+def _one_hot_positions(mask, capacity, start_counts):
+    """Slot positions for one routing choice: mask (T, E) 0/1; tokens take
+    slots in token order, starting after ``start_counts`` (E,) already-used
+    slots. Returns (pos (T, E) int32, kept (T, E) bool, counts (E,))."""
+    pos = jnp.cumsum(mask, axis=0) - 1 + start_counts[None, :]
+    kept = jnp.logical_and(mask > 0, pos < capacity)
+    counts = start_counts + jnp.sum(mask, axis=0)
+    return pos.astype(jnp.int32), kept, counts
+
+
+def moe_router(params, config: MoEConfig, x_tokens):
+    """Routing: x_tokens (T, H) -> (dispatch (T, E, C) f32 0/1,
+    combine (T, E, C) f32, aux_loss f32 scalar).
+
+    fp32 router math (softmax over expert logits is tiny and
+    precision-sensitive; reference-free design choice matching public
+    MoE practice)."""
+    t = x_tokens.shape[0]
+    e = config.num_experts
+    c = expert_capacity(config, t)
+
+    logits = jnp.einsum("th,he->te", x_tokens.astype(jnp.float32),
+                        params["router"].astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)               # (T, E)
+
+    # --- top-1 choice
+    idx1 = jnp.argmax(probs, axis=-1)                     # (T,)
+    mask1 = jax.nn.one_hot(idx1, e, dtype=jnp.float32)    # (T, E)
+    gate1 = jnp.sum(probs * mask1, axis=-1)               # (T,)
+
+    zeros = jnp.zeros((e,), jnp.int32)
+    pos1, kept1, counts = _one_hot_positions(mask1, c, zeros)
+
+    if config.top_k == 2:
+        probs2 = probs * (1.0 - mask1)                    # mask out choice 1
+        idx2 = jnp.argmax(probs2, axis=-1)
+        mask2 = jax.nn.one_hot(idx2, e, dtype=jnp.float32)
+        gate2 = jnp.sum(probs * mask2, axis=-1)
+        pos2, kept2, _ = _one_hot_positions(mask2, c, counts)
+        # renormalize over the two selected gates (GShard)
+        denom = jnp.maximum(gate1 + gate2, 1e-9)
+        gate1n, gate2n = gate1 / denom, gate2 / denom
+    else:
+        gate1n = gate1
+
+    def scatter(kept, pos, gate):
+        # (T, E, C): one-hot over the capacity slot, weighted by the gate
+        slot = jax.nn.one_hot(pos, c, dtype=jnp.float32)  # (T, E, C)
+        d = slot * kept[..., None].astype(jnp.float32)
+        return d, d * gate[:, None, None]
+
+    d1, w1 = scatter(kept1, pos1, gate1n)
+    dispatch, combine = d1, w1
+    if config.top_k == 2:
+        d2, w2 = scatter(kept2, pos2, gate2n)
+        dispatch = dispatch + d2
+        combine = combine + w2
+
+    # Switch load-balance loss: fraction of tokens routed (first choice)
+    # vs mean router probability, per expert
+    f_e = jnp.mean(mask1, axis=0)
+    p_e = jnp.mean(probs, axis=0)
+    lb = config.load_balance_coef * e * jnp.sum(f_e * p_e)
+    z = config.router_z_coef * jnp.mean(
+        jax.nn.logsumexp(logits, axis=-1) ** 2)
+    return dispatch, combine, lb + z
+
+
+def moe_layer(params, config: MoEConfig, x, *,
+              expert_axis: Optional[str] = None, mesh=None,
+              dtype=jnp.bfloat16):
+    """MoE FFN: x (B, S, H) -> (y (B, S, H), aux_loss scalar fp32).
+
+    ``expert_axis``: mesh axis name to shard experts over (expert
+    parallelism); None = fully replicated experts. The constraint is all
+    GSPMD needs — it inserts the token<->expert all_to_all pair. Pass
+    ``mesh`` when calling outside a ``with mesh:`` context (e.g. from
+    the engine's compiled step, which jits with explicit shardings)."""
+    b, s, h = x.shape
+    xt = x.reshape(b * s, h)
+    dispatch, combine, aux = moe_router(params, config, xt)
+
+    def constrain(v):
+        if expert_axis is None:
+            return v
+        from jax.lax import with_sharding_constraint as wsc
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        spec = P(expert_axis, None, None)
+        if mesh is not None:
+            return wsc(v, NamedSharding(mesh, spec))
+        return wsc(v, spec)
+
+    expert_in = constrain(jnp.einsum("tec,th->ech", dispatch.astype(dtype),
+                                     xt.astype(dtype)))
+    hdn = jax.nn.gelu(jnp.einsum("ech,ehf->ecf", expert_in,
+                                 params["wi"].astype(dtype)))
+    out = constrain(jnp.einsum("ecf,efh->ech", hdn,
+                               params["wo"].astype(dtype)))
+    y = jnp.einsum("tec,ech->th", combine.astype(dtype), out)
+    return y.reshape(b, s, h).astype(x.dtype), aux
+
+
+def moe_layer_reference(params, config: MoEConfig, x):
+    """Token-loop numpy oracle with identical routing/capacity/priority
+    semantics — the test ground truth."""
+    b, s, h = x.shape
+    xt = np.asarray(x, np.float32).reshape(b * s, h)
+    router = np.asarray(params["router"], np.float32)
+    wi = np.asarray(params["wi"], np.float32)
+    wo = np.asarray(params["wo"], np.float32)
+    e = config.num_experts
+    c = expert_capacity(config, xt.shape[0])
+
+    logits = xt @ router
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+
+    choices = []                       # (token, expert, gate) in priority order
+    idx1 = probs.argmax(-1)
+    gates1 = probs[np.arange(len(xt)), idx1]
+    if config.top_k == 2:
+        p2 = probs.copy()
+        p2[np.arange(len(xt)), idx1] = 0.0
+        idx2 = p2.argmax(-1)
+        gates2 = probs[np.arange(len(xt)), idx2]
+        denom = np.maximum(gates1 + gates2, 1e-9)
+        gates1, gates2 = gates1 / denom, gates2 / denom
+    for ti in range(len(xt)):
+        choices.append((0, ti, idx1[ti], gates1[ti]))
+    if config.top_k == 2:
+        for ti in range(len(xt)):
+            choices.append((1, ti, idx2[ti], gates2[ti]))
+
+    used = np.zeros(e, np.int32)
+    y = np.zeros_like(xt)
+    # first choices take slots before any second choice (GShard priority)
+    for _, ti, ei, g in sorted(choices, key=lambda t: t[0]):
+        if used[ei] < c:
+            used[ei] += 1
+            hdn = _np_gelu(xt[ti] @ wi[ei])
+            y[ti] += g * (hdn @ wo[ei])
+    return y.reshape(b, s, h)
+
+
+def _np_gelu(x):
+    return 0.5 * x * (1.0 + np.tanh(np.sqrt(2.0 / np.pi) *
+                                    (x + 0.044715 * x ** 3)))

@@ -6,12 +6,14 @@ serves every parallel dimension; collectives inside jit take axis names.
 
 Canonical axis names (any subset may be present, size-1 axes are legal):
 
-- ``pipe``  : pipeline stages
-- ``data``  : data parallel (ZeRO shards along this axis too)
-- ``seq``   : sequence/context parallel (ring attention) — TPU-native
-              extension; absent from the reference snapshot
-- ``model`` : tensor (megatron-style) parallel; innermost so TP peers sit on
-              ICI nearest neighbors
+- ``pipe``   : pipeline stages
+- ``data``   : data parallel (ZeRO shards along this axis too)
+- ``expert`` : expert parallel (MoE expert banks, ops/moe.py) — TPU-native
+               extension; absent from the reference snapshot
+- ``seq``    : sequence/context parallel (ring attention) — TPU-native
+               extension; absent from the reference snapshot
+- ``model``  : tensor (megatron-style) parallel; innermost so TP peers sit
+               on ICI nearest neighbors
 """
 
 import math
@@ -23,7 +25,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from deepspeed_tpu.parallel.topology import ProcessTopology
 
-CANONICAL_AXIS_ORDER = ("pipe", "data", "seq", "model")
+CANONICAL_AXIS_ORDER = ("pipe", "data", "expert", "seq", "model")
 
 
 def _order_axes(axes: Dict[str, int]) -> Dict[str, int]:

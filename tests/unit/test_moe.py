@@ -1,0 +1,121 @@
+"""MoE layer + expert parallelism (beyond-reference extension; the
+DeepSpeed v0.3.0 snapshot has no MoE — SURVEY §2.3). Three tiers like
+the rest of the suite: oracle numerics, gradient sanity, and the
+EP-sharded path on the 8-device mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.moe import (MoEConfig, expert_capacity,
+                                   init_moe_params, moe_layer,
+                                   moe_layer_reference)
+
+
+def _setup(top_k, e=4, h=16, f=32, b=2, s=8, cf=1.25, seed=0):
+    cfg = MoEConfig(hidden_size=h, intermediate_size=f, num_experts=e,
+                    top_k=top_k, capacity_factor=cf)
+    params = init_moe_params(cfg, jax.random.PRNGKey(seed))
+    x = jax.random.normal(jax.random.PRNGKey(seed + 1), (b, s, h),
+                          jnp.float32)
+    return cfg, params, x
+
+
+class TestMoENumerics:
+
+    @pytest.mark.parametrize("top_k", [1, 2])
+    def test_matches_token_loop_oracle(self, top_k):
+        cfg, params, x = _setup(top_k)
+        y, aux = moe_layer(params, cfg, x, dtype=jnp.float32)
+        y_ref = moe_layer_reference(params, cfg, x)
+        np.testing.assert_allclose(np.asarray(y), y_ref,
+                                   atol=1e-5, rtol=1e-5)
+        assert np.isfinite(float(aux)) and float(aux) > 0.0
+
+    @pytest.mark.parametrize("top_k", [1, 2])
+    def test_capacity_drops_match_oracle(self, top_k):
+        # tight capacity: forced drops must agree with the oracle's
+        # token-order priority rule
+        cfg, params, x = _setup(top_k, cf=0.5)
+        assert expert_capacity(cfg, 16) < 16 * top_k // 4 + 1
+        y, _ = moe_layer(params, cfg, x, dtype=jnp.float32)
+        y_ref = moe_layer_reference(params, cfg, x)
+        np.testing.assert_allclose(np.asarray(y), y_ref,
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_gradients_finite_and_flow(self):
+        cfg, params, x = _setup(2)
+
+        def loss(params, x):
+            y, aux = moe_layer(params, cfg, x, dtype=jnp.float32)
+            return jnp.sum(y ** 2) + aux
+
+        g = jax.grad(loss)(params, x)
+        for name in ("router", "wi", "wo"):
+            arr = np.asarray(g[name])
+            assert np.all(np.isfinite(arr)), name
+            assert np.abs(arr).max() > 0.0, name  # router learns via gates
+
+
+class TestMoEExpertParallel:
+
+    def test_ep_sharded_matches_replicated(self):
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        cfg, params, x = _setup(2, e=4, b=4, s=16)
+        devs = np.array(jax.devices()[:8]).reshape(2, 4)
+        mesh = Mesh(devs, ("data", "expert"))
+
+        y_rep, aux_rep = moe_layer(params, cfg, x, dtype=jnp.float32)
+
+        with mesh:
+            f = jax.jit(lambda p, xx: moe_layer(
+                p, cfg, xx, expert_axis="expert", dtype=jnp.float32))
+            ps = jax.device_put(params, NamedSharding(mesh, P()))
+            xs = jax.device_put(x, NamedSharding(mesh, P("data")))
+            y_ep, aux_ep = f(ps, xs)
+        np.testing.assert_allclose(np.asarray(y_ep), np.asarray(y_rep),
+                                   atol=1e-5, rtol=1e-5)
+        np.testing.assert_allclose(float(aux_ep), float(aux_rep),
+                                   rtol=1e-6)
+
+    def test_ep_training_through_engine(self):
+        """End-to-end: a toy MoE model trains through the engine on an
+        expert x data mesh — the ep member of the parallelism family."""
+        import deepspeed_tpu as ds
+        cfg = MoEConfig(hidden_size=16, intermediate_size=32,
+                        num_experts=4, top_k=2)
+        key = jax.random.PRNGKey(0)
+        params = {"moe": init_moe_params(cfg, key),
+                  "head": jax.random.normal(key, (16, 4)) * 0.1}
+
+        engine_mesh = [None]   # filled after initialize builds the mesh
+
+        def loss_fn(params, batch, rng):
+            y, aux = moe_layer(params["moe"], cfg, batch["x"],
+                               expert_axis="expert", mesh=engine_mesh[0],
+                               dtype=jnp.float32)
+            logits = jnp.mean(y, axis=1) @ params["head"]
+            lab = jax.nn.one_hot(batch["y"], 4)
+            ce = -jnp.mean(jnp.sum(jax.nn.log_softmax(logits) * lab, -1))
+            return ce + aux
+
+        engine, *_ = ds.initialize(
+            model=loss_fn, model_parameters=params,
+            config={"train_micro_batch_size_per_gpu": 8,
+                    "gradient_accumulation_steps": 1,
+                    "zero_optimization": {"stage": 1},
+                    "optimizer": {"type": "Adam", "params": {"lr": 3e-3}},
+                    "steps_per_print": 10**9,
+                    "mesh": {"axes": {"data": 2, "expert": 4}}})
+        engine_mesh[0] = engine.mesh
+        rng = np.random.RandomState(0)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        shd = NamedSharding(engine.mesh, P("data"))
+        losses = []
+        for _ in range(30):
+            x = rng.randn(16, 8, 16).astype(np.float32)
+            y = (x[:, 0, :4].argmax(-1)).astype(np.int32)
+            b = {"x": jax.device_put(x, shd), "y": jax.device_put(y, shd)}
+            losses.append(float(engine.train_batch(iter([b]))))
+        assert losses[-1] < losses[0], losses[::10]
