@@ -184,10 +184,12 @@ def _tied_xent_chunked(x, wte, targets, dtype, chunk_tokens: int = 2048,
 
 
 def gpt2_block(block_params, config: GPT2Config, x, rng, deterministic,
-               dtype, attention_fn=None):
+               dtype, attention_fn=None, mlp_fn=None):
     """One pre-LN transformer block. ``attention_fn(q, k, v, rate, rng)``
     optionally replaces causal flash attention (e.g. ring attention for
-    sequence parallelism)."""
+    sequence parallelism). ``mlp_fn(mlp_params, m_in) -> (m_out, aux)``
+    optionally replaces the dense MLP (e.g. a MoE FFN) — the block then
+    returns ``(x, aux)`` instead of ``x``."""
     B, S, h = x.shape
     heads = config.num_heads
     hd = h // heads
@@ -226,6 +228,11 @@ def gpt2_block(block_params, config: GPT2Config, x, rng, deterministic,
     # mlp
     m_in = _layer_norm(x, block_params["ln_2"], config.layer_norm_eps)
     mp = block_params["mlp"]
+    if mlp_fn is not None:
+        m_out, aux = mlp_fn(mp, m_in)
+        x = x + _dropout(m_out.astype(dtype), config.resid_dropout, r2,
+                         deterministic)
+        return x, aux
     hmid = m_in @ mp["fc_w"].astype(dtype) + mp["fc_b"].astype(dtype)
     hmid = jax.nn.gelu(hmid, approximate=True)
     m_out = hmid @ mp["proj_w"].astype(dtype) + mp["proj_b"].astype(dtype)
@@ -235,8 +242,11 @@ def gpt2_block(block_params, config: GPT2Config, x, rng, deterministic,
 
 def _gpt2_trunk(params, config: GPT2Config, input_ids, rng=None,
                 deterministic: bool = True, dtype=jnp.bfloat16,
-                remat: bool = False):
-    """Final hidden states (B, S, H) after ln_f (no LM head)."""
+                remat: bool = False, mlp_fns=None):
+    """Final hidden states (B, S, H) after ln_f (no LM head).
+
+    ``mlp_fns``: optional {layer_index: mlp_fn} replacing that block's
+    dense MLP (e.g. MoE); when given, returns ``(x, aux_loss_total)``."""
     x = _embed(params["wte"], params["wpe"], input_ids, dtype)
     if rng is not None:
         rng, r_emb = jax.random.split(rng)
@@ -244,16 +254,28 @@ def _gpt2_trunk(params, config: GPT2Config, input_ids, rng=None,
 
     block = gpt2_block
     if remat:
+        # attention_fn/mlp_fn are callables -> static under checkpoint
         block = jax.checkpoint(gpt2_block,
-                               static_argnums=(1, 4, 5))
+                               static_argnums=(1, 4, 5, 6, 7))
+    aux_total = jnp.zeros((), jnp.float32)
     for i in range(config.num_layers):
         if rng is not None:
             rng, r = jax.random.split(rng)
         else:
             r = None
-        x = block(params[f"h_{i}"], config, x, r, deterministic, dtype)
+        mlp_fn = None if mlp_fns is None else mlp_fns.get(i)
+        if mlp_fn is not None:
+            x, aux = block(params[f"h_{i}"], config, x, r, deterministic,
+                           dtype, None, mlp_fn)
+            aux_total = aux_total + aux
+        else:
+            x = block(params[f"h_{i}"], config, x, r, deterministic,
+                      dtype, None, None)
 
-    return _layer_norm(x, params["ln_f"], config.layer_norm_eps)
+    x = _layer_norm(x, params["ln_f"], config.layer_norm_eps)
+    if mlp_fns is not None:
+        return x, aux_total
+    return x
 
 
 def gpt2_forward(params, config: GPT2Config, input_ids, rng=None,
@@ -278,6 +300,62 @@ def gpt2_loss_fn(config: GPT2Config, dtype=jnp.bfloat16, remat: bool = False,
                         deterministic=deterministic, dtype=dtype,
                         remat=remat)
         return _tied_xent_chunked(x, params["wte"], targets, dtype)
+    return loss_fn
+
+
+def _is_moe_block(i: int, moe_every: int) -> bool:
+    # blocks moe_every-1, 2*moe_every-1, ... — moe_every=1 means every
+    # block; the single predicate keeps init and loss_fn in lockstep
+    return i % moe_every == moe_every - 1
+
+
+def init_gpt2_moe_params(config: GPT2Config, moe_config, key,
+                         moe_every: int = 2):
+    """GPT-2 params with the dense MLP of every ``moe_every``-th block
+    (blocks moe_every-1, 2*moe_every-1, ...) replaced by a MoE expert
+    bank; ``moe_every=1`` converts every block."""
+    from deepspeed_tpu.ops.moe import init_moe_params
+    params = init_gpt2_params(config, key)
+    for i in range(config.num_layers):
+        if _is_moe_block(i, moe_every):
+            key, km = jax.random.split(key)
+            params[f"h_{i}"]["mlp"] = init_moe_params(moe_config, km)
+    return params
+
+
+def gpt2_moe_loss_fn(config: GPT2Config, moe_config, mesh=None,
+                     moe_every: int = 2, dtype=jnp.bfloat16,
+                     remat: bool = False, deterministic: bool = False):
+    """Engine-contract loss for a MoE GPT-2: next-token cross entropy plus
+    the routers' load-balance/z aux losses. Blocks selected by
+    ``_is_moe_block`` (moe_every=1 -> every block) carry a MoE FFN
+    (params from :func:`init_gpt2_moe_params`); experts shard over the
+    ``expert`` mesh axis when ``mesh`` has one.
+
+    Beyond-reference extension (no MoE in the v0.3.0 snapshot): the
+    sparse-FFN scaling axis on the same engine contract as the dense
+    family."""
+    from deepspeed_tpu.ops.moe import moe_layer
+
+    expert_axis = ("expert" if mesh is not None
+                   and "expert" in mesh.axis_names else None)
+
+    def mlp_fn(mp, m_in):
+        return moe_layer(mp, moe_config, m_in, expert_axis=expert_axis,
+                         mesh=mesh, dtype=dtype)
+
+    mlp_fns = {i: mlp_fn for i in range(config.num_layers)
+               if _is_moe_block(i, moe_every)}
+
+    def loss_fn(params, batch, rng):
+        ids = batch["input_ids"]
+        inputs, targets = ids[:, :-1], ids[:, 1:]
+        x, aux_total = _gpt2_trunk(params, config, inputs, rng=rng,
+                                   deterministic=deterministic,
+                                   dtype=dtype, remat=remat,
+                                   mlp_fns=mlp_fns)
+        return (_tied_xent_chunked(x, params["wte"], targets, dtype)
+                + aux_total)
     return loss_fn
 
 

@@ -119,3 +119,50 @@ class TestMoEExpertParallel:
             b = {"x": jax.device_put(x, shd), "y": jax.device_put(y, shd)}
             losses.append(float(engine.train_batch(iter([b]))))
         assert losses[-1] < losses[0], losses[::10]
+
+
+class TestMoEGPT2:
+
+    def test_moe_gpt2_trains_through_engine(self):
+        """A MoE GPT-2 (sparse FFN every other block) trains end to end
+        on a data x expert mesh through the engine; loss decreases and
+        stays finite (router aux losses included)."""
+        import deepspeed_tpu as ds
+        from deepspeed_tpu.models.gpt2 import (GPT2Config,
+                                               gpt2_moe_loss_fn,
+                                               init_gpt2_moe_params)
+        cfg = GPT2Config(vocab_size=128, max_position_embeddings=32,
+                         hidden_size=32, num_layers=4, num_heads=4,
+                         embd_dropout=0.0, attn_dropout=0.0,
+                         resid_dropout=0.0)
+        moe_cfg = MoEConfig(hidden_size=32, intermediate_size=64,
+                            num_experts=4, top_k=2)
+        params = init_gpt2_moe_params(cfg, moe_cfg, jax.random.PRNGKey(0))
+        assert "router" in params["h_1"]["mlp"]      # MoE block
+        assert "fc_w" in params["h_0"]["mlp"]        # dense block
+
+        mesh_box = [None]
+
+        def model(params, batch, rng):
+            return gpt2_moe_loss_fn(cfg, moe_cfg, mesh=mesh_box[0],
+                                    deterministic=True)(params, batch, rng)
+
+        engine, *_ = ds.initialize(
+            model=model, model_parameters=params,
+            config={"train_micro_batch_size_per_gpu": 4,
+                    "gradient_accumulation_steps": 1,
+                    "zero_optimization": {"stage": 2},
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                    "steps_per_print": 10**9,
+                    "mesh": {"axes": {"data": 4, "expert": 2}}})
+        mesh_box[0] = engine.mesh
+        rng = np.random.RandomState(0)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        shd = NamedSharding(engine.mesh, P("data"))
+        ids = rng.randint(0, 128, (16, 17)).astype(np.int32)
+        b = {"input_ids": jax.device_put(ids, shd)}  # fixed batch:
+        losses = []                                  # memorization target
+        for _ in range(20):
+            losses.append(float(engine.train_batch(iter([b]))))
+        assert all(np.isfinite(l) for l in losses)
+        assert losses[-1] < losses[0] - 0.3, (losses[0], losses[-1])
