@@ -1,0 +1,64 @@
+"""CLI argument-group tier (reference tests/unit/test_ds_arguments.py):
+add_config_arguments must install the --deepspeed/--deepspeed_config
+flags (plus deprecated aliases) without disturbing client args."""
+
+import argparse
+
+import pytest
+
+import deepspeed_tpu
+
+
+def _parser():
+    p = argparse.ArgumentParser()
+    p.add_argument("--num_epochs", type=int)
+    return p
+
+
+def test_no_ds_arguments_no_ds_parser():
+    """(reference test_ds_arguments.py:no_ds_arguments)"""
+    parser = _parser()
+    args = parser.parse_args(["--num_epochs", "2"])
+    assert args.num_epochs == 2
+    assert not hasattr(args, "deepspeed")
+    assert not hasattr(args, "deepspeed_config")
+
+
+def test_no_ds_arguments():
+    parser = deepspeed_tpu.add_config_arguments(_parser())
+    args = parser.parse_args(["--num_epochs", "2"])
+    assert args.num_epochs == 2
+    assert args.deepspeed is False
+    assert args.deepspeed_config is None
+
+
+def test_ds_arguments():
+    parser = deepspeed_tpu.add_config_arguments(_parser())
+    args = parser.parse_args(
+        ["--num_epochs", "2", "--deepspeed",
+         "--deepspeed_config", "foo.json"])
+    assert args.num_epochs == 2
+    assert args.deepspeed is True
+    assert args.deepspeed_config == "foo.json"
+
+
+def test_ds_enable_only():
+    parser = deepspeed_tpu.add_config_arguments(_parser())
+    args = parser.parse_args(["--num_epochs", "2", "--deepspeed"])
+    assert args.deepspeed is True
+    assert args.deepspeed_config is None
+
+
+def test_deprecated_deepscale_aliases():
+    """(reference kept deepscale spellings for backward compat)"""
+    parser = deepspeed_tpu.add_config_arguments(_parser())
+    args = parser.parse_args(
+        ["--deepscale", "--deepscale_config", "bar.json"])
+    assert args.deepscale is True
+    assert args.deepscale_config == "bar.json"
+
+
+def test_core_flags_reject_unknown_value():
+    parser = deepspeed_tpu.add_config_arguments(_parser())
+    with pytest.raises(SystemExit):
+        parser.parse_args(["--deepspeed_config"])  # missing value
