@@ -41,7 +41,7 @@ def main():
     parser = argparse.ArgumentParser()
     ds.add_config_arguments(parser)
     parser.add_argument("--mode",
-                        choices=["zero2", "3d", "sp", "offload"],
+                        choices=["zero2", "3d", "sp", "offload", "moe"],
                         default="zero2")
     parser.add_argument("--tiny", action="store_true",
                         help="Tiny model for smoke runs")
@@ -73,7 +73,31 @@ def main():
     micro = config["train_micro_batch_size_per_gpu"]
     ga = config.get("gradient_accumulation_steps", 1)
 
-    if args.mode == "sp":
+    if args.mode == "moe":
+        # sparse-FFN scaling: every other block carries a MoE expert bank;
+        # experts shard over the 'expert' mesh axis (docs/moe.md)
+        from deepspeed_tpu.models.gpt2 import (gpt2_moe_loss_fn,
+                                               init_gpt2_moe_params)
+        from deepspeed_tpu.ops.moe import MoEConfig
+        from deepspeed_tpu.parallel.mesh import build_mesh
+        moe_cfg = MoEConfig(hidden_size=cfg.hidden_size,
+                            intermediate_size=cfg.inter,
+                            num_experts=8, top_k=2)
+        params = init_gpt2_moe_params(cfg, moe_cfg, jax.random.PRNGKey(0))
+        print(f"params: {count_params(params)/1e6:.0f}M (MoE)")
+        mesh = build_mesh(config["mesh"]["axes"])  # == the engine's mesh
+        loss_fn = gpt2_moe_loss_fn(cfg, moe_cfg, mesh=mesh,
+                                   deterministic=True)
+        engine, *_ = ds.initialize(model=loss_fn, model_parameters=params,
+                                   config=config)
+        bs = engine.train_batch_size() // ga
+
+        def micro_batches():
+            while True:
+                yield {"input_ids": rng.randint(
+                    0, cfg.vocab_size, (bs, seq + 1)).astype(np.int32)}
+        it = micro_batches()
+    elif args.mode == "sp":
         # sequence/context parallelism: ring attention over the 'seq'
         # mesh axis — each device holds a (B, S/P, H) activation shard
         from deepspeed_tpu.parallel.mesh import build_mesh

@@ -51,6 +51,20 @@ def test_onebit_adam_example(capsys):
     assert "done" in out and "[compressed]" in out and "[warmup]" in out
 
 
+def test_megatron_gpt2_moe_example(capsys):
+    _run("examples/megatron_gpt2/train.py", "--mode", "moe",
+         "--tiny", "--steps", "2", "--seq", "32")
+    out = capsys.readouterr().out
+    assert "done" in out and "(MoE)" in out
+
+
+def test_megatron_gpt2_offload_example(capsys):
+    _run("examples/megatron_gpt2/train.py", "--mode", "offload",
+         "--tiny", "--steps", "2", "--seq", "32")
+    out = capsys.readouterr().out
+    assert "done" in out and "lm loss" in out
+
+
 def test_megatron_gpt2_sp_example(capsys):
     _run("examples/megatron_gpt2/train.py", "--mode", "sp",
          "--tiny", "--steps", "2", "--seq", "64")
