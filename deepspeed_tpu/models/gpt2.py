@@ -15,7 +15,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from deepspeed_tpu.ops.attention.flash import flash_attention
+from deepspeed_tpu.ops.attention.flash import (NEG_INF,
+                                               flash_attention)
 
 
 class GPT2Config(NamedTuple):
@@ -301,6 +302,133 @@ def gpt2_loss_fn(config: GPT2Config, dtype=jnp.bfloat16, remat: bool = False,
                         remat=remat)
         return _tied_xent_chunked(x, params["wte"], targets, dtype)
     return loss_fn
+
+
+# --------------------------------------------------------------------- #
+# generation (KV-cache decode) — beyond-reference extension: the v0.3.0
+# snapshot is training-only; sampling here is the natural flip side of
+# the GPT-2 family. TPU-first shape discipline: the cache is a static
+# (B, heads, max_len, hd) buffer per layer, prefill is ONE full forward
+# (flash attention) that also writes the cache, and decode is a
+# lax.scan over positions — a single compiled step per token, no
+# Python-loop retracing, no dynamic shapes. Both phases run the SAME
+# gpt2_block as training, with the attention swapped via its
+# attention_fn hook (prefill captures K/V; decode attends to the cache)
+# — no second copy of the block math to drift.
+# --------------------------------------------------------------------- #
+def _cached_attention(kcache, vcache, pos, out_box):
+    """attention_fn for one decode step: write this position's K/V into
+    the cache, attend the single query to all cached positions <= pos.
+    Updated caches are returned through ``out_box`` (gpt2_block's hook
+    only returns the context)."""
+    def attn(q, k, v, rate, rng):
+        del rate, rng                      # decode is deterministic
+        kc = jax.lax.dynamic_update_slice(kcache, k.astype(kcache.dtype),
+                                          (0, 0, pos, 0))
+        vc = jax.lax.dynamic_update_slice(vcache, v.astype(vcache.dtype),
+                                          (0, 0, pos, 0))
+        out_box.append((kc, vc))
+        hd = q.shape[-1]
+        scores = jnp.einsum("bhqd,bhld->bhql", q.astype(jnp.float32),
+                            kc.astype(jnp.float32)) / np.sqrt(hd)
+        valid = (jnp.arange(kc.shape[2]) <= pos)[None, None, None, :]
+        scores = jnp.where(valid, scores, NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1)
+        return jnp.einsum("bhql,bhld->bhqd", probs,
+                          vc.astype(jnp.float32)).astype(q.dtype)
+    return attn
+
+
+def gpt2_generate(params, config: GPT2Config, prompt_ids, max_new_tokens,
+                  rng=None, temperature: float = 1.0, top_k: int = 0,
+                  dtype=jnp.bfloat16):
+    """Autoregressive sampling with a KV cache.
+
+    prompt_ids: (B, P) int32. Returns (B, P + max_new_tokens) int32.
+    temperature=0 (or rng=None) decodes greedily; top_k > 0 restricts
+    sampling to the k most likely tokens. Dense GPT-2 family only (MoE
+    params are rejected). The whole decode loop is one ``lax.scan`` —
+    compile once, generate any prompt of length P.
+    """
+    B, P = prompt_ids.shape
+    if max_new_tokens <= 0:
+        return prompt_ids
+    L = P + max_new_tokens
+    assert L <= config.max_position_embeddings, (
+        L, config.max_position_embeddings)
+    for i in range(config.num_layers):
+        if "fc_w" not in params[f"h_{i}"]["mlp"]:
+            raise ValueError(
+                "gpt2_generate supports the dense GPT-2 family only; "
+                f"block h_{i} carries MoE expert params")
+    heads = config.num_heads
+    hd = config.hidden_size // heads
+    nl = config.num_layers
+    greedy = rng is None or temperature == 0.0
+    eff_k = min(top_k, config.vocab_size)
+
+    # ---- prefill: one full forward over the prompt through gpt2_block,
+    # the attention hook capturing each layer's K/V into the cache
+    x = _embed(params["wte"], params["wpe"], prompt_ids, dtype)
+    kc = jnp.zeros((nl, B, heads, L, hd), dtype)
+    vc = jnp.zeros((nl, B, heads, L, hd), dtype)
+    captured = {}
+
+    def capture_attn(i):
+        def attn(q, k, v, rate, rng_):
+            del rate, rng_
+            captured[i] = (k, v)
+            return flash_attention(q, k, v, causal=True)
+        return attn
+
+    for i in range(nl):
+        x = gpt2_block(params[f"h_{i}"], config, x, None, True, dtype,
+                       attention_fn=capture_attn(i))
+        k, v = captured.pop(i)
+        kc = kc.at[i, :, :, :P].set(k.astype(dtype))
+        vc = vc.at[i, :, :, :P].set(v.astype(dtype))
+    x = _layer_norm(x, params["ln_f"], config.layer_norm_eps)
+    last_logits = _tied_logits(x[:, -1:], params["wte"], dtype)[:, 0]
+
+    def sample(logits, key):
+        if greedy:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        logits = logits / jnp.maximum(temperature, 1e-6)
+        if eff_k > 0:
+            kth = jax.lax.top_k(logits, eff_k)[0][:, -1][:, None]
+            logits = jnp.where(logits < kth, NEG_INF, logits)
+        return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    first_tok = sample(last_logits, jax.random.fold_in(rng, 0))
+
+    def step(carry, t):
+        tok, kc, vc = carry
+        pos = P + t                       # position of `tok` in the stream
+        x = (params["wte"][tok[:, None]]
+             + params["wpe"][pos][None, None]).astype(dtype)
+        new_kc, new_vc = [], []
+        for i in range(nl):
+            box = []
+            x = gpt2_block(params[f"h_{i}"], config, x, None, True, dtype,
+                           attention_fn=_cached_attention(kc[i], vc[i],
+                                                          pos, box))
+            ki, vi = box[0]
+            new_kc.append(ki)
+            new_vc.append(vi)
+        kc = jnp.stack(new_kc)
+        vc = jnp.stack(new_vc)
+        x = _layer_norm(x, params["ln_f"], config.layer_norm_eps)
+        logits = _tied_logits(x, params["wte"], dtype)[:, 0]
+        nxt = sample(logits, jax.random.fold_in(rng, t + 1))
+        return (nxt, kc, vc), tok
+
+    (last, _, _), toks = jax.lax.scan(
+        step, (first_tok, kc, vc), jnp.arange(max_new_tokens - 1))
+    # toks: (max_new_tokens-1, B) tokens at positions P..L-2; `last` is L-1
+    gen = jnp.concatenate([toks.T, last[:, None]], axis=1)
+    return jnp.concatenate([prompt_ids, gen], axis=1)
 
 
 def _is_moe_block(i: int, moe_every: int) -> bool:

@@ -182,3 +182,68 @@ def test_hash_dropout_statistics():
     # different key -> different mask
     y3 = np.asarray(dropout(x, 0.3, jax.random.PRNGKey(4), False))
     assert (y1 != y3).any()
+
+
+class TestGPT2Generate:
+    """KV-cache sampling (beyond-reference: the snapshot is
+    training-only). Greedy decode must exactly reproduce the naive
+    full-forward-per-token loop — one shared cache bug (wrong position,
+    stale layer, missed LN) breaks equality immediately."""
+
+    def _cfg_params(self):
+        from deepspeed_tpu.models.gpt2 import GPT2Config, init_gpt2_params
+        cfg = GPT2Config(vocab_size=97, max_position_embeddings=32,
+                         hidden_size=32, num_layers=3, num_heads=4,
+                         embd_dropout=0.0, attn_dropout=0.0,
+                         resid_dropout=0.0)
+        return cfg, init_gpt2_params(cfg, jax.random.PRNGKey(3))
+
+    def test_greedy_matches_full_forward_loop(self):
+        from deepspeed_tpu.models.gpt2 import gpt2_forward, gpt2_generate
+        cfg, params = self._cfg_params()
+        prompt = jnp.asarray(
+            np.random.RandomState(0).randint(0, 97, (2, 5)), jnp.int32)
+        out = gpt2_generate(params, cfg, prompt, max_new_tokens=6,
+                            rng=None, dtype=jnp.float32)
+        assert out.shape == (2, 11)
+
+        ids = prompt
+        for _ in range(6):
+            logits = gpt2_forward(params, cfg, ids, deterministic=True,
+                                  dtype=jnp.float32)
+            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            ids = jnp.concatenate([ids, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ids))
+
+    def test_sampled_tokens_in_range_and_deterministic_per_seed(self):
+        from deepspeed_tpu.models.gpt2 import gpt2_generate
+        cfg, params = self._cfg_params()
+        prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+        r = jax.random.PRNGKey(7)
+        a = gpt2_generate(params, cfg, prompt, 8, rng=r, temperature=0.8,
+                          top_k=10, dtype=jnp.float32)
+        b = gpt2_generate(params, cfg, prompt, 8, rng=r, temperature=0.8,
+                          top_k=10, dtype=jnp.float32)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert int(jnp.max(a)) < 97 and int(jnp.min(a)) >= 0
+
+    def test_generate_edge_cases(self):
+        from deepspeed_tpu.models.gpt2 import (gpt2_generate,
+                                               init_gpt2_moe_params)
+        from deepspeed_tpu.ops.moe import MoEConfig
+        cfg, params = self._cfg_params()
+        prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+        # max_new_tokens=0 -> prompt unchanged
+        out = gpt2_generate(params, cfg, prompt, 0, dtype=jnp.float32)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(prompt))
+        # top_k beyond the vocab is clamped, not a trace error
+        out = gpt2_generate(params, cfg, prompt, 2, rng=jax.random.PRNGKey(0),
+                            top_k=10**6, dtype=jnp.float32)
+        assert out.shape == (1, 5)
+        # MoE params rejected with a clear error
+        moe_cfg = MoEConfig(hidden_size=32, intermediate_size=64,
+                            num_experts=2, top_k=1)
+        moe_params = init_gpt2_moe_params(cfg, moe_cfg,
+                                          jax.random.PRNGKey(0))
+        with pytest.raises(ValueError, match="dense GPT-2 family"):
+            gpt2_generate(moe_params, cfg, prompt, 2)
