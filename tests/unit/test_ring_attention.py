@@ -97,3 +97,34 @@ def test_ring_dropout_statistics_and_determinism():
     # expectation roughly (inverted scaling)
     assert not np.allclose(o1, ref, atol=1e-3)
     assert abs(o1.mean() - ref.mean()) < 0.05
+
+
+def test_ring_with_streamed_flash_chunks():
+    """Long-context compose: each ring chunk large enough that the flash
+    kernel's DMA-streaming path engages INSIDE shard_map (forced via
+    STREAM_THRESHOLD) — the layout transposes and HBM-pinned refs must
+    survive manual-axes tracing. fwd + grads vs the dense oracle."""
+    from deepspeed_tpu.ops.attention import flash as F
+    axes = {"seq": 4}
+    mesh = build_mesh(axes)
+    S = 256 * axes["seq"]          # 256-long chunks -> 128-wide blocks
+    q, k, v = _qkv(S, seed=5)
+    old = F.STREAM_THRESHOLD
+    try:
+        F.STREAM_THRESHOLD = 128   # force streaming per chunk
+        f = _ring_full(mesh, True, axes["seq"])
+        out = f(q, k, v)
+        g = jax.jit(jax.grad(
+            lambda a, b, c: jnp.sum(f(a, b, c) ** 2), argnums=(0, 1, 2)))(
+                q, k, v)
+    finally:
+        F.STREAM_THRESHOLD = old
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    g_ref = jax.grad(
+        lambda a, b, c: jnp.sum(attention_reference(a, b, c, causal=True)
+                                ** 2), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
