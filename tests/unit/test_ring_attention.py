@@ -107,7 +107,8 @@ def test_ring_with_streamed_flash_chunks():
     from deepspeed_tpu.ops.attention import flash as F
     axes = {"seq": 4}
     mesh = build_mesh(axes)
-    S = 256 * axes["seq"]          # 256-long chunks -> 128-wide blocks
+    S = 384 * axes["seq"]          # 384-long chunks -> three 128-wide
+                                   # blocks each: a real multi-tile DMA loop
     q, k, v = _qkv(S, seed=5)
     old = F.STREAM_THRESHOLD
     try:
