@@ -1,9 +1,28 @@
 """DeepSpeed-TPU installation (reference setup.py, minus CUDA extensions —
-native components are prebuilt ctypes shared libraries under csrc/)."""
+the TPU compute path is JAX/XLA/Pallas; the native host pieces build as
+ctypes shared libraries from csrc/ at install time, with an on-demand
+rebuild fallback in the loader for source checkouts)."""
+
+import subprocess
 
 from setuptools import find_packages, setup
+from setuptools.command.build_py import build_py
+
+
+class BuildNativeThenPy(build_py):
+    """Build csrc/ ctypes libraries before packaging (reference setup.py
+    built its op extensions here; DS_BUILD_OPS=0 skips, like the
+    reference's env toggles)."""
+
+    def run(self):
+        import os
+        if os.environ.get("DS_BUILD_OPS", "1") != "0":
+            subprocess.check_call(["make", "-C", "csrc"])
+        super().run()
+
 
 setup(
+    cmdclass={"build_py": BuildNativeThenPy},
     name="deepspeed_tpu",
     version="0.1.0",
     description="TPU-native deep learning optimization library: ZeRO, "
