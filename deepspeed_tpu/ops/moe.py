@@ -142,7 +142,14 @@ def moe_layer(params, config: MoEConfig, x, *,
     parallelism); None = fully replicated experts. The constraint is all
     GSPMD needs — it inserts the token<->expert all_to_all pair. Pass
     ``mesh`` when calling outside a ``with mesh:`` context (e.g. from
-    the engine's compiled step, which jits with explicit shardings)."""
+    the engine's compiled step, which jits with explicit shardings).
+
+    Scale note: routing is formulated over the GLOBAL token set (T =
+    B*S), so expert buffers are (E, C_global, H) — exact and simple, and
+    what the tests pin, but the dispatch collective grows with the data
+    degree. At large dp, the standard refinement is per-shard dispatch
+    under shard_map (local capacity, explicit all_to_all); the kernel
+    math here is unchanged by that wrapping."""
     b, s, h = x.shape
     xt = x.reshape(b * s, h)
     dispatch, combine, aux = moe_router(params, config, xt)
