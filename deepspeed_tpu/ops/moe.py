@@ -133,6 +133,24 @@ def moe_router(params, config: MoEConfig, x_tokens):
     return dispatch, combine, lb + z
 
 
+# The expert math shared by both dispatch forms (moe_layer injects a
+# GSPMD sharding constraint around the (E, C, H) slot tensors; the
+# sharded form injects the all_to_all pair) — one implementation, so the
+# two forms cannot drift.
+def _dispatch_slots(dispatch, xt, dtype):
+    return jnp.einsum("tec,th->ech", dispatch.astype(dtype),
+                      xt.astype(dtype))
+
+
+def _expert_ffn(slots, wi, wo, dtype):
+    hdn = jax.nn.gelu(jnp.einsum("ech,ehf->ecf", slots, wi.astype(dtype)))
+    return jnp.einsum("ecf,efh->ech", hdn, wo.astype(dtype))
+
+
+def _combine_tokens(combine, out, dtype):
+    return jnp.einsum("tec,ech->th", combine.astype(dtype), out)
+
+
 def moe_layer(params, config: MoEConfig, x, *,
               expert_axis: Optional[str] = None, mesh=None,
               dtype=jnp.bfloat16):
@@ -164,13 +182,9 @@ def moe_layer(params, config: MoEConfig, x, *,
             return wsc(v, NamedSharding(mesh, spec))
         return wsc(v, spec)
 
-    expert_in = constrain(jnp.einsum("tec,th->ech", dispatch.astype(dtype),
-                                     xt.astype(dtype)))
-    hdn = jax.nn.gelu(jnp.einsum("ech,ehf->ecf", expert_in,
-                                 params["wi"].astype(dtype)))
-    out = constrain(jnp.einsum("ecf,efh->ech", hdn,
-                               params["wo"].astype(dtype)))
-    y = jnp.einsum("tec,ech->th", combine.astype(dtype), out)
+    slots = constrain(_dispatch_slots(dispatch, xt, dtype))
+    out = constrain(_expert_ffn(slots, params["wi"], params["wo"], dtype))
+    y = _combine_tokens(combine, out, dtype)
     return y.reshape(b, s, h).astype(x.dtype), aux
 
 
@@ -219,3 +233,60 @@ def moe_layer_reference(params, config: MoEConfig, x):
 def _np_gelu(x):
     return 0.5 * x * (1.0 + np.tanh(np.sqrt(2.0 / np.pi) *
                                     (x + 0.044715 * x ** 3)))
+
+
+def moe_layer_sharded(params, config: MoEConfig, x, mesh,
+                      expert_axis: str = "expert", dtype=jnp.bfloat16):
+    """Per-shard MoE dispatch under ``shard_map`` — the scalable form of
+    :func:`moe_layer` for large meshes.
+
+    Tokens AND experts shard over ``expert_axis`` (the classic
+    single-axis MoE layout): each of the P devices routes its local
+    T/P tokens with local capacity C_l = ceil(top_k * T_l * cf / E),
+    then one explicit ``all_to_all`` pair swaps the (E, C_l, H) slot tensors
+    so every device holds its E/P experts' slots from all peers —
+    collective payload per device is capacity-bound (E * C_l * H),
+    independent of the data degree, where the GSPMD global formulation
+    grows with it. Semantics match moe_layer except capacity/priority
+    are per shard (identical when nothing overflows).
+
+    x: (B, S, H) with B divisible by the axis size; params as
+    init_moe_params (router replicated; wi/wo sharded over experts).
+    Returns (y, aux) like moe_layer (aux is the mean over shards).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    p_size = mesh.shape[expert_axis]
+    e = config.num_experts
+    assert e % p_size == 0, (e, p_size)
+    b = x.shape[0]
+    assert b % p_size == 0, (x.shape, p_size)
+
+    def shard_fn(router, wi, vo, xs):
+        bs, ss, h = xs.shape
+        xt = xs.reshape(bs * ss, h)
+        dispatch, combine, aux = moe_router(
+            {"router": router}, config, xt)
+        # (T_l, E, C_l) x (T_l, H) -> (E, C_l, H) local slots
+        slots = jnp.einsum("tec,th->ech", dispatch.astype(dtype),
+                           xt.astype(dtype))
+        # swap: split experts across peers, gather peers' slots for ours
+        slots = jax.lax.all_to_all(slots, expert_axis, split_axis=0,
+                                   concat_axis=1, tiled=True)
+        hdn = jax.nn.gelu(jnp.einsum("ech,ehf->ecf", slots,
+                                     wi.astype(dtype)))
+        out = jnp.einsum("ecf,efh->ech", hdn, vo.astype(dtype))
+        # swap back: return each peer its tokens' outputs
+        out = jax.lax.all_to_all(out, expert_axis, split_axis=1,
+                                 concat_axis=0, tiled=True)
+        y = jnp.einsum("tec,ech->th", combine.astype(dtype), out)
+        aux = jax.lax.pmean(aux, expert_axis)
+        return y.reshape(bs, ss, h).astype(xs.dtype), aux
+
+    fn = jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(), P(expert_axis, None, None),
+                  P(expert_axis, None, None), P(expert_axis, None, None)),
+        out_specs=(P(expert_axis, None, None), P()),
+        check_vma=False)
+    return fn(params["router"], params["wi"], params["wo"], x)

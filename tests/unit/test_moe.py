@@ -166,3 +166,52 @@ class TestMoEGPT2:
             losses.append(float(engine.train_batch(iter([b]))))
         assert all(np.isfinite(l) for l in losses)
         assert losses[-1] < losses[0] - 0.3, (losses[0], losses[-1])
+
+
+class TestMoEShardedDispatch:
+    """moe_layer_sharded: per-shard routing + explicit all_to_all — the
+    capacity-bound-collective form of the layer."""
+
+    def _mesh(self):
+        from jax.sharding import Mesh
+        return Mesh(np.array(jax.devices()[:4]), ("expert",))
+
+    def test_matches_global_form_when_nothing_drops(self):
+        from deepspeed_tpu.ops.moe import moe_layer_sharded
+        # ample capacity: per-shard routing == global routing exactly
+        cfg, params, x = _setup(2, e=4, b=4, s=8, cf=8.0)
+        mesh = self._mesh()
+        y_g, _ = moe_layer(params, cfg, x, dtype=jnp.float32)
+        y_s, aux_s = jax.jit(lambda p, xx: moe_layer_sharded(
+            p, cfg, xx, mesh, dtype=jnp.float32))(params, x)
+        np.testing.assert_allclose(np.asarray(y_s), np.asarray(y_g),
+                                   atol=1e-6, rtol=1e-6)
+        assert np.isfinite(float(aux_s))
+
+    def test_gradients_flow_through_all_to_all(self):
+        from deepspeed_tpu.ops.moe import moe_layer_sharded
+        cfg, params, x = _setup(2, e=4, b=4, s=8)
+        mesh = self._mesh()
+
+        def loss(p, xx):
+            y, aux = moe_layer_sharded(p, cfg, xx, mesh,
+                                       dtype=jnp.float32)
+            return jnp.sum(y ** 2) + aux
+
+        g = jax.jit(jax.grad(loss))(params, x)
+        for name in ("router", "wi", "wo"):
+            arr = np.asarray(g[name])
+            assert np.all(np.isfinite(arr)) and np.abs(arr).max() > 0, name
+
+    def test_per_shard_capacity_is_local(self):
+        from deepspeed_tpu.ops.moe import expert_capacity, moe_layer_sharded
+        # tight capacity: per-shard dispatch drops per LOCAL counts; the
+        # layer must still produce finite outputs of the right shape
+        cfg, params, x = _setup(2, e=4, b=4, s=8, cf=0.5)
+        mesh = self._mesh()
+        y, aux = jax.jit(lambda p, xx: moe_layer_sharded(
+            p, cfg, xx, mesh, dtype=jnp.float32))(params, x)
+        assert y.shape == x.shape
+        assert bool(jnp.all(jnp.isfinite(y))) and np.isfinite(float(aux))
+        # local capacity really is smaller than the global one
+        assert expert_capacity(cfg, 8) < expert_capacity(cfg, 32)
