@@ -451,6 +451,25 @@ def init_gpt2_moe_params(config: GPT2Config, moe_config, key,
     return params
 
 
+def gpt2_moe_param_specs(config: GPT2Config, moe_every: int = 2):
+    """PartitionSpecs for the MoE GPT-2: dense blocks keep the Megatron
+    column/row TP specs; MoE blocks shard their expert banks over the
+    ``expert`` mesh axis (true expert parallelism — each device OWNS
+    E/ep experts' weights and optimizer state, it does not just
+    constrain activations). Router stays replicated (tiny, every token
+    needs it)."""
+    specs = gpt2_param_specs(config)
+    moe_mlp = {
+        "router": P(),
+        "wi": P("expert", None, None),
+        "wo": P("expert", None, None),
+    }
+    for i in range(config.num_layers):
+        if _is_moe_block(i, moe_every):
+            specs[f"h_{i}"] = dict(specs[f"h_{i}"], mlp=moe_mlp)
+    return specs
+
+
 def gpt2_moe_loss_fn(config: GPT2Config, moe_config, mesh=None,
                      moe_every: int = 2, dtype=jnp.bfloat16,
                      remat: bool = False, deterministic: bool = False):
