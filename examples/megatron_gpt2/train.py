@@ -55,6 +55,9 @@ def main():
     parser.add_argument("--save_interval", type=int, default=0)
     parser.add_argument("--load_dir", type=str, default=None,
                         help="resume from the latest checkpoint here")
+    parser.add_argument("--generate", type=int, default=0, metavar="N",
+                        help="after training, sample N tokens from the "
+                             "trained weights (dense zero2/offload modes)")
     args = parser.parse_args()
 
     here = os.path.dirname(os.path.abspath(__file__))
@@ -166,6 +169,22 @@ def main():
         if args.save_dir and args.save_interval and \
                 (step + 1) % args.save_interval == 0:
             engine.save_checkpoint(args.save_dir)
+
+    if args.generate:
+        if args.mode not in ("zero2", "offload"):
+            print(f"--generate: not supported for --mode {args.mode} "
+                  "(dense zero2/offload only); skipping")
+        else:
+            # sample from the just-trained weights (KV-cache decode);
+            # drain any in-flight offloaded host-Adam update first
+            engine.synchronize()
+            from deepspeed_tpu.models.gpt2 import gpt2_generate
+            prompt = rng.randint(0, cfg.vocab_size, (1, 4)).astype(np.int32)
+            out = gpt2_generate(engine.module_params, cfg,
+                                jax.numpy.asarray(prompt), args.generate,
+                                rng=jax.random.PRNGKey(0), temperature=0.9,
+                                top_k=40)
+            print("sampled:", np.asarray(out)[0].tolist())
     print("done")
 
 
