@@ -263,9 +263,31 @@ def bench_gpt2(on_tpu, rtt, dropout: float, metric: str, emit_last=False):
 
 
 def main():
+    import os
+    import threading
+
+    # fail fast with a clean JSON line if the device tunnel dies at ANY
+    # point — a blocked fetch hangs inside the C++ runtime where Python
+    # signal handlers never run, so a watchdog THREAD with os._exit is
+    # the only reliable escape. The main thread heartbeats after each
+    # metric; 900s with no progress = dead (a single row legitimately
+    # takes minutes of remote compiles, never 15 of them).
+    last_beat = [time.monotonic()]
+
+    def _watchdog():
+        while True:
+            time.sleep(30)
+            if time.monotonic() - last_beat[0] > 900:
+                _emit("gpt2_train_mfu", 0.0, "error", 0.0,
+                      {"error": "device unreachable: no benchmark "
+                                "progress for 900s (tunnel down?)"})
+                os._exit(1)
+
+    threading.Thread(target=_watchdog, daemon=True).start()
     import jax
     on_tpu = jax.default_backend() == "tpu"
     rtt = _rtt()
+    last_beat[0] = time.monotonic()
 
     for name, fn in [
         ("bert_large_samples_per_s", lambda: bench_bert_large(on_tpu, rtt)),
@@ -278,6 +300,7 @@ def main():
             fn()
         except Exception as e:  # a broken side metric must not kill the
             _emit(name, 0.0, "error", 0.0, {"error": repr(e)})  # headline
+        last_beat[0] = time.monotonic()
 
     # headline metric LAST (the driver reads the final JSON line)
     bench_gpt2(on_tpu, rtt, 0.0, "gpt2_train_mfu")
