@@ -111,9 +111,9 @@ def bench_sparse_attention(on_tpu, rtt):
         SparseSelfAttention, BSLongformerSparsityConfig)
 
     if on_tpu:
-        # S=8192: the longest dense flash supports on one v5e chip; the
-        # O(S) Longformer layout is where block-sparse pulls ahead (it
-        # also runs S=16384+, where dense cannot compile at all — the
+        # S=8192 with both kernels DMA-streaming; the O(S) Longformer
+        # layout is where block-sparse pulls ahead, and the gap widens
+        # at S=16k/32k where dense pays the full O(S^2) compute (the
         # reference's 10x-longer-sequences claim)
         B, H, S, D, iters = 1, 16, 8192, 64, 5
         block, win = 128, 9
