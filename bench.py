@@ -15,18 +15,58 @@ Metrics (BASELINE.md rows):
 - gpt2_train_mfu : the headline — Megatron-GPT2 345M + ZeRO-2, bf16,
   printed last (reference hardware-efficiency headline: 52% of peak)
 
-Timing protocol: value-fetch completion barrier + RTT subtraction, because
-block_until_ready acks early across the device tunnel (see
-.claude/skills/verify/SKILL.md).
+Architecture (tunnel-hardened): the parent process NEVER touches the
+device. Each metric runs in its own child subprocess
+(`bench.py --metric NAME`) with a wall-clock timeout; a dead tunnel
+hangs (and then kills) one child, not the whole ladder. Completed rows
+are checkpointed to a commit-keyed partial file so a re-run resumes
+instead of repeating, and each failed metric is retried after a tunnel
+liveness probe. A flaky tunnel therefore yields N good rows + an error
+row for the metric that died — never a single error line.
+
+Timing protocol (inside each child): value-fetch completion barrier +
+RTT subtraction, because block_until_ready acks early across the device
+tunnel (see .claude/skills/verify/SKILL.md).
 
 MFU accounting: model flops/token = 6*N + 12*L*S*H (PaLM appendix formula);
 peak = 197 TFLOP/s bf16 (TPU v5e).
 """
 
 import json
+import os
+import subprocess
+import sys
+import threading
 import time
 
 import numpy as np
+
+_EMIT_LOCK = threading.Lock()
+
+# Canonical ladder order; headline last (the driver reads the final line).
+METRICS = [
+    "bert_large_samples_per_s",
+    "sparse_attention_speedup_s8k",
+    "gpt2_train_mfu_dropout",
+    "gpt2_train_mfu",
+]
+HEADLINE = "gpt2_train_mfu"
+
+PARTIAL_PATH = os.environ.get(
+    "BENCH_PARTIAL", "/tmp/dstpu_bench_partial.jsonl")
+# First metric in a cold child pays remote compile time; give headroom.
+METRIC_TIMEOUT = int(os.environ.get("BENCH_METRIC_TIMEOUT", "1500"))
+METRIC_RETRIES = int(os.environ.get("BENCH_METRIC_RETRIES", "1"))
+
+
+def _apply_platform_override(jax):
+    """Honor JAX_PLATFORMS even though sitecustomize preloads jax (and the
+    axon TPU plugin) before env vars are read — same workaround as
+    tests/conftest.py. Without this, JAX_PLATFORMS=cpu still initializes
+    the tunnel backend, which HANGS when the tunnel is down."""
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        jax.config.update("jax_platforms", plat)
 
 
 def _fetch_time(zf):
@@ -43,11 +83,19 @@ def _rtt():
     return min(_fetch_time(zf) for _ in range(3))
 
 
+def _emit_row(row):
+    with _EMIT_LOCK:
+        print(json.dumps(row), flush=True)
+
+
 def _emit(metric, value, unit, vs_baseline, detail):
-    print(json.dumps({
-        "metric": metric, "value": value, "unit": unit,
-        "vs_baseline": vs_baseline, "detail": detail,
-    }), flush=True)
+    row = {"metric": metric, "value": value, "unit": unit,
+           "vs_baseline": vs_baseline, "detail": detail}
+    _emit_row(row)
+    return row
+
+
+# ---------------------------------------------------------------- metrics
 
 
 def bench_bert_large(on_tpu, rtt):
@@ -97,10 +145,10 @@ def bench_bert_large(on_tpu, rtt):
     np.asarray(loss)
     dt = max(time.perf_counter() - t0 - rtt, 1e-9)
     sps = batch * steps / dt
-    _emit("bert_large_samples_per_s", round(sps / max(n_dev, 1), 2),
-          "samples_per_s_per_chip", round(sps / max(n_dev, 1) / 272.0, 4),
-          {"seq": seq, "batch": batch, "dropout": 0.1,
-           "step_ms": round(dt / steps * 1000, 2), "loss": float(loss)})
+    return _emit("bert_large_samples_per_s", round(sps / max(n_dev, 1), 2),
+                 "samples_per_s_per_chip", round(sps / max(n_dev, 1) / 272.0, 4),
+                 {"seq": seq, "batch": batch, "dropout": 0.1,
+                  "step_ms": round(dt / steps * 1000, 2), "loss": float(loss)})
 
 
 def bench_sparse_attention(on_tpu, rtt):
@@ -182,17 +230,18 @@ def bench_sparse_attention(on_tpu, rtt):
             else "flash_time_over_sparse_time")
     # the 6.3x reference target is vanilla-relative: a flash-relative
     # fallback ratio is not comparable to it, so report no vs_baseline
-    _emit("sparse_attention_speedup_s8k", round(speedup, 3),
-          unit, round(speedup / 6.3, 4) if t_vanilla else None,
-          {"seq": S, "heads": H, "block": block, "window_blocks": win,
-           "kernel": kernel, "baseline": "vanilla" if t_vanilla else "flash",
-           "vanilla_ms": round(t_vanilla * 1000, 2) if t_vanilla else None,
-           "flash_ms": round(t_dense * 1000, 2),
-           "vs_flash": round(t_dense / t_sparse, 3),
-           "sparse_ms": round(t_sparse * 1000, 2)})
+    return _emit("sparse_attention_speedup_s8k", round(speedup, 3),
+                 unit, round(speedup / 6.3, 4) if t_vanilla else None,
+                 {"seq": S, "heads": H, "block": block, "window_blocks": win,
+                  "kernel": kernel,
+                  "baseline": "vanilla" if t_vanilla else "flash",
+                  "vanilla_ms": round(t_vanilla * 1000, 2) if t_vanilla else None,
+                  "flash_ms": round(t_dense * 1000, 2),
+                  "vs_flash": round(t_dense / t_sparse, 3),
+                  "sparse_ms": round(t_sparse * 1000, 2)})
 
 
-def bench_gpt2(on_tpu, rtt, dropout: float, metric: str, emit_last=False):
+def bench_gpt2(on_tpu, rtt, dropout: float, metric: str):
     import jax
     import jax.numpy as jnp
     import deepspeed_tpu
@@ -254,56 +303,222 @@ def bench_gpt2(on_tpu, rtt, dropout: float, metric: str, emit_last=False):
     tflops = tokens_per_s * flops_per_token / 1e12
     peak = 197.0 if on_tpu else 1e9
     mfu = tflops / peak / max(n_dev, 1)
-    _emit(metric, round(mfu, 4), "fraction_of_peak_bf16",
-          round(mfu / 0.52, 4),
-          {"model": f"gpt2-{n_params/1e6:.0f}M", "dropout": dropout,
-           "tokens_per_s_per_chip": round(tokens_per_s / max(n_dev, 1), 1),
-           "tflops_per_chip": round(tflops / max(n_dev, 1), 2),
-           "step_ms": round(dt / steps * 1000, 2), "loss": float(loss)})
+    return _emit(metric, round(mfu, 4), "fraction_of_peak_bf16",
+                 round(mfu / 0.52, 4),
+                 {"model": f"gpt2-{n_params/1e6:.0f}M", "dropout": dropout,
+                  "tokens_per_s_per_chip": round(tokens_per_s / max(n_dev, 1), 1),
+                  "tflops_per_chip": round(tflops / max(n_dev, 1), 2),
+                  "step_ms": round(dt / steps * 1000, 2), "loss": float(loss)})
 
 
-def main():
-    import os
-    import threading
+# ------------------------------------------------------------- child mode
 
-    # fail fast with a clean JSON line if the device tunnel dies at ANY
-    # point — a blocked fetch hangs inside the C++ runtime where Python
-    # signal handlers never run, so a watchdog THREAD with os._exit is
-    # the only reliable escape. The main thread heartbeats after each
-    # metric; 900s with no progress = dead (a single row legitimately
-    # takes minutes of remote compiles, never 15 of them).
+
+def run_child(metric):
+    """Run one metric in this process; print exactly one JSON row.
+
+    A stall watchdog still guards the child: a blocked device fetch hangs
+    inside the C++ runtime where Python signal handlers never run, so a
+    watchdog THREAD with os._exit is the only reliable escape (the parent's
+    subprocess timeout is the backstop if even this thread is starved).
+    """
     last_beat = [time.monotonic()]
 
     def _watchdog():
         while True:
             time.sleep(30)
             if time.monotonic() - last_beat[0] > 900:
-                _emit("gpt2_train_mfu", 0.0, "error", 0.0,
+                _emit(metric, 0.0, "error", 0.0,
                       {"error": "device unreachable: no benchmark "
                                 "progress for 900s (tunnel down?)"})
-                os._exit(1)
+                os._exit(2)
 
     threading.Thread(target=_watchdog, daemon=True).start()
     import jax
+    _apply_platform_override(jax)
     on_tpu = jax.default_backend() == "tpu"
     rtt = _rtt()
     last_beat[0] = time.monotonic()
 
-    for name, fn in [
-        ("bert_large_samples_per_s", lambda: bench_bert_large(on_tpu, rtt)),
-        ("sparse_attention_speedup_s8k",
-         lambda: bench_sparse_attention(on_tpu, rtt)),
-        ("gpt2_train_mfu_dropout",
-         lambda: bench_gpt2(on_tpu, rtt, 0.1, "gpt2_train_mfu_dropout")),
-    ]:
-        try:
-            fn()
-        except Exception as e:  # a broken side metric must not kill the
-            _emit(name, 0.0, "error", 0.0, {"error": repr(e)})  # headline
-        last_beat[0] = time.monotonic()
+    if metric == "bert_large_samples_per_s":
+        bench_bert_large(on_tpu, rtt)
+    elif metric == "sparse_attention_speedup_s8k":
+        bench_sparse_attention(on_tpu, rtt)
+    elif metric == "gpt2_train_mfu_dropout":
+        bench_gpt2(on_tpu, rtt, 0.1, "gpt2_train_mfu_dropout")
+    elif metric == "gpt2_train_mfu":
+        bench_gpt2(on_tpu, rtt, 0.0, "gpt2_train_mfu")
+    else:
+        raise SystemExit(f"unknown metric {metric!r}")
 
-    # headline metric LAST (the driver reads the final JSON line)
-    bench_gpt2(on_tpu, rtt, 0.0, "gpt2_train_mfu")
+
+# ------------------------------------------------------------ parent mode
+
+
+def _git_head():
+    """Resume key: HEAD commit + a digest of any uncommitted changes —
+    a dirty-tree edit must invalidate checkpointed rows (they measured
+    the pre-edit code)."""
+    import hashlib
+    repo = os.path.dirname(os.path.abspath(__file__))
+    try:
+        head = subprocess.run(
+            ["git", "rev-parse", "HEAD"], capture_output=True, text=True,
+            cwd=repo, timeout=10).stdout.strip()
+        if not head:
+            return None
+        diff = subprocess.run(
+            ["git", "diff", "HEAD"], capture_output=True, text=True,
+            cwd=repo, timeout=30).stdout
+        # untracked files count too: a new module imported by the
+        # benchmarked code must invalidate checkpointed rows
+        h = hashlib.sha256(diff.encode())
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            capture_output=True, text=True, cwd=repo, timeout=30
+        ).stdout.split()
+        for f in sorted(untracked):
+            h.update(f.encode())
+            try:
+                with open(os.path.join(repo, f), "rb") as fh:
+                    h.update(fh.read())
+            except OSError:
+                pass
+        if diff or untracked:
+            head += "+" + h.hexdigest()[:12]
+        return head
+    except Exception:
+        return None
+
+
+def _load_partial(head):
+    """Rows checkpointed by a previous run at the SAME commit, else {}."""
+    if os.environ.get("BENCH_NO_RESUME") or head is None:
+        return {}
+    rows = {}
+    try:
+        with open(PARTIAL_PATH) as f:
+            header = json.loads(f.readline())
+            if header.get("head") != head:
+                return {}
+            for line in f:
+                row = json.loads(line)
+                if row.get("unit") != "error":
+                    rows[row["metric"]] = row
+    except Exception:
+        return {}
+    return rows
+
+
+def _append_partial(head, row, fresh):
+    """Returns the next value of ``fresh``: stays True if the header
+    write failed (appending under a stale different-commit header would
+    let a later run resume the wrong rows)."""
+    try:
+        mode = "w" if fresh else "a"
+        with open(PARTIAL_PATH, mode) as f:
+            if fresh:
+                f.write(json.dumps({"head": head}) + "\n")
+            f.write(json.dumps(row) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        return False
+    except Exception:
+        # checkpointing is best-effort; never kill the ladder for it
+        return fresh
+
+
+def _probe_tunnel(timeout=300):
+    """True iff a tiny device matmul completes in a fresh subprocess."""
+    code = ("import os, jax\n"
+            "p = os.environ.get('JAX_PLATFORMS')\n"
+            "if p: jax.config.update('jax_platforms', p)\n"
+            "import numpy as np, jax.numpy as jnp\n"
+            "x = jnp.ones((256,256), jnp.bfloat16)\n"
+            "np.asarray(x @ x); print('ok')")
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True, timeout=timeout)
+        return "ok" in r.stdout
+    except Exception:
+        return False
+
+
+def _run_metric_subprocess(metric):
+    """(row, err): parse the child's last JSON row; err string on failure."""
+    cmd = [sys.executable, os.path.abspath(__file__), "--metric", metric]
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=METRIC_TIMEOUT)
+    except subprocess.TimeoutExpired:
+        return None, f"metric subprocess exceeded {METRIC_TIMEOUT}s (killed)"
+    row = None
+    for line in r.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                cand = json.loads(line)
+                if cand.get("metric") == metric:
+                    row = cand
+            except ValueError:
+                pass
+    if row is None:
+        tail = (r.stderr or r.stdout or "").strip().splitlines()[-3:]
+        return None, f"child rc={r.returncode}, no row; tail={' | '.join(tail)}"
+    if row.get("unit") == "error":
+        return None, str(row.get("detail", {}).get("error", "child error row"))
+    return row, None
+
+
+def main():
+    if len(sys.argv) >= 3 and sys.argv[1] == "--metric":
+        run_child(sys.argv[2])
+        return
+
+    head = _git_head()
+    done = _load_partial(head)
+    fresh = not done  # rewrite the partial file unless resuming
+    if done:
+        print(f"# resuming {len(done)} checkpointed row(s) from "
+              f"{PARTIAL_PATH}", file=sys.stderr, flush=True)
+
+    failed = {}
+    for metric in METRICS:
+        if metric in done:
+            continue
+        err = None
+        for attempt in range(1 + METRIC_RETRIES):
+            if attempt > 0:
+                # only retry against a live tunnel; a second hang costs
+                # another METRIC_TIMEOUT for nothing
+                if not _probe_tunnel():
+                    time.sleep(60)
+                    if not _probe_tunnel():
+                        err = f"{err}; tunnel probe dead, retry skipped"
+                        break
+            row, err = _run_metric_subprocess(metric)
+            if row is not None:
+                done[metric] = row
+                fresh = _append_partial(head, row, fresh)
+                break
+        if metric not in done:
+            failed[metric] = err or "unknown failure"
+
+    # Emit everything in canonical order, headline last. Completed rows
+    # are real; failed rows are explicit error rows — a flaky tunnel
+    # yields N good rows + per-metric errors, never one bare error line.
+    for metric in METRICS:
+        if metric == HEADLINE:
+            continue
+        if metric in done:
+            _emit_row(done[metric])
+        else:
+            _emit(metric, 0.0, "error", 0.0, {"error": failed[metric]})
+    if HEADLINE in done:
+        _emit_row(done[HEADLINE])
+    else:
+        _emit(HEADLINE, 0.0, "error", 0.0,
+              {"error": failed.get(HEADLINE, "unknown failure")})
 
 
 if __name__ == "__main__":

@@ -167,12 +167,27 @@ class DeepSpeedCPUAdam:
         p -= (lr / bc1) * (m / denom)
 
     def step(self, grads: Any, lr: Optional[float] = None,
-             bf16_out: bool = False):
+             bf16_out: bool = False, beta1: Optional[float] = None):
         """One Adam step over every leaf. Returns the updated parameter
         pytree — bf16 numpy arrays when ``bf16_out`` (the H2D payload),
-        else fp32 views of the master copy."""
+        else fp32 views of the master copy.
+
+        ``beta1``: scheduled momentum override (OneCycle cycle_momentum).
+        The native side keeps only an AdamConfig (all state lives in the
+        numpy arrays here), so re-registering the config with the new
+        beta1 is a cheap, safe way to retune it mid-training."""
         import jax
         lr = self.lr if lr is None else float(lr)
+        if beta1 is not None and float(beta1) != self.betas[0]:
+            self.betas = (float(beta1), self.betas[1])
+            if self._lib is not None:
+                self._lib.ds_adam_create(
+                    self.opt_id, ctypes.c_float(self.lr),
+                    ctypes.c_float(self.betas[0]),
+                    ctypes.c_float(self.betas[1]),
+                    ctypes.c_float(self.eps),
+                    ctypes.c_float(self.weight_decay),
+                    int(self.adamw_mode), int(self.bias_correction))
         self.step_count += 1
         g_leaves = self._treedef.flatten_up_to(grads)
         outs = []

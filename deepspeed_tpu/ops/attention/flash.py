@@ -798,6 +798,34 @@ def flash_attention(q, k, v, mask=None, causal: bool = False,
                                    dropout_rate=dropout_rate,
                                    dropout_seed=seed.reshape(())
                                    if dropout_rate > 0.0 else None)
+    if (max(sq, sk) >= STREAM_THRESHOLD
+            and (sq % 128 != 0 or sk % 128 != 0)):
+        # long irregular sequences: the resident path may fail to compile
+        # at S>=16k (VMEM), so pad to the next 128 multiple and let the
+        # DMA-streaming path engage. Padded keys get a NEG_INF additive
+        # mask (their probabilities are exactly squashed, so valid rows
+        # are unchanged); padded query rows are sliced away, which also
+        # zeroes their gradient contribution under autodiff.
+        pq, pk = (-sq) % 128, (-sk) % 128
+        b = q.shape[0]
+        qp = jnp.pad(q, ((0, 0), (0, 0), (0, pq), (0, 0)))
+        kp = jnp.pad(k, ((0, 0), (0, 0), (0, pk), (0, 0)))
+        vp = jnp.pad(v, ((0, 0), (0, 0), (0, pk), (0, 0)))
+        if mask is None and pk == 0:
+            mp = None   # query-only padding needs no mask: stay unmasked
+        else:
+            key_pad = jnp.concatenate(
+                [jnp.zeros((b, 1, 1, sk), jnp.float32),
+                 jnp.full((b, 1, 1, pk), -1e30, jnp.float32)], axis=-1)
+            mp = key_pad if mask is None else (
+                jnp.pad(mask.astype(jnp.float32),
+                        ((0, 0), (0, 0), (0, 0), (0, pk))) + key_pad)
+        out = flash_attention(qp, kp, vp, mask=mp, causal=causal,
+                              sm_scale=sm_scale,
+                              dropout_rate=dropout_rate,
+                              dropout_rng=dropout_rng,
+                              interpret=interpret)
+        return out[:, :, :sq, :]
     if mask is None:
         return _flash_attention(q, k, v, seed, causal, float(sm_scale),
                                 interpret, dropout_rate)

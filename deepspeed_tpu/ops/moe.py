@@ -165,9 +165,13 @@ def moe_layer(params, config: MoEConfig, x, *,
     Scale note: routing is formulated over the GLOBAL token set (T =
     B*S), so expert buffers are (E, C_global, H) — exact and simple, and
     what the tests pin, but the dispatch collective grows with the data
-    degree. At large dp, the standard refinement is per-shard dispatch
-    under shard_map (local capacity, explicit all_to_all); the kernel
-    math here is unchanged by that wrapping."""
+    degree, AND the one-hot dispatch/combine tensors are (T, E, C) with
+    E*C ~= top_k*capacity_factor*T, i.e. ~2.5*T^2 elements per MoE layer
+    — at T=16k global tokens that is ~2.6GB fp32 of HBM per layer,
+    which OOMs before the collective-growth concern bites. Above a few
+    thousand global tokens use :func:`moe_layer_sharded` (per-shard
+    dispatch under shard_map: local capacity, explicit all_to_all);
+    the kernel math here is unchanged by that wrapping."""
     b, s, h = x.shape
     xt = x.reshape(b * s, h)
     dispatch, combine, aux = moe_router(params, config, xt)

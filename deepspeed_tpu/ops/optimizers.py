@@ -80,10 +80,15 @@ class Adam(Optimizer):
             exp_avg_sq=_tree_zeros_like(params, jnp.float32),
         )
 
-    def update(self, grads, state, params, lr=None):
+    def update(self, grads, state, params, lr=None, momentum=None):
+        """``momentum``: optional (traced) beta1 override — the OneCycle
+        momentum-cycling hook (reference lr_schedules.py:518 mutates
+        param_groups betas every step; here the scheduled value flows
+        into the compiled update like the lr does)."""
         lr = self.lr if lr is None else lr
+        b1 = self.b1 if momentum is None else momentum
         step = state.step + 1
-        b1, b2, eps, wd = self.b1, self.b2, self.eps, self.weight_decay
+        b2, eps, wd = self.b2, self.eps, self.weight_decay
 
         if self.bias_correction:
             bc1 = 1.0 - b1 ** step.astype(jnp.float32)
@@ -132,9 +137,10 @@ class SGD(Optimizer):
             momentum_buf=_tree_zeros_like(params, jnp.float32),
         )
 
-    def update(self, grads, state, params, lr=None):
+    def update(self, grads, state, params, lr=None, momentum=None):
         lr = self.lr if lr is None else lr
-        mu, wd = self.momentum, self.weight_decay
+        mu = self.momentum if momentum is None else momentum
+        wd = self.weight_decay
 
         def leaf(p, g, buf):
             g = g.astype(jnp.float32)
@@ -181,10 +187,11 @@ class Lamb(Optimizer):
             exp_avg_sq=_tree_zeros_like(params, jnp.float32),
         )
 
-    def update(self, grads, state, params, lr=None):
+    def update(self, grads, state, params, lr=None, momentum=None):
         lr = self.lr if lr is None else lr
+        b1 = self.b1 if momentum is None else momentum
         step = state.step + 1
-        b1, b2, eps, wd = self.b1, self.b2, self.eps, self.weight_decay
+        b2, eps, wd = self.b2, self.eps, self.weight_decay
         if self.bias_correction:
             bc1 = 1.0 - b1 ** step.astype(jnp.float32)
             bc2 = 1.0 - b2 ** step.astype(jnp.float32)

@@ -337,8 +337,8 @@ def _sparse_attention_fn(layout: np.ndarray, block: int, sm_scale: float,
         return _FN_CACHE[key]
 
     H, nq, nk = layout.shape
-    use_v2 = not has_am and USE_SPLASH_V2 and (interpret or block % 128 == 0)
-    if not use_v2 and not has_am and USE_SPLASH_V2 and not interpret:
+    use_v2 = USE_SPLASH_V2 and (interpret or block % 128 == 0)
+    if not use_v2 and USE_SPLASH_V2 and not interpret:
         # v2 wanted but the block width can't be a DMA lane dim
         global _WARNED_V1_BLOCK
         if not _WARNED_V1_BLOCK:
@@ -351,27 +351,44 @@ def _sparse_attention_fn(layout: np.ndarray, block: int, sm_scale: float,
                 "v1 kernels (~row-degree x more program launches). Use "
                 "block=128 for long-sequence performance.", stacklevel=3)
     if use_v2:
-        # row-run kernels: one program per block row, K/V streamed by
-        # DMA (blocksparse_v2.py) — ~row-degree x fewer program launches.
-        # Compiled mode needs 128-multiple blocks: the streamed (D, block)
-        # tile puts the block width in the DMA lane dim, which Mosaic
-        # requires to be 128-aligned; smaller blocks use the v1 kernels
+        # row-run kernels: one program per block row, K/V (and the
+        # deduped attn-mask tiles) streamed by DMA (blocksparse_v2.py)
+        # — ~row-degree x fewer program launches. Compiled mode needs
+        # 128-multiple blocks: a streamed tile puts the block width in
+        # the DMA lane dim, which Mosaic requires to be 128-aligned;
+        # smaller blocks use the v1 kernels
         from deepspeed_tpu.ops.sparse_attention.blocksparse_v2 import (
             build_v2_impls)
-        fwd2, bwd2 = build_v2_impls(layout, block, sm_scale, interpret)
+        fwd2, bwd2 = build_v2_impls(layout, block, sm_scale, interpret,
+                                    has_am=has_am)
 
-        @jax.custom_vjp
-        def f2(q, k, v, kpm):
-            return fwd2(q, k, v, kpm, None)[0]
+        if has_am:
+            @jax.custom_vjp
+            def f2(q, k, v, kpm, am):
+                return fwd2(q, k, v, kpm, am)[0]
 
-        def f2_fwd(q, k, v, kpm):
-            o, lse = fwd2(q, k, v, kpm, None)
-            return o, (q, k, v, kpm, o, lse)
+            def f2_fwd(q, k, v, kpm, am):
+                o, lse = fwd2(q, k, v, kpm, am)
+                return o, (q, k, v, kpm, am, o, lse)
 
-        def f2_bwd(res, g):
-            q, k, v, kpm, o, lse = res
-            dq, dk, dv = bwd2(q, k, v, kpm, None, o, lse, g)
-            return dq, dk, dv, jnp.zeros_like(kpm)
+            def f2_bwd(res, g):
+                q, k, v, kpm, am, o, lse = res
+                dq, dk, dv = bwd2(q, k, v, kpm, am, o, lse, g)
+                return (dq, dk, dv, jnp.zeros_like(kpm),
+                        jnp.zeros_like(am))
+        else:
+            @jax.custom_vjp
+            def f2(q, k, v, kpm):
+                return fwd2(q, k, v, kpm, None)[0]
+
+            def f2_fwd(q, k, v, kpm):
+                o, lse = fwd2(q, k, v, kpm, None)
+                return o, (q, k, v, kpm, o, lse)
+
+            def f2_bwd(res, g):
+                q, k, v, kpm, o, lse = res
+                dq, dk, dv = bwd2(q, k, v, kpm, None, o, lse, g)
+                return dq, dk, dv, jnp.zeros_like(kpm)
 
         f2.defvjp(f2_fwd, f2_bwd)
         _FN_CACHE[key] = f2

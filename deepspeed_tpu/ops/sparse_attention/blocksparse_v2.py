@@ -22,9 +22,18 @@ VMEM-resident (1, 1, S) blocked refs (≤256KB at S=16k) sliced in-kernel
 at 128-aligned offsets. The dkv pass mirrors the walk column-major with
 CSC metadata (q/do streamed transposed, k/v resident).
 
+Blocked attention masks (``has_am`` — the BERT fine-tune configuration
+the reference's sparse speedups are built on,
+deepspeed/ops/sparse_attention/trsrc/softmax_fwd.tr:100-119) stream the
+same way: the (nq, nk, block, block) additive mask is deduplicated to
+the UNIQUE nonzero tiles of the head-union layout (masks are
+head-independent, so storing per-item tiles would multiply HBM by H),
+and a scalar-prefetched per-item uid list drives a third double-buffered
+DMA stream — a (block, block) tile's lane dim is the 128-aligned block,
+so the same alignment argument as K/V applies.
+
 Same math as v1 (bf16 MXU operands / fp32 accumulation, scale post-dot,
-exact-zero structurally-masked probabilities); used for the
-``has_am=False`` path — the blocked attn-mask variant stays on v1.
+exact-zero structurally-masked probabilities).
 """
 
 import functools
@@ -66,35 +75,82 @@ def build_row_runs(layout: np.ndarray) -> Tuple[np.ndarray, ...]:
             np.asarray(cols if cols else [0], np.int32))
 
 
+def build_am_index(layout: np.ndarray):
+    """(uq, uk, csr_uids, csc_uids): unique (qb, kb) tile coordinates of
+    the head-union layout, plus per-item indices into that unique array
+    in CSR (row-run) and CSC (column-run) walk order."""
+    H, nq, nk = layout.shape
+    union = layout.sum(axis=0) > 0
+    pairs = np.argwhere(union)                      # (U, 2) [qb, kb]
+    uid_of = {(int(a), int(b)): i for i, (a, b) in enumerate(pairs)}
+    csr_uids, csc_uids = [], []
+    for h in range(H):
+        for r in range(nq):
+            for c in np.nonzero(layout[h, r])[0]:
+                csr_uids.append(uid_of[(r, int(c))])
+    lt = layout.transpose(0, 2, 1)
+    for h in range(H):
+        for kb in range(nk):
+            for rq in np.nonzero(lt[h, kb])[0]:
+                csc_uids.append(uid_of[(int(rq), kb)])
+    return (np.asarray(pairs[:, 0], np.int32),
+            np.asarray(pairs[:, 1], np.int32),
+            np.asarray(csr_uids or [0], np.int32),
+            np.asarray(csc_uids or [0], np.int32))
+
+
 def _dma(src_hbm, c, row, buf, slot, sem):
     # src_hbm: full (rows, n_blocks, D, block) in HBM; whole-tile copy
     return pltpu.make_async_copy(src_hbm.at[row, c], buf.at[slot],
                                  sem.at[slot])
 
 
-def _stream_start(refs_bufs_sems, cols_ref, base, i, row):
+def _am_dma(am_hbm, uid, buf, slot, sem):
+    # am_hbm: unique tiles (U, block, block) in HBM
+    return pltpu.make_async_copy(am_hbm.at[uid], buf.at[slot],
+                                 sem.at[slot])
+
+
+def _stream_start(refs_bufs_sems, cols_ref, base, i, row,
+                  am_stream=None, uids_ref=None):
     c = cols_ref[base + i]
     slot = jax.lax.rem(i, 2)
     for src, buf, sem in refs_bufs_sems:
         _dma(src, c, row, buf, slot, sem).start()
+    if am_stream is not None:
+        am_hbm, ambuf, amsem = am_stream
+        _am_dma(am_hbm, uids_ref[base + i], ambuf, slot, amsem).start()
 
 
-def _stream_wait(refs_bufs_sems, cols_ref, base, i, row):
+def _stream_wait(refs_bufs_sems, cols_ref, base, i, row,
+                 am_stream=None, uids_ref=None):
     c = cols_ref[base + i]
     slot = jax.lax.rem(i, 2)
     out = []
     for src, buf, sem in refs_bufs_sems:
         _dma(src, c, row, buf, slot, sem).wait()
         out.append(buf[slot])
+    if am_stream is not None:
+        am_hbm, ambuf, amsem = am_stream
+        _am_dma(am_hbm, uids_ref[base + i], ambuf, slot, amsem).wait()
+        out.append(ambuf[slot])
     return c, out
 
 
 # --------------------------------------------------------------------- #
 # forward: one program per block row
 # --------------------------------------------------------------------- #
-def _v2_fwd_kernel(rows_ref, offs_ref, cnts_ref, cols_ref,
-                   q_ref, k_hbm, v_hbm, kpm_ref, o_ref, lse_ref,
-                   kbuf, vbuf, ksem, vsem, *, sm_scale, block, heads, nq):
+def _v2_fwd_kernel(*refs, sm_scale, block, heads, nq, has_am):
+    if has_am:
+        (rows_ref, offs_ref, cnts_ref, cols_ref, uids_ref,
+         q_ref, k_hbm, v_hbm, am_hbm, kpm_ref, o_ref, lse_ref,
+         kbuf, vbuf, ambuf, ksem, vsem, amsem) = refs
+        am_stream = (am_hbm, ambuf, amsem)
+    else:
+        (rows_ref, offs_ref, cnts_ref, cols_ref,
+         q_ref, k_hbm, v_hbm, kpm_ref, o_ref, lse_ref,
+         kbuf, vbuf, ksem, vsem) = refs
+        uids_ref = am_stream = None
     r = pl.program_id(1)
     n = cnts_ref[r]
     base = offs_ref[r]
@@ -105,21 +161,26 @@ def _v2_fwd_kernel(rows_ref, offs_ref, cnts_ref, cols_ref,
 
     @pl.when(n > 0)
     def _prologue():
-        _stream_start(streams, cols_ref, base, 0, bh)
+        _stream_start(streams, cols_ref, base, 0, bh, am_stream, uids_ref)
 
     def body(i, carry):
         m, l, acc = carry
 
         @pl.when(i + 1 < n)
         def _prefetch_next():
-            _stream_start(streams, cols_ref, base, i + 1, bh)
+            _stream_start(streams, cols_ref, base, i + 1, bh,
+                          am_stream, uids_ref)
 
         # streamed tiles arrive transposed: k, v are (D, block)
-        c, (k, v) = _stream_wait(streams, cols_ref, base, i, bh)
+        c, tiles = _stream_wait(streams, cols_ref, base, i, bh,
+                                am_stream, uids_ref)
+        k, v = tiles[0], tiles[1]
         s = jax.lax.dot_general(q, k, (((1,), (0,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s = s * sm_scale
         s += kpm_ref[0, 0, pl.ds(c * block, block)][None, :]
+        if has_am:
+            s += tiles[2]                              # (block, block)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         p = jnp.where(s > VALID_THRESH, jnp.exp(s - m_new[:, None]), 0.0)
         alpha = jnp.exp(m - m_new)
@@ -141,10 +202,17 @@ def _v2_fwd_kernel(rows_ref, offs_ref, cnts_ref, cols_ref,
 # --------------------------------------------------------------------- #
 # dq: same row-run walk
 # --------------------------------------------------------------------- #
-def _v2_dq_kernel(rows_ref, offs_ref, cnts_ref, cols_ref,
-                  q_ref, k_hbm, v_hbm, kpm_ref, do_ref, lse_ref, delta_ref,
-                  dq_ref, kbuf, vbuf, ksem, vsem,
-                  *, sm_scale, block, heads, nq):
+def _v2_dq_kernel(*refs, sm_scale, block, heads, nq, has_am):
+    if has_am:
+        (rows_ref, offs_ref, cnts_ref, cols_ref, uids_ref,
+         q_ref, k_hbm, v_hbm, am_hbm, kpm_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, kbuf, vbuf, ambuf, ksem, vsem, amsem) = refs
+        am_stream = (am_hbm, ambuf, amsem)
+    else:
+        (rows_ref, offs_ref, cnts_ref, cols_ref,
+         q_ref, k_hbm, v_hbm, kpm_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, kbuf, vbuf, ksem, vsem) = refs
+        uids_ref = am_stream = None
     r = pl.program_id(1)
     n = cnts_ref[r]
     base = offs_ref[r]
@@ -158,19 +226,24 @@ def _v2_dq_kernel(rows_ref, offs_ref, cnts_ref, cols_ref,
 
     @pl.when(n > 0)
     def _prologue():
-        _stream_start(streams, cols_ref, base, 0, bh)
+        _stream_start(streams, cols_ref, base, 0, bh, am_stream, uids_ref)
 
     def body(i, dq):
         @pl.when(i + 1 < n)
         def _prefetch_next():
-            _stream_start(streams, cols_ref, base, i + 1, bh)
+            _stream_start(streams, cols_ref, base, i + 1, bh,
+                          am_stream, uids_ref)
 
         # streamed tiles arrive transposed: k, v are (D, block)
-        c, (k, v) = _stream_wait(streams, cols_ref, base, i, bh)
+        c, tiles = _stream_wait(streams, cols_ref, base, i, bh,
+                                am_stream, uids_ref)
+        k, v = tiles[0], tiles[1]
         s = jax.lax.dot_general(q, k, (((1,), (0,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s = s * sm_scale
         s += kpm_ref[0, 0, pl.ds(c * block, block)][None, :]
+        if has_am:
+            s += tiles[2]
         p = jnp.where(s > VALID_THRESH, jnp.exp(s - lse[:, None]), 0.0)
         dp = jax.lax.dot_general(do, v, (((1,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -186,10 +259,17 @@ def _v2_dq_kernel(rows_ref, offs_ref, cnts_ref, cols_ref,
 # --------------------------------------------------------------------- #
 # dk/dv: one program per block column, streaming q/do
 # --------------------------------------------------------------------- #
-def _v2_dkv_kernel(crows_ref, coffs_ref, ccnts_ref, crowids_ref,
-                   k_ref, v_ref, kpm_ref, q_hbm, do_hbm, lse_ref, delta_ref,
-                   dk_ref, dv_ref, qbuf, dobuf, qsem, dosem,
-                   *, sm_scale, block, heads, nk):
+def _v2_dkv_kernel(*refs, sm_scale, block, heads, nk, has_am):
+    if has_am:
+        (crows_ref, coffs_ref, ccnts_ref, crowids_ref, uids_ref,
+         k_ref, v_ref, kpm_ref, q_hbm, do_hbm, am_hbm, lse_ref, delta_ref,
+         dk_ref, dv_ref, qbuf, dobuf, ambuf, qsem, dosem, amsem) = refs
+        am_stream = (am_hbm, ambuf, amsem)
+    else:
+        (crows_ref, coffs_ref, ccnts_ref, crowids_ref,
+         k_ref, v_ref, kpm_ref, q_hbm, do_hbm, lse_ref, delta_ref,
+         dk_ref, dv_ref, qbuf, dobuf, qsem, dosem) = refs
+        uids_ref = am_stream = None
     t = pl.program_id(1)
     n = ccnts_ref[t]
     base = coffs_ref[t]
@@ -202,23 +282,29 @@ def _v2_dkv_kernel(crows_ref, coffs_ref, ccnts_ref, crowids_ref,
 
     @pl.when(n > 0)
     def _prologue():
-        _stream_start(streams, crowids_ref, base, 0, bh)
+        _stream_start(streams, crowids_ref, base, 0, bh,
+                      am_stream, uids_ref)
 
     def body(i, carry):
         dk, dv = carry
 
         @pl.when(i + 1 < n)
         def _prefetch_next():
-            _stream_start(streams, crowids_ref, base, i + 1, bh)
+            _stream_start(streams, crowids_ref, base, i + 1, bh,
+                          am_stream, uids_ref)
 
         # streamed tiles arrive transposed: q, do are (D, block)
-        rq, (q, do) = _stream_wait(streams, crowids_ref, base, i, bh)
+        rq, tiles = _stream_wait(streams, crowids_ref, base, i, bh,
+                                 am_stream, uids_ref)
+        q, do = tiles[0], tiles[1]
         lse = lse_ref[0, 0, pl.ds(rq * block, block)]
         delta = delta_ref[0, 0, pl.ds(rq * block, block)]
         s = jax.lax.dot_general(q, k, (((0,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s = s * sm_scale                               # (bq, bk)
         s += kpm_row[None, :]
+        if has_am:
+            s += tiles[2]                              # (bq, bk) tile
         p = jnp.where(s > VALID_THRESH, jnp.exp(s - lse[:, None]), 0.0)
         dv_new = dv + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (1,)), ((), ())),
@@ -241,37 +327,65 @@ def _v2_dkv_kernel(crows_ref, coffs_ref, ccnts_ref, crowids_ref,
 # builders
 # --------------------------------------------------------------------- #
 def build_v2_impls(layout: np.ndarray, block: int, sm_scale: float,
-                   interpret: bool):
-    """Returns (fwd_impl, bwd_impl) with the v1 signatures (am must be
-    None)."""
+                   interpret: bool, has_am: bool = False):
+    """Returns (fwd_impl, bwd_impl) with the v1 signatures. When
+    ``has_am`` the impls take a pre-blocked additive (nq, nk, block,
+    block) mask; it is deduplicated to unique head-union tiles and
+    DMA-streamed per item."""
     H, nq, nk = layout.shape
     rr = build_row_runs(layout)
     cr = build_row_runs(np.ascontiguousarray(layout.transpose(0, 2, 1)))
     R = rr[0].shape[0]
     C = cr[0].shape[0]
+    if has_am:
+        uq, uk, csr_uids, csc_uids = build_am_index(layout)
     compiler_params = _compiler_params(interpret, stream=True)
     hbm_spec = pl.BlockSpec(memory_space=pltpu.HBM)
 
+    def _unique_am(am):
+        # (nq, nk, block, block) additive -> (U, block, block) fp32
+        return am.astype(jnp.float32)[jnp.asarray(uq), jnp.asarray(uk)]
+
+    def _am_scratch(dtype=jnp.float32):
+        return [pltpu.VMEM((2, block, block), dtype),
+                pltpu.SemaphoreType.DMA((2,))]
+
     def fwd_impl(q, k, v, kpm, am):
-        assert am is None
+        assert (am is not None) == has_am
         B, _, S, D = q.shape
         qr = q.reshape(B * H, S, D)
         kr = _stream_layout(k.reshape(B * H, S, D), block)
         vr = _stream_layout(v.reshape(B * H, S, D), block)
         kpmr = kpm.reshape(B, 1, S)   # VMEM-resident, sliced in-kernel
         kernel = functools.partial(_v2_fwd_kernel, sm_scale=sm_scale,
-                                   block=block, heads=H, nq=nq)
+                                   block=block, heads=H, nq=nq,
+                                   has_am=has_am)
+        in_specs = [
+            pl.BlockSpec((1, block, D),
+                         lambda i, r, rw, *_: (i * H + rw[r] // nq,
+                                               rw[r] % nq, 0)),
+            hbm_spec,
+            hbm_spec,
+        ]
+        args = [qr, kr, vr]
+        scalars = list(rr)
+        if has_am:
+            scalars.append(csr_uids)
+            in_specs.append(hbm_spec)
+            args.append(_unique_am(am))
+        in_specs.append(pl.BlockSpec((1, 1, S), lambda i, r, *_: (i, 0, 0)))
+        args.append(kpmr)
+        scratch = [
+            pltpu.VMEM((2, D, block), k.dtype),
+            pltpu.VMEM((2, D, block), v.dtype),
+        ] + (_am_scratch()[:1] if has_am else []) + [
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ] + (_am_scratch()[1:] if has_am else [])
         grid_spec = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=4,
+            num_scalar_prefetch=len(scalars),
             grid=(B, R),
-            in_specs=[
-                pl.BlockSpec((1, block, D),
-                             lambda i, r, rw, *_: (i * H + rw[r] // nq,
-                                                   rw[r] % nq, 0)),
-                hbm_spec,
-                hbm_spec,
-                pl.BlockSpec((1, 1, S), lambda i, r, *_: (i, 0, 0)),
-            ],
+            in_specs=in_specs,
             out_specs=[
                 pl.BlockSpec((1, block, D),
                              lambda i, r, rw, *_: (i * H + rw[r] // nq,
@@ -280,12 +394,7 @@ def build_v2_impls(layout: np.ndarray, block: int, sm_scale: float,
                              lambda i, r, rw, *_: (i * H + rw[r] // nq,
                                                    rw[r] % nq, 0)),
             ],
-            scratch_shapes=[
-                pltpu.VMEM((2, D, block), k.dtype),
-                pltpu.VMEM((2, D, block), v.dtype),
-                pltpu.SemaphoreType.DMA((2,)),
-                pltpu.SemaphoreType.DMA((2,)),
-            ])
+            scratch_shapes=scratch)
         o, lse = pl.pallas_call(
             kernel,
             grid_spec=grid_spec,
@@ -295,103 +404,109 @@ def build_v2_impls(layout: np.ndarray, block: int, sm_scale: float,
             ],
             interpret=interpret,
             compiler_params=compiler_params,
-        )(*(jnp.asarray(x) for x in rr), qr, kr, vr, kpmr)
+        )(*(jnp.asarray(x) for x in scalars), *args)
         return o.reshape(B, H, S, D), lse
 
     def bwd_impl(q, k, v, kpm, am, o, lse, g):
-        assert am is None
+        assert (am is not None) == has_am
         B, _, S, D = q.shape
         qr = q.reshape(B * H, S, D)
         kr = k.reshape(B * H, S, D)
         vr = v.reshape(B * H, S, D)
         dor = g.reshape(B * H, S, D)
         kpmr = kpm.reshape(B, 1, S)
+        am_u = _unique_am(am) if has_am else None
         delta = jnp.sum(dor.astype(jnp.float32) *
                         o.reshape(B * H, S, D).astype(jnp.float32),
                         axis=-1, keepdims=True)           # (B*H, S, 1)
 
         # ---- dq (row runs) ----
         kernel = functools.partial(_v2_dq_kernel, sm_scale=sm_scale,
-                                   block=block, heads=H, nq=nq)
+                                   block=block, heads=H, nq=nq,
+                                   has_am=has_am)
+        row_spec = pl.BlockSpec(
+            (1, block, D),
+            lambda i, r, rw, *_: (i * H + rw[r] // nq, rw[r] % nq, 0))
+        row_vec_spec = pl.BlockSpec(
+            (1, block, 1),
+            lambda i, r, rw, *_: (i * H + rw[r] // nq, rw[r] % nq, 0))
+        in_specs = [row_spec, hbm_spec, hbm_spec]
+        args = [qr, _stream_layout(kr, block), _stream_layout(vr, block)]
+        scalars = list(rr)
+        if has_am:
+            scalars.append(csr_uids)
+            in_specs.append(hbm_spec)
+            args.append(am_u)
+        in_specs += [
+            pl.BlockSpec((1, 1, S), lambda i, r, *_: (i, 0, 0)),
+            row_spec, row_vec_spec, row_vec_spec,
+        ]
+        args += [kpmr, dor, lse, delta]
+        scratch = [
+            pltpu.VMEM((2, D, block), k.dtype),
+            pltpu.VMEM((2, D, block), v.dtype),
+        ] + (_am_scratch()[:1] if has_am else []) + [
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ] + (_am_scratch()[1:] if has_am else [])
         grid_spec = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=4,
+            num_scalar_prefetch=len(scalars),
             grid=(B, R),
-            in_specs=[
-                pl.BlockSpec((1, block, D),
-                             lambda i, r, rw, *_: (i * H + rw[r] // nq,
-                                                   rw[r] % nq, 0)),
-                hbm_spec,
-                hbm_spec,
-                pl.BlockSpec((1, 1, S), lambda i, r, *_: (i, 0, 0)),
-                pl.BlockSpec((1, block, D),
-                             lambda i, r, rw, *_: (i * H + rw[r] // nq,
-                                                   rw[r] % nq, 0)),
-                pl.BlockSpec((1, block, 1),
-                             lambda i, r, rw, *_: (i * H + rw[r] // nq,
-                                                   rw[r] % nq, 0)),
-                pl.BlockSpec((1, block, 1),
-                             lambda i, r, rw, *_: (i * H + rw[r] // nq,
-                                                   rw[r] % nq, 0)),
-            ],
-            out_specs=pl.BlockSpec(
-                (1, block, D),
-                lambda i, r, rw, *_: (i * H + rw[r] // nq, rw[r] % nq, 0)),
-            scratch_shapes=[
-                pltpu.VMEM((2, D, block), k.dtype),
-                pltpu.VMEM((2, D, block), v.dtype),
-                pltpu.SemaphoreType.DMA((2,)),
-                pltpu.SemaphoreType.DMA((2,)),
-            ])
+            in_specs=in_specs,
+            out_specs=row_spec,
+            scratch_shapes=scratch)
         dq = pl.pallas_call(
             kernel,
             grid_spec=grid_spec,
             out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
             interpret=interpret,
             compiler_params=compiler_params,
-        )(*(jnp.asarray(x) for x in rr), qr,
-          _stream_layout(kr, block), _stream_layout(vr, block),
-          kpmr, dor, lse, delta)
+        )(*(jnp.asarray(x) for x in scalars), *args)
 
         # ---- dk, dv (column runs) ----
         kernel = functools.partial(_v2_dkv_kernel, sm_scale=sm_scale,
-                                   block=block, heads=H, nk=nk)
+                                   block=block, heads=H, nk=nk,
+                                   has_am=has_am)
         lser = lse.reshape(B * H, 1, S)   # VMEM-resident per program
         deltar = delta.reshape(B * H, 1, S)
+        col_spec = pl.BlockSpec(
+            (1, block, D),
+            lambda i, t, cw, *_: (i * H + cw[t] // nk, cw[t] % nk, 0))
+        in_specs = [
+            col_spec,
+            col_spec,
+            pl.BlockSpec((1, 1, 1, block),
+                         lambda i, t, cw, *_: (i, cw[t] % nk, 0, 0)),
+            hbm_spec,
+            hbm_spec,
+        ]
+        args = [kr, vr, kpm,
+                _stream_layout(qr, block), _stream_layout(dor, block)]
+        scalars = list(cr)
+        if has_am:
+            scalars.append(csc_uids)
+            in_specs.append(hbm_spec)
+            args.append(am_u)
+        in_specs += [
+            pl.BlockSpec((1, 1, S),
+                         lambda i, t, cw, *_: (i * H + cw[t] // nk, 0, 0)),
+            pl.BlockSpec((1, 1, S),
+                         lambda i, t, cw, *_: (i * H + cw[t] // nk, 0, 0)),
+        ]
+        args += [lser, deltar]
+        scratch = [
+            pltpu.VMEM((2, D, block), q.dtype),
+            pltpu.VMEM((2, D, block), g.dtype),
+        ] + (_am_scratch()[:1] if has_am else []) + [
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ] + (_am_scratch()[1:] if has_am else [])
         grid_spec = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=4,
+            num_scalar_prefetch=len(scalars),
             grid=(B, C),
-            in_specs=[
-                pl.BlockSpec((1, block, D),
-                             lambda i, t, cw, *_: (i * H + cw[t] // nk,
-                                                   cw[t] % nk, 0)),
-                pl.BlockSpec((1, block, D),
-                             lambda i, t, cw, *_: (i * H + cw[t] // nk,
-                                                   cw[t] % nk, 0)),
-                pl.BlockSpec((1, 1, 1, block),
-                             lambda i, t, cw, *_: (i, cw[t] % nk, 0, 0)),
-                hbm_spec,
-                hbm_spec,
-                pl.BlockSpec((1, 1, S),
-                             lambda i, t, cw, *_: (i * H + cw[t] // nk,
-                                                   0, 0)),
-                pl.BlockSpec((1, 1, S),
-                             lambda i, t, cw, *_: (i * H + cw[t] // nk,
-                                                   0, 0)),
-            ],
-            out_specs=[
-                pl.BlockSpec((1, block, D),
-                             lambda i, t, cw, *_: (i * H + cw[t] // nk,
-                                                   cw[t] % nk, 0)),
-                pl.BlockSpec((1, block, D),
-                             lambda i, t, cw, *_: (i * H + cw[t] // nk,
-                                                   cw[t] % nk, 0)),
-            ],
-            scratch_shapes=[
-                pltpu.VMEM((2, D, block), q.dtype),
-                pltpu.VMEM((2, D, block), g.dtype),
-                pltpu.SemaphoreType.DMA((2,)),
-                pltpu.SemaphoreType.DMA((2,)),
-            ])
+            in_specs=in_specs,
+            out_specs=[col_spec, col_spec],
+            scratch_shapes=scratch)
         dk, dv = pl.pallas_call(
             kernel,
             grid_spec=grid_spec,
@@ -401,9 +516,7 @@ def build_v2_impls(layout: np.ndarray, block: int, sm_scale: float,
             ],
             interpret=interpret,
             compiler_params=compiler_params,
-        )(*(jnp.asarray(x) for x in cr), kr, vr, kpm,
-          _stream_layout(qr, block), _stream_layout(dor, block),
-          lser, deltar)
+        )(*(jnp.asarray(x) for x in scalars), *args)
         return (dq.reshape(q.shape), dk.reshape(k.shape),
                 dv.reshape(v.shape))
 

@@ -491,7 +491,19 @@ class DeepSpeedEngine:
 
     @property
     def module_params(self):
-        """Current master params (host view on demand)."""
+        """Current master params (host view on demand).
+
+        With ``zero_optimization.overlap_comm`` offload, an update may
+        still be in flight — reads here would see the previous window's
+        params. Warn once rather than silently returning stale weights
+        (call :meth:`synchronize` first, as save/eval do)."""
+        if getattr(self, "_offload_pending", None) is not None and \
+                not getattr(self, "_warned_stale_params", False):
+            self._warned_stale_params = True
+            logger.warning(
+                "module_params read with an overlapped ZeRO-Offload "
+                "update still in flight — values are one window stale; "
+                "call engine.synchronize() first for settled weights")
         return self.state.params
 
     def is_gradient_accumulation_boundary(self):
@@ -521,6 +533,30 @@ class DeepSpeedEngine:
         if self.lr_scheduler is not None:
             return self.lr_scheduler.lr_at(step)
         return jnp.asarray(self.base_lr, jnp.float32)
+
+    def _mom_at(self, step):
+        """Scheduled momentum (OneCycle cycle_momentum, reference
+        lr_schedules.py:518), or None when the schedule doesn't cycle it.
+        Flows into the compiled optimizer update as a beta1/mu override,
+        the same way _lr_at flows as the lr."""
+        sch = self.lr_scheduler
+        if (sch is not None and getattr(sch, "cycle_momentum", False)
+                and hasattr(sch, "mom_at")):
+            if getattr(self, "_onebit", False) or \
+                    getattr(self, "_onebit_dist", False):
+                # 1-bit Adam's error-feedback state is calibrated against
+                # a FIXED beta1 during compression (its update does not
+                # take a momentum override); cycling it silently would be
+                # worse than not cycling — warn once and keep beta1 fixed
+                if not getattr(self, "_warned_onebit_mom", False):
+                    self._warned_onebit_mom = True
+                    logger.warning(
+                        "OneCycle cycle_momentum is ignored with "
+                        "OnebitAdam: beta1 stays at its configured value "
+                        "(set cycle_momentum=false to silence this)")
+                return None
+            return sch.mom_at(step)
+        return None
 
     def _cast_for_loss(self, params):
         """fp32 master -> compute dtype, unless the loss fn owns the cast
@@ -789,6 +825,7 @@ class DeepSpeedEngine:
             grads = jax.tree_util.tree_map(lambda g: g * clip, grads)
 
         lr = self._lr_at(state.global_step)
+        mom = self._mom_at(state.global_step)
 
         def do_update(operand):
             params, opt_state, g = operand
@@ -798,6 +835,9 @@ class DeepSpeedEngine:
                 return self.optimizer.update(
                     g, opt_state, params, lr=lr,
                     compression=self._onebit_compression)
+            if mom is not None:
+                return self.optimizer.update(g, opt_state, params, lr=lr,
+                                             momentum=mom)
             return self.optimizer.update(g, opt_state, params, lr=lr)
 
         def skip_update(operand):
@@ -957,7 +997,7 @@ class DeepSpeedEngine:
             micro_step=jnp.zeros((), jnp.int32))
         return grads
 
-    def _host_optimize(self, grads, lr):
+    def _host_optimize(self, grads, lr, mom=None):
         """Overflow check + clip + native C++ SIMD Adam on the host fp32
         master (reference stage2.py:1418-1431 DeepSpeedCPUAdam.step).
         Thread-safe w.r.t. device work: touches only host state."""
@@ -974,7 +1014,8 @@ class DeepSpeedEngine:
                 grads = jax.tree_util.tree_map(
                     lambda g: g * np.float32(clip), grads)
         use_bf16 = self.compute_dtype == jnp.bfloat16
-        new_params = self.optimizer.step(grads, lr=lr, bf16_out=use_bf16)
+        new_params = self.optimizer.step(grads, lr=lr, bf16_out=use_bf16,
+                                         beta1=mom)
         if not use_bf16:
             dtype = self.compute_dtype or jnp.float32
             new_params = jax.tree_util.tree_map(
@@ -1003,7 +1044,9 @@ class DeepSpeedEngine:
         """Synchronous ZeRO-Offload boundary: snapshot -> Adam -> H2D."""
         grads = self._host_grad_snapshot()
         lr = float(self._lr_at(self.state.global_step))
-        new_params, overflow = self._host_optimize(grads, lr)
+        mom = self._mom_at(self.state.global_step)
+        new_params, overflow = self._host_optimize(
+            grads, lr, None if mom is None else float(mom))
         self._apply_host_result(new_params, overflow)
 
     def _host_apply_update_overlapped(self):
@@ -1016,8 +1059,10 @@ class DeepSpeedEngine:
         self._offload_drain()
         grads = self._host_grad_snapshot()
         lr = float(self._lr_at(self.state.global_step))
+        mom = self._mom_at(self.state.global_step)
         self._offload_pending = self._offload_pool.submit(
-            self._host_optimize, grads, lr)
+            self._host_optimize, grads, lr,
+            None if mom is None else float(mom))
 
     def _offload_drain(self):
         if getattr(self, "_offload_pending", None) is not None:

@@ -25,6 +25,7 @@ timers/throughput. Reference parity notes:
   step, so the reference's conflict — engine.py:751-754 — does not exist).
 """
 
+import os
 from typing import Any, Callable, Optional, Tuple
 
 import jax
@@ -86,7 +87,14 @@ class PipelineEngine(DeepSpeedEngine):
         inner["train_micro_batch_size_per_gpu"] = \
             resolved.train_micro_batch_size_per_gpu
 
-        num_stages = axis_size(probe_mesh, "pipe")
+        # interleaved virtual stages: each device hosts V chunks of 1/V
+        # the layers, cutting the normalized fill/drain bubble from
+        # 2(S-1) to ((V-1)S + 2(S-1))/V ticks (spmd.py module docstring)
+        self.num_virtual = int(raw.get("pipeline", {})
+                               .get("virtual_stages", 1))
+        if self.num_virtual < 1:
+            raise ValueError("pipeline.virtual_stages must be >= 1")
+        num_stages = axis_size(probe_mesh, "pipe") * self.num_virtual
         if isinstance(model, PipelineModule):
             self.pipeline_spec = module_pipeline_spec(model, num_stages)
             self.module = model
@@ -97,6 +105,10 @@ class PipelineEngine(DeepSpeedEngine):
             raise TypeError(
                 "PipelineEngine model must be a PipelineModule or "
                 f"PipelineSpec, got {type(model)}")
+        if self.pipeline_spec.num_stages != num_stages:
+            raise ValueError(
+                f"spec has {self.pipeline_spec.num_stages} stages; mesh "
+                f"pipe axis x virtual_stages = {num_stages}")
 
         params = kwargs.pop("model_parameters", None)
         if params is None:
@@ -106,6 +118,15 @@ class PipelineEngine(DeepSpeedEngine):
             # flat per-layer PipelineModule params -> stacked pipeline form
             params = {"pre": {}, "stages": self.module.stack_stage_params(
                 params), "post": {}}
+        if self.num_virtual > 1:
+            # caller-facing layout is global-stage order; the executors
+            # (and checkpoints) use the interleaved at-rest layout so the
+            # contiguous 'pipe' sharding lands each device's cyclic chunks
+            from deepspeed_tpu.runtime.pipe.spmd import interleave_stages
+            params = dict(params)
+            params["stages"] = interleave_stages(
+                params["stages"], axis_size(probe_mesh, "pipe"),
+                self.num_virtual)
         specs = pipeline_param_specs(self.pipeline_spec, params)
 
         if resolved.fp16_enabled:
@@ -117,13 +138,13 @@ class PipelineEngine(DeepSpeedEngine):
         loss_fn = build_pipeline_loss_fn(
             self.pipeline_spec, probe_mesh, num_micro=self.micro_batches,
             remat=raw.get("pipeline", {}).get("activation_checkpoint", True),
-            compute_dtype=compute_dtype)
+            compute_dtype=compute_dtype, num_virtual=self.num_virtual)
         # training runs the explicit 1F1B executor (O(S) activation memory,
         # grads computed in-schedule); the forward-only wavefront above
         # remains for eval_batch
         loss_fn.grad_fn = build_pipeline_grad_fn(
             self.pipeline_spec, probe_mesh, num_micro=self.micro_batches,
-            compute_dtype=compute_dtype)
+            compute_dtype=compute_dtype, num_virtual=self.num_virtual)
 
         super().__init__(model=loss_fn, model_parameters=params,
                          param_specs=specs, config=inner, seed=seed,
@@ -191,6 +212,106 @@ class PipelineEngine(DeepSpeedEngine):
         batch = self._stack_micro_batches(data_iter)
         return self._compiled_pipe_eval(self.state.params, batch,
                                         self.state.rng)
+
+    # ---------------- checkpoint layout portability ----------------- #
+    # stage weights are stored in the V-dependent interleaved layout
+    # (spmd.py module docstring); a resume at a different pipe width or
+    # virtual_stages must re-permute or every device silently runs the
+    # wrong layers' weights. save records the layout; load converts.
+
+    def _stage_order(self):
+        from deepspeed_tpu.runtime.pipe.spmd import interleave_stage_order
+        S = axis_size(self.mesh, "pipe")
+        return interleave_stage_order(S, self.num_virtual)
+
+    def save_checkpoint(self, save_dir: str, tag=None, client_state=None):
+        if tag is None:
+            tag = f"global_step{int(self.state.global_step)}"
+        # the layout file must exist BEFORE super() flips the 'latest'
+        # pointer: a crash in between must never leave a loadable V>1
+        # checkpoint that load_checkpoint misreads as V=1 and mis-permutes
+        if jax.process_index() == 0:
+            import json as _json
+            ckpt_dir = os.path.join(save_dir, tag)
+            os.makedirs(ckpt_dir, exist_ok=True)
+            with open(os.path.join(ckpt_dir, "pipe_layout.json"), "w") as f:
+                _json.dump({"pipe_axis": axis_size(self.mesh, "pipe"),
+                            "virtual_stages": self.num_virtual}, f)
+        return super().save_checkpoint(save_dir, tag, client_state)
+
+    def load_checkpoint(self, load_dir: str, tag=None, **kw):
+        ret = super().load_checkpoint(load_dir, tag, **kw)
+        if not ret or ret[0] is None:
+            return ret
+        ckpt_dir = ret[0]
+        import json as _json
+        from deepspeed_tpu.runtime.pipe.spmd import interleave_stage_order
+        layout_path = os.path.join(ckpt_dir, "pipe_layout.json")
+        if os.path.exists(layout_path):
+            with open(layout_path) as f:
+                saved = _json.load(f)
+        else:
+            # pre-layout checkpoints were only ever written at V=1
+            # (identity order)
+            saved = {"pipe_axis": self.pipeline_spec.num_stages,
+                     "virtual_stages": 1}
+        saved_order = interleave_stage_order(saved["pipe_axis"],
+                                             saved["virtual_stages"])
+        cur_order = self._stage_order()
+        if saved_order != cur_order:
+            # slot j currently holds global stage saved_order[j]; we need
+            # it to hold cur_order[j]
+            pos = {g: j for j, g in enumerate(saved_order)}
+            perm = jnp.asarray([pos[g] for g in cur_order])
+
+            def permute(tree, shd):
+                if isinstance(tree, dict):
+                    if "stages" in tree:
+                        out = dict(tree)
+                        out["stages"] = jax.tree_util.tree_map(
+                            lambda x, s: jax.device_put(
+                                jnp.take(x, perm, axis=0), s),
+                            tree["stages"], shd["stages"])
+                        return out
+                    return {k: permute(v, shd[k]) for k, v in tree.items()}
+                if hasattr(tree, "_fields"):
+                    return type(tree)(*(
+                        permute(getattr(tree, f), getattr(shd, f))
+                        for f in tree._fields))
+                if isinstance(tree, (list, tuple)):
+                    return type(tree)(
+                        permute(t, s) for t, s in zip(tree, shd))
+                return tree
+
+            shardings = self._state_shardings
+            self.state = self.state._replace(
+                params=permute(self.state.params, shardings.params),
+                opt_state=permute(self.state.opt_state,
+                                  shardings.opt_state))
+            if getattr(self, "zero_cpu_offload", False):
+                # the host-resident fp32 master + moments (ZeRO-Offload)
+                # were restored in the saved layout too; left unpermuted,
+                # the first host Adam step would push the wrong layers'
+                # weights back to every device
+                perm_np = np.asarray([pos[g] for g in cur_order])
+                leaves = jax.tree_util.tree_flatten_with_path(
+                    self.state.params)[0]
+                for i, (path, leaf) in enumerate(leaves):
+                    if not any(getattr(p, "key", None) == "stages"
+                               for p in path):
+                        continue
+                    for arrs in (self.optimizer.master_params,
+                                 self.optimizer.exp_avg,
+                                 self.optimizer.exp_avg_sq):
+                        a = arrs[i].reshape(leaf.shape)
+                        arrs[i] = np.ascontiguousarray(
+                            a[perm_np]).ravel()
+            log_dist(
+                f"pipe checkpoint re-permuted: saved layout "
+                f"{saved['pipe_axis']}x{saved['virtual_stages']} -> "
+                f"{axis_size(self.mesh, 'pipe')}x{self.num_virtual}",
+                ranks=[0])
+        return ret
 
     # forward/backward/step facade does not decompose for a pipelined
     # batch — the reference documents the same restriction

@@ -44,9 +44,32 @@ on every tick. A branch whose predicate varies along 'pipe' (e.g. "am I
 the last stage") would send device cohorts into different collectives and
 deadlock (observed as a rendezvous hang on the CPU mesh; a real-TPU hang
 in the field). So validity is handled by ``where``-masks on data, never by
-skipping code. The cost is honest: fill/drain bubble is 2(S-1) ticks
-instead of the reference 1F1B's S-1 — the price of single-program SPMD —
-while utilization M/(M+2S-2) approaches 1 at pipelining's target depths.
+skipping code. The cost is honest: at ``num_virtual=1`` the fill/drain
+bubble is 2(S-1) ticks instead of the reference 1F1B's S-1 — the price of
+single-program SPMD.
+
+**Interleaved virtual stages (``num_virtual=V``)** recover most of that
+bubble, the Megatron-LM interleaved-1F1B idea re-derived for the SPMD
+scan: each device hosts V *chunks* of 1/V the layers — device s owns
+global stages {c*S + s : c < V} (cyclic assignment). The SAME single
+ppermute rotation carries the interleaved flow: a forward item index
+j = tick - s decodes to chunk c = (j // S) %% V *independently of s*, so
+the neighbor rotation always delivers the activation the receiver needs
+next tick, and the S-1 -> 0 wraparound carries chunk c's exit back as
+chunk c+1's entry. Each macro-tick still runs exactly one forward and one
+backward sub-step per device, but a sub-step is now 1/V the work, so in
+units of a full (fwd+bwd) stage pass the bubble shrinks from 2(S-1) to
+((V-1)S + 2(S-1))/V — ~1.5(S-1) at V=2, approaching S at large V. The
+price is the interleaved in-flight window: the stage-input buffer deepens
+from 2S-1 to 2VS-1 (1/V-sized) entries, i.e. ~2x activation memory at
+V=2 — the same trade Megatron's interleaved schedule makes.
+
+Stage weights for V>1 are stored **interleaved**: stacked index
+j = s*V + c holds global stage c*S + s, so the plain contiguous
+P('pipe') sharding gives device s exactly its V chunks (local leading
+dim V, local index = chunk). Use :func:`interleave_stages` /
+:func:`deinterleave_stages` to convert; PipelineEngine does this once at
+init and checkpoints store the interleaved layout.
 
 Head placement: the loss head would naively run (masked) on every pipe row
 — S redundant vocab-GEMMs per micro. When the spec provides
@@ -182,6 +205,114 @@ def _head_mode(spec: "PipelineSpec", S: int, act_shape):
     return False, 0, 0
 
 
+def interleave_stage_order(S: int, V: int):
+    """Permutation: interleaved slot ``j = s*V + c`` holds global stage
+    ``c*S + s`` (device s's contiguous block = its V cyclic chunks)."""
+    return [(j % V) * S + j // V for j in range(S * V)]
+
+
+def interleave_stages(stages: Any, S: int, V: int) -> Any:
+    """Reorder a (G, ...)-stacked stage pytree from global-stage order to
+    the interleaved at-rest layout the V>1 executors expect."""
+    if V == 1:
+        return stages
+    order = jnp.asarray(interleave_stage_order(S, V))
+    return jax.tree_util.tree_map(lambda x: jnp.take(x, order, axis=0),
+                                  stages)
+
+
+def deinterleave_stages(stages: Any, S: int, V: int) -> Any:
+    """Inverse of :func:`interleave_stages` (global stage g sits at
+    interleaved slot (g %% S)*V + g//S)."""
+    if V == 1:
+        return stages
+    inv = jnp.asarray([(g % S) * V + g // S for g in range(S * V)])
+    return jax.tree_util.tree_map(lambda x: jnp.take(x, inv, axis=0),
+                                  stages)
+
+
+def _padded_micro_count(S: int, M: int, V: int) -> int:
+    """Interleaving schedules micros in groups of S (the cyclic rotation
+    only lines up for full groups — a partial group's chunk handoff would
+    arrive a tick early). For V>1 the item space is padded to whole
+    groups; padded micros decode as invalid and are masked, costing
+    (Mp-M)V bubble ticks. V=1 needs no grouping (decode is exact)."""
+    if V == 1:
+        return M
+    return -(-M // S) * S
+
+
+def pipeline_tick_counts(S: int, M: int, V: int = 1):
+    """(scan_ticks, normalized_ticks) for the 1F1B grad executor.
+
+    ``normalized`` is in units of one full (fwd+bwd) pass over a device's
+    whole layer share — the V=1 macro-tick — so the ideal is M and the
+    bubble is ``normalized - M`` = ((V-1)S + 2(S-1))/V when S divides M
+    (plus the group-padding ticks otherwise).
+    """
+    Mp = _padded_micro_count(S, M, V)
+    total = Mp * V + (V - 1) * S + 2 * (S - 1)
+    return total, total / V
+
+
+def _decode_fwd(j, S: int, V: int, M: int, Mp: int):
+    """Forward work-item index -> (micro, chunk, clipped_item, valid).
+
+    Device s's ordered forward list: for group q, for chunk c, for i < S:
+    item q*V*S + c*S + i = micro q*S + i, chunk c — over the PADDED micro
+    space [0, Mp); items whose micro lands in the pad tail [M, Mp) are
+    invalid (masked)."""
+    in_items = jnp.logical_and(j >= 0, j < Mp * V)
+    jc = jnp.clip(j, 0, Mp * V - 1)
+    c = (jc // S) % V
+    m = (jc // (S * V)) * S + jc % S
+    valid = jnp.logical_and(in_items, m < M)
+    return jnp.clip(m, 0, M - 1), c, jc, valid
+
+
+def _decode_bwd(k, S: int, V: int, M: int, Mp: int):
+    """Backward work-item index -> (micro, chunk, fwd_item, valid);
+    chunks drain in reverse (c = V-1 first), mirroring the forward list."""
+    in_items = jnp.logical_and(k >= 0, k < Mp * V)
+    kc = jnp.clip(k, 0, Mp * V - 1)
+    c = V - 1 - (kc // S) % V
+    m = (kc // (S * V)) * S + kc % S
+    jf = (kc // (S * V)) * (S * V) + c * S + kc % S
+    valid = jnp.logical_and(in_items, m < M)
+    return jnp.clip(m, 0, M - 1), c, jf, valid
+
+
+def _select_chunk(tree: Any, c, V: int) -> Any:
+    """Slice chunk ``c`` from local (V, ...)-leading stage leaves via a
+    one-hot contraction (traced-index dynamic_slice on shard_map operands
+    trips the XLA partitioner — see seq_chunk_select). Reads all V chunks,
+    but the V chunks together are one stage's weights: total read
+    bandwidth matches V=1."""
+    if V == 1:
+        return jax.tree_util.tree_map(lambda x: x[0], tree)
+    oh = jax.lax.iota(jnp.int32, V) == c
+
+    def sel(x):
+        m = oh.reshape((V,) + (1,) * (x.ndim - 1))
+        return jnp.sum(jnp.where(m, x, jnp.zeros((), x.dtype)), axis=0)
+    return jax.tree_util.tree_map(sel, tree)
+
+
+def _acc_chunk(acc: Any, grads: Any, c, valid, V: int) -> Any:
+    """Accumulate chunk-shaped fp32 grads into the (V, ...)-leading
+    accumulator at row ``c`` (transpose of :func:`_select_chunk`)."""
+    if V == 1:
+        return jax.tree_util.tree_map(
+            lambda a, x: a + jnp.where(valid, x.astype(jnp.float32), 0.0),
+            acc, grads)
+    oh = jax.lax.iota(jnp.int32, V) == c
+
+    def add(a, x):
+        m = jnp.logical_and(oh, valid).reshape((V,) + (1,) * x.ndim)
+        return a + jnp.where(m, x.astype(jnp.float32)[None], 0.0)
+    return jax.tree_util.tree_map(add, acc, grads)
+
+
 def pipeline_param_specs(spec: PipelineSpec, params: Any) -> Any:
     """PartitionSpec pytree for the full pipeline params: stacked stage
     leaves get 'pipe' on dim 0 (+ any TP spec shifted right); pre/post get
@@ -202,7 +333,8 @@ def pipeline_param_specs(spec: PipelineSpec, params: Any) -> Any:
 
 def build_pipeline_loss_fn(spec: PipelineSpec, mesh: Mesh, num_micro: int,
                            remat: bool = True,
-                           compute_dtype=None) -> Callable:
+                           compute_dtype=None,
+                           num_virtual: int = 1) -> Callable:
     """Return ``loss_fn(params, batch, rng) -> scalar`` running the full
     pipelined forward; engine-contract compatible (runtime/engine.py).
 
@@ -214,14 +346,21 @@ def build_pipeline_loss_fn(spec: PipelineSpec, mesh: Mesh, num_micro: int,
     its own cast). This keeps every cross-stage gradient psum in fp32 —
     the master-grad precision ZeRO expects — with only the bf16 compute
     copies crossing into the stage bodies.
+
+    ``num_virtual``: interleaved virtual stages per device (module
+    docstring); ``spec.num_stages`` must equal ``num_virtual * pipe-axis``
+    and the stacked stage params must be in the interleaved layout
+    (:func:`interleave_stages`).
     """
     if "pipe" not in mesh.axis_names:
         raise ValueError("pipeline execution requires a 'pipe' mesh axis")
-    S = spec.num_stages
+    V = num_virtual
+    S = axis_size(mesh, "pipe")
     M = num_micro
-    if axis_size(mesh, "pipe") != S:
+    if spec.num_stages != V * S:
         raise ValueError(
-            f"mesh pipe axis {axis_size(mesh, 'pipe')} != num_stages {S}")
+            f"num_stages {spec.num_stages} != num_virtual {V} * pipe axis "
+            f"{S}")
 
     stage_apply = spec.stage_apply
     if remat:
@@ -240,38 +379,46 @@ def build_pipeline_loss_fn(spec: PipelineSpec, mesh: Mesh, num_micro: int,
                 if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
         s_idx = jax.lax.axis_index("pipe")
         pre_p, post_p = params["pre"], params["post"]
-        # local slice of the stacked stage weights: (1, ...) -> (...)
-        st_p = jax.tree_util.tree_map(lambda x: x[0], params["stages"])
+        # local slice of the stacked stage weights: (V, ...) chunks
+        st_p = params["stages"]
 
         # probe activation shape/dtype via the first micro-batch
         micro0 = jax.tree_util.tree_map(lambda x: x[0], batch)
         probe = jax.eval_shape(spec.pre_apply, pre_p, micro0, rng)
         act_shape, act_dtype = probe.shape, probe.dtype
         coop, chunk, ntok = _head_mode(spec, S, act_shape)
+        G = V * S  # global stage count; fold-in domain stride is G+1
+        Mp = _padded_micro_count(S, M, V)
 
-        def tick(carry, t):
+        def tick(carry, t, with_head):
             act, loss_acc = carry
-            in_idx = jnp.clip(t, 0, M - 1)
-            micro = jax.tree_util.tree_map(lambda x: x[in_idx], batch)
+            # forward work item t - s: micro m_f, chunk c_f
+            m_f, c_f, _, _ = _decode_fwd(t - s_idx, S, V, M, Mp)
+            micro = jax.tree_util.tree_map(lambda x: x[m_f], batch)
             # LoadMicroBatch + first-stage layers (computed uniformly on
             # every row — NO branch: pre may contain TP collectives —
-            # selected by where to stage 0).
-            # disjoint fold-in domains mod (S+1): pre uses residue 0, stages
-            # use residues 1..S — no dropout-mask key ever collides
+            # selected by where to global stage 0 = (row 0, chunk 0)).
+            # disjoint fold-in domains mod (G+1): pre uses residue 0,
+            # stages use residues 1..G — no dropout-mask key ever collides
             fresh = spec.pre_apply(pre_p, micro,
-                                   jax.random.fold_in(rng, t * (S + 1)))
-            act_in = jnp.where(s_idx == 0, fresh.astype(act.dtype), act)
-            # ForwardPass for every stage's current micro-batch
-            r = jax.random.fold_in(rng, t * (S + 1) + s_idx + 1)
-            out = stage_apply(st_p, act_in, r)
-            # loss head on the wave exiting the last stage (micro t-(S-1)):
-            # cooperative sequence-sharded head when available, else the
-            # masked redundant head — always executed uniformly
-            out_t = t - (S - 1)
-            o_idx = jnp.clip(out_t, 0, M - 1)
-            micro_out = jax.tree_util.tree_map(lambda x: x[o_idx], batch)
-            valid = jnp.logical_and(out_t >= 0, out_t < M)
-            if coop:
+                                   jax.random.fold_in(rng, m_f * (G + 1)))
+            act_in = jnp.where(
+                jnp.logical_and(s_idx == 0, c_f == 0),
+                fresh.astype(act.dtype), act)
+            # ForwardPass for every row's current (micro, chunk) item
+            g_idx = c_f * S + s_idx  # global stage
+            r = jax.random.fold_in(rng, m_f * (G + 1) + g_idx + 1)
+            out = stage_apply(_select_chunk(st_p, c_f, V), act_in, r)
+            # loss head on the wave exiting the LAST GLOBAL stage — the
+            # tick where row S-1 forwards a chunk V-1 item: cooperative
+            # sequence-sharded head when available, else the masked
+            # redundant head. ``with_head`` is STATIC (grad-fn tick
+            # docstring): headless ticks skip the head entirely.
+            if with_head:
+                m_h, c_h, _, in_range = _decode_fwd(t - (S - 1), S, V, M, Mp)
+                micro_out = jax.tree_util.tree_map(lambda x: x[m_h], batch)
+                valid = jnp.logical_and(in_range, c_h == V - 1)
+            if with_head and coop:
                 out_last = _psum_act(
                     jnp.where(s_idx == S - 1, out,
                               jnp.zeros(act_shape, act_dtype)), "pipe")
@@ -280,19 +427,46 @@ def build_pipeline_loss_fn(spec: PipelineSpec, mesh: Mesh, num_micro: int,
                 lsum = spec.post_shard_apply(post_p, pre_p, sl, micro_out,
                                              start)
                 loss_m = jnp.where(valid, lsum.astype(jnp.float32), 0.0)
-            else:
+            elif with_head:
                 lm = spec.post_apply(post_p, pre_p, out, micro_out)
                 loss_m = jnp.where(
                     jnp.logical_and(valid, s_idx == S - 1),
                     lm.astype(jnp.float32), 0.0)
-            # SendActivation/RecvActivation: rotate stage s -> s+1
+            else:
+                loss_m = jnp.zeros((), jnp.float32)
+            # SendActivation/RecvActivation: rotate stage s -> s+1 (the
+            # S-1 -> 0 wraparound carries chunk c's exit to chunk c+1)
             act = jax.lax.ppermute(
                 out, "pipe", [(i, (i + 1) % S) for i in range(S)])
-            return (act, loss_acc + loss_m), None
+            return (act, loss_acc + loss_m)
 
-        act0 = jnp.zeros(act_shape, act_dtype)
-        (_, loss_sum), _ = jax.lax.scan(
-            tick, (act0, jnp.zeros((), jnp.float32)), jnp.arange(M + S - 1))
+        def scan_range(carry, start, length, with_head):
+            if length <= 0:
+                return carry
+            carry, _ = jax.lax.scan(
+                lambda c, t: (tick(c, t, with_head), None),
+                carry, start + jnp.arange(length))
+            return carry
+
+        carry = (jnp.zeros(act_shape, act_dtype),
+                 jnp.zeros((), jnp.float32))
+        if Mp % S == 0:
+            # head-active ticks are runs of S every VS starting at VS-1
+            # (grad-fn phasing comment); the wavefront has no drain, so
+            # the final superblock is the bare head run
+            carry = scan_range(carry, jnp.int32(0), G - 1, False)
+
+            def qblock(c, q0):
+                c = scan_range(c, q0, S, True)
+                c = scan_range(c, q0 + S, (V - 1) * S, False)
+                return c, None
+            if Mp // S > 1:
+                starts = (G - 1) + G * jnp.arange(Mp // S - 1)
+                carry, _ = jax.lax.scan(qblock, carry, starts)
+            carry = scan_range(carry, jnp.int32(Mp * V - 1), S, True)
+        else:
+            carry = scan_range(carry, jnp.int32(0), Mp * V + S - 1, True)
+        (_, loss_sum) = carry
 
         # _aggregate_total_loss (reference pipe/engine.py:374): psum shares
         # the per-row partial losses with every stage, pmean averages DP
@@ -322,26 +496,32 @@ def build_pipeline_loss_fn(spec: PipelineSpec, mesh: Mesh, num_micro: int,
         return mapped(params, batch, rng)
 
     loss_fn.owns_cast = compute_dtype is not None
+    loss_fn.num_virtual = V
     return loss_fn
 
 
 def build_pipeline_grad_fn(spec: PipelineSpec, mesh: Mesh, num_micro: int,
-                           compute_dtype=None) -> Callable:
+                           compute_dtype=None,
+                           num_virtual: int = 1) -> Callable:
     """Return ``grad_fn(params, batch, rng, scale) -> (loss, grads)``
     executing a 1F1B-style pipeline schedule (reference TrainSchedule,
     runtime/pipe/schedule.py:182) as one compiled scan.
 
-    Timing (0-indexed stage s of S, micro m of M): macro-tick u of
-    M + 2S - 2 runs, on EVERY row, one forward sub-step (stage s forwards
-    micro u - s) and one backward sub-step (stage s backwards micro
-    u - (2S-2-s), recomputing its stage body under ``jax.vjp``). Out-of-
-    range micros execute on garbage data and are ``where``-masked out —
-    never skipped, preserving the uniformity invariant (module docstring):
-    all collectives run on every device every tick. The last stage's
-    forward and backward of a micro coincide (in-flight depth 0), stage 0
-    holds the deepest window (2S-2); the circular stage-input buffer has
-    depth 2S-1, so peak activation memory is O(S), flat in M — the
-    reference's 1F1B in-flight bound (schedule.py:243 num_pipe_buffers).
+    Timing (0-indexed device s of S, V chunks per device, micro m of M):
+    macro-tick u of MV + (V-1)S + 2(S-1) runs, on EVERY row, one forward
+    sub-step (device s forwards its work item u - s: micro/chunk decoded
+    by :func:`_decode_fwd`) and one backward sub-step (work item
+    u - (VS + S - 2 - s), chunks draining in reverse, recomputing the
+    chunk body under ``jax.vjp``). Out-of-range items execute on garbage
+    data and are ``where``-masked out — never skipped, preserving the
+    uniformity invariant (module docstring): all collectives run on every
+    device every tick. The last global stage's forward and backward of a
+    micro coincide (in-flight depth 0); the circular stage-input buffer
+    has depth 2VS-1, so peak activation memory is O(VS) 1/V-sized
+    entries, flat in M — the reference's 1F1B in-flight bound
+    (schedule.py:243 num_pipe_buffers) times the interleaving window.
+    At V=1 this is exactly the classic schedule: forward micro u - s,
+    backward micro u - (2S-2-s), M + 2S - 2 ticks.
 
     Gradient semantics: returns ``d(mean_micro_loss * scale)/d(params)`` in
     fp32 (accumulated across ticks in fp32; cross-stage grad messages
@@ -353,15 +533,20 @@ def build_pipeline_grad_fn(spec: PipelineSpec, mesh: Mesh, num_micro: int,
     """
     if "pipe" not in mesh.axis_names:
         raise ValueError("pipeline execution requires a 'pipe' mesh axis")
-    S = spec.num_stages
+    V = num_virtual
+    S = axis_size(mesh, "pipe")
     M = num_micro
-    if axis_size(mesh, "pipe") != S:
+    if spec.num_stages != V * S:
         raise ValueError(
-            f"mesh pipe axis {axis_size(mesh, 'pipe')} != num_stages {S}")
+            f"num_stages {spec.num_stages} != num_virtual {V} * pipe axis "
+            f"{S}")
 
     manual_axes = _pipe_manual_axes(mesh)
     manual_only = partial(_manual_only, manual_axes=manual_axes)
-    B = 2 * S - 1   # circular buffer depth >= deepest in-flight window + 1
+    G = V * S
+    Mp = _padded_micro_count(S, M, V)
+    B = 2 * G - 1   # circular buffer depth >= deepest in-flight window + 1
+    num_ticks, normalized_ticks = pipeline_tick_counts(S, M, V)
 
     def per_device(params, batch, rng, scale):
         if compute_dtype is not None:
@@ -370,7 +555,7 @@ def build_pipeline_grad_fn(spec: PipelineSpec, mesh: Mesh, num_micro: int,
                 if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
         s_idx = jax.lax.axis_index("pipe")
         pre_p, post_p = params["pre"], params["post"]
-        st_p = jax.tree_util.tree_map(lambda x: x[0], params["stages"])
+        st_p = params["stages"]  # local (V, ...) chunks
 
         micro0 = jax.tree_util.tree_map(lambda x: x[0], batch)
         probe = jax.eval_shape(spec.pre_apply, pre_p, micro0, rng)
@@ -379,10 +564,10 @@ def build_pipeline_grad_fn(spec: PipelineSpec, mesh: Mesh, num_micro: int,
         zeros_act = jnp.zeros(act_shape, act_dtype)
 
         def key_pre(m):
-            return jax.random.fold_in(rng, m * (S + 1))
+            return jax.random.fold_in(rng, m * (G + 1))
 
-        def key_stage(m):
-            return jax.random.fold_in(rng, m * (S + 1) + s_idx + 1)
+        def key_stage(m, c):
+            return jax.random.fold_in(rng, m * (G + 1) + c * S + s_idx + 1)
 
         f32_zeros = lambda tree: jax.tree_util.tree_map(
             lambda x: jnp.zeros(x.shape, jnp.float32), tree)
@@ -397,30 +582,38 @@ def build_pipeline_grad_fn(spec: PipelineSpec, mesh: Mesh, num_micro: int,
         def micro_at(m):
             return jax.tree_util.tree_map(lambda x: x[m], batch)
 
-        def tick(carry, u):
+        def tick(carry, u, with_head):
+            """One macro-tick. ``with_head`` is STATIC: ticks where no
+            micro can exit the last global stage skip the head entirely.
+            The head-active ticks form a static pattern (runs of S every
+            VS ticks), so the caller phases the scan instead of paying a
+            masked full head (+ its vjp) on every tick — without this,
+            interleaving (V>1) would multiply total head work by ~V and
+            eat its own bubble gain."""
             fwd_msg, bwd_msg, buf, loss_acc, g_pre, g_st, g_post = carry
 
-            # ---------------- forward sub-step: micro u - s -------------
-            mf_raw = u - s_idx
-            mf = jnp.clip(mf_raw, 0, M - 1)
-            valid_f = jnp.logical_and(mf_raw >= 0, mf_raw < M)
+            # ------------- forward sub-step: work item u - s ------------
+            mf, cf, jf, valid_f = _decode_fwd(u - s_idx, S, V, M, Mp)
             micro_f = micro_at(mf)
             fresh = spec.pre_apply(pre_p, micro_f, key_pre(mf))
-            act_in = jnp.where(s_idx == 0, fresh.astype(act_dtype), fwd_msg)
-            out = spec.stage_apply(st_p, act_in, key_stage(mf))
-            slot = mf % B
+            act_in = jnp.where(
+                jnp.logical_and(s_idx == 0, cf == 0),
+                fresh.astype(act_dtype), fwd_msg)
+            out = spec.stage_apply(_select_chunk(st_p, cf, V), act_in,
+                                   key_stage(mf, cf))
+            slot = jf % B
             old = jax.lax.dynamic_index_in_dim(buf, slot, 0, keepdims=False)
             buf = jax.lax.dynamic_update_index_in_dim(
                 buf, jnp.where(valid_f, act_in, old), slot, 0)
 
-            # ------------- head: micro u - (S-1), all rows --------------
-            # (the last stage's forward and backward of a micro coincide,
-            # so its head input is this tick's fresh `out`)
-            mh_raw = u - (S - 1)
-            mh = jnp.clip(mh_raw, 0, M - 1)
-            valid_h = jnp.logical_and(mh_raw >= 0, mh_raw < M)
-            micro_h = micro_at(mh)
-            if coop:
+            # --- head: item u - (S-1) when it exits chunk V-1, all rows -
+            # (the last global stage's forward and backward of a micro
+            # coincide, so its head input is this tick's fresh `out`)
+            if with_head:
+                mh, ch, _, h_range = _decode_fwd(u - (S - 1), S, V, M, Mp)
+                valid_h = jnp.logical_and(h_range, ch == V - 1)
+                micro_h = micro_at(mh)
+            if with_head and coop:
                 # sequence-sharded cooperative head: broadcast the exiting
                 # activation, each row computes (and differentiates) its
                 # 1/S sequence chunk — total head work 1x per micro
@@ -437,7 +630,7 @@ def build_pipeline_grad_fn(spec: PipelineSpec, mesh: Mesh, num_micro: int,
                     seq_chunk_scatter(d_sl, s_idx, S, axis=1), "pipe")
                 loss_add = jnp.where(valid_h, lsum.astype(jnp.float32), 0.0)
                 head_valid = valid_h
-            else:
+            elif with_head:
                 # masked redundant head: every row computes post_apply on
                 # its own `out`; only the last row's input is meaningful
                 lmean, vjp_head = jax.vjp(
@@ -447,28 +640,38 @@ def build_pipeline_grad_fn(spec: PipelineSpec, mesh: Mesh, num_micro: int,
                 sel = jnp.logical_and(valid_h, s_idx == S - 1)
                 loss_add = jnp.where(sel, lmean.astype(jnp.float32), 0.0)
                 head_valid = sel
-            g_post = acc_masked(g_post, gpo, head_valid)
-            g_pre = acc_masked(g_pre, gpr, head_valid)
+            else:
+                # no micro exits the last global stage on this tick: the
+                # backward's cb==V-1 selector can only fire on garbage
+                # (valid_b False), so a zero stand-in is sound
+                d_out_head = zeros_act
+                loss_add = jnp.zeros((), jnp.float32)
+            if with_head:
+                g_post = acc_masked(g_post, gpo, head_valid)
+                g_pre = acc_masked(g_pre, gpr, head_valid)
 
-            # ------------- backward sub-step: micro u - (2S-2-s) --------
-            mb_raw = u - (2 * S - 2 - s_idx)
-            mb = jnp.clip(mb_raw, 0, M - 1)
-            valid_b = jnp.logical_and(mb_raw >= 0, mb_raw < M)
+            # ------ backward sub-step: work item u - (VS + S - 2 - s) ---
+            mb, cb, jfb, valid_b = _decode_bwd(
+                u - (G + S - 2 - s_idx), S, V, M, Mp)
             micro_b = micro_at(mb)
             a_stored = jax.lax.dynamic_index_in_dim(
-                buf, mb % B, 0, keepdims=False)
-            kb = key_stage(mb)
+                buf, jfb % B, 0, keepdims=False)
+            kb = key_stage(mb, cb)
+            st_c = _select_chunk(st_p, cb, V)
             _, vjp_stage = jax.vjp(
-                lambda sp, a: spec.stage_apply(sp, a, kb), st_p, a_stored)
-            g_out = jnp.where(s_idx == S - 1,
-                              d_out_head.astype(act_dtype), bwd_msg)
+                lambda sp, a: spec.stage_apply(sp, a, kb), st_c, a_stored)
+            g_out = jnp.where(
+                jnp.logical_and(s_idx == S - 1, cb == V - 1),
+                d_out_head.astype(act_dtype), bwd_msg)
             g_st_m, d_act = vjp_stage(g_out)
-            g_st = acc_masked(g_st, g_st_m, valid_b)
+            g_st = _acc_chunk(g_st, g_st_m, cb, valid_b, V)
 
             # embedding backward (BackwardPass reaching LoadMicroBatch's
-            # producer): executed by every row, input masked to stage 0
+            # producer): executed by every row, input masked to global
+            # stage 0 = (row 0, chunk 0)
             d_for_pre = jnp.where(
-                jnp.logical_and(s_idx == 0, valid_b), d_act, 0.0
+                jnp.logical_and(jnp.logical_and(s_idx == 0, cb == 0),
+                                valid_b), d_act, 0.0
             ).astype(act_dtype)
             _, vjp_pre = jax.vjp(
                 lambda pp: spec.pre_apply(pp, micro_b, key_pre(mb)
@@ -482,13 +685,42 @@ def build_pipeline_grad_fn(spec: PipelineSpec, mesh: Mesh, num_micro: int,
                 jnp.where(valid_b, d_act, 0.0).astype(act_dtype),
                 "pipe", [(i, (i - 1) % S) for i in range(S)])
             return (new_fwd, new_bwd, buf, loss_acc + loss_add,
-                    g_pre, g_st, g_post), None
+                    g_pre, g_st, g_post)
+
+        def scan_range(carry, start, length, with_head):
+            """Scan ``length`` consecutive ticks from (traced) ``start``."""
+            if length <= 0:
+                return carry
+            carry, _ = jax.lax.scan(
+                lambda c, u: (tick(c, u, with_head), None),
+                carry, start + jnp.arange(length))
+            return carry
 
         buf0 = jnp.zeros((B,) + act_shape, act_dtype)
+        g_st0 = f32_zeros(_select_chunk(st_p, 0, V) if V == 1 else st_p)
         carry0 = (zeros_act, zeros_act, buf0, jnp.zeros((), jnp.float32),
-                  f32_zeros(pre_p), f32_zeros(st_p), f32_zeros(post_p))
-        (_, _, _, loss_sum, g_pre, g_st, g_post), _ = jax.lax.scan(
-            tick, carry0, jnp.arange(M + 2 * S - 2))
+                  f32_zeros(pre_p), g_st0, f32_zeros(post_p))
+        if Mp % S == 0:
+            # Phased schedule. Head-active ticks are u with
+            # (u-(S-1))//S %% V == V-1: runs of S ticks starting at
+            # u = (q+1)VS - 1 for each micro group q < M/S. Phases:
+            # fill (VS-1 headless) -> M/S superblocks (S head +
+            # (V-1)S headless) -> drain (S-1 headless); total
+            # (VS-1) + (M/S)VS + (S-1) = num_ticks exactly.
+            carry = scan_range(carry0, jnp.int32(0), G - 1, False)
+
+            def qblock(c, q0):
+                c = scan_range(c, q0, S, True)
+                c = scan_range(c, q0 + S, (V - 1) * S, False)
+                return c, None
+            starts = (G - 1) + G * jnp.arange(Mp // S)
+            carry, _ = jax.lax.scan(qblock, carry, starts)
+            carry = scan_range(carry, jnp.int32(Mp * V + G - 1), S - 1,
+                               False)
+        else:
+            # uneven micro count: fall back to head-on-every-tick
+            carry = scan_range(carry0, jnp.int32(0), num_ticks, True)
+        (_, _, _, loss_sum, g_pre, g_st, g_post) = carry
 
         # ReduceTiedGrads + loss aggregation: pipe-psum combines the head
         # chunks / embedding / tied contributions and replicates them
@@ -502,7 +734,8 @@ def build_pipeline_grad_fn(spec: PipelineSpec, mesh: Mesh, num_micro: int,
             g_post = jax.lax.pmean(g_post, "data")
             g_st = jax.tree_util.tree_map(
                 lambda g: jax.lax.pmean(g, "data"), g_st)
-        g_stages = jax.tree_util.tree_map(lambda x: x[None], g_st)
+        g_stages = (jax.tree_util.tree_map(lambda x: x[None], g_st)
+                    if V == 1 else g_st)
         return loss, {"pre": g_pre, "stages": g_stages, "post": g_post}
 
     def grad_fn(params, batch, rng, scale):
@@ -526,6 +759,9 @@ def build_pipeline_grad_fn(spec: PipelineSpec, mesh: Mesh, num_micro: int,
         return mapped(params, batch, rng,
                       jnp.asarray(scale, jnp.float32))
 
+    grad_fn.num_ticks = num_ticks
+    grad_fn.normalized_ticks = normalized_ticks
+    grad_fn.num_virtual = V
     return grad_fn
 
 

@@ -1,0 +1,124 @@
+"""Synthetic-task convergence gates (VERDICT r2 #7).
+
+The reference's model tests gate on task metrics (SQuAD F1,
+tests/model/BingBertSquad/test_e2e_squad.py); with no datasets in this
+image, the equivalent gate is a LEARNABLE synthetic task: sequences
+follow the deterministic affine map t_{i+1} = (3 t_i + 1) mod V, so
+next-token loss starts at ~ln(V) and must fall near zero — any broken
+optimizer semantics (mis-sharded moments, dropped grads, stale offload
+masters, mis-routed experts) fails the threshold even when loss-parity
+tests pass. One parametrized test per parallelism/optimizer mode.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models.gpt2 import (GPT2Config, gpt2_loss_fn,
+                                       gpt2_moe_loss_fn, gpt2_sp_loss_fn,
+                                       init_gpt2_moe_params,
+                                       init_gpt2_params)
+
+pytestmark = pytest.mark.slow  # multi-minute e2e compiles (VERDICT r2 #8 tiering)
+
+V, SEQ, BATCH = 32, 16, 16
+CFG = GPT2Config(vocab_size=V, max_position_embeddings=SEQ + 1,
+                 hidden_size=32, num_layers=2, num_heads=2,
+                 embd_dropout=0.0, attn_dropout=0.0, resid_dropout=0.0)
+
+
+def _affine_batch(rng, bs=BATCH):
+    """(bs, SEQ+1) sequences following t_{i+1} = (3 t_i + 1) mod V."""
+    t = rng.randint(0, V, size=(bs,)).astype(np.int64)
+    cols = [t]
+    for _ in range(SEQ):
+        t = (3 * t + 1) % V
+        cols.append(t)
+    return {"input_ids": np.stack(cols, axis=1).astype(np.int32)}
+
+
+def _train(loss_fn, params, config, steps=60, seed=0):
+    eng, *_ = ds.initialize(model=loss_fn, model_parameters=params,
+                            config=config)
+    rng = np.random.RandomState(seed)
+    losses = [float(eng.train_batch(iter([_affine_batch(rng)])))
+              for _ in range(steps)]
+    eng.synchronize()  # drain any overlapped offload update
+    return losses
+
+
+def _base_config(**over):
+    cfg = {
+        "train_micro_batch_size_per_gpu": BATCH // 8,
+        "gradient_accumulation_steps": 1,
+        "steps_per_print": 10**9,
+        "optimizer": {"type": "Adam", "params": {"lr": 3e-3}},
+    }
+    cfg.update(over)
+    return cfg
+
+
+THRESHOLD = 1.0   # from ~ln(32)=3.47 start; a healthy run reaches <0.5
+
+
+def test_convergence_zero2():
+    params = init_gpt2_params(CFG, jax.random.PRNGKey(0))
+    losses = _train(gpt2_loss_fn(CFG, dtype=jnp.float32,
+                                 deterministic=True),
+                    params, _base_config(
+                        zero_optimization={"stage": 2},
+                        mesh={"axes": {"data": 8}}))
+    assert losses[-1] < THRESHOLD, losses[::10]
+
+
+def test_convergence_zero_offload():
+    params = init_gpt2_params(CFG, jax.random.PRNGKey(0))
+    losses = _train(gpt2_loss_fn(CFG, dtype=jnp.float32,
+                                 deterministic=True),
+                    params, _base_config(
+                        zero_optimization={"stage": 2,
+                                           "cpu_offload": True},
+                        mesh={"axes": {"data": 8}}))
+    assert losses[-1] < THRESHOLD, losses[::10]
+
+
+def test_convergence_moe():
+    from deepspeed_tpu.ops.moe import MoEConfig
+    moe_cfg = MoEConfig(hidden_size=32, intermediate_size=64,
+                        num_experts=4, top_k=2)
+    params = init_gpt2_moe_params(CFG, moe_cfg, jax.random.PRNGKey(0))
+    mesh_box = [None]
+
+    def loss_fn(p, batch, rng):
+        fn = gpt2_moe_loss_fn(CFG, moe_cfg, mesh=mesh_box[0],
+                              dtype=jnp.float32, deterministic=True)
+        return fn(p, batch, rng)
+
+    eng, *_ = ds.initialize(
+        model=loss_fn, model_parameters=params,
+        config=_base_config(zero_optimization={"stage": 1},
+                            mesh={"axes": {"data": 2, "expert": 4}},
+                            train_micro_batch_size_per_gpu=BATCH // 2))
+    mesh_box[0] = eng.mesh
+    rng = np.random.RandomState(0)
+    losses = [float(eng.train_batch(iter([_affine_batch(rng)])))
+              for _ in range(60)]
+    # the router aux losses keep a floor above the xent threshold; gate
+    # on the drop from the ln(V) start instead
+    assert losses[-1] < THRESHOLD + 0.5, losses[::10]
+
+
+def test_convergence_sp():
+    from deepspeed_tpu.parallel.mesh import build_mesh
+    axes = {"seq": 4, "data": 2}
+    mesh = build_mesh(axes)
+    params = init_gpt2_params(CFG, jax.random.PRNGKey(0))
+    losses = _train(gpt2_sp_loss_fn(CFG, mesh, dtype=jnp.float32,
+                                    deterministic=True),
+                    params, _base_config(
+                        zero_optimization={"stage": 1},
+                        mesh={"axes": axes},
+                        train_micro_batch_size_per_gpu=BATCH // 2))
+    assert losses[-1] < THRESHOLD, losses[::10]

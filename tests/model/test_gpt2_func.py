@@ -11,6 +11,8 @@ import sys
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # multi-minute e2e compiles (VERDICT r2 #8 tiering)
+
 _ROOT = os.path.normpath(os.path.join(os.path.dirname(__file__), "..", ".."))
 _TRAIN = os.path.join(_ROOT, "examples", "megatron_gpt2", "train.py")
 

@@ -360,6 +360,42 @@ def test_flash_streaming_dropout_matches_resident():
     _grads_match_streamed(loss, (q, k, v))
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_irregular_long_seq_pads_to_stream(causal):
+    """ADVICE r2: a long sequence that is 16- but not 128-divisible must
+    be internally padded (NEG_INF-masked tail keys, sliced outputs) so
+    streaming always engages, instead of warn-then-maybe-crash on the
+    resident path. Output and grads must match the dense reference."""
+    from deepspeed_tpu.ops.attention import flash as F
+    key = jax.random.PRNGKey(4)
+    S = 208                      # %16 == 0, %128 != 0
+    q, k, v = (jax.random.normal(jax.random.fold_in(key, i),
+                                 (1, 2, S, 16), jnp.float32)
+               for i in range(3))
+
+    old = F.STREAM_THRESHOLD
+    try:
+        F.STREAM_THRESHOLD = 128   # make S=208 a "long" sequence
+        o = F.flash_attention(q, k, v, causal=causal)
+        g = jax.grad(lambda q, k, v: jnp.sum(
+            F.flash_attention(q, k, v, causal=causal) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+    finally:
+        F.STREAM_THRESHOLD = old
+    o_ref = F.attention_reference(q, k, v, causal=causal,
+                                  sm_scale=1.0 / np.sqrt(16))
+    g_ref = jax.grad(lambda q, k, v: jnp.sum(
+        F.attention_reference(q, k, v, causal=causal,
+                              sm_scale=1.0 / np.sqrt(16)) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(o_ref),
+                               rtol=2e-5, atol=2e-5)
+    for a, b, name in zip(g, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-5, atol=5e-5,
+                                   err_msg=f"d{name}")
+
+
 def test_flash_streaming_masked_matches_resident():
     """Streamed + key-padding-mask path: the mask rides as a
     VMEM-resident ref sliced at dynamic 128-aligned offsets while K/V
