@@ -507,7 +507,8 @@ def gpt2_moe_loss_fn(config: GPT2Config, moe_config, mesh=None,
 
 
 def gpt2_sp_loss_fn(config: GPT2Config, mesh, dtype=jnp.bfloat16,
-                    remat: bool = False, deterministic: bool = False):
+                    remat: bool = False, deterministic: bool = False,
+                    zigzag: bool = False):
     """Sequence-parallel (context-parallel) GPT-2 loss over the ``seq``
     mesh axis — long-context training beyond one chip's activation
     memory (a TPU-native extension past the reference's block-sparse
@@ -530,7 +531,8 @@ def gpt2_sp_loss_fn(config: GPT2Config, mesh, dtype=jnp.bfloat16,
 
     def attention_fn(q, k, v, rate, rng):
         return ring_attention(q, k, v, axis_name="seq", causal=True,
-                              dropout_rate=rate, dropout_rng=rng)
+                              dropout_rate=rate, dropout_rng=rng,
+                              zigzag=zigzag)
 
     block = gpt2_block
     if remat:
@@ -542,11 +544,28 @@ def gpt2_sp_loss_fn(config: GPT2Config, mesh, dtype=jnp.bfloat16,
         S = ids.shape[1] - 1
         assert S % Pn == 0, (S, Pn)
         sl = S // Pn
-        # this shard's token window [idx*sl, idx*sl+sl] (+1 for targets)
-        win = jax.lax.dynamic_slice_in_dim(ids, idx * sl, sl + 1, axis=1)
-        inputs, targets = win[:, :-1], win[:, 1:]
-        pos_emb = jax.lax.dynamic_slice_in_dim(params["wpe"], idx * sl,
-                                               sl, axis=0)
+        if zigzag:
+            # load-balanced causal layout: this shard owns global chunks
+            # (idx, 2P-1-idx) of 2P (ring.zigzag_layout_indices); all
+            # token-local math is position-gathered, so only the window
+            # selection changes
+            lc = sl // 2
+            starts = (idx * lc, (2 * Pn - 1 - idx) * lc)
+            wins = [jax.lax.dynamic_slice_in_dim(ids, st, lc + 1, axis=1)
+                    for st in starts]
+            inputs = jnp.concatenate([w[:, :-1] for w in wins], axis=1)
+            targets = jnp.concatenate([w[:, 1:] for w in wins], axis=1)
+            pos_emb = jnp.concatenate(
+                [jax.lax.dynamic_slice_in_dim(params["wpe"], st, lc,
+                                              axis=0) for st in starts],
+                axis=0)
+        else:
+            # this shard's token window [idx*sl, idx*sl+sl] (+1 targets)
+            win = jax.lax.dynamic_slice_in_dim(ids, idx * sl, sl + 1,
+                                               axis=1)
+            inputs, targets = win[:, :-1], win[:, 1:]
+            pos_emb = jax.lax.dynamic_slice_in_dim(params["wpe"],
+                                                   idx * sl, sl, axis=0)
         x = (params["wte"][inputs] + pos_emb[None]).astype(dtype)
         if rng is not None and not deterministic:
             rng = jax.random.fold_in(rng, 0)

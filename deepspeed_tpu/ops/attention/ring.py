@@ -31,11 +31,23 @@ decomposition ds = p * (dp - delta) with p = exp(s - lse_total).
 
 Causal cost note: the plain ring computes all P chunks and discards the
 future ones (~2x the minimal causal work, like the unbalanced ring in the
-paper); the zigzag load-balanced schedule is a follow-up optimization.
+paper). ``zigzag=True`` runs the load-balanced schedule instead: the
+global sequence is cut into 2P chunks and shard i owns chunks
+(i, 2P-1-i) — its local sequence is the concatenation of those two
+halves. Each ring step then computes exactly TWO half-chunk flash calls
+per device: (late half vs visiting early half), which causality always
+needs, plus one call whose operands are SELECTED by the uniform
+predicate ``src < idx`` — (early vs visiting-early) for past sources,
+(late vs visiting-late) for future ones — merged into the right half's
+accumulator by masked combines. Work is identical on every device and
+totals the minimal causal 2P+1 half-chunk pairs per device (~half the
+plain ring's FLOPs), with the same single rotating KV channel.
+Use :func:`zigzag_layout_indices` to lay the global sequence out.
 
-Dropout: each chunk derives a distinct seed (seed ^ mix(src)) so the
-in-kernel counter-based mask never repeats across chunks and regenerates
-identically in forward and backward.
+Dropout: each chunk pair derives a distinct seed (seed ^ mix(src) plain,
+seed ^ mix(q_chunk, k_chunk) zigzag) so the in-kernel counter-based mask
+never repeats across chunks and regenerates identically in forward and
+backward.
 """
 
 import functools
@@ -160,6 +172,210 @@ def _ring_bwd_impl(res, do, axis_name, causal, sm_scale, interpret, rate):
         dv_acc.astype(v.dtype)
 
 
+# --------------------------------------------------------------------- #
+# zigzag (load-balanced causal) schedule
+# --------------------------------------------------------------------- #
+def zigzag_layout_indices(P: int, seq: int) -> np.ndarray:
+    """Global gather indices for the zigzag layout: shard i's local
+    sequence = global chunks (i, 2P-1-i) concatenated. ``g`` is laid out
+    shard-major, so with a (seq,)-sharded array x over P shards,
+    ``x[..., g, :]`` re-distributes it into the zigzag layout (one XLA
+    all-to-all under GSPMD); apply ``np.argsort(g)`` to invert."""
+    assert seq % (2 * P) == 0, (seq, P)
+    lc = seq // (2 * P)
+    out = []
+    for i in range(P):
+        out.extend(range(i * lc, (i + 1) * lc))
+        out.extend(range((2 * P - 1 - i) * lc, (2 * P - i) * lc))
+    return np.asarray(out, np.int64)
+
+
+def _zz_seed(seed, qc, kc, P):
+    # distinct stream per (q-chunk, k-chunk) pair, fwd/bwd reproducible
+    return seed + ((qc * 2 * P + kc + 1) * jnp.int32(-1640531527))
+
+
+def _halves(x, axis=2):
+    if x is None:
+        return None, None
+    lc = x.shape[axis] // 2
+    lo = jax.lax.slice_in_dim(x, 0, lc, axis=axis)
+    hi = jax.lax.slice_in_dim(x, lc, 2 * lc, axis=axis)
+    return lo, hi
+
+
+def _sel(pred, a, b):
+    return None if a is None else jnp.where(pred, a, b)
+
+
+def _zz_fwd_impl(q, k, v, kpm, seed, axis_name, sm_scale, interpret, rate):
+    P = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    a1, a2 = idx, 2 * P - 1 - idx
+    q1, q2 = _halves(q)
+    k1, k2 = _halves(k)
+    v1, v2 = _halves(v)
+    m1, m2 = _halves(kpm, axis=3)
+
+    def fwd(qc, kc, vc, mc, causal, sq, sk):
+        s = _zz_seed(seed, sq, sk, P) if rate > 0.0 else seed
+        return _flash_fwd(qc, kc, vc, mc, causal, sm_scale, interpret,
+                          dropout_rate=rate, seed=s)
+
+    # local: causal diagonals of both halves + (late vs own early)
+    o1, l1 = fwd(q1, k1, v1, m1, True, a1, a1)
+    o1 = o1.astype(jnp.float32)
+    o2a, l2a = fwd(q2, k2, v2, m2, True, a2, a2)
+    o2b, l2b = fwd(q2, k1, v1, m1, False, a2, a1)
+    o2, l2 = _combine(o2a.astype(jnp.float32), l2a, o2b, l2b)
+
+    def step(carry, j):
+        k_cur, v_cur, m_cur, o1, l1, o2, l2 = carry
+        k_cur = _rot(k_cur, axis_name, P)
+        v_cur = _rot(v_cur, axis_name, P)
+        if m_cur is not None:
+            m_cur = _rot(m_cur, axis_name, P)
+        src = (idx - j) % P
+        b1, b2 = src, 2 * P - 1 - src
+        kb1, kb2 = _halves(k_cur)
+        vb1, vb2 = _halves(v_cur)
+        mb1, mb2 = _halves(m_cur, axis=3)
+        # call A: late half vs visiting early half — always causal-valid
+        oA, lA = fwd(q2, kb1, vb1, mb1, False, a2, b1)
+        o2, l2 = _combine(o2, l2, oA, lA)
+        # call B: operand-selected by the uniform predicate src < idx
+        pred = src < idx
+        qB = _sel(pred, q1, q2)
+        kB = _sel(pred, kb1, kb2)
+        vB = _sel(pred, vb1, vb2)
+        mB = _sel(pred, mb1, mb2) if m_cur is not None else None
+        sq_ = jnp.where(pred, a1, a2)
+        sk_ = jnp.where(pred, b1, b2)
+        oB, lB = fwd(qB, kB, vB, mB, False, sq_, sk_)
+        o1, l1 = _combine(o1, l1, oB, jnp.where(pred, lB, NEG_BIG))
+        o2, l2 = _combine(o2, l2, oB, jnp.where(pred, NEG_BIG, lB))
+        return (k_cur, v_cur, m_cur, o1, l1, o2, l2), None
+
+    if P > 1:
+        (_, _, _, o1, l1, o2, l2), _ = jax.lax.scan(
+            step, (k, v, kpm, o1, l1, o2, l2), jnp.arange(1, P))
+    o = jnp.concatenate([o1, o2], axis=2).astype(q.dtype)
+    lse = jnp.concatenate([l1, l2], axis=2)
+    return o, lse
+
+
+def _zz_bwd_impl(res, do, axis_name, sm_scale, interpret, rate):
+    q, k, v, kpm, seed, o, lse = res
+    P = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    a1, a2 = idx, 2 * P - 1 - idx
+    q1, q2 = _halves(q)
+    k1, k2 = _halves(k)
+    v1, v2 = _halves(v)
+    m1, m2 = _halves(kpm, axis=3)
+    o1, o2 = _halves(o)
+    l1, l2 = _halves(lse)
+    do1, do2 = _halves(do)
+
+    def bwd(qc, kc, vc, mc, oc, lc_, doc, causal, sq, sk):
+        s = _zz_seed(seed, sq, sk, P) if rate > 0.0 else seed
+        dq_, dk_, dv_, _ = _flash_bwd(
+            (qc, kc, vc, mc, s, oc, lc_), doc, causal, sm_scale,
+            interpret, dropout_rate=rate)
+        return (dq_.astype(jnp.float32), dk_.astype(jnp.float32),
+                dv_.astype(jnp.float32))
+
+    # local pairs
+    dq1, dk1, dv1 = bwd(q1, k1, v1, m1, o1, l1, do1, True, a1, a1)
+    dq2, dk2, dv2 = bwd(q2, k2, v2, m2, o2, l2, do2, True, a2, a2)
+    g2b = bwd(q2, k1, v1, m1, o2, l2, do2, False, a2, a1)
+    dq2 = dq2 + g2b[0]
+    dk1 = dk1 + g2b[1]
+    dv1 = dv1 + g2b[2]
+    dk_buf = jnp.concatenate([dk1, dk2], axis=2)
+    dv_buf = jnp.concatenate([dv1, dv2], axis=2)
+
+    def step(carry, j):
+        k_cur, v_cur, m_cur, dk_buf, dv_buf, dq1, dq2 = carry
+        k_cur = _rot(k_cur, axis_name, P)
+        v_cur = _rot(v_cur, axis_name, P)
+        if m_cur is not None:
+            m_cur = _rot(m_cur, axis_name, P)
+        dk_buf = _rot(dk_buf, axis_name, P)
+        dv_buf = _rot(dv_buf, axis_name, P)
+        src = (idx - j) % P
+        b1, b2 = src, 2 * P - 1 - src
+        kb1, kb2 = _halves(k_cur)
+        vb1, vb2 = _halves(v_cur)
+        mb1, mb2 = _halves(m_cur, axis=3)
+        dkb1, dkb2 = _halves(dk_buf)
+        dvb1, dvb2 = _halves(dv_buf)
+        # call A: q2 vs visiting early half — always valid
+        gA = bwd(q2, kb1, vb1, mb1, o2, l2, do2, False, a2, b1)
+        dq2 = dq2 + gA[0]
+        dkb1 = dkb1 + gA[1]
+        dvb1 = dvb1 + gA[2]
+        # call B: operand-selected
+        pred = src < idx
+        qB = _sel(pred, q1, q2)
+        kB = _sel(pred, kb1, kb2)
+        vB = _sel(pred, vb1, vb2)
+        mB = _sel(pred, mb1, mb2) if m_cur is not None else None
+        oB = _sel(pred, o1, o2)
+        lB = _sel(pred, l1, l2)
+        doB = _sel(pred, do1, do2)
+        sq_ = jnp.where(pred, a1, a2)
+        sk_ = jnp.where(pred, b1, b2)
+        gB = bwd(qB, kB, vB, mB, oB, lB, doB, False, sq_, sk_)
+        w = pred.astype(jnp.float32)
+        dq1 = dq1 + gB[0] * w
+        dq2 = dq2 + gB[0] * (1.0 - w)
+        dkb1 = dkb1 + gB[1] * w
+        dkb2 = dkb2 + gB[1] * (1.0 - w)
+        dvb1 = dvb1 + gB[2] * w
+        dvb2 = dvb2 + gB[2] * (1.0 - w)
+        dk_buf = jnp.concatenate([dkb1, dkb2], axis=2)
+        dv_buf = jnp.concatenate([dvb1, dvb2], axis=2)
+        return (k_cur, v_cur, m_cur, dk_buf, dv_buf, dq1, dq2), None
+
+    if P > 1:
+        (_, _, _, dk_buf, dv_buf, dq1, dq2), _ = jax.lax.scan(
+            step, (k, v, kpm, dk_buf, dv_buf, dq1, dq2), jnp.arange(1, P))
+        # final rotation returns each (dk, dv) buffer to its chunk owner
+        dk_buf = _rot(dk_buf, axis_name, P)
+        dv_buf = _rot(dv_buf, axis_name, P)
+    dq = jnp.concatenate([dq1, dq2], axis=2)
+    return dq.astype(q.dtype), dk_buf.astype(k.dtype), \
+        dv_buf.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _zz_attention(q, k, v, seed, has_kpm, axis_name, sm_scale,
+                  interpret, rate):
+    kpm, seed = seed if has_kpm else (None, seed)
+    o, _ = _zz_fwd_impl(q, k, v, kpm, seed, axis_name, sm_scale,
+                        interpret, rate)
+    return o
+
+
+def _zz_attention_fwd(q, k, v, seed, has_kpm, axis_name, sm_scale,
+                      interpret, rate):
+    kpm, seed = seed if has_kpm else (None, seed)
+    o, lse = _zz_fwd_impl(q, k, v, kpm, seed, axis_name, sm_scale,
+                          interpret, rate)
+    return o, (q, k, v, kpm, seed, o, lse)
+
+
+def _zz_attention_bwd(has_kpm, axis_name, sm_scale, interpret, rate,
+                      res, g):
+    dq, dk, dv = _zz_bwd_impl(res, g, axis_name, sm_scale, interpret,
+                              rate)
+    return dq, dk, dv, ((None, None) if has_kpm else None)
+
+
+_zz_attention.defvjp(_zz_attention_fwd, _zz_attention_bwd)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
 def _ring_attention(q, k, v, seed, has_kpm, axis_name, causal, sm_scale,
                     interpret, rate):
@@ -191,13 +407,17 @@ def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = False,
                    sm_scale: Optional[float] = None,
                    dropout_rate: float = 0.0, dropout_rng=None,
                    key_padding_mask=None,
-                   interpret: Optional[bool] = None):
+                   interpret: Optional[bool] = None,
+                   zigzag: bool = False):
     """Sequence-parallel flash attention over ``axis_name``.
 
     Call INSIDE ``shard_map`` with ``axis_name`` manual; q/k/v are this
     device's sequence shard, shape (batch, heads, seq_local, head_dim)
-    with identical seq_local on every shard (global seq = P * seq_local,
-    shard i owning positions [i*seq_local, (i+1)*seq_local)).
+    with identical seq_local on every shard. Plain layout: shard i owns
+    positions [i*seq_local, (i+1)*seq_local). ``zigzag=True`` (causal
+    only) uses the load-balanced layout instead — shard i owns global
+    chunks (i, 2P-1-i) of 2P, concatenated (:func:`zigzag_layout_indices`)
+    — for ~half the causal FLOPs at identical math (module docstring).
 
     ``key_padding_mask``: optional *additive* (B, 1, 1, seq_local) mask
     for this shard's keys (BERT padding); it rotates around the ring
@@ -214,6 +434,16 @@ def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = False,
         seed = dropout_seed_from_rng(dropout_rng)
     else:
         seed = jnp.zeros((1, 1), jnp.int32)
+    if zigzag:
+        assert causal, "zigzag schedule is a causal-attention optimization"
+        assert q.shape[2] % 2 == 0, \
+            f"zigzag needs an even local seq, got {q.shape[2]}"
+        if key_padding_mask is not None:
+            return _zz_attention(q, k, v, (key_padding_mask, seed), True,
+                                 axis_name, float(sm_scale), interpret,
+                                 dropout_rate)
+        return _zz_attention(q, k, v, seed, False, axis_name,
+                             float(sm_scale), interpret, dropout_rate)
     if key_padding_mask is not None:
         return _ring_attention(q, k, v, (key_padding_mask, seed), True,
                                axis_name, causal, float(sm_scale),

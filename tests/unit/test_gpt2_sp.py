@@ -122,3 +122,36 @@ def test_bert_sp_matches_dense():
             jax.tree_util.tree_flatten_with_path(g_d)[0]):
         np.testing.assert_allclose(np.asarray(a), np.asarray(d),
                                    rtol=2e-4, atol=2e-5, err_msg=str(pa))
+
+
+def test_sp_zigzag_loss_matches_dense():
+    """zigzag=True: the load-balanced causal schedule computes the SAME
+    LM loss (every token's loss lands once, whichever shard owns it)."""
+    mesh = build_mesh({"seq": 4, "data": 2})
+    params = init_gpt2_params(CFG, jax.random.PRNGKey(0))
+    sp = gpt2_sp_loss_fn(CFG, mesh, dtype=jnp.float32, deterministic=True,
+                         zigzag=True)
+    dense = gpt2_loss_fn(CFG, dtype=jnp.float32, deterministic=True)
+    b = _batch(seed=11)
+    rng = jax.random.PRNGKey(1)
+    l_sp = float(jax.jit(sp)(params, b, rng))
+    l_d = float(jax.jit(dense)(params, b, rng))
+    np.testing.assert_allclose(l_sp, l_d, rtol=2e-5)
+
+
+def test_sp_zigzag_grads_match_dense():
+    mesh = build_mesh({"seq": 4, "data": 2})
+    params = init_gpt2_params(CFG, jax.random.PRNGKey(0))
+    sp = gpt2_sp_loss_fn(CFG, mesh, dtype=jnp.float32, deterministic=True,
+                         zigzag=True)
+    dense = gpt2_loss_fn(CFG, dtype=jnp.float32, deterministic=True)
+    b = _batch(seed=12)
+    rng = jax.random.PRNGKey(1)
+    g_sp = jax.jit(jax.grad(lambda p: sp(p, b, rng)))(params)
+    g_d = jax.jit(jax.grad(lambda p: dense(p, b, rng)))(params)
+    for (pa, a), (_, d) in zip(
+            jax.tree_util.tree_flatten_with_path(g_sp)[0],
+            jax.tree_util.tree_flatten_with_path(g_d)[0]):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(d), rtol=1e-4, atol=1e-5,
+            err_msg=str(pa))

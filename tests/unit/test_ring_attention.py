@@ -131,3 +131,124 @@ def test_ring_with_streamed_flash_chunks():
     for a, b in zip(g, g_ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-4)
+
+
+# ------------------------- zigzag schedule --------------------------- #
+def _zz_full(mesh, P_seq, dropout_rate=0.0, rng=None):
+    """Full-array wrapper for the zigzag schedule: permute the global
+    sequence into the zigzag layout, shard over 'seq', run, un-permute."""
+    from deepspeed_tpu.ops.attention.ring import zigzag_layout_indices
+
+    def fn(q, k, v):
+        S = q.shape[2]
+        g = zigzag_layout_indices(P_seq, S)
+        inv = np.argsort(g)
+
+        def inner(q, k, v):
+            return ring_attention(q, k, v, axis_name="seq", causal=True,
+                                  dropout_rate=dropout_rate,
+                                  dropout_rng=rng, zigzag=True)
+        spec = P(None, None, "seq", None)
+        mapped = jax.shard_map(inner, mesh=mesh,
+                               in_specs=(spec, spec, spec),
+                               out_specs=spec, check_vma=False)
+        out_z = mapped(q[:, :, g, :], k[:, :, g, :], v[:, :, g, :])
+        return out_z[:, :, inv, :]
+    return jax.jit(fn)
+
+
+@pytest.mark.parametrize("axes", [{"seq": 8}, {"data": 2, "seq": 4}])
+def test_zigzag_matches_reference_forward(axes):
+    mesh = build_mesh(axes)
+    S = 32 * axes["seq"]
+    q, k, v = _qkv(S)
+    out = _zz_full(mesh, axes["seq"])(q, k, v)
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_zigzag_matches_reference_grads():
+    axes = {"seq": 4, "data": 2}
+    mesh = build_mesh(axes)
+    S = 32 * axes["seq"]
+    q, k, v = _qkv(S, seed=3)
+    w = jax.random.normal(jax.random.PRNGKey(9), (B, H, S, D), jnp.float32)
+
+    zz = _zz_full(mesh, axes["seq"])
+
+    def zz_loss(q, k, v):
+        return jnp.sum(zz(q, k, v) * w)
+
+    def ref_loss(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, causal=True)
+                       .astype(jnp.float32) * w)
+
+    g_zz = jax.grad(zz_loss, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for gr, gf, name in zip(g_zz, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(gr), np.asarray(gf),
+                                   rtol=5e-5, atol=5e-5,
+                                   err_msg=f"d{name}")
+
+
+def test_zigzag_halves_causal_flops():
+    """VERDICT r2 #5 'done' criterion: the balanced schedule does ~half
+    the plain causal ring's attention work at P=4 (jaxpr dot FLOPs; scan
+    bodies are weighted by trip count)."""
+    from jax.extend import core as jex_core
+
+    def dot_flops(jaxpr, mult=1):
+        total = 0
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name in ("dot_general", "dot"):
+                lhs = eqn.invars[0].aval.shape
+                rhs = eqn.invars[1].aval.shape
+                dims = eqn.params["dimension_numbers"][0]
+                contract = 1
+                for d in dims[0]:
+                    contract *= lhs[d]
+                m = 1
+                for s in lhs:
+                    m *= s
+                n = 1
+                for s in rhs:
+                    n *= s
+                total += 2 * m * n // max(contract, 1)
+            m2 = (eqn.params.get("length", 1)
+                  if eqn.primitive.name == "scan" else 1)
+            for v_ in eqn.params.values():
+                subs = []
+                if isinstance(v_, jex_core.ClosedJaxpr):
+                    subs = [v_.jaxpr]
+                elif hasattr(v_, "eqns"):
+                    subs = [v_]
+                elif isinstance(v_, (tuple, list)):
+                    subs = [s.jaxpr if isinstance(s, jex_core.ClosedJaxpr)
+                            else s for s in v_ if
+                            isinstance(s, jex_core.ClosedJaxpr)
+                            or hasattr(s, "eqns")]
+                for s in subs:
+                    total += mult * m2 * dot_flops(s)
+        return total
+
+    axes = {"seq": 4, "data": 2}
+    mesh = build_mesh(axes)
+    S = 32 * axes["seq"]
+    q, k, v = _qkv(S)
+
+    def loss_plain(q, k, v):
+        ring = _ring_full(mesh, True, axes["seq"])
+        return jnp.sum(ring(q, k, v) ** 2)
+
+    def loss_zz(q, k, v):
+        zz = _zz_full(mesh, axes["seq"])
+        return jnp.sum(zz(q, k, v) ** 2)
+
+    f_plain = dot_flops(jax.make_jaxpr(
+        jax.grad(loss_plain, argnums=(0, 1, 2)))(q, k, v).jaxpr)
+    f_zz = dot_flops(jax.make_jaxpr(
+        jax.grad(loss_zz, argnums=(0, 1, 2)))(q, k, v).jaxpr)
+    # plain causal ring computes-and-discards future chunks; zigzag does
+    # the minimal balanced work -> ~0.5x + per-call overhead
+    assert f_zz < 0.65 * f_plain, (f_zz, f_plain, f_zz / f_plain)
