@@ -488,3 +488,22 @@ class TestTransformerLayerGrid:
                                         deterministic=True)
         np.testing.assert_allclose(np.asarray(o16, np.float32),
                                    np.asarray(o32), atol=5e-2, rtol=5e-2)
+
+
+def test_block_table_lookup_and_fallback():
+    """Autotuned block table (tools/autotune_blocks.py): exact shape hits
+    override the heuristic; unknown shapes keep it; the sweep override
+    wins over both."""
+    from deepspeed_tpu.ops.attention import flash as F
+    old_table, old_force = F._BLOCK_TABLE, F._FORCE_BLOCKS
+    try:
+        F._BLOCK_TABLE = {(128, 128, 64, False): (64, 64)}
+        assert F._pick_blocks(128, 128, 64) == (64, 64)
+        # unknown shape -> heuristic (largest divisor under cap)
+        assert F._pick_blocks(256, 256, 64) == (256, 256)
+        # no head-dim given (legacy callers) -> heuristic
+        assert F._pick_blocks(128, 128) == (128, 128)
+        F._FORCE_BLOCKS = (32, 32)
+        assert F._pick_blocks(128, 128, 64) == (32, 32)
+    finally:
+        F._BLOCK_TABLE, F._FORCE_BLOCKS = old_table, old_force

@@ -1,0 +1,139 @@
+"""Flash-attention block-size autotune sweep (VERDICT r2 #6).
+
+TPU-native analog of the reference's GemmTest autotuner
+(/root/reference/csrc/includes/gemm_test.h:27): instead of per-GEMM
+algorithm search at engine construction, this offline harness times the
+Pallas flash kernel's (block_q, block_k) combinations per shape class
+(seq_q, seq_k, head_dim, stream) on the REAL chip and writes the winners
+to ``deepspeed_tpu/ops/attention/block_table.json``, which
+``flash._pick_blocks`` consults at trace time (unknown shapes keep the
+hand-measured heuristic).
+
+Run on hardware:  PYTHONPATH=/root/repo python tools/autotune_blocks.py
+(~minutes; each combo pays one compile). Timing: value-fetch completion
+barrier + RTT subtraction, min-of-3 windows (the device tunnel adds
+large variable latency — see bench.py).
+"""
+
+import argparse
+import json
+import os
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "deepspeed_tpu", "ops", "attention",
+                   "block_table.json")
+
+# the bench/model ladder's attention shapes (seq_q, seq_k, head_dim)
+SHAPES = [
+    (128, 128, 64),        # BERT-large seq128 (bench headline row)
+    (512, 512, 64),        # BERT seq512
+    (1024, 1024, 64),      # GPT-2 345M / 1.5B pretraining
+    (2048, 2048, 64),
+    (8192, 8192, 64),      # long-context / sparse-vs-dense row
+    (16384, 16384, 64),    # streamed
+    (32768, 32768, 64),    # streamed
+    (1024, 1024, 80),      # 80-dim heads (e.g. 2560/32-style configs)
+]
+CANDIDATES = (64, 128, 256, 512)
+
+
+def _rtt():
+    import jax
+    import jax.numpy as jnp
+    zf = jax.jit(lambda: jnp.zeros(()))
+    np.asarray(zf())
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        np.asarray(zf())
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+def time_combo(sq, sk, d, bq, bk, rtt, iters=5, heads=8):
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.ops.attention import flash as F
+
+    key = jax.random.PRNGKey(0)
+    q, k, v = (jax.random.normal(jax.random.fold_in(key, i),
+                                 (1, heads, s, d), jnp.bfloat16)
+               for i, s in enumerate((sq, sk, sk)))
+
+    def loss(q, k, v):
+        return jnp.sum(F.flash_attention(q, k, v, causal=True)
+                       .astype(jnp.float32))
+
+    F._FORCE_BLOCKS = (bq, bk)
+    try:
+        g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        out = g(q, k, v)
+        jax.tree_util.tree_map(np.asarray, out)   # compile + settle
+        best = None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = g(q, k, v)
+            jax.tree_util.tree_map(np.asarray, out[0])
+            w = max(time.perf_counter() - t0 - rtt, 1e-9) / iters
+            best = w if best is None else min(best, w)
+        return best
+    finally:
+        F._FORCE_BLOCKS = None
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=OUT)
+    ap.add_argument("--iters", type=int, default=5)
+    args = ap.parse_args()
+
+    import jax
+    from deepspeed_tpu.ops.attention import flash as F
+    backend = jax.default_backend()
+    print(f"# backend: {backend} (results are only meaningful on tpu)")
+    rtt = _rtt()
+    print(f"# rtt: {rtt*1e3:.2f} ms")
+
+    rows = []
+    for sq, sk, d in SHAPES:
+        stream = F._use_stream(sq, sk)
+        combos = [
+            (bq, bk) for bq in CANDIDATES for bk in CANDIDATES
+            if sq % bq == 0 and sk % bk == 0
+            # streamed tiles put the block width in the DMA lane dim
+            and (not stream or (bq % 128 == 0 and bk % 128 == 0))
+        ]
+        results = {}
+        for bq, bk in combos:
+            try:
+                dt = time_combo(sq, sk, d, bq, bk, rtt, iters=args.iters)
+                results[(bq, bk)] = dt
+                print(f"S=({sq},{sk}) d={d} stream={stream} "
+                      f"bq={bq} bk={bk}: {dt*1e3:.2f} ms")
+            except Exception as e:  # combo may not compile (VMEM, Mosaic)
+                print(f"S=({sq},{sk}) d={d} bq={bq} bk={bk}: "
+                      f"FAILED {type(e).__name__}")
+        if not results:
+            continue
+        (bq, bk), dt = min(results.items(), key=lambda kv: kv[1])
+        default = F._pick_blocks(sq, sk)   # heuristic, table not loaded
+        print(f"--> best ({sq},{sk},{d}): bq={bq} bk={bk} "
+              f"{dt*1e3:.2f} ms (heuristic would pick {default})")
+        rows.append({"seq_q": sq, "seq_k": sk, "d": d, "stream": stream,
+                     "bq": bq, "bk": bk, "ms": round(dt * 1e3, 3),
+                     "backend": backend})
+
+    if backend != "tpu":
+        print("# not on TPU - NOT writing the table")
+        return
+    with open(args.out, "w") as f:
+        json.dump(rows, f, indent=1)
+    print(f"# wrote {len(rows)} entries to {args.out}")
+
+
+if __name__ == "__main__":
+    main()
