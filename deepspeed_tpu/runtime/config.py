@@ -98,6 +98,11 @@ def get_sparse_gradients_enabled(param_dict):
                             C.SPARSE_GRADIENTS_DEFAULT)
 
 
+def get_sparse_gradients_params(param_dict):
+    return get_scalar_param(param_dict, C.SPARSE_GRADIENTS_PARAMS,
+                            C.SPARSE_GRADIENTS_PARAMS_DEFAULT)
+
+
 def get_steps_per_print(param_dict):
     return get_scalar_param(param_dict, C.STEPS_PER_PRINT,
                             C.STEPS_PER_PRINT_DEFAULT)
@@ -368,6 +373,7 @@ class DeepSpeedConfig:
         self.disable_allgather = get_disable_allgather(param_dict)
         self.gradient_predivide_factor = get_gradient_predivide_factor(param_dict)
         self.sparse_gradients_enabled = get_sparse_gradients_enabled(param_dict)
+        self.sparse_gradients_params = get_sparse_gradients_params(param_dict)
 
         self.zero_config = DeepSpeedZeroConfig(param_dict)
         self.zero_optimization_stage = self.zero_config.stage

@@ -29,6 +29,10 @@ GRADIENT_ACCUMULATION_STEPS_DEFAULT = None
 
 SPARSE_GRADIENTS = "sparse_gradients"
 SPARSE_GRADIENTS_DEFAULT = False
+# explicit opt-in list of embedding leaf paths (or path substrings) for
+# the CSR grad exchange; when set, the name-regex heuristic is bypassed
+SPARSE_GRADIENTS_PARAMS = "sparse_gradients_params"
+SPARSE_GRADIENTS_PARAMS_DEFAULT = None
 
 #############################################
 # Optimizer / scheduler

@@ -405,10 +405,36 @@ class DeepSpeedEngine:
         self._sparse_grad_paths = set()
         if (self.sparse_gradients_enabled() and self.dp_world_size > 1
                 and not self._onebit):
-            self._sparse_grad_paths = _detect_embedding_paths(params)
+            explicit = getattr(self._config, "sparse_gradients_params",
+                               None)
+            if explicit:
+                # explicit opt-in (safer than the name heuristic: a
+                # tied-head "embedding" is NOT a pure lookup table and
+                # must stay dense — the heuristic can only catch that at
+                # runtime via the overflow flag)
+                eligible = {
+                    _path_key(p): leaf for p, leaf in
+                    jax.tree_util.tree_flatten_with_path(params)[0]
+                    if hasattr(leaf, "ndim") and leaf.ndim == 2
+                    and jnp.issubdtype(leaf.dtype, jnp.floating)}
+                resolved = set()
+                for entry in explicit:
+                    hits = {p for p in eligible
+                            if p == entry or entry in p}
+                    if not hits:
+                        raise ValueError(
+                            f"sparse_gradients_params entry {entry!r} "
+                            f"matches no 2-D float leaf; eligible: "
+                            f"{sorted(eligible)}")
+                    resolved |= hits
+                self._sparse_grad_paths = resolved
+            else:
+                self._sparse_grad_paths = _detect_embedding_paths(params)
             if self._sparse_grad_paths:
                 log_dist("sparse_gradients: CSR allreduce for "
-                         f"{sorted(self._sparse_grad_paths)}", ranks=[0])
+                         f"{sorted(self._sparse_grad_paths)}"
+                         + ("" if explicit else " (name heuristic; set "
+                            "sparse_gradients_params to pin)"), ranks=[0])
             else:
                 logger.warning(
                     "sparse_gradients enabled but no embedding-named 2-D "
