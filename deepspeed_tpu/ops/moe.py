@@ -42,7 +42,7 @@ class MoEConfig:
     router_z_coef: float = 1e-3
 
     def __post_init__(self):
-        assert self.top_k in (1, 2), self.top_k
+        assert self.top_k >= 1, self.top_k
         assert self.num_experts >= self.top_k, (self.num_experts,
                                                 self.top_k)
 
@@ -90,25 +90,25 @@ def moe_router(params, config: MoEConfig, x_tokens):
                         params["router"].astype(jnp.float32))
     probs = jax.nn.softmax(logits, axis=-1)               # (T, E)
 
-    # --- top-1 choice
-    idx1 = jnp.argmax(probs, axis=-1)                     # (T,)
-    mask1 = jax.nn.one_hot(idx1, e, dtype=jnp.float32)    # (T, E)
-    gate1 = jnp.sum(probs * mask1, axis=-1)               # (T,)
-
-    zeros = jnp.zeros((e,), jnp.int32)
-    pos1, kept1, counts = _one_hot_positions(mask1, c, zeros)
-
-    if config.top_k == 2:
-        probs2 = probs * (1.0 - mask1)                    # mask out choice 1
-        idx2 = jnp.argmax(probs2, axis=-1)
-        mask2 = jax.nn.one_hot(idx2, e, dtype=jnp.float32)
-        gate2 = jnp.sum(probs * mask2, axis=-1)
-        pos2, kept2, _ = _one_hot_positions(mask2, c, counts)
-        # renormalize over the two selected gates (GShard)
-        denom = jnp.maximum(gate1 + gate2, 1e-9)
-        gate1n, gate2n = gate1 / denom, gate2 / denom
+    # --- top-k choices (static unroll over k): each round takes the
+    # argmax of the remaining probs; earlier rounds claim capacity slots
+    # first on ties (GShard priority — round r's choices take slots
+    # before any round r+1 choice)
+    remaining = probs
+    counts = jnp.zeros((e,), jnp.int32)
+    choices = []                                          # (mask, gate, pos, kept)
+    for _ in range(config.top_k):
+        idx = jnp.argmax(remaining, axis=-1)              # (T,)
+        mask = jax.nn.one_hot(idx, e, dtype=jnp.float32)  # (T, E)
+        gate = jnp.sum(probs * mask, axis=-1)             # (T,)
+        pos, kept, counts = _one_hot_positions(mask, c, counts)
+        choices.append((mask, gate, pos, kept))
+        remaining = remaining * (1.0 - mask)
+    if config.top_k > 1:
+        # renormalize over the selected gates (GShard)
+        denom = jnp.maximum(sum(g for _, g, _, _ in choices), 1e-9)
     else:
-        gate1n = gate1
+        denom = 1.0
 
     def scatter(kept, pos, gate):
         # (T, E, C): one-hot over the capacity slot, weighted by the gate
@@ -116,16 +116,16 @@ def moe_router(params, config: MoEConfig, x_tokens):
         d = slot * kept[..., None].astype(jnp.float32)
         return d, d * gate[:, None, None]
 
-    d1, w1 = scatter(kept1, pos1, gate1n)
-    dispatch, combine = d1, w1
-    if config.top_k == 2:
-        d2, w2 = scatter(kept2, pos2, gate2n)
-        dispatch = dispatch + d2
-        combine = combine + w2
+    dispatch = jnp.zeros((t, e, c), jnp.float32)
+    combine = jnp.zeros((t, e, c), jnp.float32)
+    for mask, gate, pos, kept in choices:
+        d_r, w_r = scatter(kept, pos, gate / denom)
+        dispatch = dispatch + d_r
+        combine = combine + w_r
 
     # Switch load-balance loss: fraction of tokens routed (first choice)
     # vs mean router probability, per expert
-    f_e = jnp.mean(mask1, axis=0)
+    f_e = jnp.mean(choices[0][0], axis=0)
     p_e = jnp.mean(probs, axis=0)
     lb = config.load_balance_coef * e * jnp.sum(f_e * p_e)
     z = config.router_z_coef * jnp.mean(
@@ -207,21 +207,19 @@ def moe_layer_reference(params, config: MoEConfig, x):
     probs = np.exp(logits - logits.max(-1, keepdims=True))
     probs /= probs.sum(-1, keepdims=True)
 
-    choices = []                       # (token, expert, gate) in priority order
-    idx1 = probs.argmax(-1)
-    gates1 = probs[np.arange(len(xt)), idx1]
-    if config.top_k == 2:
-        p2 = probs.copy()
-        p2[np.arange(len(xt)), idx1] = 0.0
-        idx2 = p2.argmax(-1)
-        gates2 = probs[np.arange(len(xt)), idx2]
-        denom = np.maximum(gates1 + gates2, 1e-9)
-        gates1, gates2 = gates1 / denom, gates2 / denom
-    for ti in range(len(xt)):
-        choices.append((0, ti, idx1[ti], gates1[ti]))
-    if config.top_k == 2:
-        for ti in range(len(xt)):
-            choices.append((1, ti, idx2[ti], gates2[ti]))
+    arange = np.arange(len(xt))
+    idxs, gates = [], []
+    p = probs.copy()
+    for _ in range(config.top_k):
+        idx = p.argmax(-1)
+        gates.append(probs[arange, idx])
+        idxs.append(idx)
+        p[arange, idx] = 0.0
+    if config.top_k > 1:
+        denom = np.maximum(sum(gates), 1e-9)
+        gates = [g / denom for g in gates]
+    choices = [(r, ti, idxs[r][ti], gates[r][ti])
+               for r in range(config.top_k) for ti in range(len(xt))]
 
     used = np.zeros(e, np.int32)
     y = np.zeros_like(xt)

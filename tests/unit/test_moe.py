@@ -26,7 +26,7 @@ def _setup(top_k, e=4, h=16, f=32, b=2, s=8, cf=1.25, seed=0):
 
 class TestMoENumerics:
 
-    @pytest.mark.parametrize("top_k", [1, 2])
+    @pytest.mark.parametrize("top_k", [1, 2, 4])
     def test_matches_token_loop_oracle(self, top_k):
         cfg, params, x = _setup(top_k)
         y, aux = moe_layer(params, cfg, x, dtype=jnp.float32)
@@ -35,7 +35,7 @@ class TestMoENumerics:
                                    atol=1e-5, rtol=1e-5)
         assert np.isfinite(float(aux)) and float(aux) > 0.0
 
-    @pytest.mark.parametrize("top_k", [1, 2])
+    @pytest.mark.parametrize("top_k", [1, 2, 4])
     def test_capacity_drops_match_oracle(self, top_k):
         # tight capacity: forced drops must agree with the oracle's
         # token-order priority rule
