@@ -138,7 +138,8 @@ def main():
                     0, cfg.vocab_size, (bs, seq + 1)).astype(np.int32)}
         it = micro_batches()
     else:
-        stages = config["mesh"]["axes"]["pipe"]
+        stages = (config["mesh"]["axes"]["pipe"]
+                  * config.get("pipeline", {}).get("virtual_stages", 1))
         spec = gpt2_pipeline_spec(cfg, num_stages=stages)
         engine, *_ = ds.initialize(model=spec, config=config)
         data_par = config["mesh"]["axes"].get("data", 1)

@@ -8,6 +8,8 @@ import sys
 
 import pytest
 
+pytestmark = pytest.mark.slow  # CLI e2e compiles (VERDICT r2 #8 tiering)
+
 _ROOT = os.path.normpath(os.path.join(os.path.dirname(__file__), "..", ".."))
 
 

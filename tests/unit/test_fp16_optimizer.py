@@ -109,6 +109,7 @@ def test_state_dict_roundtrip():
         np.asarray(opt2.params["w"], np.float32))
 
 
+@pytest.mark.slow
 def test_unfused_lamb_variant():
     loss_fn = _quad_loss(jnp.arange(6.0))
     # nonzero start: LAMB's trust ratio scales with ||w||, so w=0 barely

@@ -62,6 +62,7 @@ class TestGPT2:
                   for _ in range(15)]
         assert losses[-1] < losses[0], losses
 
+    @pytest.mark.slow
     def test_tp_sharded_train_step(self):
         """TP over 'model' axis + DP: the Megatron-style 3D slice minus
         pipe (covered in pipeline tests)."""
@@ -134,6 +135,7 @@ class TestBert:
                                    np.asarray(out2[:, :8]), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_bert_tensor_parallel_training():
     """BERT + Megatron-style TP specs over the 'model' axis trains under
     GSPMD (dp x tp mesh) and matches the replicated run's loss."""
@@ -198,6 +200,7 @@ class TestGPT2Generate:
                          resid_dropout=0.0)
         return cfg, init_gpt2_params(cfg, jax.random.PRNGKey(3))
 
+    @pytest.mark.slow
     def test_greedy_matches_full_forward_loop(self):
         from deepspeed_tpu.models.gpt2 import gpt2_forward, gpt2_generate
         cfg, params = self._cfg_params()

@@ -35,6 +35,7 @@ def test_monitor_nonzero_rank_noops(tmp_path):
     assert m.writer is None
 
 
+@pytest.mark.slow
 def test_monitor_writes_scalars(tmp_path):
     m = TensorBoardMonitor(enabled=True, output_path=str(tmp_path),
                            job_name="job")

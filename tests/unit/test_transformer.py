@@ -98,6 +98,7 @@ class TestFlashDropout:
         return tuple(jnp.asarray(rng.randn(B, H, S, D), jnp.float32)
                      for _ in range(3))
 
+    @pytest.mark.slow
     def test_mask_rate_and_scaling(self):
         from deepspeed_tpu.ops.attention.flash import dropout_mask_reference
         for rate in (0.1, 0.3, 0.5):
@@ -133,6 +134,7 @@ class TestFlashDropout:
                                    atol=3e-5, rtol=3e-5)
 
     @pytest.mark.parametrize("masked", [False, True])
+    @pytest.mark.slow
     def test_grads_match_oracle_same_mask(self, masked):
         """fwd/bwd mask consistency: dq/dk/dv against the dense oracle
         that applies the identical hash mask — if the backward kernels
@@ -176,6 +178,7 @@ class TestFlashDropout:
         np.testing.assert_array_equal(np.asarray(o1a), np.asarray(o1b))
         assert float(jnp.abs(o1a - o2).max()) > 1e-3
 
+    @pytest.mark.slow
     def test_gpt2_trains_through_flash_dropout(self):
         """attn_dropout=0.1 training path must run the flash kernel (no
         dense (S,S) fallback) and produce a finite decreasing loss."""
@@ -243,6 +246,7 @@ class TestTransformerLayer:
         np.testing.assert_allclose(np.asarray(out), np.asarray(out_ref),
                                    atol=2e-5, rtol=2e-5)
 
+    @pytest.mark.slow
     def test_backward_matches(self):
         cfg, params, x = self._mk(seq=64)
 
@@ -318,6 +322,7 @@ def _grads_match_streamed(loss, args, thresh=128, tol=1e-5):
 
 @pytest.mark.parametrize("S,causal",
                          [(128, True), (384, True), (384, False)])
+@pytest.mark.slow
 def test_flash_streaming_matches_resident(S, causal):
     """Force streaming at a small S: outputs and grads must match the
     resident path. S=384 uses 128-blocks -> 3-deep DMA loops incl. the
@@ -340,6 +345,7 @@ def test_flash_streaming_matches_resident(S, causal):
     _grads_match_streamed(loss, (q, k, v))
 
 
+@pytest.mark.slow
 def test_flash_streaming_dropout_matches_resident():
     """Streamed + in-kernel dropout: the counter-hash mask must
     regenerate identically whether K/V are resident or DMA-streamed
@@ -361,6 +367,7 @@ def test_flash_streaming_dropout_matches_resident():
 
 
 @pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.slow
 def test_flash_irregular_long_seq_pads_to_stream(causal):
     """ADVICE r2: a long sequence that is 16- but not 128-divisible must
     be internally padded (NEG_INF-masked tail keys, sliced outputs) so
@@ -396,6 +403,7 @@ def test_flash_irregular_long_seq_pads_to_stream(causal):
                                    err_msg=f"d{name}")
 
 
+@pytest.mark.slow
 def test_flash_streaming_masked_matches_resident():
     """Streamed + key-padding-mask path: the mask rides as a
     VMEM-resident ref sliced at dynamic 128-aligned offsets while K/V
@@ -445,6 +453,7 @@ class TestTransformerLayerGrid:
         (1, 256, 64, 2),
     ])
     @pytest.mark.parametrize("pre_ln", [True, False])
+    @pytest.mark.slow
     def test_forward_grid(self, batch, seq, hidden, heads, pre_ln):
         from deepspeed_tpu.ops.transformer.transformer import (
             transformer_layer_forward)
@@ -459,6 +468,7 @@ class TestTransformerLayerGrid:
         (2, 64, 64, 4), (1, 128, 96, 3),
     ])
     @pytest.mark.parametrize("pre_ln", [True, False])
+    @pytest.mark.slow
     def test_backward_grid(self, batch, seq, hidden, heads, pre_ln):
         from deepspeed_tpu.ops.transformer.transformer import (
             transformer_layer_forward)
