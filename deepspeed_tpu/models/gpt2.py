@@ -136,7 +136,7 @@ def _next_token_xent(logits, targets):
 
 
 def _tied_xent_chunked(x, wte, targets, dtype, chunk_tokens: int = 2048,
-                       mean: bool = True):
+                       mean: bool = True, weights=None):
     """Fused tied-LM-head + next-token cross entropy, chunked over tokens.
 
     The naive path materializes fp32 logits (B·S, V) plus a log_softmax
@@ -154,9 +154,11 @@ def _tied_xent_chunked(x, wte, targets, dtype, chunk_tokens: int = 2048,
     c = min(chunk_tokens, n)
     # pad to a multiple of c (weight-masked) rather than shrinking the
     # chunk — a prime n would otherwise degrade to c=1 and a scan of
-    # thousands of single-token GEMMs
+    # thousands of single-token GEMMs. ``weights``: optional per-token
+    # loss weights (e.g. 0 for positions past a ragged sequence end)
     pad = (-n) % c
-    wf = jnp.ones((n,), jnp.float32)
+    wf = (jnp.ones((n,), jnp.float32) if weights is None
+          else weights.reshape(n).astype(jnp.float32))
     if pad:
         xf = jnp.concatenate([xf, jnp.zeros((pad, H), xf.dtype)])
         tf = jnp.concatenate([tf, jnp.zeros((pad,), tf.dtype)])
@@ -708,15 +710,28 @@ def gpt2_pipeline_spec(config: GPT2Config, num_stages: int,
         # per-token xent decomposes, so a SUM over the slice is exact.
         # Targets come via static shift + one-hot block select — a traced
         # `start` dynamic_slice here trips the XLA partitioner under auto
-        # mesh axes (see spmd.seq_chunk_select).
+        # mesh axes (see spmd.seq_chunk_select). Ragged sequences
+        # (seq %% S != 0): the executor pads the exit activation to
+        # S*ceil(seq/S); targets pad with zeros and the pad positions are
+        # weight-masked out of the loss.
         from deepspeed_tpu.runtime.pipe.spmd import seq_chunk_select
         length = act_slice.shape[1]
         shifted = micro["input_ids"][:, 1:]            # (mb, seq) next-token
-        S = shifted.shape[1] // length
+        seq = shifted.shape[1]
+        S = -(-seq // length)
+        weights = None
+        if S * length != seq:
+            shifted = jnp.pad(shifted,
+                              ((0, 0), (0, S * length - seq)))
+            j = jax.lax.iota(jnp.int32, length)
+            weights = jnp.broadcast_to(
+                (start + j < seq)[None, :].astype(jnp.float32),
+                act_slice.shape[:2])
         targets = seq_chunk_select(shifted, start // length, S, axis=1)
         x = _layer_norm(act_slice, post_p["ln_f"], config.layer_norm_eps)
         return _tied_xent_chunked(x, pre_p["wte"], targets,
-                                  _dtype_of(act_slice), mean=False)
+                                  _dtype_of(act_slice), mean=False,
+                                  weights=weights)
 
     block_specs = gpt2_param_specs(config)["h_0"]
     # stacked stage leaves carry (lps, ...) — shift TP specs right one dim

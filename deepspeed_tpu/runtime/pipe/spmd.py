@@ -197,12 +197,23 @@ def seq_chunk_scatter(chunk_val, s_idx, S: int, axis: int = 1):
 
 def _head_mode(spec: "PipelineSpec", S: int, act_shape):
     """(coop, chunk, ntok): cooperative sequence-sharded head is usable
-    when the spec provides post_shard_apply, the activation is (mb, seq,
-    ...) and seq divides into S chunks."""
-    if (spec.post_shard_apply is not None and len(act_shape) >= 2
-            and act_shape[1] % S == 0):
-        return True, act_shape[1] // S, act_shape[0] * act_shape[1]
+    whenever the spec provides post_shard_apply and the activation is
+    (mb, seq, ...). Ragged sequences (seq %% S != 0) are zero-padded to
+    S*chunk at the head boundary (chunk = ceil(seq/S)); the spec's
+    post_shard_apply weight-masks the pad positions (models/gpt2.py).
+    ``ntok`` counts only REAL tokens."""
+    if spec.post_shard_apply is not None and len(act_shape) >= 2:
+        return True, -(-act_shape[1] // S), act_shape[0] * act_shape[1]
     return False, 0, 0
+
+
+def _pad_head_seq(x, S: int, chunk: int):
+    """Zero-pad the (mb, seq, ...) head input to seq = S*chunk."""
+    pad = S * chunk - x.shape[1]
+    if pad == 0:
+        return x
+    cfg = ((0, 0), (0, pad)) + ((0, 0),) * (x.ndim - 2)
+    return jnp.pad(x, cfg)
 
 
 def interleave_stage_order(S: int, V: int):
@@ -422,6 +433,7 @@ def build_pipeline_loss_fn(spec: PipelineSpec, mesh: Mesh, num_micro: int,
                 out_last = _psum_act(
                     jnp.where(s_idx == S - 1, out,
                               jnp.zeros(act_shape, act_dtype)), "pipe")
+                out_last = _pad_head_seq(out_last, S, chunk)
                 start = s_idx * chunk
                 sl = seq_chunk_select(out_last, s_idx, S, axis=1)
                 lsum = spec.post_shard_apply(post_p, pre_p, sl, micro_out,
@@ -619,6 +631,7 @@ def build_pipeline_grad_fn(spec: PipelineSpec, mesh: Mesh, num_micro: int,
                 # 1/S sequence chunk — total head work 1x per micro
                 out_last = _psum_act(
                     jnp.where(s_idx == S - 1, out, zeros_act), "pipe")
+                out_last = _pad_head_seq(out_last, S, chunk)
                 start = s_idx * chunk
                 sl = seq_chunk_select(out_last, s_idx, S, axis=1)
                 lsum, vjp_head = jax.vjp(
@@ -626,8 +639,11 @@ def build_pipeline_grad_fn(spec: PipelineSpec, mesh: Mesh, num_micro: int,
                         pp, prp, a, micro_h, start), post_p, pre_p, sl)
                 gpo, gpr, d_sl = vjp_head(ct_sum.astype(lsum.dtype))
                 d_sl = jnp.where(valid_h, d_sl, 0.0).astype(act_dtype)
-                d_out_head = _psum_act(
-                    seq_chunk_scatter(d_sl, s_idx, S, axis=1), "pipe")
+                d_full = seq_chunk_scatter(d_sl, s_idx, S, axis=1)
+                if d_full.shape[1] != act_shape[1]:   # drop ragged pad
+                    d_full = jax.lax.slice_in_dim(
+                        d_full, 0, act_shape[1], axis=1)
+                d_out_head = _psum_act(d_full, "pipe")
                 loss_add = jnp.where(valid_h, lsum.astype(jnp.float32), 0.0)
                 head_valid = valid_h
             elif with_head:
