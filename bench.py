@@ -483,6 +483,29 @@ def main():
               f"{PARTIAL_PATH}", file=sys.stderr, flush=True)
 
     failed = {}
+    if not all(m in done for m in METRICS):
+        # upfront liveness gate: with a dead tunnel every child would
+        # burn METRIC_TIMEOUT before failing (~25 min per metric);
+        # probing twice up front converts that into four explicit error
+        # rows in minutes
+        if not _probe_tunnel() and (time.sleep(60) or not _probe_tunnel()):
+            err = "device unreachable at bench start (2 probes failed)"
+            for metric in METRICS:
+                if metric not in done:
+                    failed[metric] = err
+            for metric in METRICS:
+                if metric == HEADLINE:
+                    continue
+                if metric in done:
+                    _emit_row(done[metric])
+                else:
+                    _emit(metric, 0.0, "error", 0.0, {"error": err})
+            if HEADLINE in done:
+                _emit_row(done[HEADLINE])
+            else:
+                _emit(HEADLINE, 0.0, "error", 0.0, {"error": err})
+            return
+
     for metric in METRICS:
         if metric in done:
             continue
