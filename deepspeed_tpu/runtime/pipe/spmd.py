@@ -216,6 +216,55 @@ def _pad_head_seq(x, S: int, chunk: int):
     return jnp.pad(x, cfg)
 
 
+def _run_phased(tick, carry, S: int, V: int, Mp: int, drain: bool):
+    """Drive a tick body over the phased head schedule — the ONE place
+    encoding the head-active-tick invariant for both executors.
+
+    ``tick(carry, u, with_head) -> carry`` with STATIC ``with_head``.
+    Head-active ticks are u with (u-(S-1))//S %% V == V-1: runs of S
+    ticks starting at u = (q+1)VS - 1 per micro group q < Mp/S. Phases:
+    fill (VS-1 headless), Mp/S superblocks (S head + (V-1)S headless),
+    then for the grad executor (``drain=True``) S-1 headless drain ticks
+    — total Mp·V + VS + S - 2 = pipeline_tick_counts; the forward-only
+    wavefront (``drain=False``) instead ends ON the final head run —
+    total Mp·V + S - 1. Requires Mp %% S == 0 (callers fall back to a
+    uniform head-on-every-tick scan otherwise).
+    """
+    assert Mp % S == 0, (Mp, S)
+    G = V * S
+
+    def scan_range(carry, start, length, with_head):
+        if length <= 0:
+            return carry
+        carry, _ = jax.lax.scan(
+            lambda c, u: (tick(c, u, with_head), None),
+            carry, start + jnp.arange(length))
+        return carry
+
+    def qblock(c, q0):
+        c = scan_range(c, q0, S, True)
+        c = scan_range(c, q0 + S, (V - 1) * S, False)
+        return c, None
+
+    carry = scan_range(carry, jnp.int32(0), G - 1, False)
+    if drain:
+        starts = (G - 1) + G * jnp.arange(Mp // S)
+        carry, _ = jax.lax.scan(qblock, carry, starts)
+        return scan_range(carry, jnp.int32(Mp * V + G - 1), S - 1, False)
+    if Mp // S > 1:
+        starts = (G - 1) + G * jnp.arange(Mp // S - 1)
+        carry, _ = jax.lax.scan(qblock, carry, starts)
+    return scan_range(carry, jnp.int32(Mp * V - 1), S, True)
+
+
+def _run_uniform(tick, carry, num_ticks: int):
+    """Fallback: every tick carries the (masked) head."""
+    carry, _ = jax.lax.scan(
+        lambda c, u: (tick(c, u, True), None),
+        carry, jnp.arange(num_ticks))
+    return carry
+
+
 def interleave_stage_order(S: int, V: int):
     """Permutation: interleaved slot ``j = s*V + c`` holds global stage
     ``c*S + s`` (device s's contiguous block = its V cyclic chunks)."""
@@ -452,32 +501,12 @@ def build_pipeline_loss_fn(spec: PipelineSpec, mesh: Mesh, num_micro: int,
                 out, "pipe", [(i, (i + 1) % S) for i in range(S)])
             return (act, loss_acc + loss_m)
 
-        def scan_range(carry, start, length, with_head):
-            if length <= 0:
-                return carry
-            carry, _ = jax.lax.scan(
-                lambda c, t: (tick(c, t, with_head), None),
-                carry, start + jnp.arange(length))
-            return carry
-
         carry = (jnp.zeros(act_shape, act_dtype),
                  jnp.zeros((), jnp.float32))
         if Mp % S == 0:
-            # head-active ticks are runs of S every VS starting at VS-1
-            # (grad-fn phasing comment); the wavefront has no drain, so
-            # the final superblock is the bare head run
-            carry = scan_range(carry, jnp.int32(0), G - 1, False)
-
-            def qblock(c, q0):
-                c = scan_range(c, q0, S, True)
-                c = scan_range(c, q0 + S, (V - 1) * S, False)
-                return c, None
-            if Mp // S > 1:
-                starts = (G - 1) + G * jnp.arange(Mp // S - 1)
-                carry, _ = jax.lax.scan(qblock, carry, starts)
-            carry = scan_range(carry, jnp.int32(Mp * V - 1), S, True)
+            carry = _run_phased(tick, carry, S, V, Mp, drain=False)
         else:
-            carry = scan_range(carry, jnp.int32(0), Mp * V + S - 1, True)
+            carry = _run_uniform(tick, carry, Mp * V + S - 1)
         (_, loss_sum) = carry
 
         # _aggregate_total_loss (reference pipe/engine.py:374): psum shares
@@ -703,39 +732,16 @@ def build_pipeline_grad_fn(spec: PipelineSpec, mesh: Mesh, num_micro: int,
             return (new_fwd, new_bwd, buf, loss_acc + loss_add,
                     g_pre, g_st, g_post)
 
-        def scan_range(carry, start, length, with_head):
-            """Scan ``length`` consecutive ticks from (traced) ``start``."""
-            if length <= 0:
-                return carry
-            carry, _ = jax.lax.scan(
-                lambda c, u: (tick(c, u, with_head), None),
-                carry, start + jnp.arange(length))
-            return carry
-
         buf0 = jnp.zeros((B,) + act_shape, act_dtype)
         g_st0 = f32_zeros(_select_chunk(st_p, 0, V) if V == 1 else st_p)
         carry0 = (zeros_act, zeros_act, buf0, jnp.zeros((), jnp.float32),
                   f32_zeros(pre_p), g_st0, f32_zeros(post_p))
         if Mp % S == 0:
-            # Phased schedule. Head-active ticks are u with
-            # (u-(S-1))//S %% V == V-1: runs of S ticks starting at
-            # u = (q+1)VS - 1 for each micro group q < M/S. Phases:
-            # fill (VS-1 headless) -> M/S superblocks (S head +
-            # (V-1)S headless) -> drain (S-1 headless); total
-            # (VS-1) + (M/S)VS + (S-1) = num_ticks exactly.
-            carry = scan_range(carry0, jnp.int32(0), G - 1, False)
-
-            def qblock(c, q0):
-                c = scan_range(c, q0, S, True)
-                c = scan_range(c, q0 + S, (V - 1) * S, False)
-                return c, None
-            starts = (G - 1) + G * jnp.arange(Mp // S)
-            carry, _ = jax.lax.scan(qblock, carry, starts)
-            carry = scan_range(carry, jnp.int32(Mp * V + G - 1), S - 1,
-                               False)
+            carry = _run_phased(tick, carry0, S, V, Mp, drain=True)
         else:
-            # uneven micro count: fall back to head-on-every-tick
-            carry = scan_range(carry0, jnp.int32(0), num_ticks, True)
+            # uneven micro count (only reachable at V=1 where Mp == M):
+            # fall back to head-on-every-tick
+            carry = _run_uniform(tick, carry0, num_ticks)
         (_, _, _, loss_sum, g_pre, g_st, g_post) = carry
 
         # ReduceTiedGrads + loss aggregation: pipe-psum combines the head
