@@ -391,14 +391,15 @@ def test_pipeline_memory_flat_in_accumulation_depth():
     assert temps[16] <= temps[2] * 1.25, temps
 
 
-@pytest.mark.parametrize("gas", [4, 6])
-def test_interleaved_pipeline_matches_nonpipelined_training(gas):
+@pytest.mark.parametrize("gas,virtual", [(4, 2), (6, 2), (4, 4)])
+def test_interleaved_pipeline_matches_nonpipelined_training(gas, virtual):
     """virtual_stages=2: 8 layers as 8 global stages cyclically assigned
     to 4 devices — the interleaved executor must compute the SAME
     grads/updates as sequential execution (Megatron interleaved-1F1B
     semantics on the SPMD scan). gas=6 exercises the padded-group decode
     (M %% S != 0): the tail micros' chunk-1 items must still run."""
     steps, lr = 3, 1e-3
+    pipe_axis = 8 // virtual
     module = ds.PipelineModule(
         [ds.LayerSpec(Linear, H) for _ in range(8)],
         num_stages=8, loss_fn=_mse, partition_method="uniform")
@@ -410,10 +411,11 @@ def test_interleaved_pipeline_matches_nonpipelined_training(gas):
     eng, *_ = ds.initialize(
         model=module, model_parameters=params,
         config=_pipe_config(gradient_accumulation_steps=gas,
-                            pipeline={"virtual_stages": 2},
+                            mesh={"axes": {"pipe": pipe_axis, "data": 2}},
+                            pipeline={"virtual_stages": virtual},
                             optimizer={"type": "Adam",
                                        "params": {"lr": lr}}))
-    assert eng.num_virtual == 2
+    assert eng.num_virtual == virtual
     it = iter(micros)
     pipe = [float(eng.train_batch(it)) for _ in range(steps)]
 
@@ -569,3 +571,20 @@ def test_pipeline_fp16_loss_scaling():
     assert all(np.isfinite(l) for l in losses)
     assert eng.loss_scale() > 0
     assert losses[-1] < losses[0]
+
+
+def test_interleaved_eval_batch():
+    """eval_batch (forward-only wavefront) under virtual_stages=2 must
+    equal the sequential forward mean."""
+    module = ds.PipelineModule(
+        [ds.LayerSpec(Linear, H) for _ in range(8)],
+        num_stages=8, loss_fn=_mse, partition_method="uniform")
+    params = module.init_params(jax.random.PRNGKey(0))
+    micros = _micro_batches(4, global_mb=4)
+    eng, *_ = ds.initialize(model=module, model_parameters=params,
+                            config=_pipe_config(
+                                pipeline={"virtual_stages": 2}))
+    ev = float(eng.eval_batch(iter(micros)))
+    ref = np.mean([float(_mse(module.forward(params, m["x"]), m))
+                   for m in micros])
+    np.testing.assert_allclose(ev, ref, rtol=2e-4)

@@ -252,3 +252,75 @@ def test_zigzag_halves_causal_flops():
     # plain causal ring computes-and-discards future chunks; zigzag does
     # the minimal balanced work -> ~0.5x + per-call overhead
     assert f_zz < 0.65 * f_plain, (f_zz, f_plain, f_zz / f_plain)
+
+
+def test_zigzag_key_padding_mask_matches_reference():
+    """zigzag + rotating key-padding mask: the mask halves ride the
+    zigzag layout with their K/V chunks."""
+    from deepspeed_tpu.ops.attention.ring import zigzag_layout_indices
+    axes = {"seq": 4, "data": 2}
+    mesh = build_mesh(axes)
+    S = 32 * axes["seq"]
+    q, k, v = _qkv(S, seed=6)
+    mrng = np.random.RandomState(8)
+    kpm = jnp.asarray(
+        np.where(mrng.rand(B, 1, 1, S) > 0.25, 0.0, -1e9), jnp.float32)
+
+    g = zigzag_layout_indices(axes["seq"], S)
+    inv = np.argsort(g)
+
+    def inner(q, k, v, m):
+        return ring_attention(q, k, v, axis_name="seq", causal=True,
+                              key_padding_mask=m, zigzag=True)
+    spec = P(None, None, "seq", None)
+    mspec = P(None, None, None, "seq")
+    mapped = jax.shard_map(inner, mesh=mesh,
+                           in_specs=(spec, spec, spec, mspec),
+                           out_specs=spec, check_vma=False)
+    out = jax.jit(mapped)(q[:, :, g, :], k[:, :, g, :], v[:, :, g, :],
+                          kpm[:, :, :, g])[:, :, inv, :]
+    ref = attention_reference(q, k, v, mask=kpm, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_zigzag_dropout_deterministic_and_consistent():
+    """zigzag + in-kernel dropout: seed-deterministic, seeds distinct per
+    chunk pair (different rngs give different outputs), and the custom
+    VJP runs (fwd/bwd regenerate the same per-pair masks)."""
+    axes = {"seq": 4, "data": 2}
+    mesh = build_mesh(axes)
+    S = 32 * axes["seq"]
+    q, k, v = _qkv(S, seed=7)
+    r1, r2 = jax.random.PRNGKey(3), jax.random.PRNGKey(4)
+
+    def run(rng):
+        def inner(q, k, v):
+            return ring_attention(q, k, v, axis_name="seq", causal=True,
+                                  dropout_rate=0.2, dropout_rng=rng,
+                                  zigzag=True)
+        spec = P(None, None, "seq", None)
+        return jax.jit(jax.shard_map(
+            inner, mesh=mesh, in_specs=(spec, spec, spec),
+            out_specs=spec, check_vma=False))
+
+    o1a = run(r1)(q, k, v)
+    o1b = run(r1)(q, k, v)
+    o2 = run(r2)(q, k, v)
+    np.testing.assert_array_equal(np.asarray(o1a), np.asarray(o1b))
+    assert float(jnp.abs(o1a - o2).max()) > 1e-4
+
+    def loss(q, k, v):
+        def inner(q, k, v):
+            return ring_attention(q, k, v, axis_name="seq", causal=True,
+                                  dropout_rate=0.2, dropout_rng=r1,
+                                  zigzag=True)
+        spec = P(None, None, "seq", None)
+        out = jax.shard_map(inner, mesh=mesh,
+                            in_specs=(spec, spec, spec),
+                            out_specs=spec, check_vma=False)(q, k, v)
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    gs = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+    for a in gs:
+        assert np.all(np.isfinite(np.asarray(a)))
