@@ -13,7 +13,8 @@ from deepspeed_tpu.runtime.pipe import (
     LayerSpec, PipelineModule, PipelineSpec, TiedLayerSpec)
 from deepspeed_tpu.runtime.pipe.engine import PipelineEngine
 from deepspeed_tpu.runtime.lr_schedules import (
-    WarmupLR, OneCycle, LRRangeTest)
+    WarmupLR, OneCycle, LRRangeTest, add_tuning_arguments)
+from deepspeed_tpu.utils.logging import log_dist
 from deepspeed_tpu.runtime.dataloader import (
     DeepSpeedDataLoader, RepeatingLoader)
 from deepspeed_tpu.parallel.topology import (
