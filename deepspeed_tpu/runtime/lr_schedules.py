@@ -76,6 +76,80 @@ def add_tuning_arguments(parser):
     return parser
 
 
+def parse_arguments():
+    import argparse
+    parser = argparse.ArgumentParser()
+    parser = add_tuning_arguments(parser)
+    return parser.parse_known_args()
+
+
+_OVERRIDE_KEYS = {
+    LR_RANGE_TEST: ("lr_range_test_min_lr", "lr_range_test_step_rate",
+                    "lr_range_test_step_size", "lr_range_test_staircase"),
+    ONE_CYCLE: ("cycle_first_step_size", "cycle_first_stair_count",
+                "cycle_second_step_size", "cycle_second_stair_count",
+                "decay_step_size", "cycle_min_lr", "cycle_max_lr",
+                "decay_lr_rate", "cycle_momentum", "cycle_min_mom",
+                "cycle_max_mom", "decay_mom_rate"),
+    WARMUP_LR: ("warmup_min_lr", "warmup_max_lr", "warmup_num_steps"),
+}
+
+
+def _override(args, params, schedule):
+    for k in _OVERRIDE_KEYS[schedule]:
+        v = getattr(args, k, None)
+        if v is not None:
+            params[k] = v
+    return params
+
+
+def override_lr_range_test_params(args, params):
+    return _override(args, params, LR_RANGE_TEST)
+
+
+def override_1cycle_params(args, params):
+    return _override(args, params, ONE_CYCLE)
+
+
+def override_warmupLR_params(args, params):
+    return _override(args, params, WARMUP_LR)
+
+
+def override_params(args, params):
+    override_lr_range_test_params(args, params)
+    override_1cycle_params(args, params)
+    return override_warmupLR_params(args, params)
+
+
+def get_config_from_args(args):
+    """(config, error): scheduler config dict from tuning CLI args
+    (reference lr_schedules.py:238)."""
+    if not hasattr(args, LR_SCHEDULE) or args.lr_schedule is None:
+        return None, f"--{LR_SCHEDULE} not specified on command line"
+    if args.lr_schedule not in VALID_LR_SCHEDULES:
+        return None, f"{args.lr_schedule} is not supported LR schedule"
+    config = {"type": args.lr_schedule, "params": {}}
+    _override(args, config["params"], args.lr_schedule)
+    return config, None
+
+
+def get_lr_from_config(config):
+    """(lr, error): the schedule's nominal peak/start LR
+    (reference lr_schedules.py:259)."""
+    if "type" not in config:
+        return None, "LR schedule type not defined in config"
+    if "params" not in config:
+        return None, "LR schedule params not defined in config"
+    lr_schedule, lr_params = config["type"], config["params"]
+    if lr_schedule not in VALID_LR_SCHEDULES:
+        return None, f"{lr_schedule} is not a valid LR schedule"
+    if lr_schedule == LR_RANGE_TEST:
+        return lr_params["lr_range_test_min_lr"], ""
+    if lr_schedule == ONE_CYCLE:
+        return lr_params["cycle_max_lr"], ""
+    return lr_params["warmup_max_lr"], ""
+
+
 class _Schedule:
     """Host-facing facade; ``lr_at(step)`` is the jittable core."""
 

@@ -144,3 +144,30 @@ def test_build_from_config():
     assert build_lr_schedule(None, None) is None
     with pytest.raises(ValueError):
         build_lr_schedule("CosineNope", {})
+
+
+def test_tuning_args_to_config_roundtrip():
+    """CLI tuning args -> scheduler config (reference lr_schedules.py
+    add_tuning_arguments/get_config_from_args/get_lr_from_config)."""
+    import argparse
+    from deepspeed_tpu.runtime.lr_schedules import (
+        add_tuning_arguments, get_config_from_args, get_lr_from_config)
+    p = argparse.ArgumentParser()
+    add_tuning_arguments(p)
+    args, _ = p.parse_known_args(
+        ["--lr_schedule", "OneCycle", "--cycle_min_lr", "0.02",
+         "--cycle_max_lr", "0.2", "--cycle_momentum"])
+    cfg, err = get_config_from_args(args)
+    assert err is None
+    assert cfg["type"] == "OneCycle"
+    assert cfg["params"]["cycle_min_lr"] == 0.02
+    assert cfg["params"]["cycle_momentum"] is True
+    lr, err = get_lr_from_config(cfg)
+    assert err == "" and lr == 0.2
+    # the generated config constructs a working schedule
+    s = build_lr_schedule(cfg["type"], cfg["params"])
+    assert isinstance(s, OneCycle) and s.cycle_momentum
+
+    args2, _ = p.parse_known_args([])
+    cfg2, err2 = get_config_from_args(args2)
+    assert cfg2 is None and "not specified" in err2
