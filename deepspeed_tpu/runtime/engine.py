@@ -538,6 +538,175 @@ class DeepSpeedEngine:
         return ((self._host_micro_step + 1) %
                 self.gradient_accumulation_steps == 0)
 
+    # -- remaining config-accessor facade (reference engine.py:255-370;
+    #    fp16_enabled/gradient_accumulation_steps/gradient_clipping/
+    #    zero_cpu_offload exist as engine ATTRIBUTES here — a documented
+    #    deviation, the values are identical) --
+    def optimizer_name(self):
+        return self._config.optimizer_name
+
+    def optimizer_params(self):
+        return self._config.optimizer_params
+
+    def optimizer_legacy_fusion(self):
+        return self._config.optimizer_legacy_fusion
+
+    def scheduler_name(self):
+        return self._config.scheduler_name
+
+    def scheduler_params(self):
+        return self._config.scheduler_params
+
+    def dynamic_loss_scale(self):
+        return self.fp16_enabled and self._config.loss_scale == 0
+
+    def initial_dynamic_scale(self):
+        return self._config.initial_dynamic_scale
+
+    def dynamic_loss_scale_args(self):
+        return self._config.dynamic_loss_scale_args
+
+    def amp_enabled(self):
+        return False                     # no apex/amp on TPU
+
+    def amp_params(self):
+        return None
+
+    def postscale_gradients(self):
+        return not self._config.prescale_gradients
+
+    def gradient_predivide_factor(self):
+        return self._config.gradient_predivide_factor
+
+    def wall_clock_breakdown(self):
+        return self._config.wall_clock_breakdown
+
+    def memory_breakdown(self):
+        return self._config.memory_breakdown
+
+    def tensorboard_enabled(self):
+        return self._config.tensorboard_enabled
+
+    def tensorboard_output_path(self):
+        return self._config.tensorboard_output_path
+
+    def tensorboard_job_name(self):
+        return self._config.tensorboard_job_name
+
+    def get_summary_writer(self):
+        mon = getattr(self, "monitor", None)
+        return getattr(mon, "writer", None)
+
+    def zero_optimization_stage(self):
+        return self._config.zero_optimization_stage
+
+    def zero_reduce_scatter(self):
+        return self._config.zero_config.reduce_scatter
+
+    def zero_overlap_comm(self):
+        return self._config.zero_config.overlap_comm
+
+    def zero_reduce_bucket_size(self):
+        return self._config.zero_config.reduce_bucket_size
+
+    def zero_allgather_partitions(self):
+        return self._config.zero_config.allgather_partitions
+
+    def zero_allgather_bucket_size(self):
+        return self._config.zero_config.allgather_bucket_size
+
+    def zero_contiguous_gradients(self):
+        return self._config.zero_config.contiguous_gradients
+
+    def zero_load_from_fp32_weights(self):
+        return self._config.zero_config.load_from_fp32_weights
+
+    def zero_optimization_partition_gradients(self):
+        return self.zero_optimization_stage() >= 2
+
+    def get_mom(self):
+        """Current scheduled momentum, mirroring :meth:`get_lr`
+        (reference engine.py get_mom)."""
+        mom = self._mom_at(self.state.global_step)
+        if mom is not None:
+            return [float(mom)]
+        betas = (self._config.optimizer_params or {}).get("betas")
+        if betas:
+            return [float(betas[0])]
+        return [float((self._config.optimizer_params or {})
+                      .get("momentum", 0.0))]
+
+    def train(self, mode: bool = True):
+        """Training-mode flag for API parity (reference calls
+        module.train()); determinism here is owned by the loss fn's
+        ``deterministic`` knob, so this only records intent."""
+        self._train_mode = bool(mode)
+        return self
+
+    def eval(self):
+        return self.train(False)
+
+    def zero_grad(self):
+        """Clear the gradient-accumulation buffer (the analog of zeroing
+        module grads; reference engine.py zero_grad)."""
+        zeros = jax.tree_util.tree_map(jnp.zeros_like,
+                                       self.state.accum_grads)
+        self.state = self.state._replace(
+            accum_grads=zeros, micro_step=jnp.zeros((), jnp.int32))
+
+    def allreduce_gradients(self, bucket_size=MEMORY_OPT_ALLREDUCE_SIZE):
+        """No-op by design: gradient reduction happens INSIDE the
+        compiled step (GSPMD psum/reduce-scatter over 'data'), not as a
+        separate host-driven pass (reference engine.py:751). Kept so
+        reference-style training scripts port unchanged."""
+        del bucket_size
+
+    def module_state_dict(self):
+        """Host copy of the model params (reference engine.py:1370).
+
+        Must be a REAL copy: np.asarray of a CPU-backed jax array is
+        zero-copy, and the compiled step donates the old param buffer —
+        a view would silently morph into the post-update values."""
+        from deepspeed_tpu.runtime.checkpoint import _to_host_global
+        return jax.tree_util.tree_map(
+            lambda x: np.array(_to_host_global(x), copy=True),
+            self.state.params)
+
+    def load_module_state_dict(self, state_dict, strict: bool = True):
+        """Replace model params from a host pytree (reference
+        engine.py:1342); shapes must match the current params."""
+        cur = self.state.params
+        if strict:
+            cur_leaves = jax.tree_util.tree_leaves(cur)
+            new_leaves = jax.tree_util.tree_leaves(state_dict)
+            assert len(cur_leaves) == len(new_leaves), \
+                (len(cur_leaves), len(new_leaves))
+            for a, b in zip(cur_leaves, new_leaves):
+                assert a.shape == np.shape(b), (a.shape, np.shape(b))
+        new = jax.tree_util.tree_map(
+            lambda tmpl, v: jnp.asarray(v, tmpl.dtype), cur, state_dict)
+        self.state = self.state._replace(params=jax.device_put(
+            new, self._state_shardings.params))
+
+    def dump_state(self):
+        """Readable engine-state summary (reference engine.py dump_state
+        prints its internals; ours is the compiled-step equivalent)."""
+        lines = [
+            f"world: dp={self.dp_world_size} mp={self.mp_world_size} "
+            f"mesh={dict(self.mesh.shape)}",
+            f"precision: fp16={self.fp16_enabled} "
+            f"bf16={self.bf16_enabled} loss_scale={self.loss_scale()}",
+            f"zero: stage={self.zero_optimization_stage()} "
+            f"cpu_offload={self.zero_cpu_offload}",
+            f"batch: micro={self.train_micro_batch_size_per_gpu()} "
+            f"gas={self.gradient_accumulation_steps} "
+            f"global={self.train_batch_size()}",
+            f"progress: step={self.global_steps} "
+            f"skipped={self.skipped_steps} lr={self.get_lr()[0]:.3e}",
+        ]
+        logger.info("engine state:\n  " + "\n  ".join(lines))
+        return lines
+
     # ------------------------------------------------------------------ #
     # data
     # ------------------------------------------------------------------ #

@@ -161,3 +161,50 @@ class TestGradClip:
         engine = make_engine(base_config(gradient_clipping=0.1))
         losses = train(engine, n_steps=10)
         assert np.isfinite(losses).all()
+
+
+def test_config_accessor_facade():
+    """Reference engine accessor-method surface (engine.py:255-370) —
+    scripts calling these must port unchanged."""
+    import deepspeed_tpu as ds
+    from tests.unit.simple_model import init_simple_params, simple_loss_fn
+    params = init_simple_params(jax.random.PRNGKey(0), hidden_dim=8)
+    eng, *_ = ds.initialize(
+        model=simple_loss_fn, model_parameters=params,
+        config={"train_micro_batch_size_per_gpu": 4,
+                "optimizer": {"type": "Adam",
+                              "params": {"lr": 1e-3, "betas": [0.9, 0.99]}},
+                "scheduler": {"type": "WarmupLR",
+                              "params": {"warmup_min_lr": 1e-4,
+                                         "warmup_max_lr": 1e-3}},
+                "zero_optimization": {"stage": 2},
+                "steps_per_print": 10**9})
+    assert eng.optimizer_name() == "adam"
+    assert eng.optimizer_params()["lr"] == 1e-3
+    assert eng.scheduler_name() == "WarmupLR"
+    assert eng.zero_optimization_stage() == 2
+    assert eng.zero_optimization_partition_gradients()
+    assert not eng.amp_enabled() and eng.amp_params() is None
+    assert not eng.dynamic_loss_scale()        # fp16 off
+    assert eng.get_mom() == [0.9]
+    assert isinstance(eng.wall_clock_breakdown(), bool)
+    assert eng.train() is eng and eng.eval() is eng
+
+    # module_state_dict round-trip through load_module_state_dict
+    sd = eng.module_state_dict()
+    rng = np.random.RandomState(0)
+    eng.train_batch(iter([{"x": rng.randn(8, 8).astype(np.float32),
+                           "y": rng.randn(8, 1).astype(np.float32)}]))
+    changed = eng.module_state_dict()
+    assert any(not np.allclose(a, b)
+               for a, b in zip(jax.tree_util.tree_leaves(sd),
+                               jax.tree_util.tree_leaves(changed)))
+    eng.load_module_state_dict(sd)
+    restored = eng.module_state_dict()
+    for a, b in zip(jax.tree_util.tree_leaves(sd),
+                    jax.tree_util.tree_leaves(restored)):
+        np.testing.assert_array_equal(a, b)
+
+    eng.zero_grad()                       # accum buffer cleared, no error
+    eng.allreduce_gradients()             # documented no-op
+    assert isinstance(eng.dump_state(), list)
