@@ -1,0 +1,5 @@
+"""``deepspeed_tpu.ops.lamb`` (reference deepspeed/ops/lamb/): the LAMB
+implementation lives in ops/optimizers.py as an XLA-fused update; this
+package keeps the reference import paths working."""
+
+from deepspeed_tpu.ops.lamb.fused_lamb import FusedLamb  # noqa

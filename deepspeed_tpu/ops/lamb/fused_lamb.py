@@ -1,0 +1,6 @@
+"""Reference import-path alias (deepspeed/ops/lamb/fused_lamb.py:12):
+``from deepspeed_tpu.ops.lamb.fused_lamb import FusedLamb``. The
+implementation is the XLA-fused Lamb in ops/optimizers.py (single
+jitted update; norms and trust ratios fuse — no CUDA kernel needed)."""
+
+from deepspeed_tpu.ops.optimizers import FusedLamb, Lamb  # noqa

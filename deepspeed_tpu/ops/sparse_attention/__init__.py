@@ -12,3 +12,5 @@ from deepspeed_tpu.ops.sparse_attention.blocksparse import (  # noqa
 from deepspeed_tpu.ops.sparse_attention.sparse_self_attention import (  # noqa
     SparseSelfAttention, BertSparseSelfAttention,
     init_bert_sparse_self_attention_params, SparseAttentionUtils)
+from deepspeed_tpu.ops.sparse_attention.ops import (  # noqa
+    MatMul, Softmax)

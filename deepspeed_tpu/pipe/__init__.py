@@ -1,0 +1,6 @@
+"""Top-level ``deepspeed_tpu.pipe`` alias (reference deepspeed/pipe/
+__init__.py): tutorials write ``from deepspeed.pipe import
+PipelineModule`` — the same import path works here."""
+
+from deepspeed_tpu.runtime.pipe import (  # noqa
+    LayerSpec, PipelineModule, PipelineSpec, TiedLayerSpec)

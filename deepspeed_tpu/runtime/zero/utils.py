@@ -1,0 +1,30 @@
+"""ZeRO utilities (reference deepspeed/runtime/zero/utils.py).
+
+The reference gates ZeRO on a torch-optimizer allowlist and builds
+parameter-parallel NCCL groups; here the allowlist maps to our
+optimizer classes and "parameter parallelism" IS the mesh's 'data'
+axis sharding (runtime/zero/sharding.py) — there are no groups to
+build, so the group helper returns the axis name it would shard over.
+"""
+
+from deepspeed_tpu.ops.adam.cpu_adam import DeepSpeedCPUAdam
+from deepspeed_tpu.ops.optimizers import Adam, FusedAdam, Lamb, SGD
+from deepspeed_tpu.utils.logging import logger
+
+ZERO_SUPPORTED_OPTIMIZERS = [Adam, FusedAdam, Lamb, SGD, DeepSpeedCPUAdam]
+
+
+def is_zero_supported_optimizer(optimizer) -> bool:
+    """(reference zero/utils.py is_zero_supported_optimizer)"""
+    logger.info(
+        f"Checking ZeRO support for optimizer="
+        f"{optimizer.__class__.__name__} type={type(optimizer)}")
+    return type(optimizer) in ZERO_SUPPORTED_OPTIMIZERS
+
+
+def _initialize_parameter_parallel_groups(parameter_parallel_size=None):
+    """Reference analog (zero/utils.py:8): with GSPMD there is no group
+    object to construct — optimizer state shards over the 'data' mesh
+    axis. Kept for API compatibility; returns the axis name."""
+    del parameter_parallel_size
+    return "data"

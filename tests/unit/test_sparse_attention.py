@@ -468,3 +468,103 @@ def test_surgery_respects_key_padding():
     np.testing.assert_allclose(np.asarray(sparse[:, :40], np.float32),
                                np.asarray(dense[:, :40], np.float32),
                                rtol=2e-2, atol=2e-2)
+
+
+
+# --------------------------------------------------------------------- #
+# composable MatMul / Softmax ops (reference matmul.py:595, softmax.py:207)
+# --------------------------------------------------------------------- #
+class TestComposableSparseOps:
+
+    def _setup(self, B=2, H=2, S=64, D=16, blk=16, seed=0):
+        from deepspeed_tpu.ops.sparse_attention import MatMul, Softmax
+        cfg = BSLongformerSparsityConfig(num_heads=H, block=blk,
+                                         num_sliding_window_blocks=3)
+        layout = cfg.make_layout(S)
+        q, k, v = _rand_qkv(B, H, S, D, seed=seed)
+        return MatMul, Softmax, layout, q, k, v, blk
+
+    def test_sdd_softmax_dsd_pipeline_matches_reference(self):
+        """The reference's own composition (sparse_self_attention.py:125:
+        sdd_nt -> sparse softmax -> dsd_nn) must reproduce the fused
+        oracle."""
+        MatMul, Softmax, layout, q, k, v, blk = self._setup()
+        D = q.shape[-1]
+        sdd = MatMul(layout, blk, "sdd", trans_a=False, trans_b=True)
+        dsd = MatMul(layout, blk, "dsd")
+        sm = Softmax(layout, blk)
+        scores = sdd(q, k)                       # (B, nnz, blk, blk)
+        assert scores.shape[1] == int(layout.sum())
+        probs = sm(scores, scale=float(D) ** -0.5)
+        out = dsd(probs, v)
+        ref = block_sparse_attention_reference(q, k, v, layout)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_softmax_masks_match_reference(self):
+        MatMul, Softmax, layout, q, k, v, blk = self._setup(seed=3)
+        B, H, S, D = q.shape
+        sdd = MatMul(layout, blk, "sdd", trans_b=True)
+        dsd = MatMul(layout, blk, "dsd")
+        sm = Softmax(layout, blk)
+        mrng = np.random.RandomState(5)
+        kpm = (mrng.rand(B, S) > 0.25).astype(np.float32)   # mul-mode
+        am = (mrng.rand(S, S) > 0.2).astype(np.float32)
+        probs = sm(sdd(q, k), scale=float(D) ** -0.5,
+                   key_padding_mask=jnp.asarray(kpm),
+                   key_padding_mask_mode="mul",
+                   attn_mask=jnp.asarray(am), attn_mask_mode="mul")
+        out = dsd(probs, v)
+        ref = block_sparse_attention_reference(
+            q, k, v, layout, key_padding_mask=jnp.asarray(kpm),
+            key_padding_mask_mode="mul", attn_mask=jnp.asarray(am),
+            attn_mask_mode="mul")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_dds_matches_dense_masked(self):
+        """dense x sparse: out == a @ (dense-masked b)."""
+        from deepspeed_tpu.ops.sparse_attention import MatMul
+        B, H, S, blk = 1, 2, 64, 16
+        cfg = FixedSparsityConfig(num_heads=H, block=blk,
+                                  num_local_blocks=2)
+        layout = cfg.make_layout(S)
+        rng = np.random.RandomState(7)
+        a = jnp.asarray(rng.randn(B, H, 24, S), jnp.float32)
+        dense_b = jnp.asarray(rng.randn(B, H, S, S), jnp.float32)
+        # compress dense_b to the layout's nonzero blocks
+        sdd_id = MatMul(layout, blk, "sdd")   # identity trick not needed:
+        hs, rs, cs = np.nonzero(layout)
+        bb = np.asarray(dense_b).reshape(B, H, S // blk, blk,
+                                         S // blk, blk)
+        b_sparse = jnp.asarray(
+            bb.transpose(0, 1, 2, 4, 3, 5)[:, hs, rs, cs])
+        out = MatMul(layout, blk, "dds")(a, b_sparse)
+        mask = np.kron(np.asarray(layout, np.float32),
+                       np.ones((blk, blk), np.float32))  # (H, S, S)
+        ref = jnp.einsum("bhqk,bhkn->bhqn", a,
+                         dense_b * jnp.asarray(mask)[None])
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-4, rtol=2e-4)
+
+    def test_sparse_ops_differentiable(self):
+        MatMul, Softmax, layout, q, k, v, blk = self._setup(seed=9)
+        D = q.shape[-1]
+        sdd = MatMul(layout, blk, "sdd", trans_b=True)
+        dsd = MatMul(layout, blk, "dsd")
+        sm = Softmax(layout, blk)
+
+        def loss(q, k, v):
+            return jnp.sum(dsd(sm(sdd(q, k), scale=float(D) ** -0.5), v)
+                           ** 2)
+
+        def ref_loss(q, k, v):
+            return jnp.sum(block_sparse_attention_reference(
+                q, k, v, layout) ** 2)
+
+        g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+        for a_, b_, name in zip(g, gr, "qkv"):
+            np.testing.assert_allclose(np.asarray(a_), np.asarray(b_),
+                                       atol=5e-4, rtol=1e-3,
+                                       err_msg=f"d{name}")
