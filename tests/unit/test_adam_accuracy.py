@@ -1,0 +1,70 @@
+"""Adam numerics vs torch.optim.Adam (reference
+tests/unit/test_adam_acuracy.py: DeepSpeedCPUAdam must track torch's
+Adam trajectory bit-for-bit-ish) — both the native/numpy host Adam and
+the in-jit XLA Adam are held to the same oracle."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.adam.cpu_adam import DeepSpeedCPUAdam
+from deepspeed_tpu.ops.optimizers import Adam
+
+
+def _torch_trajectory(w0, grads, lr, betas, eps, weight_decay, adamw,
+                      steps):
+    p = torch.nn.Parameter(torch.tensor(w0, dtype=torch.float64))
+    cls = torch.optim.AdamW if adamw else torch.optim.Adam
+    opt = cls([p], lr=lr, betas=betas, eps=eps, weight_decay=weight_decay)
+    outs = []
+    for g in grads:
+        opt.zero_grad()
+        p.grad = torch.tensor(g, dtype=torch.float64)
+        opt.step()
+        outs.append(p.detach().numpy().copy())
+    return outs
+
+
+@pytest.mark.parametrize("adamw,weight_decay", [(False, 0.0),
+                                                (True, 0.01)])
+def test_cpu_adam_matches_torch(adamw, weight_decay):
+    rng = np.random.RandomState(0)
+    n, steps = 257, 8            # odd size: exercises the SIMD tail
+    w0 = rng.randn(n).astype(np.float32)
+    grads = [rng.randn(n).astype(np.float32) for _ in range(steps)]
+    lr, betas, eps = 1e-2, (0.9, 0.999), 1e-8
+
+    opt = DeepSpeedCPUAdam({"w": w0.copy()}, lr=lr, betas=betas, eps=eps,
+                           weight_decay=weight_decay, adamw_mode=adamw)
+    ref = _torch_trajectory(w0, grads, lr, betas, eps, weight_decay,
+                            adamw, steps)
+    for g, r in zip(grads, ref):
+        out = opt.step({"w": g})
+        np.testing.assert_allclose(np.asarray(out["w"]).ravel(), r,
+                                   rtol=2e-5, atol=2e-6)
+
+
+@pytest.mark.parametrize("adamw,weight_decay", [(False, 0.0),
+                                                (True, 0.01)])
+def test_xla_adam_matches_torch(adamw, weight_decay):
+    rng = np.random.RandomState(1)
+    n, steps = 64, 8
+    w0 = rng.randn(n).astype(np.float32)
+    grads = [rng.randn(n).astype(np.float32) for _ in range(steps)]
+    lr, betas, eps = 1e-2, (0.9, 0.999), 1e-8
+
+    opt = Adam(lr=lr, betas=betas, eps=eps, weight_decay=weight_decay,
+               adamw_mode=adamw)
+    params = {"w": jnp.asarray(w0)}
+    state = opt.init(params)
+    ref = _torch_trajectory(w0, grads, lr, betas, eps, weight_decay,
+                            adamw, steps)
+    upd = jax.jit(opt.update)
+    for g, r in zip(grads, ref):
+        params, state = upd({"w": jnp.asarray(g)}, state, params)
+        np.testing.assert_allclose(np.asarray(params["w"]), r,
+                                   rtol=2e-5, atol=2e-6)
