@@ -223,14 +223,27 @@ def transformer_layer_forward(params: Dict[str, Any],
             params["output_b"].astype(dtype)
         return _dropout(out, config.hidden_dropout_ratio, r_h2, deterministic)
 
+    # recompute knobs (reference compile-time variants,
+    # ds_transformer_cuda.cpp ctor flags): each maps to jax.checkpoint on
+    # the corresponding segment — its intermediates are recomputed in
+    # backward instead of saved. attn_dropout_checkpoint drops the
+    # attention block's saved activations (the reference re-runs
+    # softmax-dropout); gelu_checkpoint drops the FF intermediate (the
+    # reference re-runs bias-GELU); normalize_invertible avoids saving
+    # LayerNorm outputs (the reference reconstructs the input from the
+    # output; recompute-from-input is the same memory class).
+    attn = (jax.checkpoint(attn_block)
+            if config.attn_dropout_checkpoint else attn_block)
+    ff = jax.checkpoint(ff_block) if config.gelu_checkpoint else ff_block
+    ln = (jax.checkpoint(_layer_norm)
+          if config.normalize_invertible else _layer_norm)
+
     if config.pre_layer_norm:
-        x = x + attn_block(_layer_norm(x, params["attn_nw"],
-                                       params["attn_nb"]))
-        x = x + ff_block(_layer_norm(x, params["norm_w"], params["norm_b"]))
+        x = x + attn(ln(x, params["attn_nw"], params["attn_nb"]))
+        x = x + ff(ln(x, params["norm_w"], params["norm_b"]))
     else:  # post-LN (original BERT)
-        x = _layer_norm(x + attn_block(x), params["attn_nw"],
-                        params["attn_nb"])
-        x = _layer_norm(x + ff_block(x), params["norm_w"], params["norm_b"])
+        x = ln(x + attn(x), params["attn_nw"], params["attn_nb"])
+        x = ln(x + ff(x), params["norm_w"], params["norm_b"])
     return x
 
 
