@@ -380,3 +380,43 @@ def model_parallel_seed(seed: int, model_parallel_rank: Optional[int] = None):
 
 # reference-name alias
 model_parallel_cuda_manual_seed = model_parallel_seed
+
+
+# --------------------------------------------------------------------- #
+# reference-name aliases (Megatron-style integrations call these names;
+# reference checkpointing.py:57,218,223,584,592)
+# --------------------------------------------------------------------- #
+from deepspeed_tpu.runtime.utils import see_memory_usage  # noqa: E402,F401
+
+
+def get_cuda_rng_tracker():
+    """Alias of :func:`get_rng_tracker` (no CUDA here; the tracker keys
+    jax PRNG streams)."""
+    return get_rng_tracker()
+
+
+def model_parallel_cuda_manual_seed(seed: int):
+    """Alias of :func:`model_parallel_seed`."""
+    return model_parallel_seed(seed)
+
+
+def partition_activations_in_checkpoint(partition_activation):
+    """(reference checkpointing.py:584) Toggle activation partitioning
+    outside configure()."""
+    global PARTITION_ACTIVATIONS
+    PARTITION_ACTIVATIONS = bool(partition_activation)
+
+
+def set_num_layers(nlayers):
+    """(reference checkpointing.py:592)"""
+    global num_layers
+    num_layers = nlayers
+
+
+def detach_variable(inputs, device=None):
+    """(reference checkpointing.py:89) — functional analog:
+    lax.stop_gradient over the pytree."""
+    del device
+    return jax.tree_util.tree_map(
+        lambda x: jax.lax.stop_gradient(x) if _is_floating(x) else x,
+        inputs)
