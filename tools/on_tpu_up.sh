@@ -1,0 +1,27 @@
+#!/bin/bash
+# One-shot hardware round: run when the TPU tunnel is back.
+#   PYTHONPATH=/root/repo bash tools/on_tpu_up.sh
+# 1. probes the chip; 2. sweeps the flash block table (autotune);
+# 3. runs the bench ladder (resumable; partial rows survive tunnel
+# drops). Outputs land in /tmp/tpu_round/.
+set -u
+OUT=/tmp/tpu_round
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+
+echo "== probe"
+if ! timeout 300 python -c "
+import jax, numpy as np, jax.numpy as jnp
+x = jnp.ones((256,256), jnp.bfloat16); np.asarray(x @ x); print('alive')
+"; then
+  echo "chip unreachable; aborting" >&2
+  exit 1
+fi
+
+echo "== autotune block table (writes deepspeed_tpu/ops/attention/block_table.json)"
+timeout 3600 python tools/autotune_blocks.py 2>&1 | tee "$OUT/autotune.log"
+
+echo "== bench ladder"
+timeout 7200 python bench.py 2> "$OUT/bench.err" | tee "$OUT/bench.jsonl"
+
+echo "== done; review $OUT and commit block_table.json + BENCH_NOTES update"
