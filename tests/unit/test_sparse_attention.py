@@ -547,6 +547,7 @@ class TestComposableSparseOps:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-4, rtol=2e-4)
 
+    @pytest.mark.slow
     def test_sparse_ops_differentiable(self):
         MatMul, Softmax, layout, q, k, v, blk = self._setup(seed=9)
         D = q.shape[-1]

@@ -519,6 +519,7 @@ def test_block_table_lookup_and_fallback():
         F._BLOCK_TABLE, F._FORCE_BLOCKS = old_table, old_force
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("pre_ln", [True, False])
 def test_recompute_knobs_preserve_numerics(pre_ln):
     """The recompute knobs (reference compile-time variants:
