@@ -1,6 +1,7 @@
 #!/bin/bash
 # One-shot hardware round: run when the TPU tunnel is back.
-#   PYTHONPATH=/root/repo bash tools/on_tpu_up.sh
+#   PYTHONPATH=/root/repo:/root/.axon_site bash tools/on_tpu_up.sh
+# (keep the axon site dir on PYTHONPATH — it registers the TPU plugin)
 # 1. probes the chip; 2. sweeps the flash block table (autotune);
 # 3. runs the bench ladder (resumable; partial rows survive tunnel
 # drops). Outputs land in /tmp/tpu_round/.
