@@ -53,7 +53,8 @@ setup(
                 "pipeline/3D parallelism, fused Pallas kernels, sparse "
                 "attention — DeepSpeed capabilities on JAX/XLA",
     packages=find_packages(include=["deepspeed_tpu", "deepspeed_tpu.*"]),
-    package_data={"deepspeed_tpu.ops.adam": ["*.so"]},
+    package_data={"deepspeed_tpu.ops.adam": ["*.so"],
+                  "deepspeed_tpu.ops.attention": ["block_table.json"]},
     scripts=["bin/dstpu", "bin/ds", "bin/dstpu_ssh"],
     python_requires=">=3.10",
     install_requires=["jax", "numpy"],

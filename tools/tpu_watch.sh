@@ -1,0 +1,33 @@
+#!/bin/bash
+# Tunnel watcher: probe the TPU every PERIOD seconds; the moment two
+# consecutive probes succeed, run the full hardware round
+# (tools/on_tpu_up.sh: autotune sweep + bench ladder) exactly once.
+#   PYTHONPATH=/root/repo:/root/.axon_site nohup bash tools/tpu_watch.sh &
+# Log: /tmp/tpu_watch.log (probe history), /tmp/tpu_round/ (round output).
+set -u
+PERIOD=${PERIOD:-600}
+LOG=/tmp/tpu_watch.log
+cd "$(dirname "$0")/.."
+
+probe() {
+  timeout 120 python -c "
+import jax, numpy as np, jax.numpy as jnp
+x = jnp.ones((8,8), jnp.bfloat16); np.asarray(x @ x); print('alive')
+" >/dev/null 2>&1
+}
+
+while true; do
+  if probe; then
+    echo "$(date -u +%FT%TZ) probe ok (1/2)" >> "$LOG"
+    sleep 30
+    if probe; then
+      echo "$(date -u +%FT%TZ) probe ok (2/2) — starting hardware round" >> "$LOG"
+      bash tools/on_tpu_up.sh >> "$LOG" 2>&1
+      echo "$(date -u +%FT%TZ) hardware round finished" >> "$LOG"
+      exit 0
+    fi
+  else
+    echo "$(date -u +%FT%TZ) probe dead" >> "$LOG"
+  fi
+  sleep "$PERIOD"
+done
