@@ -336,6 +336,10 @@ def run_child(metric):
     threading.Thread(target=_watchdog, daemon=True).start()
     import jax
     _apply_platform_override(jax)
+    # persistent compile cache: children share compiled executables, so a
+    # retried/resumed ladder only pays each remote compile once
+    from deepspeed_tpu.utils.platform import enable_compile_cache
+    enable_compile_cache(None)   # shared per-user default dir
     on_tpu = jax.default_backend() == "tpu"
     rtt = _rtt()
     last_beat[0] = time.monotonic()

@@ -311,6 +311,19 @@ def get_profiler_config(param_dict):
     }
 
 
+def get_compile_cache_config(param_dict):
+    """Persistent XLA compilation cache (re-runs start hot; see
+    constants.py for the knob's rationale)."""
+    sub = param_dict.get(C.COMPILE_CACHE, {})
+    return {
+        "enabled": sub.get(C.COMPILE_CACHE_ENABLED,
+                           C.COMPILE_CACHE_ENABLED_DEFAULT),
+        "dir": sub.get(C.COMPILE_CACHE_DIR, C.COMPILE_CACHE_DIR_DEFAULT),
+        "min_compile_secs": sub.get(C.COMPILE_CACHE_MIN_COMPILE_SECS,
+                                    C.COMPILE_CACHE_MIN_COMPILE_SECS_DEFAULT),
+    }
+
+
 def get_tensorboard_enabled(param_dict):
     if C.TENSORBOARD in param_dict:
         return get_scalar_param(param_dict[C.TENSORBOARD], C.TENSORBOARD_ENABLED,
@@ -401,6 +414,7 @@ class DeepSpeedConfig:
 
         self.wall_clock_breakdown = get_wall_clock_breakdown(param_dict)
         self.profiler_config = get_profiler_config(param_dict)
+        self.compile_cache_config = get_compile_cache_config(param_dict)
         self.compressed_allreduce_config = \
             get_compressed_allreduce_config(param_dict)
         self.memory_breakdown = get_memory_breakdown(param_dict)

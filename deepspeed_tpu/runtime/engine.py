@@ -394,6 +394,10 @@ class DeepSpeedEngine:
         # on TPU the XLA trace is the actionable artifact, SURVEY.md §5)
         self._profiler_cfg = self._config.profiler_config
         self._profiler_active = False
+        cc = self._config.compile_cache_config
+        if cc["enabled"]:
+            from ..utils.platform import enable_compile_cache
+            enable_compile_cache(cc["dir"], cc["min_compile_secs"])
         self._last_step_time_ms = None
 
         # -- sparse (CSR) embedding gradients (reference engine.py:181-187

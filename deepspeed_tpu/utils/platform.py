@@ -18,6 +18,40 @@ backend is not).
 
 import os
 
+_CACHE_ENABLED_DIR = None
+
+
+def enable_compile_cache(cache_dir, min_compile_secs=1.0) -> bool:
+    """Point JAX's persistent compilation cache at ``cache_dir`` so
+    re-runs (bench children, resumed jobs, repeated CLI launches) load
+    compiled executables from disk instead of re-paying XLA compiles —
+    which through a remote-compile tunnel can dominate wall time.
+
+    Idempotent; returns True when the cache is active. A second call
+    with a DIFFERENT dir is ignored (jax's cache dir is global) and
+    returns False. ``cache_dir=None`` selects the per-user default
+    (``constants.COMPILE_CACHE_DIR_DEFAULT``).
+    """
+    global _CACHE_ENABLED_DIR
+    if cache_dir is None:
+        from ..runtime.constants import COMPILE_CACHE_DIR_DEFAULT
+        cache_dir = COMPILE_CACHE_DIR_DEFAULT
+    if _CACHE_ENABLED_DIR is not None:
+        return _CACHE_ENABLED_DIR == cache_dir
+    import jax
+    # validate + set the threshold BEFORE the dir: if anything here
+    # raises, the cache dir is still unset and the cache truly inactive
+    try:
+        secs = float(min_compile_secs)
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          secs)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+    except (OSError, AttributeError, ValueError, TypeError):
+        return False   # unwritable dir / older jax / bad value: uncached
+    _CACHE_ENABLED_DIR = cache_dir
+    return True
+
 
 def apply_platform_env() -> None:
     plat = os.environ.get("DSTPU_PLATFORM")

@@ -206,3 +206,37 @@ class TestFeatureConfigs:
         bad.write_text('{"train_batch_size": 8, "train_batch_size": 16}')
         with pytest.raises(ValueError):
             DeepSpeedConfig(str(bad), world_size=1)
+
+
+class TestCompileCache:
+    def test_defaults_and_override(self):
+        cfg = DeepSpeedConfig(base_dict(), world_size=1)
+        assert cfg.compile_cache_config["enabled"] is True
+        assert cfg.compile_cache_config["dir"].endswith("xla_cache")
+        cfg = DeepSpeedConfig(
+            base_dict(compile_cache={"enabled": False, "dir": "/tmp/x",
+                                     "min_compile_secs": 0.0}),
+            world_size=1)
+        assert cfg.compile_cache_config == {
+            "enabled": False, "dir": "/tmp/x", "min_compile_secs": 0.0}
+
+    def test_enable_populates_cache_dir(self, tmp_path, monkeypatch):
+        import jax
+        import jax.numpy as jnp
+        from deepspeed_tpu.utils import platform as P
+        monkeypatch.setattr(P, "_CACHE_ENABLED_DIR", None)
+        prev = jax.config.jax_compilation_cache_dir
+        prev_secs = jax.config.jax_persistent_cache_min_compile_time_secs
+        try:
+            assert P.enable_compile_cache(str(tmp_path),
+                                          min_compile_secs=0.0)
+            # second call, different dir: refused (global setting)
+            assert not P.enable_compile_cache(str(tmp_path / "other"))
+            assert P.enable_compile_cache(str(tmp_path))
+            jax.jit(lambda x: jnp.sin(x) * 41.2512)(jnp.ones((8, 8)))
+            import os
+            assert os.listdir(str(tmp_path)), "no cache entry written"
+        finally:
+            jax.config.update("jax_compilation_cache_dir", prev)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              prev_secs)

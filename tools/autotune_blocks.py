@@ -128,6 +128,8 @@ def main():
 
     import jax
     from deepspeed_tpu.ops.attention import flash as F
+    from deepspeed_tpu.utils.platform import enable_compile_cache
+    enable_compile_cache(None)   # shared per-user default dir
     backend = jax.default_backend()
     print(f"# backend: {backend} (results are only meaningful on tpu)")
     rtt = _rtt()

@@ -5,7 +5,7 @@
 # 1. probes the chip; 2. sweeps the flash block table (autotune);
 # 3. runs the bench ladder (resumable; partial rows survive tunnel
 # drops). Outputs land in /tmp/tpu_round/.
-set -u
+set -u -o pipefail   # tee must not mask the bench exit code
 OUT=/tmp/tpu_round
 mkdir -p "$OUT"
 cd "$(dirname "$0")/.."

@@ -23,8 +23,17 @@ while true; do
     if probe; then
       echo "$(date -u +%FT%TZ) probe ok (2/2) — starting hardware round" >> "$LOG"
       bash tools/on_tpu_up.sh >> "$LOG" 2>&1
-      echo "$(date -u +%FT%TZ) hardware round finished" >> "$LOG"
-      exit 0
+      rc=$?
+      # the round is only DONE when all 4 bench rows are real; a tunnel
+      # death mid-round re-arms the watcher (completed rows resume from
+      # the partial file, so a retry only re-pays the failed metrics)
+      rows=$(grep -c '"metric"' /tmp/tpu_round/bench.jsonl 2>/dev/null || echo 0)
+      errs=$(grep -c '"unit": "error"' /tmp/tpu_round/bench.jsonl 2>/dev/null || echo 0)
+      if [ "$rc" -eq 0 ] && [ "$rows" -ge 4 ] && [ "$errs" -eq 0 ]; then
+        echo "$(date -u +%FT%TZ) hardware round COMPLETE ($rows rows)" >> "$LOG"
+        exit 0
+      fi
+      echo "$(date -u +%FT%TZ) round incomplete (rc=$rc rows=$rows errs=$errs) — re-arming" >> "$LOG"
     fi
   else
     echo "$(date -u +%FT%TZ) probe dead" >> "$LOG"
