@@ -112,6 +112,11 @@ def bench_bert_large(on_tpu, rtt):
                          num_heads=2, intermediate_size=128,
                          max_position_embeddings=128)
         batch, seq, steps = 4, 32, 2
+    # BENCH_SCAN_LAYERS=1: stacked-layer scan trunk — ~num_layers x less
+    # to compile (A/B knob for flaky-tunnel windows; throughput parity
+    # should be confirmed on hardware before making it the default)
+    if os.environ.get("BENCH_SCAN_LAYERS", "0") == "1":
+        cfg = cfg._replace(scan_layers=True)
 
     n_dev = jax.device_count()
     params = init_bert_params(cfg, jax.random.PRNGKey(0))
@@ -263,6 +268,8 @@ def bench_gpt2(on_tpu, rtt, dropout: float, metric: str):
                          embd_dropout=dropout, attn_dropout=dropout,
                          resid_dropout=dropout)
         batch, seq, steps = 4, 64, 2
+    if os.environ.get("BENCH_SCAN_LAYERS", "0") == "1":
+        cfg = cfg._replace(scan_layers=True)   # see bench_bert_large
 
     n_dev = jax.device_count()
     params = init_gpt2_params(cfg, jax.random.PRNGKey(0))

@@ -30,6 +30,9 @@ class BertConfig(NamedTuple):
     initializer_range: float = 0.02
     pre_layer_norm: bool = True     # modelingpreln.py variant (default for
     #                                 the reference's fused kernel training)
+    # stacked layers + lax.scan encoder: the layer compiles once instead
+    # of num_layers times (see GPT2Config.scan_layers)
+    scan_layers: bool = False
 
 
 BERT_BASE = BertConfig()
@@ -75,8 +78,14 @@ def init_bert_params(config: BertConfig, key) -> Dict[str, Any]:
                    "b": jnp.zeros((h,), jnp.float32)},
         "mlm_bias": jnp.zeros((config.vocab_size,), jnp.float32),
     }
-    for i in range(config.num_layers):
-        params[f"layer_{i}"] = init_transformer_params(lcfg, keys[4 + i], i)
+    layers = [init_transformer_params(lcfg, keys[4 + i], i)
+              for i in range(config.num_layers)]
+    if config.scan_layers:
+        params["layers"] = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *layers)
+    else:
+        for i, lp in enumerate(layers):
+            params[f"layer_{i}"] = lp
     return params
 
 
@@ -104,8 +113,13 @@ def bert_param_specs(config: BertConfig):
         "mlm_ln": {"w": P(), "b": P()},
         "mlm_bias": P("model"),
     }
-    for i in range(config.num_layers):
-        specs[f"layer_{i}"] = layer
+    if config.scan_layers:
+        specs["layers"] = jax.tree_util.tree_map(
+            lambda p: P(None, *p), layer,
+            is_leaf=lambda x: isinstance(x, P))
+    else:
+        for i in range(config.num_layers):
+            specs[f"layer_{i}"] = layer
     return specs
 
 
@@ -163,6 +177,21 @@ def bert_encoder(params, config: BertConfig, input_ids, attention_mask=None,
         # not pytrees
         fwd = jax.checkpoint(transformer_layer_forward,
                              static_argnums=(1, 5, 6, 7))
+    if config.scan_layers:
+        if rng is not None:
+            layer_rngs = jax.random.split(rng, config.num_layers)
+
+            def body(x, inp):
+                lp, r = inp
+                return fwd(lp, lcfg, x, add_mask, r, deterministic,
+                           True, attention_fn), None
+            x, _ = jax.lax.scan(body, x, (params["layers"], layer_rngs))
+        else:
+            def body(x, lp):
+                return fwd(lp, lcfg, x, add_mask, None, deterministic,
+                           True, attention_fn), None
+            x, _ = jax.lax.scan(body, x, params["layers"])
+        return x
     for i in range(config.num_layers):
         if rng is not None:
             rng, r = jax.random.split(rng)
@@ -188,6 +217,8 @@ def bert_mlm_sp_loss_fn(config: BertConfig, mesh, dtype=jnp.bfloat16,
     from jax.sharding import PartitionSpec as PS
     if "seq" not in mesh.axis_names:
         raise ValueError("bert_mlm_sp_loss_fn requires a 'seq' mesh axis")
+    assert not config.scan_layers, \
+        "bert_mlm_sp_loss_fn uses the layer_{i} layout (scan_layers=False)"
     Pn = axis_size(mesh, "seq")
     manual = frozenset(a for a in ("seq", "data") if a in mesh.axis_names)
     lcfg = layer_config(config, training=not deterministic, dtype=dtype)

@@ -31,6 +31,13 @@ class GPT2Config(NamedTuple):
     resid_dropout: float = 0.1
     initializer_range: float = 0.02
     layer_norm_eps: float = 1e-5
+    # Stack the (homogeneous) blocks into leading-dim-L params and run
+    # the trunk as one lax.scan: the block compiles ONCE instead of
+    # num_layers times (BERT-large/GPT-2 first-compile drops ~20x; the
+    # standard JAX LLM layout, cf. T5X/MaxText). Numerics are identical
+    # to the unrolled trunk (same per-layer init keys); only the
+    # per-layer dropout streams differ. Dense family only.
+    scan_layers: bool = False
 
     @property
     def inter(self):
@@ -60,9 +67,10 @@ def init_gpt2_params(config: GPT2Config, key) -> Dict[str, Any]:
         "ln_f": {"w": jnp.ones((h,), jnp.float32),
                  "b": jnp.zeros((h,), jnp.float32)},
     }
+    layers = []
     for i in range(config.num_layers):
         k = keys[2 + 4 * i: 6 + 4 * i]
-        params[f"h_{i}"] = {
+        layers.append({
             "ln_1": {"w": jnp.ones((h,), jnp.float32),
                      "b": jnp.zeros((h,), jnp.float32)},
             "attn": {
@@ -80,8 +88,22 @@ def init_gpt2_params(config: GPT2Config, key) -> Dict[str, Any]:
                                             jnp.float32) * out_rng,
                 "proj_b": jnp.zeros((h,), jnp.float32),
             },
-        }
+        })
+    if config.scan_layers:
+        params["h"] = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *layers)
+    else:
+        for i, lp in enumerate(layers):
+            params[f"h_{i}"] = lp
     return params
+
+
+def layer_params(params, config: GPT2Config, i: int):
+    """Block i's param pytree under either layout (``h_{i}`` keys, or the
+    ``scan_layers`` stacked ``h``)."""
+    if config.scan_layers:
+        return jax.tree_util.tree_map(lambda a: a[i], params["h"])
+    return params[f"h_{i}"]
 
 
 def gpt2_param_specs(config: GPT2Config) -> Dict[str, Any]:
@@ -101,8 +123,14 @@ def gpt2_param_specs(config: GPT2Config) -> Dict[str, Any]:
         "wpe": P(),
         "ln_f": {"w": P(), "b": P()},
     }
-    for i in range(config.num_layers):
-        specs[f"h_{i}"] = layer
+    if config.scan_layers:
+        # stacked layout: same shardings with an unsharded leading L dim
+        specs["h"] = jax.tree_util.tree_map(
+            lambda p: P(None, *p), layer,
+            is_leaf=lambda x: isinstance(x, P))
+    else:
+        for i in range(config.num_layers):
+            specs[f"h_{i}"] = layer
     return specs
 
 
@@ -261,19 +289,37 @@ def _gpt2_trunk(params, config: GPT2Config, input_ids, rng=None,
         block = jax.checkpoint(gpt2_block,
                                static_argnums=(1, 4, 5, 6, 7))
     aux_total = jnp.zeros((), jnp.float32)
-    for i in range(config.num_layers):
+    if config.scan_layers:
+        assert mlp_fns is None, \
+            "scan_layers supports the homogeneous dense family only"
+        # one compiled block, scanned over the stacked layer params
         if rng is not None:
-            rng, r = jax.random.split(rng)
+            layer_rngs = jax.random.split(rng, config.num_layers)
+
+            def body(x, inp):
+                lp, r = inp
+                return block(lp, config, x, r, deterministic,
+                             dtype, None, None), None
+            x, _ = jax.lax.scan(body, x, (params["h"], layer_rngs))
         else:
-            r = None
-        mlp_fn = None if mlp_fns is None else mlp_fns.get(i)
-        if mlp_fn is not None:
-            x, aux = block(params[f"h_{i}"], config, x, r, deterministic,
-                           dtype, None, mlp_fn)
-            aux_total = aux_total + aux
-        else:
-            x = block(params[f"h_{i}"], config, x, r, deterministic,
-                      dtype, None, None)
+            def body(x, lp):
+                return block(lp, config, x, None, deterministic,
+                             dtype, None, None), None
+            x, _ = jax.lax.scan(body, x, params["h"])
+    else:
+        for i in range(config.num_layers):
+            if rng is not None:
+                rng, r = jax.random.split(rng)
+            else:
+                r = None
+            mlp_fn = None if mlp_fns is None else mlp_fns.get(i)
+            if mlp_fn is not None:
+                x, aux = block(params[f"h_{i}"], config, x, r, deterministic,
+                               dtype, None, mlp_fn)
+                aux_total = aux_total + aux
+            else:
+                x = block(params[f"h_{i}"], config, x, r, deterministic,
+                          dtype, None, None)
 
     x = _layer_norm(x, params["ln_f"], config.layer_norm_eps)
     if mlp_fns is not None:
@@ -358,11 +404,18 @@ def gpt2_generate(params, config: GPT2Config, prompt_ids, max_new_tokens,
     L = P + max_new_tokens
     assert L <= config.max_position_embeddings, (
         L, config.max_position_embeddings)
-    for i in range(config.num_layers):
-        if "fc_w" not in params[f"h_{i}"]["mlp"]:
-            raise ValueError(
-                "gpt2_generate supports the dense GPT-2 family only; "
-                f"block h_{i} carries MoE expert params")
+    if config.scan_layers:
+        # stacked layout is structurally dense (init_gpt2_moe_params
+        # rejects it); one key check, no per-layer slicing
+        if "fc_w" not in params["h"]["mlp"]:
+            raise ValueError("gpt2_generate supports the dense GPT-2 "
+                             "family only")
+    else:
+        for i in range(config.num_layers):
+            if "fc_w" not in params[f"h_{i}"]["mlp"]:
+                raise ValueError(
+                    "gpt2_generate supports the dense GPT-2 family only; "
+                    f"block h_{i} carries MoE expert params")
     heads = config.num_heads
     hd = config.hidden_size // heads
     nl = config.num_layers
@@ -384,8 +437,8 @@ def gpt2_generate(params, config: GPT2Config, prompt_ids, max_new_tokens,
         return attn
 
     for i in range(nl):
-        x = gpt2_block(params[f"h_{i}"], config, x, None, True, dtype,
-                       attention_fn=capture_attn(i))
+        x = gpt2_block(layer_params(params, config, i), config, x, None,
+                       True, dtype, attention_fn=capture_attn(i))
         k, v = captured.pop(i)
         kc = kc.at[i, :, :, :P].set(k.astype(dtype))
         vc = vc.at[i, :, :, :P].set(v.astype(dtype))
@@ -413,7 +466,8 @@ def gpt2_generate(params, config: GPT2Config, prompt_ids, max_new_tokens,
         new_kc, new_vc = [], []
         for i in range(nl):
             box = []
-            x = gpt2_block(params[f"h_{i}"], config, x, None, True, dtype,
+            x = gpt2_block(layer_params(params, config, i), config, x,
+                           None, True, dtype,
                            attention_fn=_cached_attention(kc[i], vc[i],
                                                           pos, box))
             ki, vi = box[0]
@@ -445,6 +499,8 @@ def init_gpt2_moe_params(config: GPT2Config, moe_config, key,
     (blocks moe_every-1, 2*moe_every-1, ...) replaced by a MoE expert
     bank; ``moe_every=1`` converts every block."""
     from deepspeed_tpu.ops.moe import init_moe_params
+    assert not config.scan_layers, \
+        "MoE blocks are heterogeneous; use the h_{i} layout"
     params = init_gpt2_params(config, key)
     for i in range(config.num_layers):
         if _is_moe_block(i, moe_every):
@@ -528,6 +584,8 @@ def gpt2_sp_loss_fn(config: GPT2Config, mesh, dtype=jnp.bfloat16,
     from deepspeed_tpu.parallel.mesh import axis_size
     if "seq" not in mesh.axis_names:
         raise ValueError("gpt2_sp_loss_fn requires a 'seq' mesh axis")
+    assert not config.scan_layers, \
+        "gpt2_sp_loss_fn uses the h_{i} layout (set scan_layers=False)"
     Pn = axis_size(mesh, "seq")
     manual = frozenset(a for a in ("seq", "data") if a in mesh.axis_names)
 
@@ -640,6 +698,8 @@ def gpt2_pipeline_spec(config: GPT2Config, num_stages: int,
     """
     from deepspeed_tpu.runtime.pipe.spmd import PipelineSpec
 
+    assert not config.scan_layers, \
+        "the pipeline spec stage-stacks layers itself (scan_layers=False)"
     L = config.num_layers
     # uneven partitions supported: stages hold ceil(L/S) slots, short
     # stages pad with zero blocks masked out in stage_apply (data-masked,

@@ -397,7 +397,11 @@ class DeepSpeedEngine:
         cc = self._config.compile_cache_config
         if cc["enabled"]:
             from ..utils.platform import enable_compile_cache
-            enable_compile_cache(cc["dir"], cc["min_compile_secs"])
+            if not enable_compile_cache(cc["dir"], cc["min_compile_secs"]):
+                logger.warning(
+                    "compile_cache: could not activate %r (another dir "
+                    "already active, unwritable path, or older jax); "
+                    "running uncached", cc["dir"])
         self._last_step_time_ms = None
 
         # -- sparse (CSR) embedding gradients (reference engine.py:181-187

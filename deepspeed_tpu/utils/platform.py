@@ -36,6 +36,7 @@ def enable_compile_cache(cache_dir, min_compile_secs=1.0) -> bool:
     if cache_dir is None:
         from ..runtime.constants import COMPILE_CACHE_DIR_DEFAULT
         cache_dir = COMPILE_CACHE_DIR_DEFAULT
+    cache_dir = os.path.expanduser(cache_dir)
     if _CACHE_ENABLED_DIR is not None:
         return _CACHE_ENABLED_DIR == cache_dir
     import jax

@@ -227,6 +227,18 @@ class TestCompileCache:
         monkeypatch.setattr(P, "_CACHE_ENABLED_DIR", None)
         prev = jax.config.jax_compilation_cache_dir
         prev_secs = jax.config.jax_persistent_cache_min_compile_time_secs
+
+        def _reset_jax_cache():
+            # jax initializes its cache object once; a dir change after
+            # another test compiled (e.g. engine default cache) would be
+            # ignored without this
+            try:
+                from jax._src import compilation_cache
+                compilation_cache.reset_cache()
+            except (ImportError, AttributeError):
+                pass
+
+        _reset_jax_cache()
         try:
             assert P.enable_compile_cache(str(tmp_path),
                                           min_compile_secs=0.0)
@@ -240,3 +252,4 @@ class TestCompileCache:
             jax.config.update("jax_compilation_cache_dir", prev)
             jax.config.update("jax_persistent_cache_min_compile_time_secs",
                               prev_secs)
+            _reset_jax_cache()

@@ -250,3 +250,136 @@ class TestGPT2Generate:
                                           jax.random.PRNGKey(0))
         with pytest.raises(ValueError, match="dense GPT-2 family"):
             gpt2_generate(moe_params, cfg, prompt, 2)
+
+
+class TestScanLayers:
+    """scan_layers=True: stacked layer params + lax.scan trunk —
+    numerically equivalent to the unrolled h_{i} layout."""
+
+    def _pair(self):
+        cfg_u = TINY_GPT2._replace(num_layers=3)
+        cfg_s = cfg_u._replace(scan_layers=True)
+        pu = init_gpt2_params(cfg_u, jax.random.PRNGKey(7))
+        ps = init_gpt2_params(cfg_s, jax.random.PRNGKey(7))
+        return cfg_u, cfg_s, pu, ps
+
+    def test_stacked_init_matches_unrolled(self):
+        cfg_u, cfg_s, pu, ps = self._pair()
+        assert set(ps) == {"wte", "wpe", "ln_f", "h"}
+        assert ps["h"]["attn"]["qkvw"].shape == (3, 32, 96)
+        for i in range(3):
+            np.testing.assert_array_equal(
+                np.asarray(ps["h"]["attn"]["qkvw"][i]),
+                np.asarray(pu[f"h_{i}"]["attn"]["qkvw"]))
+        assert count_params(ps) == count_params(pu)
+
+    def test_loss_and_grads_match_unrolled(self):
+        cfg_u, cfg_s, pu, ps = self._pair()
+        ids = np.random.RandomState(0).randint(
+            0, 128, (2, 33)).astype(np.int32)
+        batch = {"input_ids": ids}
+        rng = jax.random.PRNGKey(1)
+        for remat in (False, True):
+            lu = gpt2_loss_fn(cfg_u, dtype=jnp.float32, remat=remat,
+                              deterministic=True)
+            ls = gpt2_loss_fn(cfg_s, dtype=jnp.float32, remat=remat,
+                              deterministic=True)
+            vu, gu = jax.value_and_grad(lu)(pu, batch, rng)
+            vs, gs = jax.value_and_grad(ls)(ps, batch, rng)
+            np.testing.assert_allclose(float(vu), float(vs), rtol=1e-6)
+            for i in range(3):
+                np.testing.assert_allclose(
+                    np.asarray(gs["h"]["mlp"]["fc_w"][i]),
+                    np.asarray(gu[f"h_{i}"]["mlp"]["fc_w"]),
+                    rtol=2e-5, atol=1e-6)
+
+    def test_tp_specs_and_engine_step(self):
+        import deepspeed_tpu as ds
+        cfg = TINY_GPT2._replace(num_layers=2, scan_layers=True)
+        params = init_gpt2_params(cfg, jax.random.PRNGKey(0))
+        specs = gpt2_param_specs(cfg)
+        assert specs["h"]["attn"]["qkvw"] == jax.sharding.PartitionSpec(
+            None, None, "model")
+        loss_fn = gpt2_loss_fn(cfg, dtype=jnp.float32, deterministic=True)
+        ids = np.random.RandomState(0).randint(
+            0, 128, (8, 33)).astype(np.int32)
+        e, *_ = ds.initialize(
+            model=loss_fn, model_parameters=params, param_specs=specs,
+            config={"train_micro_batch_size_per_gpu": 4,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                    "zero_optimization": {"stage": 2},
+                    "mesh": {"axes": {"data": 2, "model": 4}}})
+        first = float(e.train_batch(iter([{"input_ids": ids}])))
+        for _ in range(4):
+            last = float(e.train_batch(iter([{"input_ids": ids}])))
+        assert last < first
+
+    def test_generate_matches_unrolled(self):
+        from deepspeed_tpu.models.gpt2 import gpt2_generate
+        cfg_u, cfg_s, pu, ps = self._pair()
+        prompt = np.random.RandomState(3).randint(
+            0, 128, (2, 5)).astype(np.int32)
+        gu = gpt2_generate(pu, cfg_u, prompt, 6, dtype=jnp.float32)
+        gs = gpt2_generate(ps, cfg_s, prompt, 6, dtype=jnp.float32)
+        np.testing.assert_array_equal(np.asarray(gu), np.asarray(gs))
+
+    def test_heterogeneous_paths_rejected(self):
+        from deepspeed_tpu.models.gpt2 import (gpt2_pipeline_spec,
+                                               init_gpt2_moe_params)
+        cfg = TINY_GPT2._replace(scan_layers=True)
+        with pytest.raises(AssertionError):
+            gpt2_pipeline_spec(cfg, num_stages=2)
+        with pytest.raises(AssertionError):
+            init_gpt2_moe_params(cfg, None, jax.random.PRNGKey(0))
+
+
+class TestBertScanLayers:
+    def _pair(self):
+        cfg_u = TINY_BERT._replace(num_layers=3, hidden_dropout=0.0,
+                                   attn_dropout=0.0)
+        cfg_s = cfg_u._replace(scan_layers=True)
+        pu = init_bert_params(cfg_u, jax.random.PRNGKey(5))
+        ps = init_bert_params(cfg_s, jax.random.PRNGKey(5))
+        return cfg_u, cfg_s, pu, ps
+
+    def test_mlm_loss_and_grads_match_unrolled(self):
+        cfg_u, cfg_s, pu, ps = self._pair()
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, 128, (2, 32)).astype(np.int32)
+        labels = np.where(rng.rand(2, 32) < 0.2, ids, -100).astype(np.int32)
+        am = (rng.rand(2, 32) > 0.1).astype(np.int32)
+        batch = {"input_ids": ids, "labels": labels, "attention_mask": am}
+        key = jax.random.PRNGKey(2)
+        lu = bert_mlm_loss_fn(cfg_u, dtype=jnp.float32, deterministic=True)
+        ls = bert_mlm_loss_fn(cfg_s, dtype=jnp.float32, deterministic=True)
+        vu, gu = jax.value_and_grad(lu)(pu, batch, key)
+        vs, gs = jax.value_and_grad(ls)(ps, batch, key)
+        np.testing.assert_allclose(float(vu), float(vs), rtol=1e-6)
+        for i in range(3):
+            np.testing.assert_allclose(
+                np.asarray(gs["layers"]["qkvw"][i]),
+                np.asarray(gu[f"layer_{i}"]["qkvw"]),
+                rtol=2e-5, atol=1e-6)
+
+    def test_tp_engine_step(self):
+        import deepspeed_tpu as ds
+        from deepspeed_tpu.models.bert import bert_param_specs
+        cfg = TINY_BERT._replace(scan_layers=True, hidden_dropout=0.0,
+                                 attn_dropout=0.0)
+        params = init_bert_params(cfg, jax.random.PRNGKey(0))
+        loss_fn = bert_mlm_loss_fn(cfg, dtype=jnp.float32,
+                                   deterministic=True)
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, 128, (8, 32)).astype(np.int32)
+        labels = np.where(rng.rand(8, 32) < 0.15, ids, -100).astype(np.int32)
+        e, *_ = ds.initialize(
+            model=loss_fn, model_parameters=params,
+            param_specs=bert_param_specs(cfg),
+            config={"train_micro_batch_size_per_gpu": 4,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                    "mesh": {"axes": {"data": 2, "model": 4}}})
+        batch = {"input_ids": ids, "labels": labels}
+        first = float(e.train_batch(iter([batch])))
+        for _ in range(4):
+            last = float(e.train_batch(iter([batch])))
+        assert last < first

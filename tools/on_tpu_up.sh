@@ -28,5 +28,7 @@ echo "== bench ladder"
 # upfront liveness gate + probe-gated retries bound the all-dead case.
 BENCH_METRIC_TIMEOUT=${BENCH_METRIC_TIMEOUT:-2400} \
   timeout 14400 python bench.py 2> "$OUT/bench.err" | tee "$OUT/bench.jsonl"
+rc=$?
 
-echo "== done; review $OUT and commit block_table.json + BENCH_NOTES update"
+echo "== done (bench rc=$rc); review $OUT and commit block_table.json + BENCH_NOTES update"
+exit $rc

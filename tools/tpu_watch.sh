@@ -27,8 +27,11 @@ while true; do
       # the round is only DONE when all 4 bench rows are real; a tunnel
       # death mid-round re-arms the watcher (completed rows resume from
       # the partial file, so a retry only re-pays the failed metrics)
-      rows=$(grep -c '"metric"' /tmp/tpu_round/bench.jsonl 2>/dev/null || echo 0)
-      errs=$(grep -c '"unit": "error"' /tmp/tpu_round/bench.jsonl 2>/dev/null || echo 0)
+      # NB grep -c prints the 0 itself on no-match (and exits 1) — an
+      # `|| echo 0` here would yield the two-line "0\n0" and break -eq
+      rows=$(grep -c '"metric"' /tmp/tpu_round/bench.jsonl 2>/dev/null)
+      errs=$(grep -c '"unit": "error"' /tmp/tpu_round/bench.jsonl 2>/dev/null)
+      rows=${rows:-0}; errs=${errs:-0}
       if [ "$rc" -eq 0 ] && [ "$rows" -ge 4 ] && [ "$errs" -eq 0 ]; then
         echo "$(date -u +%FT%TZ) hardware round COMPLETE ($rows rows)" >> "$LOG"
         exit 0
