@@ -5,3 +5,6 @@ from deepspeed_tpu.models.gpt2 import (
 from deepspeed_tpu.models.bert import (
     BertConfig, BERT_BASE, BERT_LARGE, bert_encoder, bert_mlm_loss_fn,
     bert_mlm_sp_loss_fn, bert_param_specs, init_bert_params)
+from deepspeed_tpu.models.llama import (
+    LlamaConfig, init_llama_params, llama_forward, llama_loss_fn,
+    llama_param_specs)

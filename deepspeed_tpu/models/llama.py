@@ -1,0 +1,222 @@
+"""Llama-style decoder family: RoPE + RMSNorm + SwiGLU + GQA.
+
+A beyond-reference model family (the reference snapshot predates this
+architecture) demonstrating the framework on the modern decoder recipe:
+rotary position embeddings (no learned positions), RMSNorm pre-norm,
+SwiGLU MLP, and grouped-query attention served NATIVELY by the Pallas
+flash kernels (ops/attention/flash.py — kv_heads < heads share K/V rows
+via block index maps / DMA row select; K/V never expand to the full head
+count). First-class Megatron-style tensor-parallel PartitionSpecs and
+the stacked ``scan_layers`` layout ship like the GPT-2/BERT families'.
+"""
+
+from typing import Any, Dict, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.ops.attention.flash import flash_attention
+from deepspeed_tpu.ops.functional import rms_norm
+
+
+class LlamaConfig(NamedTuple):
+    vocab_size: int = 32000
+    hidden_size: int = 512
+    num_layers: int = 8
+    num_heads: int = 8
+    num_kv_heads: int = 0          # 0 => num_heads (MHA); 1 = MQA
+    intermediate_size: int = 0     # 0 => the llama 8/3 * hidden, 128-aligned
+    max_position_embeddings: int = 2048
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-5
+    initializer_range: float = 0.02
+    scan_layers: bool = False
+
+    @property
+    def kv_heads(self):
+        return self.num_kv_heads or self.num_heads
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_heads
+
+    @property
+    def inter(self):
+        if self.intermediate_size:
+            return self.intermediate_size
+        raw = int(self.hidden_size * 8 / 3)
+        return (raw + 127) // 128 * 128
+
+
+def init_llama_params(config: LlamaConfig, key) -> Dict[str, Any]:
+    h, hd = config.hidden_size, config.head_dim
+    hkv, inter = config.kv_heads, config.inter
+    rng = config.initializer_range
+    out_rng = rng / np.sqrt(2.0 * config.num_layers)
+    keys = jax.random.split(key, 2 + 7 * config.num_layers)
+    params: Dict[str, Any] = {
+        "tok_emb": jax.random.normal(keys[0], (config.vocab_size, h),
+                                     jnp.float32) * rng,
+        "ln_f": {"w": jnp.ones((h,), jnp.float32)},
+        # untied output head, stored (V, H) like a tied embedding so the
+        # chunked fused head (gpt2._tied_xent_chunked) applies unchanged
+        "lm_head": jax.random.normal(keys[1], (config.vocab_size, h),
+                                     jnp.float32) * rng,
+    }
+    layers = []
+    for i in range(config.num_layers):
+        k = keys[2 + 7 * i: 9 + 7 * i]
+        layers.append({
+            "ln_1": {"w": jnp.ones((h,), jnp.float32)},
+            "attn": {
+                "wq": jax.random.normal(k[0], (h, h), jnp.float32) * rng,
+                "wk": jax.random.normal(k[1], (h, hkv * hd),
+                                       jnp.float32) * rng,
+                "wv": jax.random.normal(k[2], (h, hkv * hd),
+                                       jnp.float32) * rng,
+                "wo": jax.random.normal(k[3], (h, h), jnp.float32) * out_rng,
+            },
+            "ln_2": {"w": jnp.ones((h,), jnp.float32)},
+            "mlp": {
+                "w_gate": jax.random.normal(k[4], (h, inter),
+                                            jnp.float32) * rng,
+                "w_up": jax.random.normal(k[5], (h, inter),
+                                          jnp.float32) * rng,
+                "w_down": jax.random.normal(k[6], (inter, h),
+                                            jnp.float32) * out_rng,
+            },
+        })
+    if config.scan_layers:
+        params["h"] = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *layers)
+    else:
+        for i, lp in enumerate(layers):
+            params[f"h_{i}"] = lp
+    return params
+
+
+def llama_param_specs(config: LlamaConfig) -> Dict[str, Any]:
+    """Megatron column/row TP over the ``model`` axis: wq/wk/wv/gate/up
+    column-parallel (output dim = heads — shard cleanly when num_heads
+    and kv_heads divide the axis), wo/down row-parallel; embeddings and
+    head vocab-sharded."""
+    layer = {
+        "ln_1": {"w": P()},
+        "attn": {"wq": P(None, "model"), "wk": P(None, "model"),
+                 "wv": P(None, "model"), "wo": P("model", None)},
+        "ln_2": {"w": P()},
+        "mlp": {"w_gate": P(None, "model"), "w_up": P(None, "model"),
+                "w_down": P("model", None)},
+    }
+    specs: Dict[str, Any] = {
+        "tok_emb": P("model", None),
+        "ln_f": {"w": P()},
+        "lm_head": P("model", None),
+    }
+    if config.scan_layers:
+        specs["h"] = jax.tree_util.tree_map(
+            lambda p: P(None, *p), layer,
+            is_leaf=lambda x: isinstance(x, P))
+    else:
+        for i in range(config.num_layers):
+            specs[f"h_{i}"] = layer
+    return specs
+
+
+from deepspeed_tpu.models.gpt2 import count_params  # noqa: E402 (reuse)
+
+
+def rope_cos_sin(seq_len: int, head_dim: int, theta: float,
+                 dtype=jnp.float32):
+    """(S, hd/2) cos/sin tables for rotary embedding."""
+    inv = 1.0 / (theta ** (np.arange(0, head_dim, 2,
+                                     dtype=np.float32) / head_dim))
+    t = jnp.arange(seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, jnp.asarray(inv))           # (S, hd/2)
+    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+
+
+def apply_rope(x, cos, sin):
+    """Rotate (B, H, S, hd) by per-position angles ((S, hd/2) tables).
+
+    Pair layout is (x[..., :hd/2], x[..., hd/2:]) — the "rotate_half"
+    convention; consistent across q and k so relative phases match.
+    """
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    c = cos[None, None].astype(x.dtype)
+    s = sin[None, None].astype(x.dtype)
+    return jnp.concatenate([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1)
+
+
+def llama_block(block_params, config: LlamaConfig, x, cos, sin, dtype):
+    B, S, h = x.shape
+    H, hkv, hd = config.num_heads, config.kv_heads, config.head_dim
+
+    a_in = rms_norm(x, block_params["ln_1"]["w"], config.rms_norm_eps)
+    ap = block_params["attn"]
+    q = (a_in @ ap["wq"].astype(dtype)).reshape(B, S, H, hd)
+    k = (a_in @ ap["wk"].astype(dtype)).reshape(B, S, hkv, hd)
+    v = (a_in @ ap["wv"].astype(dtype)).reshape(B, S, hkv, hd)
+    q = apply_rope(q.transpose(0, 2, 1, 3), cos, sin)
+    k = apply_rope(k.transpose(0, 2, 1, 3), cos, sin)
+    v = v.transpose(0, 2, 1, 3)
+    ctx = flash_attention(q, k, v, causal=True)      # native GQA
+    ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, h)
+    x = x + ctx @ ap["wo"].astype(dtype)
+
+    m_in = rms_norm(x, block_params["ln_2"]["w"], config.rms_norm_eps)
+    mp = block_params["mlp"]
+    gate = jax.nn.silu(m_in @ mp["w_gate"].astype(dtype))
+    up = m_in @ mp["w_up"].astype(dtype)
+    return x + (gate * up) @ mp["w_down"].astype(dtype)
+
+
+def _llama_trunk(params, config: LlamaConfig, input_ids,
+                 dtype=jnp.bfloat16, remat: bool = False):
+    B, S = input_ids.shape
+    assert S <= config.max_position_embeddings, (
+        "sequence length exceeds max_position_embeddings — RoPE would "
+        "silently extrapolate", S, config.max_position_embeddings)
+    x = params["tok_emb"][input_ids].astype(dtype)
+    cos, sin = rope_cos_sin(S, config.head_dim, config.rope_theta)
+
+    block = llama_block
+    if remat:
+        block = jax.checkpoint(llama_block, static_argnums=(1, 5))
+
+    if config.scan_layers:
+        def body(x, lp):
+            return block(lp, config, x, cos, sin, dtype), None
+        x, _ = jax.lax.scan(body, x, params["h"])
+    else:
+        for i in range(config.num_layers):
+            x = block(params[f"h_{i}"], config, x, cos, sin, dtype)
+    return rms_norm(x, params["ln_f"]["w"], config.rms_norm_eps)
+
+
+def llama_forward(params, config: LlamaConfig, input_ids,
+                  dtype=jnp.bfloat16, remat: bool = False):
+    """Logits (B, S, vocab)."""
+    from deepspeed_tpu.models.gpt2 import _tied_logits
+    x = _llama_trunk(params, config, input_ids, dtype=dtype, remat=remat)
+    return _tied_logits(x, params["lm_head"], dtype)
+
+
+def llama_loss_fn(config: LlamaConfig, dtype=jnp.bfloat16,
+                  remat: bool = False, deterministic: bool = True):
+    """Engine-contract loss: batch = {'input_ids': (B, S+1) int32} —
+    next-token cross entropy via the chunked fused head. The family has
+    no dropout (llama recipe), so ``deterministic`` is accepted for
+    engine-contract parity and ignored."""
+    from deepspeed_tpu.models.gpt2 import _tied_xent_chunked
+
+    def loss_fn(params, batch, rng):
+        del rng
+        ids = batch["input_ids"]
+        inputs, targets = ids[:, :-1], ids[:, 1:]
+        x = _llama_trunk(params, config, inputs, dtype=dtype, remat=remat)
+        return _tied_xent_chunked(x, params["lm_head"], targets, dtype)
+    return loss_fn

@@ -103,9 +103,13 @@ def attention_reference(q, k, v, mask=None, causal=False,
                         dropout_rate: float = 0.0, dropout_seed=None):
     """Plain jnp attention. q,k,v: (B, H, S, D); mask: additive, broadcastable
     to (B, H, Sq, Sk). With dropout_rate > 0 applies the same hash keep-mask
-    the Pallas kernels use (seed: scalar)."""
+    the Pallas kernels use (seed: scalar). GQA: k/v may carry H/G heads."""
     if sm_scale is None:
         sm_scale = 1.0 / np.sqrt(q.shape[-1])
+    if k.shape[1] != q.shape[1]:
+        rep = q.shape[1] // k.shape[1]
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * sm_scale
     if mask is not None:
@@ -181,13 +185,16 @@ def _stream_kv_wait(k_ref, v_ref, kbuf, vbuf, ksem, vsem, i, row):
 
 
 def _fwd_kernel(*refs, sm_scale, block_k, causal, seq_k, block_q,
-                has_mask, dropout_rate, stream=False):
+                has_mask, dropout_rate, stream=False, q_per_kv=1):
     if stream:
         refs, (kbuf, vbuf, ksem, vsem) = refs[:-4], refs[-4:]
     q_ref, k_ref, v_ref, mask_ref, seed_ref, (o_ref, lse_ref) = \
         _unpack_refs(refs, has_mask, dropout_rate > 0.0, 2)
     bh = pl.program_id(0)
     qb = pl.program_id(1)
+    # GQA: q_per_kv consecutive q-head rows share one kv row (the dropout
+    # hash stays keyed on the q row, matching repeat-KV semantics)
+    kv_row = bh // q_per_kv if q_per_kv > 1 else bh
     # MXU fast path: bf16 operands, fp32 accumulation — converting K/V to
     # fp32 both halves the MXU rate and makes Mosaic keep full fp32 K/V
     # copies in VMEM (the S>=8k scoped-vmem blowup). Scale is applied to
@@ -204,7 +211,8 @@ def _fwd_kernel(*refs, sm_scale, block_k, causal, seq_k, block_q,
     if stream:
         @pl.when(num_kb > 0)
         def _prologue():
-            _stream_kv_start(k_ref, v_ref, kbuf, vbuf, ksem, vsem, 0, bh)
+            _stream_kv_start(k_ref, v_ref, kbuf, vbuf, ksem, vsem, 0,
+                             kv_row)
 
     def body(i, carry):
         m, l, acc = carry
@@ -212,10 +220,10 @@ def _fwd_kernel(*refs, sm_scale, block_k, causal, seq_k, block_q,
             @pl.when(i + 1 < num_kb)
             def _prefetch_next():
                 _stream_kv_start(k_ref, v_ref, kbuf, vbuf, ksem, vsem,
-                                 i + 1, bh)
+                                 i + 1, kv_row)
             # streamed tiles arrive transposed: k, v are (D, block)
             k, v = _stream_kv_wait(k_ref, v_ref, kbuf, vbuf, ksem, vsem,
-                                   i, bh)
+                                   i, kv_row)
         else:
             k = k_ref[0, pl.ds(i * block_k, block_k), :]
             v = v_ref[0, pl.ds(i * block_k, block_k), :]
@@ -258,7 +266,7 @@ def _fwd_kernel(*refs, sm_scale, block_k, causal, seq_k, block_q,
 
 
 def _bwd_dq_kernel(*refs, sm_scale, block_k, causal, seq_k, block_q,
-                   has_mask, dropout_rate, stream=False):
+                   has_mask, dropout_rate, stream=False, q_per_kv=1):
     if stream:
         refs, (kbuf, vbuf, ksem, vsem) = refs[:-4], refs[-4:]
     (q_ref, k_ref, v_ref, mask_ref, seed_ref,
@@ -266,6 +274,7 @@ def _bwd_dq_kernel(*refs, sm_scale, block_k, causal, seq_k, block_q,
         _unpack_refs(refs, has_mask, dropout_rate > 0.0, 4)
     bh = pl.program_id(0)
     qb = pl.program_id(1)
+    kv_row = bh // q_per_kv if q_per_kv > 1 else bh
     q = q_ref[0]                                           # (bq, d) bf16
     do = do_ref[0]
     lse = lse_ref[0, :, 0]
@@ -280,17 +289,18 @@ def _bwd_dq_kernel(*refs, sm_scale, block_k, causal, seq_k, block_q,
     if stream:
         @pl.when(num_kb > 0)
         def _prologue():
-            _stream_kv_start(k_ref, v_ref, kbuf, vbuf, ksem, vsem, 0, bh)
+            _stream_kv_start(k_ref, v_ref, kbuf, vbuf, ksem, vsem, 0,
+                             kv_row)
 
     def body(i, dq):
         if stream:
             @pl.when(i + 1 < num_kb)
             def _prefetch_next():
                 _stream_kv_start(k_ref, v_ref, kbuf, vbuf, ksem, vsem,
-                                 i + 1, bh)
+                                 i + 1, kv_row)
             # streamed tiles arrive transposed: k, v are (D, block)
             k, v = _stream_kv_wait(k_ref, v_ref, kbuf, vbuf, ksem, vsem,
-                                   i, bh)
+                                   i, kv_row)
         else:
             k = k_ref[0, pl.ds(i * block_k, block_k), :]
             v = v_ref[0, pl.ds(i * block_k, block_k), :]
@@ -522,18 +532,21 @@ def _seed_spec():
 def _flash_fwd(q, k, v, mask, causal, sm_scale, interpret,
                dropout_rate=0.0, seed=None):
     b, h, sq, d = q.shape
+    hkv = k.shape[1]
+    G = h // hkv       # GQA group size (1 = MHA); validated in the API
     sk = k.shape[2]
     bq, bk = _pick_blocks(sq, sk, d)
     assert sq % bq == 0 and sk % bk == 0, (sq, sk, bq, bk)
     qr = q.reshape(b * h, sq, d)
-    kr = k.reshape(b * h, sk, d)
-    vr = v.reshape(b * h, sk, d)
+    kr = k.reshape(b * hkv, sk, d)
+    vr = v.reshape(b * hkv, sk, d)
 
     stream = _use_stream(sq, sk)
     kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, block_k=bk,
                                causal=causal, seq_k=sk, block_q=bq,
                                has_mask=mask is not None,
-                               dropout_rate=dropout_rate, stream=stream)
+                               dropout_rate=dropout_rate, stream=stream,
+                               q_per_kv=G)
     if stream:
         # streamed operands live unblocked in HBM pre-tiled TRANSPOSED
         # to (row, n_blocks, D, block) so each DMA moves whole trailing
@@ -543,7 +556,8 @@ def _flash_fwd(q, k, v, mask, causal, sm_scale, interpret,
         vr = _stream_layout(vr, bk)
         kv_spec = pl.BlockSpec(memory_space=pltpu.HBM)
     else:
-        kv_spec = pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0))
+        # q-head row i reads its group's kv row (GQA: i // G)
+        kv_spec = pl.BlockSpec((1, sk, d), lambda i, j, G=G: (i // G, 0, 0))
     in_specs = [
         pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
         kv_spec,
@@ -594,6 +608,8 @@ def _flash_bwd(res, g, causal, sm_scale, interpret,
                dropout_rate=0.0):
     q, k, v, mask, seed, o, lse = res
     b, h, sq, d = q.shape
+    hkv = k.shape[1]
+    G = h // hkv
     sk = k.shape[2]
     bq, bk = _pick_blocks(sq, sk, d)
     do = g
@@ -601,8 +617,8 @@ def _flash_bwd(res, g, causal, sm_scale, interpret,
                     axis=-1)                               # (b,h,sq)
 
     qr = q.reshape(b * h, sq, d)
-    kr = k.reshape(b * h, sk, d)
-    vr = v.reshape(b * h, sk, d)
+    kr = k.reshape(b * hkv, sk, d)
+    vr = v.reshape(b * hkv, sk, d)
     dor = do.reshape(b * h, sq, d)
     lser = lse.reshape(b * h, sq, 1)
     deltar = delta.reshape(b * h, sq, 1)
@@ -618,12 +634,13 @@ def _flash_bwd(res, g, causal, sm_scale, interpret,
     kernel = functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, block_k=bk,
                                causal=causal, seq_k=sk, block_q=bq,
                                has_mask=mask is not None,
-                               dropout_rate=dropout_rate, stream=stream)
+                               dropout_rate=dropout_rate, stream=stream,
+                               q_per_kv=G)
     if stream:
         kv_spec = pl.BlockSpec(memory_space=pltpu.HBM)
         args = [qr, _stream_layout(kr, bk), _stream_layout(vr, bk)]
     else:
-        kv_spec = pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0))
+        kv_spec = pl.BlockSpec((1, sk, d), lambda i, j, G=G: (i // G, 0, 0))
         args = list(common)
     in_specs = [
         pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),   # q
@@ -677,8 +694,8 @@ def _flash_bwd(res, g, causal, sm_scale, interpret,
         args = list(common)
     in_specs = [
         q_spec,                                             # q (full)
-        pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),   # k block
-        pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),   # v block
+        pl.BlockSpec((1, bk, d), lambda i, j, G=G: (i // G, j, 0)),  # k
+        pl.BlockSpec((1, bk, d), lambda i, j, G=G: (i // G, j, 0)),  # v
     ]
     if mask is not None:
         in_specs.append(pl.BlockSpec((1, 1, sk), lambda i, j: (i // h, 0, 0)))
@@ -709,8 +726,13 @@ def _flash_bwd(res, g, causal, sm_scale, interpret,
             pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
-            jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
+            # GQA: keep the per-q-head partials fp32 so the group sum
+            # below really accumulates at fp32 (the in-kernel
+            # accumulators are fp32 either way)
+            jax.ShapeDtypeStruct((b * h, sk, d),
+                                 jnp.float32 if G > 1 else k.dtype),
+            jax.ShapeDtypeStruct((b * h, sk, d),
+                                 jnp.float32 if G > 1 else v.dtype),
         ],
         scratch_shapes=scratch_shapes,
         interpret=interpret,
@@ -718,8 +740,16 @@ def _flash_bwd(res, g, causal, sm_scale, interpret,
     )(*args)
 
     dq = dq.reshape(b, h, sq, d)
-    dk = dk.reshape(b, h, sk, d)
-    dv = dv.reshape(b, h, sk, d)
+    if G > 1:
+        # fp32 per-q-head partials -> kv-head grads. This materializes
+        # G x the final dk/dv in HBM for one fused reduction (simple,
+        # never worse than the MHA layout); an in-kernel G-accumulating
+        # grid over (b*hkv, sk//bk) would avoid it — future optimization
+        dk = dk.reshape(b, hkv, G, sk, d).sum(2).astype(k.dtype)
+        dv = dv.reshape(b, hkv, G, sk, d).sum(2).astype(v.dtype)
+    else:
+        dk = dk.reshape(b, h, sk, d)
+        dv = dv.reshape(b, h, sk, d)
     dmask = None if mask is None else jnp.zeros_like(mask)
     return dq, dk, dv, dmask
 
@@ -798,7 +828,12 @@ def flash_attention(q, k, v, mask=None, causal: bool = False,
                     force_reference: bool = False):
     """Flash attention with O(S) memory and in-kernel attention dropout.
 
-    q, k, v: (batch, heads, seq, head_dim).
+    q: (batch, heads, seq, head_dim); k, v: (batch, kv_heads, seq_k,
+    head_dim) with heads % kv_heads == 0 — kv_heads < heads is
+    grouped-query attention (GQA; kv_heads == 1 is MQA), served natively
+    by the kernels: each group of heads/kv_heads consecutive q heads
+    reads its shared K/V row via the block index map (resident) or the
+    DMA row select (streamed) — K/V are never materialized per q head.
     mask: optional *additive* key mask of shape (batch, 1, 1, seq_k)
     (BERT-style padding mask). For 2D masks use the reference path.
     dropout_rate: attention-probability dropout (reference
@@ -807,6 +842,9 @@ def flash_attention(q, k, v, mask=None, causal: bool = False,
     """
     if sm_scale is None:
         sm_scale = 1.0 / np.sqrt(q.shape[-1])
+    assert q.shape[1] % k.shape[1] == 0 and k.shape[1] == v.shape[1], (
+        "flash_attention: heads must be a multiple of kv_heads",
+        q.shape, k.shape, v.shape)
     if interpret is None:
         interpret = not _use_pallas()
     dropout_rate = float(dropout_rate)

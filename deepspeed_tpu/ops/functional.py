@@ -21,6 +21,15 @@ def layer_norm(x, w, b, eps: float = 1e-12):
     return (out * w.astype(jnp.float32) + b.astype(jnp.float32)).astype(x.dtype)
 
 
+def rms_norm(x, w, eps: float = 1e-6):
+    """RMSNorm in fp32 statistics (no mean subtraction, no bias), output
+    in input dtype — the pre-norm used by the llama model family."""
+    x32 = x.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    return (x32 * jax.lax.rsqrt(ms + eps) *
+            w.astype(jnp.float32)).astype(x.dtype)
+
+
 def _hash_keep_mask(seed32, n, rate: float):
     """lowbias32-style counter hash -> boolean keep mask of n elements.
 

@@ -383,3 +383,109 @@ class TestBertScanLayers:
         for _ in range(4):
             last = float(e.train_batch(iter([batch])))
         assert last < first
+
+    def test_sparse_attention_composes_with_scan(self):
+        """Model surgery (SparsityConfig attention swap) under the
+        scanned encoder matches the unrolled encoder."""
+        from deepspeed_tpu.models.bert import bert_encoder
+        from deepspeed_tpu.ops.sparse_attention import FixedSparsityConfig
+        cfg_u, cfg_s, pu, ps = self._pair()
+        sc = FixedSparsityConfig(num_heads=2, block=16,
+                                 num_local_blocks=2, num_global_blocks=1,
+                                 attention="bidirectional")
+        ids = np.random.RandomState(1).randint(
+            0, 128, (2, 64)).astype(np.int32)
+        ou = bert_encoder(pu, cfg_u, ids, deterministic=True,
+                          dtype=jnp.float32, sparsity_config=sc)
+        os_ = bert_encoder(ps, cfg_s, ids, deterministic=True,
+                           dtype=jnp.float32, sparsity_config=sc)
+        np.testing.assert_allclose(np.asarray(ou), np.asarray(os_),
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestLlama:
+    """Llama-style family: RoPE + RMSNorm + SwiGLU + native-GQA flash."""
+
+    CFG = None
+
+    def _cfg(self, **kw):
+        from deepspeed_tpu.models.llama import LlamaConfig
+        base = dict(vocab_size=256, hidden_size=64, num_layers=2,
+                    num_heads=4, num_kv_heads=2,
+                    max_position_embeddings=128)
+        base.update(kw)
+        return LlamaConfig(**base)
+
+    def test_rope_relative_position_property(self):
+        """Post-RoPE q·k depends only on the relative distance."""
+        from deepspeed_tpu.models.llama import apply_rope, rope_cos_sin
+        rng = np.random.RandomState(0)
+        qv = jnp.asarray(rng.randn(1, 1, 1, 32), jnp.float32)
+        kv = jnp.asarray(rng.randn(1, 1, 1, 32), jnp.float32)
+        S = 16
+        cos, sin = rope_cos_sin(S, 32, 10000.0)
+        q = apply_rope(jnp.broadcast_to(qv, (1, 1, S, 32)), cos, sin)
+        k = apply_rope(jnp.broadcast_to(kv, (1, 1, S, 32)), cos, sin)
+        # same relative offset d: q_i . k_{i-d} constant over i
+        scores = np.asarray(jnp.einsum("bhqd,bhkd->bhqk", q, k))[0, 0]
+        for d in (1, 3, 7):
+            diag = np.array([scores[i, i - d] for i in range(d, S)])
+            np.testing.assert_allclose(diag, diag[0], rtol=1e-5, atol=1e-5)
+        # rotation preserves norms
+        np.testing.assert_allclose(
+            np.asarray(jnp.linalg.norm(q, axis=-1)),
+            float(jnp.linalg.norm(qv)), rtol=1e-5)
+
+    def test_scan_matches_unrolled(self):
+        from deepspeed_tpu.models.llama import (init_llama_params,
+                                                llama_loss_fn)
+        cfg_u = self._cfg()
+        cfg_s = self._cfg(scan_layers=True)
+        pu = init_llama_params(cfg_u, jax.random.PRNGKey(3))
+        ps = init_llama_params(cfg_s, jax.random.PRNGKey(3))
+        ids = np.random.RandomState(0).randint(
+            0, 256, (2, 33)).astype(np.int32)
+        batch = {"input_ids": ids}
+        lu = llama_loss_fn(cfg_u, dtype=jnp.float32)
+        ls = llama_loss_fn(cfg_s, dtype=jnp.float32)
+        vu, gu = jax.value_and_grad(lu)(pu, batch, None)
+        vs, gs = jax.value_and_grad(ls)(ps, batch, None)
+        np.testing.assert_allclose(float(vu), float(vs), rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(gs["h"]["attn"]["wk"][1]),
+            np.asarray(gu["h_1"]["attn"]["wk"]), rtol=2e-5, atol=1e-6)
+
+    def test_gqa_tp_zero2_trains(self):
+        import deepspeed_tpu as ds
+        from deepspeed_tpu.models.llama import (init_llama_params,
+                                                llama_loss_fn,
+                                                llama_param_specs)
+        cfg = self._cfg()
+        params = init_llama_params(cfg, jax.random.PRNGKey(0))
+        lf = llama_loss_fn(cfg, dtype=jnp.float32)
+        ids = np.random.RandomState(0).randint(
+            0, 256, (8, 33)).astype(np.int32)
+        e, *_ = ds.initialize(
+            model=lf, model_parameters=params,
+            param_specs=llama_param_specs(cfg),
+            config={"train_micro_batch_size_per_gpu": 4,
+                    "optimizer": {"type": "Adam", "params": {"lr": 3e-3}},
+                    "zero_optimization": {"stage": 2},
+                    "steps_per_print": 10**9,
+                    "mesh": {"axes": {"data": 4, "model": 2}}})
+        losses = [float(e.train_batch(iter([{"input_ids": ids}])))
+                  for _ in range(12)]
+        assert losses[-1] < losses[0] - 0.5, losses
+
+    def test_remat_matches(self):
+        from deepspeed_tpu.models.llama import (init_llama_params,
+                                                llama_loss_fn)
+        cfg = self._cfg(scan_layers=True)
+        p = init_llama_params(cfg, jax.random.PRNGKey(1))
+        ids = np.random.RandomState(2).randint(
+            0, 256, (2, 17)).astype(np.int32)
+        batch = {"input_ids": ids}
+        v0 = float(llama_loss_fn(cfg, dtype=jnp.float32)(p, batch, None))
+        v1 = float(llama_loss_fn(cfg, dtype=jnp.float32, remat=True)(
+            p, batch, None))
+        np.testing.assert_allclose(v0, v1, rtol=1e-6)

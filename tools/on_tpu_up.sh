@@ -21,6 +21,7 @@ fi
 
 echo "== autotune block table (writes deepspeed_tpu/ops/attention/block_table.json)"
 timeout 3600 python tools/autotune_blocks.py 2>&1 | tee "$OUT/autotune.log"
+at_rc=$?
 
 echo "== bench ladder"
 # Remote compiles through the tunnel can be slow: give each metric child
@@ -30,5 +31,8 @@ BENCH_METRIC_TIMEOUT=${BENCH_METRIC_TIMEOUT:-2400} \
   timeout 14400 python bench.py 2> "$OUT/bench.err" | tee "$OUT/bench.jsonl"
 rc=$?
 
-echo "== done (bench rc=$rc); review $OUT and commit block_table.json + BENCH_NOTES update"
+echo "== done (autotune rc=$at_rc, bench rc=$rc); review $OUT and commit block_table.json + BENCH_NOTES update"
+# an autotune failure must not read as a complete round either (the
+# watcher re-arms; bench rows resume from the partial file on retry)
+[ "$rc" -eq 0 ] && rc=$at_rc
 exit $rc
