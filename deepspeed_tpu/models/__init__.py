@@ -6,5 +6,5 @@ from deepspeed_tpu.models.bert import (
     BertConfig, BERT_BASE, BERT_LARGE, bert_encoder, bert_mlm_loss_fn,
     bert_mlm_sp_loss_fn, bert_param_specs, init_bert_params)
 from deepspeed_tpu.models.llama import (
-    LlamaConfig, init_llama_params, llama_forward, llama_loss_fn,
-    llama_param_specs)
+    LlamaConfig, init_llama_params, llama_forward, llama_generate,
+    llama_loss_fn, llama_param_specs)

@@ -364,6 +364,24 @@ def gpt2_loss_fn(config: GPT2Config, dtype=jnp.bfloat16, remat: bool = False,
 # attention_fn hook (prefill captures K/V; decode attends to the cache)
 # — no second copy of the block math to drift.
 # --------------------------------------------------------------------- #
+def make_token_sampler(vocab_size: int, temperature: float, top_k: int,
+                       greedy: bool):
+    """Shared decode-step sampler (gpt2_generate / llama_generate): greedy
+    argmax, or temperature + optional top-k filtering + categorical. One
+    home so sampling semantics cannot drift between model families."""
+    eff_k = min(top_k, vocab_size)
+
+    def sample(logits, key):
+        if greedy:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        t = logits / jnp.maximum(temperature, 1e-6)
+        if eff_k > 0:
+            kth = jax.lax.top_k(t, eff_k)[0][:, -1][:, None]
+            t = jnp.where(t < kth, NEG_INF, t)
+        return jax.random.categorical(key, t, axis=-1).astype(jnp.int32)
+    return sample
+
+
 def _cached_attention(kcache, vcache, pos, out_box):
     """attention_fn for one decode step: write this position's K/V into
     the cache, attend the single query to all cached positions <= pos.
@@ -420,7 +438,8 @@ def gpt2_generate(params, config: GPT2Config, prompt_ids, max_new_tokens,
     hd = config.hidden_size // heads
     nl = config.num_layers
     greedy = rng is None or temperature == 0.0
-    eff_k = min(top_k, config.vocab_size)
+    sample = make_token_sampler(config.vocab_size, temperature, top_k,
+                                greedy)
 
     # ---- prefill: one full forward over the prompt through gpt2_block,
     # the attention hook capturing each layer's K/V into the cache
@@ -444,15 +463,6 @@ def gpt2_generate(params, config: GPT2Config, prompt_ids, max_new_tokens,
         vc = vc.at[i, :, :, :P].set(v.astype(dtype))
     x = _layer_norm(x, params["ln_f"], config.layer_norm_eps)
     last_logits = _tied_logits(x[:, -1:], params["wte"], dtype)[:, 0]
-
-    def sample(logits, key):
-        if greedy:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        logits = logits / jnp.maximum(temperature, 1e-6)
-        if eff_k > 0:
-            kth = jax.lax.top_k(logits, eff_k)[0][:, -1][:, None]
-            logits = jnp.where(logits < kth, NEG_INF, logits)
-        return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
     if rng is None:
         rng = jax.random.PRNGKey(0)

@@ -17,7 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from deepspeed_tpu.ops.attention.flash import flash_attention
+from deepspeed_tpu.ops.attention.flash import NEG_INF, flash_attention
 from deepspeed_tpu.ops.functional import rms_norm
 
 
@@ -151,7 +151,11 @@ def apply_rope(x, cos, sin):
     return jnp.concatenate([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1)
 
 
-def llama_block(block_params, config: LlamaConfig, x, cos, sin, dtype):
+def llama_block(block_params, config: LlamaConfig, x, cos, sin, dtype,
+                attention_fn=None):
+    """``attention_fn(q, k, v) -> ctx`` optionally replaces causal GQA
+    flash attention (q post-RoPE (B, H, S, hd); k/v (B, kv_heads, S,
+    hd), k post-RoPE) — the KV-cache decode hook."""
     B, S, h = x.shape
     H, hkv, hd = config.num_heads, config.kv_heads, config.head_dim
 
@@ -163,7 +167,10 @@ def llama_block(block_params, config: LlamaConfig, x, cos, sin, dtype):
     q = apply_rope(q.transpose(0, 2, 1, 3), cos, sin)
     k = apply_rope(k.transpose(0, 2, 1, 3), cos, sin)
     v = v.transpose(0, 2, 1, 3)
-    ctx = flash_attention(q, k, v, causal=True)      # native GQA
+    if attention_fn is not None:
+        ctx = attention_fn(q, k, v)
+    else:
+        ctx = flash_attention(q, k, v, causal=True)  # native GQA
     ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, h)
     x = x + ctx @ ap["wo"].astype(dtype)
 
@@ -185,15 +192,15 @@ def _llama_trunk(params, config: LlamaConfig, input_ids,
 
     block = llama_block
     if remat:
-        block = jax.checkpoint(llama_block, static_argnums=(1, 5))
+        block = jax.checkpoint(llama_block, static_argnums=(1, 5, 6))
 
     if config.scan_layers:
         def body(x, lp):
-            return block(lp, config, x, cos, sin, dtype), None
+            return block(lp, config, x, cos, sin, dtype, None), None
         x, _ = jax.lax.scan(body, x, params["h"])
     else:
         for i in range(config.num_layers):
-            x = block(params[f"h_{i}"], config, x, cos, sin, dtype)
+            x = block(params[f"h_{i}"], config, x, cos, sin, dtype, None)
     return rms_norm(x, params["ln_f"]["w"], config.rms_norm_eps)
 
 
@@ -203,6 +210,108 @@ def llama_forward(params, config: LlamaConfig, input_ids,
     from deepspeed_tpu.models.gpt2 import _tied_logits
     x = _llama_trunk(params, config, input_ids, dtype=dtype, remat=remat)
     return _tied_logits(x, params["lm_head"], dtype)
+
+
+def _gqa_cached_attention(kcache, vcache, pos, out_box):
+    """Decode-step attention hook: write this position's (post-RoPE) K/V
+    into the hkv-head cache, attend the single query group-wise to all
+    cached positions <= pos. The cache stays kv_heads-sized — the point
+    of GQA at inference. Updated caches return through ``out_box``."""
+    def attn(q, k, v):
+        kc = jax.lax.dynamic_update_slice(kcache, k.astype(kcache.dtype),
+                                          (0, 0, pos, 0))
+        vc = jax.lax.dynamic_update_slice(vcache, v.astype(vcache.dtype),
+                                          (0, 0, pos, 0))
+        out_box.append((kc, vc))
+        B, H, _, hd = q.shape
+        hkv = kc.shape[1]
+        qg = q[:, :, 0].reshape(B, hkv, H // hkv, hd)
+        scores = jnp.einsum("bkgd,bkld->bkgl", qg.astype(jnp.float32),
+                            kc.astype(jnp.float32)) / np.sqrt(hd)
+        valid = (jnp.arange(kc.shape[2]) <= pos)[None, None, None, :]
+        scores = jnp.where(valid, scores, NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1)
+        ctx = jnp.einsum("bkgl,bkld->bkgd", probs,
+                         vc.astype(jnp.float32))
+        return ctx.reshape(B, H, 1, hd).astype(q.dtype)
+    return attn
+
+
+def llama_generate(params, config: LlamaConfig, prompt_ids,
+                   max_new_tokens, rng=None, temperature: float = 1.0,
+                   top_k: int = 0, dtype=jnp.bfloat16):
+    """Autoregressive sampling with a kv_heads-sized KV cache (GQA's
+    inference payoff: cache memory is kv_heads/heads of the MHA cache).
+    Same contract as :func:`deepspeed_tpu.models.gpt2.gpt2_generate`;
+    decode is one ``lax.scan``."""
+    from deepspeed_tpu.models.gpt2 import (_tied_logits, layer_params,
+                                           make_token_sampler)
+    B, Pl = prompt_ids.shape
+    if max_new_tokens <= 0:
+        return prompt_ids
+    L = Pl + max_new_tokens
+    assert L <= config.max_position_embeddings, (
+        L, config.max_position_embeddings)
+    H, hkv, hd = config.num_heads, config.kv_heads, config.head_dim
+    nl = config.num_layers
+    greedy = rng is None or temperature == 0.0
+    sample = make_token_sampler(config.vocab_size, temperature, top_k,
+                                greedy)
+    cos_full, sin_full = rope_cos_sin(L, hd, config.rope_theta)
+
+    # ---- prefill: full forward over the prompt, capturing post-RoPE K/V
+    x = params["tok_emb"][prompt_ids].astype(dtype)
+    kc = jnp.zeros((nl, B, hkv, L, hd), dtype)
+    vc = jnp.zeros((nl, B, hkv, L, hd), dtype)
+    captured = {}
+
+    def capture_attn(i):
+        def attn(q, k, v):
+            captured[i] = (k, v)
+            return flash_attention(q, k, v, causal=True)
+        return attn
+
+    cos_p, sin_p = cos_full[:Pl], sin_full[:Pl]
+    for i in range(nl):
+        x = llama_block(layer_params(params, config, i), config, x,
+                        cos_p, sin_p, dtype, attention_fn=capture_attn(i))
+        k, v = captured.pop(i)
+        kc = kc.at[i, :, :, :Pl].set(k.astype(dtype))
+        vc = vc.at[i, :, :, :Pl].set(v.astype(dtype))
+    x = rms_norm(x, params["ln_f"]["w"], config.rms_norm_eps)
+    last_logits = _tied_logits(x[:, -1:], params["lm_head"], dtype)[:, 0]
+
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    first_tok = sample(last_logits, jax.random.fold_in(rng, 0))
+
+    def step(carry, t):
+        tok, kc, vc = carry
+        pos = Pl + t                      # position of `tok` in the stream
+        x = params["tok_emb"][tok[:, None]].astype(dtype)
+        cos_t = jax.lax.dynamic_slice_in_dim(cos_full, pos, 1, 0)
+        sin_t = jax.lax.dynamic_slice_in_dim(sin_full, pos, 1, 0)
+        new_kc, new_vc = [], []
+        for i in range(nl):
+            box = []
+            x = llama_block(layer_params(params, config, i), config, x,
+                            cos_t, sin_t, dtype,
+                            attention_fn=_gqa_cached_attention(
+                                kc[i], vc[i], pos, box))
+            ki, vi = box[0]
+            new_kc.append(ki)
+            new_vc.append(vi)
+        kc = jnp.stack(new_kc)
+        vc = jnp.stack(new_vc)
+        x = rms_norm(x, params["ln_f"]["w"], config.rms_norm_eps)
+        logits = _tied_logits(x, params["lm_head"], dtype)[:, 0]
+        nxt = sample(logits, jax.random.fold_in(rng, t + 1))
+        return (nxt, kc, vc), tok
+
+    (last, _, _), toks = jax.lax.scan(
+        step, (first_tok, kc, vc), jnp.arange(max_new_tokens - 1))
+    gen = jnp.concatenate([toks.T, last[:, None]], axis=1)
+    return jnp.concatenate([prompt_ids, gen], axis=1)
 
 
 def llama_loss_fn(config: LlamaConfig, dtype=jnp.bfloat16,

@@ -406,8 +406,6 @@ class TestBertScanLayers:
 class TestLlama:
     """Llama-style family: RoPE + RMSNorm + SwiGLU + native-GQA flash."""
 
-    CFG = None
-
     def _cfg(self, **kw):
         from deepspeed_tpu.models.llama import LlamaConfig
         base = dict(vocab_size=256, hidden_size=64, num_layers=2,
@@ -489,3 +487,25 @@ class TestLlama:
         v1 = float(llama_loss_fn(cfg, dtype=jnp.float32, remat=True)(
             p, batch, None))
         np.testing.assert_allclose(v0, v1, rtol=1e-6)
+
+    @pytest.mark.parametrize("scan", [False, True])
+    def test_generate_greedy_matches_full_forward(self, scan):
+        """KV-cache GQA decode == argmax over the full forward at every
+        step (the cache stays kv_heads-sized)."""
+        from deepspeed_tpu.models.llama import (init_llama_params,
+                                                llama_forward,
+                                                llama_generate)
+        cfg = self._cfg(scan_layers=scan)
+        p = init_llama_params(cfg, jax.random.PRNGKey(4))
+        prompt = np.random.RandomState(5).randint(
+            0, 256, (2, 5)).astype(np.int32)
+        out = np.asarray(llama_generate(p, cfg, prompt, 6,
+                                        dtype=jnp.float32))
+        assert out.shape == (2, 11)
+        seq = prompt
+        for t in range(6):
+            logits = llama_forward(p, cfg, jnp.asarray(seq),
+                                   dtype=jnp.float32)
+            nxt = np.asarray(jnp.argmax(logits[:, -1], axis=-1))
+            np.testing.assert_array_equal(out[:, 5 + t], nxt)
+            seq = np.concatenate([seq, nxt[:, None].astype(np.int32)], 1)
