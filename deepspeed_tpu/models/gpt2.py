@@ -382,6 +382,25 @@ def make_token_sampler(vocab_size: int, temperature: float, top_k: int,
     return sample
 
 
+def run_decode_scan(step_logits, sample, first_tok, caches,
+                    max_new_tokens, rng):
+    """Shared decode loop (gpt2_generate / llama_generate): one
+    ``lax.scan`` over ``step_logits(tok, t, caches) -> (logits, caches)``.
+    Owns the carry shape, the max_new_tokens-1 step count (`first_tok`
+    was already sampled from the prefill logits), and the
+    ``[toks.T | last]`` assembly — one home so the off-by-one contract
+    cannot drift between model families. Returns (B, max_new_tokens)."""
+    def step(carry, t):
+        tok, caches = carry
+        logits, caches = step_logits(tok, t, caches)
+        nxt = sample(logits, jax.random.fold_in(rng, t + 1))
+        return (nxt, caches), tok
+
+    (last, _), toks = jax.lax.scan(
+        step, (first_tok, caches), jnp.arange(max_new_tokens - 1))
+    return jnp.concatenate([toks.T, last[:, None]], axis=1)
+
+
 def _cached_attention(kcache, vcache, pos, out_box):
     """attention_fn for one decode step: write this position's K/V into
     the cache, attend the single query to all cached positions <= pos.
@@ -468,8 +487,8 @@ def gpt2_generate(params, config: GPT2Config, prompt_ids, max_new_tokens,
         rng = jax.random.PRNGKey(0)
     first_tok = sample(last_logits, jax.random.fold_in(rng, 0))
 
-    def step(carry, t):
-        tok, kc, vc = carry
+    def step_logits(tok, t, caches):
+        kc, vc = caches
         pos = P + t                       # position of `tok` in the stream
         x = (params["wte"][tok[:, None]]
              + params["wpe"][pos][None, None]).astype(dtype)
@@ -483,17 +502,12 @@ def gpt2_generate(params, config: GPT2Config, prompt_ids, max_new_tokens,
             ki, vi = box[0]
             new_kc.append(ki)
             new_vc.append(vi)
-        kc = jnp.stack(new_kc)
-        vc = jnp.stack(new_vc)
         x = _layer_norm(x, params["ln_f"], config.layer_norm_eps)
         logits = _tied_logits(x, params["wte"], dtype)[:, 0]
-        nxt = sample(logits, jax.random.fold_in(rng, t + 1))
-        return (nxt, kc, vc), tok
+        return logits, (jnp.stack(new_kc), jnp.stack(new_vc))
 
-    (last, _, _), toks = jax.lax.scan(
-        step, (first_tok, kc, vc), jnp.arange(max_new_tokens - 1))
-    # toks: (max_new_tokens-1, B) tokens at positions P..L-2; `last` is L-1
-    gen = jnp.concatenate([toks.T, last[:, None]], axis=1)
+    gen = run_decode_scan(step_logits, sample, first_tok, (kc, vc),
+                          max_new_tokens, rng)
     return jnp.concatenate([prompt_ids, gen], axis=1)
 
 

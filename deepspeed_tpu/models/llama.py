@@ -245,14 +245,15 @@ def llama_generate(params, config: LlamaConfig, prompt_ids,
     Same contract as :func:`deepspeed_tpu.models.gpt2.gpt2_generate`;
     decode is one ``lax.scan``."""
     from deepspeed_tpu.models.gpt2 import (_tied_logits, layer_params,
-                                           make_token_sampler)
+                                           make_token_sampler,
+                                           run_decode_scan)
     B, Pl = prompt_ids.shape
     if max_new_tokens <= 0:
         return prompt_ids
     L = Pl + max_new_tokens
     assert L <= config.max_position_embeddings, (
         L, config.max_position_embeddings)
-    H, hkv, hd = config.num_heads, config.kv_heads, config.head_dim
+    hkv, hd = config.kv_heads, config.head_dim
     nl = config.num_layers
     greedy = rng is None or temperature == 0.0
     sample = make_token_sampler(config.vocab_size, temperature, top_k,
@@ -285,8 +286,8 @@ def llama_generate(params, config: LlamaConfig, prompt_ids,
         rng = jax.random.PRNGKey(0)
     first_tok = sample(last_logits, jax.random.fold_in(rng, 0))
 
-    def step(carry, t):
-        tok, kc, vc = carry
+    def step_logits(tok, t, caches):
+        kc, vc = caches
         pos = Pl + t                      # position of `tok` in the stream
         x = params["tok_emb"][tok[:, None]].astype(dtype)
         cos_t = jax.lax.dynamic_slice_in_dim(cos_full, pos, 1, 0)
@@ -301,16 +302,12 @@ def llama_generate(params, config: LlamaConfig, prompt_ids,
             ki, vi = box[0]
             new_kc.append(ki)
             new_vc.append(vi)
-        kc = jnp.stack(new_kc)
-        vc = jnp.stack(new_vc)
         x = rms_norm(x, params["ln_f"]["w"], config.rms_norm_eps)
         logits = _tied_logits(x, params["lm_head"], dtype)[:, 0]
-        nxt = sample(logits, jax.random.fold_in(rng, t + 1))
-        return (nxt, kc, vc), tok
+        return logits, (jnp.stack(new_kc), jnp.stack(new_vc))
 
-    (last, _, _), toks = jax.lax.scan(
-        step, (first_tok, kc, vc), jnp.arange(max_new_tokens - 1))
-    gen = jnp.concatenate([toks.T, last[:, None]], axis=1)
+    gen = run_decode_scan(step_logits, sample, first_tok, (kc, vc),
+                          max_new_tokens, rng)
     return jnp.concatenate([prompt_ids, gen], axis=1)
 
 

@@ -122,3 +122,24 @@ def test_convergence_sp():
                         mesh={"axes": axes},
                         train_micro_batch_size_per_gpu=BATCH // 2))
     assert losses[-1] < THRESHOLD, losses[::10]
+
+
+def test_convergence_llama_gqa_tp():
+    """Llama family: GQA + RoPE + SwiGLU learns the affine map under
+    data x model TP with ZeRO-2 (scanned layer layout)."""
+    from deepspeed_tpu.models.llama import (LlamaConfig, init_llama_params,
+                                            llama_loss_fn,
+                                            llama_param_specs)
+    cfg = LlamaConfig(vocab_size=V, hidden_size=32, num_layers=2,
+                      num_heads=4, num_kv_heads=2,
+                      max_position_embeddings=SEQ + 1, scan_layers=True)
+    params = init_llama_params(cfg, jax.random.PRNGKey(0))
+    eng, *_ = ds.initialize(
+        model=llama_loss_fn(cfg, dtype=jnp.float32),
+        model_parameters=params, param_specs=llama_param_specs(cfg),
+        config=_base_config(zero_optimization={"stage": 2},
+                            mesh={"axes": {"data": 4, "model": 2}}))
+    rng = np.random.RandomState(0)
+    losses = [float(eng.train_batch(iter([_affine_batch(rng)])))
+              for _ in range(60)]
+    assert losses[-1] < THRESHOLD, losses[::10]

@@ -90,3 +90,10 @@ def test_bing_bert_sp_example(capsys):
     _run("examples/bing_bert/train.py", "--model", "tiny", "--mode", "sp",
          "--steps", "2", "--seq", "64", "--deepspeed_config", f.name)
     assert "done" in capsys.readouterr().out
+
+
+def test_llama_tp_example(capsys):
+    _run("examples/llama/train.py", "--mode", "tp", "--tiny",
+         "--scan-layers", "--steps", "4", "--generate", "4")
+    out = capsys.readouterr().out
+    assert "final loss" in out and "generated:" in out
