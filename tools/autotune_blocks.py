@@ -118,12 +118,41 @@ def time_combo(sq, sk, d, bq, bk, rtt, iters=None, heads=None):
         F._FORCE_BLOCKS = None
 
 
+def _merge_write(out_path, rows, backend):
+    """Merge-write the table keyed by shape class: entries measured in THIS
+    run replace same-shape entries, every other existing entry survives —
+    a sweep that dies mid-ladder (tunnel drop) must never erase the shapes
+    a previous window already paid for."""
+    if backend != "tpu":
+        return
+    existing = []
+    try:
+        with open(out_path) as f:
+            existing = json.load(f)
+    except (OSError, ValueError):
+        pass
+    key = lambda r: (r["seq_q"], r["seq_k"], r["d"], bool(r.get("stream")))
+    merged = {key(r): r for r in existing}
+    merged.update({key(r): r for r in rows})
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(sorted(merged.values(),
+                         key=lambda r: (r["seq_q"], r["seq_k"], r["d"])),
+                  f, indent=1)
+    os.replace(tmp, out_path)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=OUT)
     ap.add_argument("--iters", type=int, default=None,
                     help="override the per-shape scan length (debug only; "
                          "default: _shape_plan governs)")
+    ap.add_argument("--stall-timeout", type=int, default=1200,
+                    help="seconds without a completed combo before the "
+                         "watchdog flushes measured shapes and exits (a "
+                         "dead-tunnel fetch hangs in C++ where signals "
+                         "never run; cf. bench.py run_child)")
     args = ap.parse_args()
 
     import jax
@@ -136,6 +165,22 @@ def main():
     print(f"# rtt: {rtt*1e3:.2f} ms")
 
     rows = []
+    last_beat = [time.monotonic()]
+
+    def _watchdog():
+        import threading as _t  # noqa: F401  (thread module kept local)
+        while True:
+            time.sleep(30)
+            if time.monotonic() - last_beat[0] > args.stall_timeout:
+                print(f"# WATCHDOG: no combo finished in "
+                      f"{args.stall_timeout}s - flushing "
+                      f"{len(rows)} shapes and exiting", flush=True)
+                _merge_write(args.out, rows, backend)
+                os._exit(3)
+
+    import threading
+    threading.Thread(target=_watchdog, daemon=True).start()
+
     for sq, sk, d in SHAPES:
         stream = F._use_stream(sq, sk)
         combos = [
@@ -150,26 +195,30 @@ def main():
                 dt = time_combo(sq, sk, d, bq, bk, rtt, iters=args.iters)
                 results[(bq, bk)] = dt
                 print(f"S=({sq},{sk}) d={d} stream={stream} "
-                      f"bq={bq} bk={bk}: {dt*1e3:.2f} ms")
+                      f"bq={bq} bk={bk}: {dt*1e3:.2f} ms", flush=True)
             except Exception as e:  # combo may not compile (VMEM, Mosaic)
                 print(f"S=({sq},{sk}) d={d} bq={bq} bk={bk}: "
-                      f"FAILED {type(e).__name__}")
+                      f"FAILED {type(e).__name__}", flush=True)
+            last_beat[0] = time.monotonic()
         if not results:
             continue
         (bq, bk), dt = min(results.items(), key=lambda kv: kv[1])
         default = F._pick_blocks(sq, sk)   # heuristic, table not loaded
         print(f"--> best ({sq},{sk},{d}): bq={bq} bk={bk} "
-              f"{dt*1e3:.2f} ms (heuristic would pick {default})")
+              f"{dt*1e3:.2f} ms (heuristic would pick {default})",
+              flush=True)
         rows.append({"seq_q": sq, "seq_k": sk, "d": d, "stream": stream,
                      "bq": bq, "bk": bk, "ms": round(dt * 1e3, 3),
                      "backend": backend})
+        # incremental: each finished shape lands immediately, so a later
+        # tunnel drop costs only the in-flight shape
+        _merge_write(args.out, rows, backend)
 
     if backend != "tpu":
         print("# not on TPU - NOT writing the table")
         return
-    with open(args.out, "w") as f:
-        json.dump(rows, f, indent=1)
-    print(f"# wrote {len(rows)} entries to {args.out}")
+    _merge_write(args.out, rows, backend)
+    print(f"# wrote/merged {len(rows)} entries into {args.out}")
 
 
 if __name__ == "__main__":
