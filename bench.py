@@ -277,12 +277,18 @@ def bench_gpt2(on_tpu, rtt, dropout: float, metric: str):
     loss_fn = gpt2_loss_fn(cfg, dtype=jnp.bfloat16,
                            deterministic=(dropout == 0.0))
 
+    bf16_cfg = {"enabled": True}
+    if os.environ.get("BENCH_MASTER_FREE", "0") == "1":
+        # master-weight-free bf16 + stochastic rounding (docs/config.md):
+        # A/B the fp32-master-less update (no fp32 param copy to stream
+        # through HBM at the optimizer boundary; same compute path)
+        bf16_cfg.update(master_weights=False, stochastic_rounding=True)
     engine, *_ = deepspeed_tpu.initialize(
         model=loss_fn, model_parameters=params,
         config={
             "train_micro_batch_size_per_gpu": max(batch // n_dev, 1),
             "gradient_accumulation_steps": 1,
-            "bf16": {"enabled": True},
+            "bf16": bf16_cfg,
             "steps_per_print": 10**9,
             "zero_optimization": {"stage": 2 if n_dev > 1 else 0},
             "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},

@@ -105,3 +105,32 @@ def _mm_bf16_bwd(res, g):
 
 
 matmul_bf16_accum_fp32.defvjp(_mm_bf16_fwd, _mm_bf16_bwd)
+
+
+def stochastic_round_bf16(x, key):
+    """fp32 -> bf16 cast with stochastic rounding.
+
+    TPU-native realization of the reference's ``__STOCHASTIC_MODE__``
+    build variant (csrc stochastic-rounding kernels, setup.py:211-242 in
+    the reference): rounding direction is random with probability equal
+    to the remainder, so E[sr(x)] == x and sub-ulp optimizer updates
+    accumulate in expectation instead of being RNE-truncated to zero.
+    This is what makes master-weight-free bf16 training track fp32-master
+    quality (``bf16: {"master_weights": false}`` in the engine config).
+
+    Mechanics: bitcast fp32 to uint32, add a uniform 16-bit integer to
+    the low (truncated) mantissa bits, then keep the high 16 bits as the
+    bf16 pattern. The carry out of the low half implements round-up with
+    exactly remainder/2^16 probability; truncation otherwise rounds
+    down. Finite values above bf16's max finite may stochastically round
+    up to inf (their high half is at most 0x7F7F, so the +1 carry stops
+    at 0x7F80 = inf, never NaN-space); only non-finite inputs bypass SR
+    and take the plain RNE cast.
+    """
+    x = x.astype(jnp.float32)
+    bits = jax.lax.bitcast_convert_type(x, jnp.uint32)
+    noise = jax.random.bits(key, x.shape, jnp.uint32) & jnp.uint32(0xFFFF)
+    rounded = jax.lax.shift_right_logical(
+        bits + noise, jnp.uint32(16)).astype(jnp.uint16)
+    sr = jax.lax.bitcast_convert_type(rounded, jnp.bfloat16)
+    return jnp.where(jnp.isfinite(x), sr, x.astype(jnp.bfloat16))

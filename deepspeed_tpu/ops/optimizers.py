@@ -30,6 +30,19 @@ def _tree_zeros_like(params, dtype=None):
         lambda p: jnp.zeros_like(p, dtype=dtype or p.dtype), params)
 
 
+def _cast_out(new_p32, p, sr_key, i):
+    """fp32 update result -> param dtype. With ``sr_key`` set and a bf16
+    param (master-weight-free mode, engine config
+    ``bf16: {"master_weights": false}``) the cast uses stochastic rounding
+    so sub-ulp updates accumulate in expectation — the TPU-native analog
+    of the reference's ``__STOCHASTIC_MODE__`` kernels. ``i`` is the flat
+    leaf index, folded in so leaves see independent noise."""
+    if sr_key is not None and p.dtype == jnp.bfloat16:
+        from deepspeed_tpu.ops.functional import stochastic_round_bf16
+        return stochastic_round_bf16(new_p32, jax.random.fold_in(sr_key, i))
+    return new_p32.astype(p.dtype)
+
+
 class AdamState(NamedTuple):
     step: jnp.ndarray  # int32 scalar
     exp_avg: Params    # first moment
@@ -80,11 +93,13 @@ class Adam(Optimizer):
             exp_avg_sq=_tree_zeros_like(params, jnp.float32),
         )
 
-    def update(self, grads, state, params, lr=None, momentum=None):
+    def update(self, grads, state, params, lr=None, momentum=None,
+               sr_key=None):
         """``momentum``: optional (traced) beta1 override — the OneCycle
         momentum-cycling hook (reference lr_schedules.py:518 mutates
         param_groups betas every step; here the scheduled value flows
-        into the compiled update like the lr does)."""
+        into the compiled update like the lr does). ``sr_key``: PRNG key
+        enabling stochastic rounding of bf16 params (see _cast_out)."""
         lr = self.lr if lr is None else lr
         b1 = self.b1 if momentum is None else momentum
         step = state.step + 1
@@ -96,7 +111,7 @@ class Adam(Optimizer):
         else:
             bc1 = bc2 = jnp.float32(1.0)
 
-        def leaf(p, g, m, v):
+        def leaf(i, p, g, m, v):
             g = g.astype(jnp.float32)
             p32 = p.astype(jnp.float32)
             if wd != 0.0 and not self.adamw_mode:
@@ -108,14 +123,15 @@ class Adam(Optimizer):
             if wd != 0.0 and self.adamw_mode:
                 update = update + wd * p32  # decoupled (AdamW)
             new_p = p32 - lr * update
-            return new_p.astype(p.dtype), m, v
+            return _cast_out(new_p, p, sr_key, i), m, v
 
         flat_p, treedef = jax.tree_util.tree_flatten(params)
         flat_g = treedef.flatten_up_to(grads)
         flat_m = treedef.flatten_up_to(state.exp_avg)
         flat_v = treedef.flatten_up_to(state.exp_avg_sq)
-        out = [leaf(p, g, m, v)
-               for p, g, m, v in zip(flat_p, flat_g, flat_m, flat_v)]
+        out = [leaf(i, p, g, m, v)
+               for i, (p, g, m, v)
+               in enumerate(zip(flat_p, flat_g, flat_m, flat_v))]
         new_params = treedef.unflatten([o[0] for o in out])
         new_m = treedef.unflatten([o[1] for o in out])
         new_v = treedef.unflatten([o[2] for o in out])
@@ -137,24 +153,26 @@ class SGD(Optimizer):
             momentum_buf=_tree_zeros_like(params, jnp.float32),
         )
 
-    def update(self, grads, state, params, lr=None, momentum=None):
+    def update(self, grads, state, params, lr=None, momentum=None,
+               sr_key=None):
         lr = self.lr if lr is None else lr
         mu = self.momentum if momentum is None else momentum
         wd = self.weight_decay
 
-        def leaf(p, g, buf):
+        def leaf(i, p, g, buf):
             g = g.astype(jnp.float32)
             p32 = p.astype(jnp.float32)
             if wd != 0.0:
                 g = g + wd * p32
             buf = mu * buf + g
             d = (g + mu * buf) if self.nesterov else buf
-            return (p32 - lr * d).astype(p.dtype), buf
+            return _cast_out(p32 - lr * d, p, sr_key, i), buf
 
         flat_p, treedef = jax.tree_util.tree_flatten(params)
         flat_g = treedef.flatten_up_to(grads)
         flat_b = treedef.flatten_up_to(state.momentum_buf)
-        out = [leaf(p, g, b) for p, g, b in zip(flat_p, flat_g, flat_b)]
+        out = [leaf(i, p, g, b)
+               for i, (p, g, b) in enumerate(zip(flat_p, flat_g, flat_b))]
         return (treedef.unflatten([o[0] for o in out]),
                 SGDState(step=state.step + 1,
                          momentum_buf=treedef.unflatten([o[1] for o in out])))
@@ -187,7 +205,8 @@ class Lamb(Optimizer):
             exp_avg_sq=_tree_zeros_like(params, jnp.float32),
         )
 
-    def update(self, grads, state, params, lr=None, momentum=None):
+    def update(self, grads, state, params, lr=None, momentum=None,
+               sr_key=None):
         lr = self.lr if lr is None else lr
         b1 = self.b1 if momentum is None else momentum
         step = state.step + 1
@@ -198,7 +217,7 @@ class Lamb(Optimizer):
         else:
             bc1 = bc2 = jnp.float32(1.0)
 
-        def leaf(p, g, m, v):
+        def leaf(i, p, g, m, v):
             g = g.astype(jnp.float32)
             p32 = p.astype(jnp.float32)
             m = b1 * m + (1.0 - b1) * g
@@ -213,14 +232,15 @@ class Lamb(Optimizer):
                 jnp.clip(w_norm / u_norm, self.min_coeff, self.max_coeff),
                 jnp.float32(1.0))
             new_p = p32 - lr * trust * update
-            return new_p.astype(p.dtype), m, v, trust
+            return _cast_out(new_p, p, sr_key, i), m, v, trust
 
         flat_p, treedef = jax.tree_util.tree_flatten(params)
         flat_g = treedef.flatten_up_to(grads)
         flat_m = treedef.flatten_up_to(state.exp_avg)
         flat_v = treedef.flatten_up_to(state.exp_avg_sq)
-        out = [leaf(p, g, m, v)
-               for p, g, m, v in zip(flat_p, flat_g, flat_m, flat_v)]
+        out = [leaf(i, p, g, m, v)
+               for i, (p, g, m, v)
+               in enumerate(zip(flat_p, flat_g, flat_m, flat_v))]
         coeffs = [o[3] for o in out]
         if not any(isinstance(c, jax.core.Tracer) for c in coeffs):
             # only capture concrete values; under jit tracing the coeffs are

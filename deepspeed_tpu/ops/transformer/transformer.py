@@ -76,6 +76,12 @@ class DeepSpeedTransformerConfig:
         self.gelu_checkpoint = gelu_checkpoint
         self.attn_dropout_checkpoint = attn_dropout_checkpoint
         self.adjust_init_range = adjust_init_range
+        # In the reference, stochastic_mode selects the __STOCHASTIC_MODE__
+        # kernel build (stochastic-rounding fp16 ops, ~2% faster, run-to-run
+        # nondeterministic). Here rounding mode is an optimizer-boundary
+        # concern, not a kernel build flag: the engine-level
+        # ``bf16: {"master_weights": false, "stochastic_rounding": true}``
+        # config (docs/config.md) is the TPU-native equivalent.
         self.stochastic_mode = stochastic_mode
         self.huggingface = huggingface
         self.training = training

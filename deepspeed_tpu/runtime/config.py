@@ -45,6 +45,28 @@ def get_bf16_enabled(param_dict):
     return False
 
 
+def get_bf16_master_weights(param_dict):
+    if C.BF16 in param_dict:
+        return get_scalar_param(param_dict[C.BF16], C.BF16_MASTER_WEIGHTS,
+                                C.BF16_MASTER_WEIGHTS_DEFAULT)
+    return C.BF16_MASTER_WEIGHTS_DEFAULT
+
+
+def get_bf16_stochastic_rounding(param_dict):
+    if C.BF16 in param_dict:
+        return get_scalar_param(param_dict[C.BF16],
+                                C.BF16_STOCHASTIC_ROUNDING,
+                                C.BF16_STOCHASTIC_ROUNDING_DEFAULT)
+    return C.BF16_STOCHASTIC_ROUNDING_DEFAULT
+
+
+def get_bf16_sr_seed(param_dict):
+    if C.BF16 in param_dict:
+        return get_scalar_param(param_dict[C.BF16], C.BF16_SR_SEED,
+                                C.BF16_SR_SEED_DEFAULT)
+    return C.BF16_SR_SEED_DEFAULT
+
+
 def get_loss_scale(param_dict):
     if get_fp16_enabled(param_dict):
         return get_scalar_param(param_dict[C.FP16], C.FP16_LOSS_SCALE,
@@ -398,6 +420,10 @@ class DeepSpeedConfig:
         self.gradient_clipping = get_gradient_clipping(param_dict)
         self.fp16_enabled = get_fp16_enabled(param_dict)
         self.bf16_enabled = get_bf16_enabled(param_dict)
+        self.bf16_master_weights = get_bf16_master_weights(param_dict)
+        self.bf16_stochastic_rounding = \
+            get_bf16_stochastic_rounding(param_dict)
+        self.bf16_sr_seed = get_bf16_sr_seed(param_dict)
         self.loss_scale = get_loss_scale(param_dict)
         self.initial_dynamic_scale = get_initial_dynamic_scale(param_dict)
         self.dynamic_loss_scale_args = get_dynamic_loss_scale_args(param_dict)
@@ -492,8 +518,34 @@ class DeepSpeedConfig:
         if self.fp16_enabled and self.bf16_enabled:
             raise DeepSpeedConfigError(
                 "fp16 and bf16 cannot both be enabled; pick one")
+        if not self.bf16_master_weights:
+            if not self.bf16_enabled:
+                raise DeepSpeedConfigError(
+                    "bf16.master_weights=false requires bf16.enabled=true "
+                    "(params are held in bf16 end-to-end)")
+            if not self.bf16_stochastic_rounding:
+                raise DeepSpeedConfigError(
+                    "bf16.master_weights=false requires "
+                    "bf16.stochastic_rounding=true: RNE-cast bf16 updates "
+                    "silently drop sub-ulp steps (set it explicitly to "
+                    "acknowledge the rounding-mode change)")
+        if self.bf16_stochastic_rounding and not self.bf16_enabled:
+            raise DeepSpeedConfigError(
+                "bf16.stochastic_rounding=true requires bf16.enabled=true")
+        if not self.bf16_master_weights and self.zero_enabled and \
+                self.zero_config.cpu_offload:
+            raise DeepSpeedConfigError(
+                "bf16.master_weights=false contradicts ZeRO-Offload: the "
+                "offloaded host fp32 copy IS a master copy (drop one of "
+                "the two)")
 
     def _do_warning_check(self):
+        if self.bf16_stochastic_rounding and self.bf16_master_weights:
+            logger.warning(
+                "DeepSpeedConfig: bf16.stochastic_rounding has no effect "
+                "while master_weights=true (updates land on the fp32 "
+                "master); set bf16.master_weights=false for "
+                "master-weight-free bf16 training")
         fp16_enabled = self.fp16_enabled or self.zero_enabled
         vocabulary_size = get_scalar_param(self._param_dict, C.VOCABULARY_SIZE,
                                            C.VOCABULARY_SIZE_DEFAULT)

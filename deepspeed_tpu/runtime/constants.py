@@ -116,6 +116,17 @@ FP16_MIN_LOSS_SCALE_DEFAULT = 1
 BF16 = "bf16"
 BF16_ENABLED = "enabled"
 BF16_ENABLED_DEFAULT = False
+# Master-weight-free bf16: params held in bf16 end-to-end (no fp32
+# master copy — saves 4 bytes/param of HBM); requires stochastic
+# rounding so sub-ulp updates accumulate in expectation. The TPU-native
+# analog of the reference's __STOCHASTIC_MODE__ kernel build variant
+# (reference setup.py:211-242, transformer.py stochastic_mode flag).
+BF16_MASTER_WEIGHTS = "master_weights"
+BF16_MASTER_WEIGHTS_DEFAULT = True
+BF16_STOCHASTIC_ROUNDING = "stochastic_rounding"
+BF16_STOCHASTIC_ROUNDING_DEFAULT = False
+BF16_SR_SEED = "sr_seed"
+BF16_SR_SEED_DEFAULT = 0
 
 #############################################
 # Gradient clipping

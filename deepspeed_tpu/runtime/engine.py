@@ -162,6 +162,15 @@ class DeepSpeedEngine:
             self.compute_dtype = jnp.bfloat16
         else:
             self.compute_dtype = None  # fp32 end to end
+        # Master-weight-free bf16 (TPU-native analog of the reference's
+        # __STOCHASTIC_MODE__ kernels, setup.py:211-242 there): params are
+        # held in bf16 end-to-end — no fp32 master copy, saving 4
+        # bytes/param of HBM (and, at stage 3, halving the param
+        # all-gather bytes) — and the optimizer casts its fp32 update
+        # result back with stochastic rounding so sub-ulp steps
+        # accumulate in expectation instead of RNE-truncating to zero.
+        self.bf16_master_weights = self._config.bf16_master_weights
+        self.bf16_stochastic_rounding = self._config.bf16_stochastic_rounding
 
         if self.fp16_enabled:
             if self._config.loss_scale == 0:
@@ -266,6 +275,8 @@ class DeepSpeedEngine:
                 master_params, self.mesh, model_specs=param_specs)
 
         if self.zero_cpu_offload:
+            # (master_weights=false x cpu_offload is refused earlier, in
+            # DeepSpeedConfig._do_error_check)
             from deepspeed_tpu.ops.adam import DeepSpeedCPUAdam
             p = dict(self._config.optimizer_params or {})
             self.optimizer = DeepSpeedCPUAdam(
@@ -284,7 +295,25 @@ class DeepSpeedEngine:
             opt_state = ()
             self._opt_shardings = ()
         else:
-            params = master_params
+            if self.bf16_enabled and not self.bf16_master_weights:
+                assert not self._onebit, \
+                    "bf16.master_weights=false does not compose with " \
+                    "OnebitAdam (its error-feedback state assumes an " \
+                    "fp32-precision param target)"
+                try:
+                    accepts_sr = "sr_key" in inspect.signature(
+                        self.optimizer.update).parameters
+                except (TypeError, ValueError):
+                    accepts_sr = False
+                assert accepts_sr, \
+                    "bf16.master_weights=false needs an optimizer whose " \
+                    "update() accepts sr_key (the built-in Adam/SGD/Lamb " \
+                    "do); this one would silently RNE-truncate bf16 updates"
+                # params live in bf16; moments stay fp32 (Optimizer.init
+                # allocates them fp32 regardless of param dtype)
+                params = _tree_cast(master_params, jnp.bfloat16)
+            else:
+                params = master_params
             opt_state = self.optimizer.init(params)
             if self.zero_stage >= 1:
                 self._opt_shardings = zero_shardings(
@@ -1029,6 +1058,13 @@ class DeepSpeedEngine:
 
         lr = self._lr_at(state.global_step)
         mom = self._mom_at(state.global_step)
+        # master-weight-free bf16: per-step PRNG key for the stochastic
+        # rounding of the fp32 update result back into the bf16 params
+        sr_key = None
+        if self.bf16_enabled and not self.bf16_master_weights:
+            sr_key = jax.random.fold_in(
+                jax.random.PRNGKey(self._config.bf16_sr_seed),
+                state.global_step)
 
         def do_update(operand):
             params, opt_state, g = operand
@@ -1038,10 +1074,11 @@ class DeepSpeedEngine:
                 return self.optimizer.update(
                     g, opt_state, params, lr=lr,
                     compression=self._onebit_compression)
+            kw = {} if sr_key is None else {"sr_key": sr_key}
             if mom is not None:
                 return self.optimizer.update(g, opt_state, params, lr=lr,
-                                             momentum=mom)
-            return self.optimizer.update(g, opt_state, params, lr=lr)
+                                             momentum=mom, **kw)
+            return self.optimizer.update(g, opt_state, params, lr=lr, **kw)
 
         def skip_update(operand):
             params, opt_state, _ = operand

@@ -1,0 +1,200 @@
+"""Stochastic-rounding bf16 training (master-weight-free mode).
+
+TPU-native analog of the reference's ``__STOCHASTIC_MODE__`` kernel build
+variant (reference setup.py:211-242; ``stochastic_mode`` flag in
+ops/transformer/transformer.py there): params live in bf16 end-to-end (no
+fp32 master copy) and the optimizer's fp32 update result is cast back to
+bf16 with stochastic rounding, so sub-ulp updates accumulate in
+expectation instead of RNE-truncating to zero.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.ops.functional import stochastic_round_bf16
+from deepspeed_tpu.ops.optimizers import Adam, SGD
+from tests.unit.simple_model import (
+    base_config, init_simple_params, random_batches, simple_loss_fn)
+
+HIDDEN = 16
+
+
+def sr_config(**overrides):
+    cfg = base_config(
+        bf16={"enabled": True, "master_weights": False,
+              "stochastic_rounding": True})
+    cfg.update(overrides)
+    return cfg
+
+
+class TestSRPrimitive:
+
+    def test_exact_bf16_values_are_fixed_points(self):
+        x = jnp.array([1.0, -2.5, 0.0, -0.0, 384.0, 2.0 ** -100],
+                      jnp.float32)
+        for s in range(8):
+            out = stochastic_round_bf16(x, jax.random.PRNGKey(s))
+            assert (out == x.astype(jnp.bfloat16)).all()
+
+    def test_unbiased_between_grid_points(self):
+        # 1 + 2^-9: remainder is 1/4 of the bf16 ulp at 1.0 (2^-7), so
+        # E[sr(x)] == x and P(round up) == 0.25
+        x = jnp.full((40000,), 1.0 + 2 ** -9, jnp.float32)
+        out = stochastic_round_bf16(x, jax.random.PRNGKey(0))
+        mean = float(out.astype(jnp.float32).mean())
+        assert abs(mean - float(x[0])) < 3e-4
+        p_up = float((out.astype(jnp.float32) > 1.0).mean())
+        assert abs(p_up - 0.25) < 0.02
+
+    def test_nonfinite_passthrough(self):
+        x = jnp.array([np.inf, -np.inf, np.nan], jnp.float32)
+        out = stochastic_round_bf16(x, jax.random.PRNGKey(1))
+        assert jnp.isposinf(out[0]) and jnp.isneginf(out[1])
+        assert jnp.isnan(out[2])
+
+    def test_deterministic_for_fixed_key(self):
+        x = jax.random.normal(jax.random.PRNGKey(2), (256,), jnp.float32)
+        a = stochastic_round_bf16(x, jax.random.PRNGKey(3))
+        b = stochastic_round_bf16(x, jax.random.PRNGKey(3))
+        assert (a == b).all()
+
+
+class TestSROptimizer:
+    """The defining property: repeated sub-ulp updates move bf16 params
+    under SR (in expectation) but freeze under plain RNE casting."""
+
+    def _run_sgd(self, sr: bool, steps=400, delta=1e-3):
+        # bf16 ulp at 1.0 is 2^-7 = 7.8e-3; a 1e-3 step is sub-ulp, so an
+        # RNE cast of 1.0 - 1e-3... rounds back to 1.0 every single step.
+        opt = SGD(lr=1.0)
+        p = {"w": jnp.ones((64,), jnp.bfloat16)}
+        st = opt.init(p)
+        g = {"w": jnp.full((64,), delta, jnp.float32)}
+        for i in range(steps):
+            kw = {"sr_key": jax.random.PRNGKey(i)} if sr else {}
+            p, st = opt.update(g, st, p, **kw)
+        return float(np.mean(np.asarray(p["w"], np.float32)))
+
+    def test_rne_freezes_sub_ulp_updates(self):
+        assert self._run_sgd(sr=False) == 1.0
+
+    def test_sr_accumulates_sub_ulp_updates(self):
+        final = self._run_sgd(sr=True)
+        # expected drift: 400 steps * 1e-3 = 0.4 -> ~0.6
+        assert final < 0.8, final
+        assert abs(final - 0.6) < 0.1, final
+
+    def test_adam_sr_matches_fp32_reference_in_expectation(self):
+        # one Adam step from identical state: the SR bf16 result must be
+        # an unbiased rounding of the fp32 result
+        opt = Adam(lr=1e-2)
+        key = jax.random.PRNGKey(0)
+        w32 = jax.random.normal(key, (4096,), jnp.float32)
+        g = {"w": jax.random.normal(jax.random.fold_in(key, 1), (4096,),
+                                    jnp.float32)}
+        p32, _ = opt.update(g, opt.init({"w": w32}), {"w": w32})
+        pbf = {"w": w32.astype(jnp.bfloat16)}
+        acc = np.zeros((4096,), np.float64)
+        n = 32
+        for i in range(n):
+            out, _ = opt.update(g, opt.init(pbf), pbf,
+                                sr_key=jax.random.PRNGKey(100 + i))
+            acc += np.asarray(out["w"], np.float64)
+        mean_sr = acc / n
+        ref = np.asarray(p32["w"], np.float64)
+        # mean over keys approaches the fp32 target much tighter than one
+        # bf16 ulp (~2^-8 relative)
+        err = np.abs(mean_sr - ref).mean()
+        scale = np.abs(ref).mean()
+        assert err < 1.5e-3 * max(scale, 1.0), (err, scale)
+
+
+class TestSREngine:
+
+    def test_params_are_bf16_no_fp32_master(self):
+        params = init_simple_params(jax.random.PRNGKey(0), HIDDEN)
+        engine, *_ = deepspeed_tpu.initialize(
+            model=simple_loss_fn, model_parameters=params,
+            config=sr_config())
+        leaves = jax.tree_util.tree_leaves(engine.state.params)
+        assert all(l.dtype == jnp.bfloat16 for l in leaves)
+        # moments stay fp32
+        m_leaves = jax.tree_util.tree_leaves(engine.state.opt_state.exp_avg)
+        assert all(l.dtype == jnp.float32 for l in m_leaves)
+
+    def test_loss_decreases_master_free(self):
+        params = init_simple_params(jax.random.PRNGKey(0), HIDDEN)
+        engine, *_ = deepspeed_tpu.initialize(
+            model=simple_loss_fn, model_parameters=params,
+            config=sr_config())
+        batches = random_batches(30, 16, HIDDEN)
+        it = iter(batches)
+        losses = [float(engine.train_batch(it)) for _ in range(30)]
+        assert losses[-1] < losses[0] * 0.7, losses
+
+    def test_master_free_tracks_fp32_master_loss(self):
+        """Same data, same init: the master-free bf16 run's final loss must
+        stay close to the fp32-master bf16 run's (the whole point of SR)."""
+        def run(cfg):
+            params = init_simple_params(jax.random.PRNGKey(0), HIDDEN)
+            engine, *_ = deepspeed_tpu.initialize(
+                model=simple_loss_fn, model_parameters=params, config=cfg)
+            it = iter(random_batches(40, 16, HIDDEN))
+            return [float(engine.train_batch(it)) for _ in range(40)]
+
+        ref = run(base_config(bf16={"enabled": True}))
+        mf = run(sr_config())
+        assert mf[-1] < ref[-1] * 1.5 + 1e-3, (ref[-1], mf[-1])
+
+    def test_zero2_composition(self):
+        params = init_simple_params(jax.random.PRNGKey(0), HIDDEN)
+        engine, *_ = deepspeed_tpu.initialize(
+            model=simple_loss_fn, model_parameters=params,
+            config=sr_config(zero_optimization={"stage": 2}))
+        it = iter(random_batches(20, 16, HIDDEN))
+        losses = [float(engine.train_batch(it)) for _ in range(20)]
+        assert losses[-1] < losses[0] * 0.8, losses
+        leaves = jax.tree_util.tree_leaves(engine.state.params)
+        assert all(l.dtype == jnp.bfloat16 for l in leaves)
+
+    def test_checkpoint_roundtrip_keeps_bf16(self, tmp_path):
+        params = init_simple_params(jax.random.PRNGKey(0), HIDDEN)
+        engine, *_ = deepspeed_tpu.initialize(
+            model=simple_loss_fn, model_parameters=params,
+            config=sr_config())
+        it = iter(random_batches(5, 16, HIDDEN))
+        for _ in range(5):
+            engine.train_batch(it)
+        engine.save_checkpoint(str(tmp_path), tag="sr")
+        params2 = init_simple_params(jax.random.PRNGKey(1), HIDDEN)
+        engine2, *_ = deepspeed_tpu.initialize(
+            model=simple_loss_fn, model_parameters=params2,
+            config=sr_config())
+        engine2.load_checkpoint(str(tmp_path), tag="sr")
+        a = jax.tree_util.tree_leaves(engine.state.params)
+        b = jax.tree_util.tree_leaves(engine2.state.params)
+        for x, y in zip(a, b):
+            assert x.dtype == jnp.bfloat16 and y.dtype == jnp.bfloat16
+            assert (np.asarray(x) == np.asarray(y)).all()
+
+    def test_config_validation(self):
+        params = init_simple_params(jax.random.PRNGKey(0), HIDDEN)
+        from deepspeed_tpu.runtime.config import DeepSpeedConfigError
+        with pytest.raises(DeepSpeedConfigError):
+            deepspeed_tpu.initialize(
+                model=simple_loss_fn, model_parameters=params,
+                config=base_config(
+                    bf16={"enabled": True, "master_weights": False}))
+        with pytest.raises(DeepSpeedConfigError):
+            deepspeed_tpu.initialize(
+                model=simple_loss_fn, model_parameters=params,
+                config=base_config(
+                    bf16={"enabled": False, "stochastic_rounding": True}))
+        with pytest.raises(DeepSpeedConfigError):
+            deepspeed_tpu.initialize(
+                model=simple_loss_fn, model_parameters=params,
+                config=sr_config(
+                    zero_optimization={"stage": 2, "cpu_offload": True}))
