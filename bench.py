@@ -373,37 +373,51 @@ def run_child(metric):
 
 
 def _git_head():
-    """Resume key: HEAD commit + a digest of any uncommitted changes —
-    a dirty-tree edit must invalidate checkpointed rows (they measured
-    the pre-edit code)."""
+    """Resume key: a digest of the sources that determine the measured
+    numbers — bench.py itself plus everything importable from the
+    package (py/json/cpp/h under deepspeed_tpu/ and csrc/, setup.py).
+    Edits to tests/docs/examples/notes do NOT invalidate checkpointed
+    rows (they cannot change a measurement); any edit to benchmarked
+    code does, whether committed or not."""
     import hashlib
     repo = os.path.dirname(os.path.abspath(__file__))
+    # sources only, never build artifacts: the runtime-built .so would
+    # make the key unstable (rebuilt on import), and its inputs (.cpp/.h
+    # + Makefile flags) are what actually determine the measurement
+    exts = (".py", ".json", ".cpp", ".cc", ".h")
+    names = ("Makefile",)
+    roots = ["bench.py", "setup.py", "deepspeed_tpu", "csrc"]
     try:
-        head = subprocess.run(
-            ["git", "rev-parse", "HEAD"], capture_output=True, text=True,
-            cwd=repo, timeout=10).stdout.strip()
-        if not head:
-            return None
-        diff = subprocess.run(
-            ["git", "diff", "HEAD"], capture_output=True, text=True,
-            cwd=repo, timeout=30).stdout
-        # untracked files count too: a new module imported by the
-        # benchmarked code must invalidate checkpointed rows
-        h = hashlib.sha256(diff.encode())
-        untracked = subprocess.run(
-            ["git", "ls-files", "--others", "--exclude-standard"],
-            capture_output=True, text=True, cwd=repo, timeout=30
-        ).stdout.split()
-        for f in sorted(untracked):
-            h.update(f.encode())
-            try:
-                with open(os.path.join(repo, f), "rb") as fh:
-                    h.update(fh.read())
-            except OSError:
-                pass
-        if diff or untracked:
-            head += "+" + h.hexdigest()[:12]
-        return head
+        h = hashlib.sha256()
+        for root in roots:
+            path = os.path.join(repo, root)
+            if os.path.isfile(path):
+                files = [path]
+            else:
+                files = []
+                for dirpath, dirnames, filenames in os.walk(path):
+                    dirnames[:] = [d for d in dirnames
+                                   if d != "__pycache__"]
+                    files.extend(os.path.join(dirpath, f)
+                                 for f in filenames
+                                 if f.endswith(exts) or f in names)
+            for f in sorted(files):
+                try:
+                    with open(f, "rb") as fh:
+                        content = fh.read()
+                except OSError:
+                    continue   # racing writer/deleter; skip, stay stable
+                h.update(os.path.relpath(f, repo).encode())
+                h.update(content)
+        # measurement-config env knobs (BENCH_SCAN_LAYERS, BENCH_MASTER_FREE,
+        # future ones) change what a row measures and must invalidate it;
+        # control knobs (timeouts/paths/retries/resume) must not
+        control = {"BENCH_PARTIAL", "BENCH_METRIC_TIMEOUT",
+                   "BENCH_METRIC_RETRIES", "BENCH_NO_RESUME"}
+        for k in sorted(os.environ):
+            if k.startswith("BENCH_") and k not in control:
+                h.update(f"{k}={os.environ[k]}".encode())
+        return "src-" + h.hexdigest()[:16]
     except Exception:
         return None
 

@@ -502,8 +502,11 @@ def _load_block_table():
             with open(path) as f:
                 for e in json.load(f):
                     key = (e["seq_q"], e["seq_k"], e["d"], bool(e["stream"]))
+                    # ms <= 0 is an RTT-subtraction artifact from an old
+                    # sweep harness, never a real measurement — skip it
                     if e["seq_q"] % e["bq"] == 0 and \
-                            e["seq_k"] % e["bk"] == 0:
+                            e["seq_k"] % e["bk"] == 0 and \
+                            e.get("ms", 1.0) > 0.0:
                         table[key] = (e["bq"], e["bk"])
         except (OSError, ValueError, KeyError):
             pass

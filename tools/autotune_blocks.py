@@ -94,24 +94,52 @@ def time_combo(sq, sk, d, bq, bk, rtt, iters=None, heads=None):
     # N sequential grad evals in ONE dispatch: the tiny dq-feedback into q
     # chains the iterations so XLA cannot hoist the loop-invariant work,
     # and the tunnel's per-call latency is paid once, not N times.
-    def many(q, k, v):
-        def body(carry, _):
-            q, k, v = carry
-            dq, dk, dv = grad_fn(q, k, v)
-            return (q + 1e-6 * dq, k + 1e-6 * dk, v + 1e-6 * dv), ()
-        (q, k, v), _ = lax.scan(body, (q, k, v), None, length=n)
-        return jnp.sum(q.astype(jnp.float32))
+    def build(length):
+        def many(q, k, v):
+            def body(carry, _):
+                q, k, v = carry
+                dq, dk, dv = grad_fn(q, k, v)
+                return (q + 1e-6 * dq, k + 1e-6 * dk, v + 1e-6 * dv), ()
+            (q, k, v), _ = lax.scan(body, (q, k, v), None, length=length)
+            return jnp.sum(q.astype(jnp.float32))
+        return jax.jit(many)
 
     F._FORCE_BLOCKS = (bq, bk)
     try:
-        g = jax.jit(many)
-        np.asarray(g(q, k, v))   # compile + settle
-        best = None
-        for _ in range(3):
+        # A window must dwarf the tunnel's RTT jitter or the subtraction
+        # is noise (a 20 ms scan against 66 ms RTT once "measured" 0.00 ms
+        # and poisoned the table). Rescale n until one window clears the
+        # floor; a combo that can't clear it is FAILED, never ~0.
+        floor = max(8.0 * rtt, 0.25)
+        w = None
+        for _ in range(4):
+            g = build(n)
+            np.asarray(g(q, k, v))   # compile + settle
             t0 = time.perf_counter()
             np.asarray(g(q, k, v))
-            w = max(time.perf_counter() - t0 - rtt, 1e-9) / n
-            best = w if best is None else min(best, w)
+            w = time.perf_counter() - t0 - rtt
+            if w >= floor:
+                break
+            if w > 0.5 * rtt:
+                # trustworthy-enough window: grow by the measured ratio
+                factor = int(np.ceil(floor / w * 1.5))
+            else:
+                # jitter swallowed the window (w ~ 0 or negative): the
+                # ratio would explode (a -5 ms reading once implied a
+                # 792x jump); grow geometrically instead
+                factor = 8
+            n *= min(max(factor, 2), 64)
+        else:
+            raise RuntimeError(
+                f"window {w*1e3:.1f} ms never cleared the {floor*1e3:.0f} ms "
+                f"RTT-noise floor at n={n}")
+        best = w / n
+        for _ in range(2):
+            t0 = time.perf_counter()
+            np.asarray(g(q, k, v))
+            w = time.perf_counter() - t0 - rtt
+            if w >= floor:
+                best = min(best, w / n)
         # normalize to the old (1, 8, S) work unit so tables stay comparable
         return best * 8.0 / (batch * h)
     finally:
