@@ -317,6 +317,62 @@ _FN_CACHE = {}
 USE_SPLASH_V2 = True
 _WARNED_V1_BLOCK = False
 
+# layout coarsening (blocksparse_v2.build_coarse_index): walk coarse
+# tiles, express fine structure as streamed NEG_INF mask tiles. Auto by
+# cost model; _FORCE_COARSE_BLOCK: None = auto, 0 = off, N = force N.
+USE_COARSE = True
+_FORCE_COARSE_BLOCK = None
+_COARSE_TILE_BUDGET = 256 * 2 ** 20   # bytes of unique (CB, CB) tiles
+
+
+def _iter_cost_us(blk):
+    """Empirical per-inner-iteration cost (v5e, 2026-07-31 ladder): a
+    ~2us fixed floor (DMA latency + loop/VPU epilogue) plus ~22us of
+    MXU+VPU work at a 512-wide tile, linear in tile width below that.
+    Only RATIOS matter — this picks between walking many fine tiles and
+    fewer coarse tiles with masked lanes."""
+    return 2.0 + 22.0 * (blk / 512.0)
+
+
+def _pick_coarse_block(layout: np.ndarray, block: int, has_am: bool):
+    """Choose a coarse walk-tile size (or None): coarsening must beat the
+    fine walk's modeled cost by >10% and keep the unique mask tiles under
+    the HBM budget. Fine blocks that v2 cannot stream (block % 128 != 0)
+    are costed at the v1 per-triple launch overhead (~30us/block), which
+    coarsening almost always beats."""
+    if not USE_COARSE:
+        return None
+    if _FORCE_COARSE_BLOCK is not None:
+        cb = _FORCE_COARSE_BLOCK
+        if not cb:
+            return None
+        H, nq, nk = layout.shape
+        assert cb > block and cb % block == 0 and cb % 128 == 0 and \
+            (nq * block) % cb == 0 and (nk * block) % cb == 0, (
+                f"_FORCE_COARSE_BLOCK={cb} incompatible with block={block}, "
+                f"S=({nq * block},{nk * block})")
+        return cb
+    from deepspeed_tpu.ops.sparse_attention.blocksparse_v2 import (
+        build_coarse_index)
+    H, nq, nk = layout.shape
+    nnz_f = int(np.count_nonzero(layout))
+    fine_cost = nnz_f * (_iter_cost_us(block) if block % 128 == 0
+                         else 30.0)
+    best = None
+    for cb in (512, 256):
+        if cb <= block or cb % block or (nq * block) % cb or \
+                (nk * block) % cb:
+            continue
+        nnz_c, n_unique = build_coarse_index(layout, block, cb,
+                                             per_coord=has_am,
+                                             count_only=True)
+        if n_unique * cb * cb * 4 > _COARSE_TILE_BUDGET:
+            continue
+        cost = nnz_c * _iter_cost_us(cb)
+        if cost < fine_cost * 0.9 and (best is None or cost < best[0]):
+            best = (cost, cb)
+    return best[1] if best else None
+
 
 def _use_pallas():
     try:
@@ -332,14 +388,19 @@ def _sparse_attention_fn(layout: np.ndarray, block: int, sm_scale: float,
     pre-blocked additive (nq, nk, block, block) mask. Nonzero-block triples
     are closed over as static data and fed to Mosaic via scalar prefetch."""
     key = (layout.shape, layout.tobytes(), block, float(sm_scale), has_am,
-           interpret)
+           interpret, USE_SPLASH_V2, USE_COARSE, _FORCE_COARSE_BLOCK,
+           _COARSE_TILE_BUDGET)
     if key in _FN_CACHE:
         return _FN_CACHE[key]
 
     H, nq, nk = layout.shape
-    use_v2 = USE_SPLASH_V2 and (interpret or block % 128 == 0)
+    coarse_block = (_pick_coarse_block(layout, block, has_am)
+                    if USE_SPLASH_V2 else None)
+    use_v2 = USE_SPLASH_V2 and (interpret or block % 128 == 0
+                                or coarse_block is not None)
     if not use_v2 and USE_SPLASH_V2 and not interpret:
-        # v2 wanted but the block width can't be a DMA lane dim
+        # v2 wanted but the block width can't be a DMA lane dim and no
+        # coarse walk tile fits either
         global _WARNED_V1_BLOCK
         if not _WARNED_V1_BLOCK:
             _WARNED_V1_BLOCK = True
@@ -347,20 +408,24 @@ def _sparse_attention_fn(layout: np.ndarray, block: int, sm_scale: float,
             warnings.warn(
                 f"block_sparse_attention: block={block} is not a multiple "
                 "of 128, so the fast row-run (splash v2) kernels cannot "
-                "stream it by DMA on TPU — falling back to the per-triple "
-                "v1 kernels (~row-degree x more program launches). Use "
-                "block=128 for long-sequence performance.", stacklevel=3)
+                "stream it by DMA on TPU, and no coarse walk tile divides "
+                "the sequence — falling back to the per-triple v1 kernels "
+                "(~row-degree x more program launches). Use 128-multiple "
+                "blocks (or 512-divisible sequences) for long-sequence "
+                "performance.", stacklevel=3)
     if use_v2:
         # row-run kernels: one program per block row, K/V (and the
         # deduped attn-mask tiles) streamed by DMA (blocksparse_v2.py)
         # — ~row-degree x fewer program launches. Compiled mode needs
-        # 128-multiple blocks: a streamed tile puts the block width in
-        # the DMA lane dim, which Mosaic requires to be 128-aligned;
-        # smaller blocks use the v1 kernels
+        # 128-multiple WALK blocks: a streamed tile puts the block width
+        # in the DMA lane dim, which Mosaic requires to be 128-aligned.
+        # When the cost model picked a coarse walk tile, the fine layout
+        # (any block size) rides the streamed-mask channel instead.
         from deepspeed_tpu.ops.sparse_attention.blocksparse_v2 import (
             build_v2_impls)
         fwd2, bwd2 = build_v2_impls(layout, block, sm_scale, interpret,
-                                    has_am=has_am)
+                                    has_am=has_am,
+                                    coarse_block=coarse_block)
 
         if has_am:
             @jax.custom_vjp

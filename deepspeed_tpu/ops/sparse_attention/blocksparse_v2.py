@@ -324,27 +324,118 @@ def _v2_dkv_kernel(*refs, sm_scale, block, heads, nk, has_am):
 
 
 # --------------------------------------------------------------------- #
+# layout coarsening: trade masked FLOPs for per-iteration efficiency
+# --------------------------------------------------------------------- #
+def build_coarse_index(fine_layout: np.ndarray, fine_block: int,
+                       coarse_block: int, per_coord: bool,
+                       count_only: bool = False):
+    """Coarsen a fine block layout to ``coarse_block`` tiles, expressing
+    the fine structure as additive NEG_INF mask tiles streamed through
+    the existing attn-mask DMA channel (masked entries produce exact-zero
+    probabilities — bit-identical to walking the fine blocks).
+
+    Tiles are deduplicated by CONTENT of the (f, f) fine-bit pattern
+    (banded layouts like BSLongformer collapse to a handful of uniques);
+    with ``per_coord`` (a user attention mask must be folded in per
+    coordinate) the key also includes (R, C). Returns
+    (coarse_layout, tiles, csr_uids, csc_uids, qrows, kcols); with
+    ``count_only`` returns just (coarse_nnz, n_unique) for cost/memory
+    planning without materializing anything."""
+    H, nqf, nkf = fine_layout.shape
+    f = coarse_block // fine_block
+    nqc, nkc = nqf // f, nkf // f
+    fine = fine_layout.astype(bool)
+    coarse = fine.reshape(H, nqc, f, nkc, f).any(axis=(2, 4))
+
+    pat_of = {}
+    pats, coords = [], []
+
+    def uid_for(h, R, C):
+        patt = np.ascontiguousarray(fine[h, R * f:(R + 1) * f,
+                                         C * f:(C + 1) * f])
+        key = patt.tobytes() + (b"|%d,%d" % (R, C) if per_coord else b"")
+        uid = pat_of.get(key)
+        if uid is None:
+            uid = len(pats)
+            pat_of[key] = uid
+            pats.append(patt)
+            coords.append((R, C))
+        return uid
+
+    csr, csc = [], []
+    for h in range(H):
+        for R in range(nqc):
+            for C in np.nonzero(coarse[h, R])[0]:
+                csr.append(uid_for(h, R, int(C)))
+    if count_only:
+        return len(csr), len(pats)
+    for h in range(H):
+        for C in range(nkc):
+            for R in np.nonzero(coarse[h, :, C])[0]:
+                csc.append(uid_for(h, int(R), C))
+
+    b = fine_block
+    ones = np.ones((b, b), bool)
+    tiles = np.stack([np.where(np.kron(p, ones), 0.0, NEG_INF)
+                      for p in pats]).astype(np.float32) \
+        if pats else np.zeros((1, coarse_block, coarse_block), np.float32)
+    qrows = np.asarray([[R * f + i for i in range(f)]
+                        for R, _ in coords] or [[0] * f], np.int32)
+    kcols = np.asarray([[C * f + j for j in range(f)]
+                        for _, C in coords] or [[0] * f], np.int32)
+    return (coarse.astype(fine_layout.dtype), tiles,
+            np.asarray(csr or [0], np.int32),
+            np.asarray(csc or [0], np.int32), qrows, kcols)
+
+
+# --------------------------------------------------------------------- #
 # builders
 # --------------------------------------------------------------------- #
 def build_v2_impls(layout: np.ndarray, block: int, sm_scale: float,
-                   interpret: bool, has_am: bool = False):
+                   interpret: bool, has_am: bool = False,
+                   coarse_block=None):
     """Returns (fwd_impl, bwd_impl) with the v1 signatures. When
     ``has_am`` the impls take a pre-blocked additive (nq, nk, block,
     block) mask; it is deduplicated to unique head-union tiles and
-    DMA-streamed per item."""
+    DMA-streamed per item.
+
+    With ``coarse_block`` the walk runs over coarsened tiles and the
+    fine structure (plus any user mask) rides the same DMA mask channel
+    — see build_coarse_index. The public signature stays in FINE blocks;
+    per-iteration work grows from (block, block) to (coarse, coarse),
+    which is what makes a 128-block Longformer walk competitive with
+    dense flash tile sizes."""
+    fine_layout, fine_block = layout, block
+    if coarse_block is not None:
+        (layout, _struct_tiles, csr_uids, csc_uids,
+         _uq_rows, _uk_cols) = build_coarse_index(
+            fine_layout, fine_block, coarse_block, per_coord=has_am)
+        block = coarse_block
     H, nq, nk = layout.shape
     rr = build_row_runs(layout)
     cr = build_row_runs(np.ascontiguousarray(layout.transpose(0, 2, 1)))
     R = rr[0].shape[0]
     C = cr[0].shape[0]
-    if has_am:
+    stream_am = has_am or coarse_block is not None
+    if has_am and coarse_block is None:
         uq, uk, csr_uids, csc_uids = build_am_index(layout)
     compiler_params = _compiler_params(interpret, stream=True)
     hbm_spec = pl.BlockSpec(memory_space=pltpu.HBM)
 
     def _unique_am(am):
-        # (nq, nk, block, block) additive -> (U, block, block) fp32
-        return am.astype(jnp.float32)[jnp.asarray(uq), jnp.asarray(uk)]
+        if coarse_block is None:
+            # (nq, nk, block, block) additive -> (U, block, block) fp32
+            return am.astype(jnp.float32)[jnp.asarray(uq), jnp.asarray(uk)]
+        st = jnp.asarray(_struct_tiles)
+        if am is None:
+            return st
+        # fold the user's FINE mask tiles into each unique coarse tile:
+        # gather the (f, f) grid of fine (b, b) tiles and re-lay as
+        # (coarse, coarse)
+        g = am.astype(jnp.float32)[jnp.asarray(_uq_rows)[:, :, None],
+                                   jnp.asarray(_uk_cols)[:, None, :]]
+        g = g.transpose(0, 1, 3, 2, 4).reshape(st.shape)
+        return st + g
 
     def _am_scratch(dtype=jnp.float32):
         return [pltpu.VMEM((2, block, block), dtype),
@@ -359,7 +450,7 @@ def build_v2_impls(layout: np.ndarray, block: int, sm_scale: float,
         kpmr = kpm.reshape(B, 1, S)   # VMEM-resident, sliced in-kernel
         kernel = functools.partial(_v2_fwd_kernel, sm_scale=sm_scale,
                                    block=block, heads=H, nq=nq,
-                                   has_am=has_am)
+                                   has_am=stream_am)
         in_specs = [
             pl.BlockSpec((1, block, D),
                          lambda i, r, rw, *_: (i * H + rw[r] // nq,
@@ -369,7 +460,7 @@ def build_v2_impls(layout: np.ndarray, block: int, sm_scale: float,
         ]
         args = [qr, kr, vr]
         scalars = list(rr)
-        if has_am:
+        if stream_am:
             scalars.append(csr_uids)
             in_specs.append(hbm_spec)
             args.append(_unique_am(am))
@@ -378,10 +469,10 @@ def build_v2_impls(layout: np.ndarray, block: int, sm_scale: float,
         scratch = [
             pltpu.VMEM((2, D, block), k.dtype),
             pltpu.VMEM((2, D, block), v.dtype),
-        ] + (_am_scratch()[:1] if has_am else []) + [
+        ] + (_am_scratch()[:1] if stream_am else []) + [
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
-        ] + (_am_scratch()[1:] if has_am else [])
+        ] + (_am_scratch()[1:] if stream_am else [])
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=len(scalars),
             grid=(B, R),
@@ -415,7 +506,7 @@ def build_v2_impls(layout: np.ndarray, block: int, sm_scale: float,
         vr = v.reshape(B * H, S, D)
         dor = g.reshape(B * H, S, D)
         kpmr = kpm.reshape(B, 1, S)
-        am_u = _unique_am(am) if has_am else None
+        am_u = _unique_am(am) if stream_am else None
         delta = jnp.sum(dor.astype(jnp.float32) *
                         o.reshape(B * H, S, D).astype(jnp.float32),
                         axis=-1, keepdims=True)           # (B*H, S, 1)
@@ -423,7 +514,7 @@ def build_v2_impls(layout: np.ndarray, block: int, sm_scale: float,
         # ---- dq (row runs) ----
         kernel = functools.partial(_v2_dq_kernel, sm_scale=sm_scale,
                                    block=block, heads=H, nq=nq,
-                                   has_am=has_am)
+                                   has_am=stream_am)
         row_spec = pl.BlockSpec(
             (1, block, D),
             lambda i, r, rw, *_: (i * H + rw[r] // nq, rw[r] % nq, 0))
@@ -433,7 +524,7 @@ def build_v2_impls(layout: np.ndarray, block: int, sm_scale: float,
         in_specs = [row_spec, hbm_spec, hbm_spec]
         args = [qr, _stream_layout(kr, block), _stream_layout(vr, block)]
         scalars = list(rr)
-        if has_am:
+        if stream_am:
             scalars.append(csr_uids)
             in_specs.append(hbm_spec)
             args.append(am_u)
@@ -445,10 +536,10 @@ def build_v2_impls(layout: np.ndarray, block: int, sm_scale: float,
         scratch = [
             pltpu.VMEM((2, D, block), k.dtype),
             pltpu.VMEM((2, D, block), v.dtype),
-        ] + (_am_scratch()[:1] if has_am else []) + [
+        ] + (_am_scratch()[:1] if stream_am else []) + [
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
-        ] + (_am_scratch()[1:] if has_am else [])
+        ] + (_am_scratch()[1:] if stream_am else [])
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=len(scalars),
             grid=(B, R),
@@ -466,7 +557,7 @@ def build_v2_impls(layout: np.ndarray, block: int, sm_scale: float,
         # ---- dk, dv (column runs) ----
         kernel = functools.partial(_v2_dkv_kernel, sm_scale=sm_scale,
                                    block=block, heads=H, nk=nk,
-                                   has_am=has_am)
+                                   has_am=stream_am)
         lser = lse.reshape(B * H, 1, S)   # VMEM-resident per program
         deltar = delta.reshape(B * H, 1, S)
         col_spec = pl.BlockSpec(
@@ -480,10 +571,10 @@ def build_v2_impls(layout: np.ndarray, block: int, sm_scale: float,
             hbm_spec,
             hbm_spec,
         ]
-        args = [kr, vr, kpm,
+        args = [kr, vr, kpm.reshape(B, nk, 1, block),  # fine->walk re-block
                 _stream_layout(qr, block), _stream_layout(dor, block)]
         scalars = list(cr)
-        if has_am:
+        if stream_am:
             scalars.append(csc_uids)
             in_specs.append(hbm_spec)
             args.append(am_u)
@@ -497,10 +588,10 @@ def build_v2_impls(layout: np.ndarray, block: int, sm_scale: float,
         scratch = [
             pltpu.VMEM((2, D, block), q.dtype),
             pltpu.VMEM((2, D, block), g.dtype),
-        ] + (_am_scratch()[:1] if has_am else []) + [
+        ] + (_am_scratch()[:1] if stream_am else []) + [
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
-        ] + (_am_scratch()[1:] if has_am else [])
+        ] + (_am_scratch()[1:] if stream_am else [])
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=len(scalars),
             grid=(B, C),

@@ -31,7 +31,11 @@ BENCH_METRIC_TIMEOUT=${BENCH_METRIC_TIMEOUT:-2400} \
   timeout 14400 python bench.py 2> "$OUT/bench.err" | tee "$OUT/bench.jsonl"
 rc=$?
 
-echo "== done (autotune rc=$at_rc, bench rc=$rc); review $OUT and commit block_table.json + BENCH_NOTES update"
+echo "== coarse sparse A/B"
+timeout 1800 python tools/ab_coarse_sparse.py 2>&1 | tee "$OUT/coarse_ab.log"
+ab_rc=$?
+
+echo "== done (autotune rc=$at_rc, bench rc=$rc, coarse A/B rc=$ab_rc); review $OUT and commit block_table.json + BENCH_NOTES update"
 # an autotune failure must not read as a complete round either (the
 # watcher re-arms; bench rows resume from the partial file on retry)
 [ "$rc" -eq 0 ] && rc=$at_rc
