@@ -363,6 +363,10 @@ def _pick_coarse_block(layout: np.ndarray, block: int, has_am: bool):
         if cb <= block or cb % block or (nq * block) % cb or \
                 (nk * block) % cb:
             continue
+        # count_only passes here + the winner's full build in
+        # build_v2_impls re-hash the (f, f) patterns up to 3x per fn-cache
+        # miss — a few thousand tiny tobytes() calls, negligible next to
+        # the kernel compile the miss is about to pay
         nnz_c, n_unique = build_coarse_index(layout, block, cb,
                                              per_coord=has_am,
                                              count_only=True)

@@ -331,8 +331,12 @@ def build_coarse_index(fine_layout: np.ndarray, fine_block: int,
                        count_only: bool = False):
     """Coarsen a fine block layout to ``coarse_block`` tiles, expressing
     the fine structure as additive NEG_INF mask tiles streamed through
-    the existing attn-mask DMA channel (masked entries produce exact-zero
-    probabilities — bit-identical to walking the fine blocks).
+    the existing attn-mask DMA channel. Masked entries produce
+    EXACT-ZERO probabilities (same guarantee as the fine walk); the
+    unmasked math is numerically equivalent but not bit-identical — the
+    online-softmax running max and f32 accumulation group per coarse
+    tile instead of per fine tile, so outputs agree to normal fp32
+    reduction tolerance (see test_coarse_walk_matches_fine).
 
     Tiles are deduplicated by CONTENT of the (f, f) fine-bit pattern
     (banded layouts like BSLongformer collapse to a handful of uniques);

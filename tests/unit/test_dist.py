@@ -1,0 +1,154 @@
+"""The distributed substrate itself — mirrors the reference's
+tests/unit/test_dist.py (which validates its @distributed_test NCCL
+fixture and a bare all_reduce) for the TPU-native design: the named-axis
+Mesh replaces process groups, in-jit XLA collectives replace
+torch.distributed calls, and ``init_distributed`` replaces the MPI/env
+rendezvous (reference tests/unit/test_dist.py:10-31, engine.py:134-139).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.parallel.mesh import (axis_size, build_mesh,
+                                         data_sharding, replicated)
+from deepspeed_tpu import distributed as dist
+
+
+# --------------------------------------------------------------------- #
+# mesh construction (the process-group analog)
+# --------------------------------------------------------------------- #
+def test_default_mesh_all_data():
+    mesh = build_mesh()
+    assert mesh.axis_names == ("data",)
+    assert axis_size(mesh, "data") == 8
+
+
+def test_mesh_infer_one_axis():
+    mesh = build_mesh({"pipe": 2, "data": -1, "model": 2})
+    assert axis_size(mesh, "data") == 2
+    assert mesh.devices.size == 8
+
+
+def test_mesh_two_unknown_axes_rejected():
+    with pytest.raises(ValueError, match="at most one"):
+        build_mesh({"data": -1, "model": -1})
+
+
+def test_mesh_subset_for_elastic_resume():
+    # explicit smaller world: runs on a device subset (elastic reload)
+    mesh = build_mesh({"data": 4})
+    assert mesh.devices.size == 4
+
+
+def test_mesh_indivisible_rejected():
+    with pytest.raises(ValueError, match="not divisible"):
+        build_mesh({"pipe": 3, "data": -1})
+
+
+# --------------------------------------------------------------------- #
+# collectives (the all_reduce/broadcast analog of test_dist.py:24-31)
+# --------------------------------------------------------------------- #
+def _ranked(mesh, axis):
+    """Per-shard (1,) array holding the shard's axis index."""
+    n = axis_size(mesh, axis)
+    return jax.device_put(
+        jnp.arange(n, dtype=jnp.float32),
+        jax.sharding.NamedSharding(mesh, P(axis)))
+
+
+def test_psum_matches_sum_of_ranks():
+    mesh = build_mesh({"data": 8})
+    x = _ranked(mesh, "data")
+
+    @jax.jit
+    def f(x):
+        def body(x):
+            return jax.lax.psum(x, "data")
+        return shard_map(body, mesh=mesh, in_specs=P("data"),
+                         out_specs=P("data"))(x)
+
+    out = np.asarray(f(x))
+    np.testing.assert_array_equal(out, np.full(8, 28.0))  # sum 0..7
+
+
+def test_all_gather_and_reduce_scatter_roundtrip():
+    mesh = build_mesh({"data": 8})
+    x = _ranked(mesh, "data")
+
+    @jax.jit
+    def f(x):
+        def body(x):
+            g = jax.lax.all_gather(x, "data")          # (8, 1) per shard
+            return jax.lax.psum_scatter(g.reshape(8), "data",
+                                        scatter_dimension=0, tiled=True)
+        return shard_map(body, mesh=mesh, in_specs=P("data"),
+                         out_specs=P("data"))(x)
+
+    # all_gather then reduce-scatter of identical vectors = 8 * rank_r
+    out = np.asarray(f(x))
+    np.testing.assert_array_equal(out, 8.0 * np.arange(8))
+
+
+def test_ppermute_ring_rotation():
+    # the pipe p2p analog (reference p2p.py:31-55 2-rank broadcast)
+    mesh = build_mesh({"pipe": 8})
+    x = _ranked(mesh, "pipe")
+
+    @jax.jit
+    def f(x):
+        def body(x):
+            n = jax.lax.axis_size("pipe")
+            perm = [(i, (i + 1) % n) for i in range(n)]
+            return jax.lax.ppermute(x, "pipe", perm)
+        return shard_map(body, mesh=mesh, in_specs=P("pipe"),
+                         out_specs=P("pipe"))(x)
+
+    out = np.asarray(f(x))
+    np.testing.assert_array_equal(out, np.roll(np.arange(8), 1))
+
+
+def test_all_to_all_transpose():
+    # the MoE dispatch primitive: shard i sends slice j to shard j
+    mesh = build_mesh({"expert": 4})
+    vals = jnp.arange(16, dtype=jnp.float32).reshape(4, 4)
+    x = jax.device_put(vals, jax.sharding.NamedSharding(mesh, P("expert")))
+
+    @jax.jit
+    def f(x):
+        def body(x):                                   # (1, 4) per shard
+            return jax.lax.all_to_all(x, "expert", split_axis=1,
+                                      concat_axis=0, tiled=False)
+        return shard_map(body, mesh=mesh, in_specs=P("expert"),
+                         out_specs=P("expert"))(x)
+
+    out = np.asarray(f(x)).reshape(4, 4)
+    np.testing.assert_array_equal(out, np.asarray(vals).T.reshape(4, 4))
+
+
+def test_sharding_helpers():
+    mesh = build_mesh({"data": 8})
+    ds = data_sharding(mesh)
+    rep = replicated(mesh)
+    x = jax.device_put(jnp.zeros((16, 4)), ds)
+    y = jax.device_put(jnp.zeros((4,)), rep)
+    assert x.sharding.spec == P("data")
+    assert y.sharding.is_fully_replicated
+
+
+# --------------------------------------------------------------------- #
+# host bootstrap (the MPI/env rendezvous analog, engine.py:198-235)
+# --------------------------------------------------------------------- #
+def test_init_distributed_single_process_noop(monkeypatch):
+    for k in ("DSTPU_COORDINATOR", "DSTPU_NUM_PROCESSES",
+              "DSTPU_PROCESS_ID", "TPU_WORKER_HOSTNAMES"):
+        monkeypatch.delenv(k, raising=False)
+    before = dist.is_initialized()
+    dist.init_distributed()
+    # single process: must stay un-initialized rather than hang on a
+    # coordinator that does not exist
+    assert dist.is_initialized() == before

@@ -2,9 +2,12 @@
 # One-shot hardware round: run when the TPU tunnel is back.
 #   PYTHONPATH=/root/repo:/root/.axon_site bash tools/on_tpu_up.sh
 # (keep the axon site dir on PYTHONPATH — it registers the TPU plugin)
-# 1. probes the chip; 2. sweeps the flash block table (autotune);
-# 3. runs the bench ladder (resumable; partial rows survive tunnel
-# drops). Outputs land in /tmp/tpu_round/.
+# Ordered by value per minute of tunnel time (windows have been
+# 20-45 min): 1. probe; 2. bench ladder (the driver-protocol artifact;
+# resumable — partial rows survive tunnel drops); 3. coarse-sparse A/B;
+# 4. headline variant A/Bs (master-free, scan_layers); 5. autotune
+# merge-sweep (table already hardware-validated; re-sweep is a refresh).
+# Outputs land in /tmp/tpu_round/.
 set -u -o pipefail   # tee must not mask the bench exit code
 OUT=/tmp/tpu_round
 mkdir -p "$OUT"
@@ -19,10 +22,6 @@ x = jnp.ones((256,256), jnp.bfloat16); np.asarray(x @ x); print('alive')
   exit 1
 fi
 
-echo "== autotune block table (writes deepspeed_tpu/ops/attention/block_table.json)"
-timeout 3600 python tools/autotune_blocks.py 2>&1 | tee "$OUT/autotune.log"
-at_rc=$?
-
 echo "== bench ladder"
 # Remote compiles through the tunnel can be slow: give each metric child
 # 40 min (first child pays the model compile) and the ladder 4 h — the
@@ -35,8 +34,19 @@ echo "== coarse sparse A/B"
 timeout 1800 python tools/ab_coarse_sparse.py 2>&1 | tee "$OUT/coarse_ab.log"
 ab_rc=$?
 
-echo "== done (autotune rc=$at_rc, bench rc=$rc, coarse A/B rc=$ab_rc); review $OUT and commit block_table.json + BENCH_NOTES update"
-# an autotune failure must not read as a complete round either (the
-# watcher re-arms; bench rows resume from the partial file on retry)
+echo "== headline variant A/Bs (log-only; the ladder rows above are canonical)"
+BENCH_MASTER_FREE=1 timeout 2400 python bench.py --metric gpt2_train_mfu \
+  2>&1 | tee "$OUT/headline_master_free.log"
+BENCH_SCAN_LAYERS=1 timeout 2400 python bench.py --metric gpt2_train_mfu \
+  2>&1 | tee "$OUT/headline_scan_layers.log"
+
+echo "== autotune block table (writes deepspeed_tpu/ops/attention/block_table.json)"
+timeout 3600 python tools/autotune_blocks.py 2>&1 | tee "$OUT/autotune.log"
+at_rc=$?
+
+echo "== done (bench rc=$rc, coarse A/B rc=$ab_rc, autotune rc=$at_rc); review $OUT and commit block_table.json + BENCH_NOTES update"
+# an autotune or A/B failure must not read as a complete round either
+# (the watcher re-arms; bench rows resume from the partial file on retry)
 [ "$rc" -eq 0 ] && rc=$at_rc
+[ "$rc" -eq 0 ] && rc=$ab_rc
 exit $rc
