@@ -3,8 +3,6 @@
 sparse_attention_speedup_s8k row. Run on hardware:
   PYTHONPATH=/root/repo python tools/ab_coarse_sparse.py
 Prints both times, the speedup, and asserts on-chip grad parity."""
-import time
-
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -25,25 +23,27 @@ def main():
     q, k, v = (jax.random.normal(jax.random.fold_in(key, i), (B, H, S, D),
                                  jnp.bfloat16) for i in range(3))
 
+    from deepspeed_tpu.utils.benchtime import measure_rtt, scan_grad_seconds
+    rtt = measure_rtt()
+    print(f"rtt: {rtt * 1e3:.1f} ms", flush=True)
+
     def timed(tag, force):
+        # Shared scan-amortized protocol (utils/benchtime.py): chained
+        # grad evals in ONE dispatch, RTT-subtracted windows over a noise
+        # floor — per-dispatch tunnel latency would otherwise dwarf the
+        # ~10ms kernels being compared.
         bs._FORCE_COARSE_BLOCK = force
         bs._FN_CACHE.clear()
 
         def loss(q, k, v):
             return jnp.sum(block_sparse_attention(q, k, v, layout)
                            .astype(jnp.float32))
-        g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
-        r = g(q, k, v)
-        np.asarray(r[0][0, 0, 0])          # fetch barrier
-        best = None
-        for _ in range(3):
-            t0 = time.perf_counter()
-            r = g(q, k, v)
-            np.asarray(r[0][0, 0, 0])
-            dt = time.perf_counter() - t0
-            best = dt if best is None else min(best, dt)
-        print(f"{tag}: {best * 1e3:.1f} ms", flush=True)
-        return best, r
+        grad_fn = jax.grad(loss, argnums=(0, 1, 2))
+        r = jax.jit(grad_fn)(q, k, v)       # parity grads (one dispatch)
+        jax.tree_util.tree_map(np.asarray, r)
+        sec, n = scan_grad_seconds(grad_fn, (q, k, v), rtt, start_len=16)
+        print(f"{tag}: {sec * 1e3:.1f} ms/eval ({n}-chained)", flush=True)
+        return sec, r
 
     auto = bs._pick_coarse_block(layout, 128, has_am=False)
     print("cost model picks:", auto, flush=True)

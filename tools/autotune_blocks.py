@@ -20,7 +20,6 @@ import json
 import os
 import time
 
-import numpy as np
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "deepspeed_tpu", "ops", "attention",
@@ -41,16 +40,8 @@ CANDIDATES = (64, 128, 256, 512)
 
 
 def _rtt():
-    import jax
-    import jax.numpy as jnp
-    zf = jax.jit(lambda: jnp.zeros(()))
-    np.asarray(zf())
-    ts = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        np.asarray(zf())
-        ts.append(time.perf_counter() - t0)
-    return min(ts)
+    from deepspeed_tpu.utils.benchtime import measure_rtt
+    return measure_rtt()
 
 
 def _shape_plan(sq):
@@ -72,7 +63,6 @@ def time_combo(sq, sk, d, bq, bk, rtt, iters=None, heads=None):
     # always lets _shape_plan pick them so winners aren't latency-noise.
     import jax
     import jax.numpy as jnp
-    from jax import lax
     from deepspeed_tpu.ops.attention import flash as F
 
     batch, h, n = _shape_plan(max(sq, sk))
@@ -91,57 +81,17 @@ def time_combo(sq, sk, d, bq, bk, rtt, iters=None, heads=None):
 
     grad_fn = jax.grad(loss, argnums=(0, 1, 2))
 
-    # N sequential grad evals in ONE dispatch: the tiny dq-feedback into q
-    # chains the iterations so XLA cannot hoist the loop-invariant work,
-    # and the tunnel's per-call latency is paid once, not N times.
-    def build(length):
-        def many(q, k, v):
-            def body(carry, _):
-                q, k, v = carry
-                dq, dk, dv = grad_fn(q, k, v)
-                return (q + 1e-6 * dq, k + 1e-6 * dk, v + 1e-6 * dv), ()
-            (q, k, v), _ = lax.scan(body, (q, k, v), None, length=length)
-            return jnp.sum(q.astype(jnp.float32))
-        return jax.jit(many)
+    # Shared scan-amortized protocol (utils/benchtime.py): chained grad
+    # evals in ONE dispatch, RTT-noise floor with rescaling, fail —
+    # never ~0 — when the floor is unreachable.
+    from deepspeed_tpu.utils.benchtime import scan_grad_seconds
 
     F._FORCE_BLOCKS = (bq, bk)
     try:
-        # A window must dwarf the tunnel's RTT jitter or the subtraction
-        # is noise (a 20 ms scan against 66 ms RTT once "measured" 0.00 ms
-        # and poisoned the table). Rescale n until one window clears the
-        # floor; a combo that can't clear it is FAILED, never ~0.
-        floor = max(8.0 * rtt, 0.25)
-        w = None
-        for _ in range(4):
-            g = build(n)
-            np.asarray(g(q, k, v))   # compile + settle
-            t0 = time.perf_counter()
-            np.asarray(g(q, k, v))
-            w = time.perf_counter() - t0 - rtt
-            if w >= floor:
-                break
-            if w > 0.5 * rtt:
-                # trustworthy-enough window: grow by the measured ratio
-                factor = int(np.ceil(floor / w * 1.5))
-            else:
-                # jitter swallowed the window (w ~ 0 or negative): the
-                # ratio would explode (a -5 ms reading once implied a
-                # 792x jump); grow geometrically instead
-                factor = 8
-            n *= min(max(factor, 2), 64)
-        else:
-            raise RuntimeError(
-                f"window {w*1e3:.1f} ms never cleared the {floor*1e3:.0f} ms "
-                f"RTT-noise floor at n={n}")
-        best = w / n
-        for _ in range(2):
-            t0 = time.perf_counter()
-            np.asarray(g(q, k, v))
-            w = time.perf_counter() - t0 - rtt
-            if w >= floor:
-                best = min(best, w / n)
+        sec, _n = scan_grad_seconds(grad_fn, (q, k, v), rtt, start_len=n,
+                                    max_len=n * 4096)
         # normalize to the old (1, 8, S) work unit so tables stay comparable
-        return best * 8.0 / (batch * h)
+        return sec * 8.0 / (batch * h)
     finally:
         F._FORCE_BLOCKS = None
 
