@@ -57,6 +57,13 @@ PARTIAL_PATH = os.environ.get(
 # First metric in a cold child pays remote compile time; give headroom.
 METRIC_TIMEOUT = int(os.environ.get("BENCH_METRIC_TIMEOUT", "1500"))
 METRIC_RETRIES = int(os.environ.get("BENCH_METRIC_RETRIES", "1"))
+# Child stall watchdog: a fresh remote model compile through the tunnel
+# can exceed 15 min with no heartbeat (the first train_batch call IS the
+# compile), so the stall budget tracks the per-metric budget rather than
+# racing it.  Control knob: excluded from the source digest (see
+# _git_head's control set).
+STALL_TIMEOUT = int(os.environ.get(
+    "BENCH_STALL_TIMEOUT", str(max(900, METRIC_TIMEOUT - 120))))
 
 
 def _apply_platform_override(jax):
@@ -346,10 +353,11 @@ def run_child(metric):
     def _watchdog():
         while True:
             time.sleep(30)
-            if time.monotonic() - _BEAT[0] > 900:
+            if time.monotonic() - _BEAT[0] > STALL_TIMEOUT:
                 _emit(metric, 0.0, "error", 0.0,
                       {"error": "device unreachable: no benchmark "
-                                "progress for 900s (tunnel down?)"})
+                                f"progress for {STALL_TIMEOUT}s "
+                                "(tunnel down?)"})
                 os._exit(2)
 
     threading.Thread(target=_watchdog, daemon=True).start()
@@ -419,7 +427,8 @@ def _git_head():
         # future ones) change what a row measures and must invalidate it;
         # control knobs (timeouts/paths/retries/resume) must not
         control = {"BENCH_PARTIAL", "BENCH_METRIC_TIMEOUT",
-                   "BENCH_METRIC_RETRIES", "BENCH_NO_RESUME"}
+                   "BENCH_METRIC_RETRIES", "BENCH_NO_RESUME",
+                   "BENCH_STALL_TIMEOUT"}
         for k in sorted(os.environ):
             if k.startswith("BENCH_") and k not in control:
                 h.update(f"{k}={os.environ[k]}".encode())

@@ -29,6 +29,11 @@ echo "== bench ladder"
 BENCH_METRIC_TIMEOUT=${BENCH_METRIC_TIMEOUT:-2400} \
   timeout 14400 python bench.py 2> "$OUT/bench.err" | tee "$OUT/bench.jsonl"
 rc=$?
+# children of the --metric A/B runs below inherit these: a fresh variant
+# compile (master-free / scan_layers changes the HLO) can exceed the
+# default 900s child stall watchdog with the tunnel alive
+export BENCH_METRIC_TIMEOUT=${BENCH_METRIC_TIMEOUT:-2400}
+export BENCH_STALL_TIMEOUT=${BENCH_STALL_TIMEOUT:-2280}
 
 echo "== coarse sparse A/B"
 timeout 1800 python tools/ab_coarse_sparse.py 2>&1 | tee "$OUT/coarse_ab.log"
