@@ -195,7 +195,7 @@ def bench_sparse_attention(on_tpu, rtt):
     def sparse_loss(q, k, v):
         return jnp.sum(sp(q, k, v).astype(jnp.float32))
 
-    def timed(fn):
+    def timed(fn, arrays=None, start_len=None):
         # Scan-amortized timing (shared protocol, utils/benchtime.py):
         # chained grad evals in ONE dispatch, scalar result.  A per-call
         # loop pays the tunnel's per-dispatch latency AND eagerly
@@ -205,8 +205,10 @@ def bench_sparse_attention(on_tpu, rtt):
         # makes the op row measure the same thing (device compute).
         from deepspeed_tpu.utils.benchtime import scan_grad_seconds
         sec, _n = scan_grad_seconds(
-            jax.grad(fn, argnums=(0, 1, 2)), (q, k, v), rtt,
-            start_len=iters, beat=_beat)
+            jax.grad(fn, argnums=(0, 1, 2)),
+            (q, k, v) if arrays is None else arrays, rtt,
+            start_len=iters if start_len is None else start_len,
+            beat=_beat)
         return sec
 
     from deepspeed_tpu.utils.benchtime import NoiseFloorError
@@ -243,6 +245,29 @@ def bench_sparse_attention(on_tpu, rtt):
         raise   # measurement failure: error row, not a baseline switch
     except Exception:
         t_vanilla = None               # O(S^2) buffers may not fit
+    # Long-context detail (reference claim: 10x longer sequences,
+    # sparse-attention post :28): at 2x the row's sequence the dense
+    # kernel pays O(S^2) while the Longformer walk stays O(S) — measure
+    # sparse-vs-flash at S=16k as evidence the gap widens.  Best-effort:
+    # a failure (VMEM, tunnel) never costs the row.
+    s16k = {}
+    if on_tpu:
+        try:
+            S2 = 2 * S
+            q2, k2, v2 = (jax.random.normal(jax.random.fold_in(key, 9 + i),
+                                            (B, H, S2, D), jnp.bfloat16)
+                          for i in range(3))
+            # sp resolves its layout per sequence length at call time
+            args2 = (q2, k2, v2)
+            n2 = max(iters // 2, 1)
+            t_d2 = timed(dense_loss, arrays=args2, start_len=n2)
+            t_s2 = timed(sparse_loss, arrays=args2, start_len=n2)
+            s16k = {"s16k_flash_ms": round(t_d2 * 1000, 2),
+                    "s16k_sparse_ms": round(t_s2 * 1000, 2),
+                    "s16k_vs_flash": round(t_d2 / t_s2, 3)}
+        except Exception as e:
+            s16k = {"s16k_error": f"{type(e).__name__}: {e}"[:120]}
+
     speedup = (t_vanilla / t_sparse) if t_vanilla else t_dense / t_sparse
     unit = ("vanilla_time_over_sparse_time" if t_vanilla
             else "flash_time_over_sparse_time")
@@ -256,7 +281,7 @@ def bench_sparse_attention(on_tpu, rtt):
                   "vanilla_ms": round(t_vanilla * 1000, 2) if t_vanilla else None,
                   "flash_ms": round(t_dense * 1000, 2),
                   "vs_flash": round(t_dense / t_sparse, 3),
-                  "sparse_ms": round(t_sparse * 1000, 2)})
+                  "sparse_ms": round(t_sparse * 1000, 2), **s16k})
 
 
 def bench_gpt2(on_tpu, rtt, dropout: float, metric: str):
