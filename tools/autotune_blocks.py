@@ -133,31 +133,36 @@ def main():
                          "never run; cf. bench.py run_child)")
     args = ap.parse_args()
 
-    import jax
-    from deepspeed_tpu.ops.attention import flash as F
-    from deepspeed_tpu.utils.platform import enable_compile_cache
-    enable_compile_cache(None)   # shared per-user default dir
-    backend = jax.default_backend()
-    print(f"# backend: {backend} (results are only meaningful on tpu)")
-    rtt = _rtt()
-    print(f"# rtt: {rtt*1e3:.2f} ms")
-
+    # Arm the watchdog BEFORE any device touch: jax backend init and the
+    # rtt probe themselves hang on a dead tunnel, inside C++ where
+    # signal handlers never run, and a watchdog started after them would
+    # never start at all.
     rows = []
+    backend = [None]
     last_beat = [time.monotonic()]
 
     def _watchdog():
-        import threading as _t  # noqa: F401  (thread module kept local)
         while True:
             time.sleep(30)
             if time.monotonic() - last_beat[0] > args.stall_timeout:
                 print(f"# WATCHDOG: no combo finished in "
                       f"{args.stall_timeout}s - flushing "
                       f"{len(rows)} shapes and exiting", flush=True)
-                _merge_write(args.out, rows, backend)
+                _merge_write(args.out, rows, backend[0])
                 os._exit(3)
 
     import threading
     threading.Thread(target=_watchdog, daemon=True).start()
+
+    import jax
+    from deepspeed_tpu.ops.attention import flash as F
+    from deepspeed_tpu.utils.platform import enable_compile_cache
+    enable_compile_cache(None)   # shared per-user default dir
+    backend[0] = jax.default_backend()
+    print(f"# backend: {backend[0]} (results are only meaningful on tpu)")
+    rtt = _rtt()
+    print(f"# rtt: {rtt*1e3:.2f} ms")
+    last_beat[0] = time.monotonic()
 
     for sq, sk, d in SHAPES:
         stream = F._use_stream(sq, sk)
@@ -187,15 +192,15 @@ def main():
               flush=True)
         rows.append({"seq_q": sq, "seq_k": sk, "d": d, "stream": stream,
                      "bq": bq, "bk": bk, "ms": round(dt * 1e3, 3),
-                     "backend": backend})
+                     "backend": backend[0]})
         # incremental: each finished shape lands immediately, so a later
         # tunnel drop costs only the in-flight shape
-        _merge_write(args.out, rows, backend)
+        _merge_write(args.out, rows, backend[0])
 
-    if backend != "tpu":
+    if backend[0] != "tpu":
         print("# not on TPU - NOT writing the table")
         return
-    _merge_write(args.out, rows, backend)
+    _merge_write(args.out, rows, backend[0])
     print(f"# wrote/merged {len(rows)} entries into {args.out}")
 
 
