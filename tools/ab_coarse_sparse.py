@@ -1,6 +1,6 @@
 """A/B the coarse sparse walk vs the fine v2 walk on the bench config
-(real chip): Longformer w=9, block=128, S=8192, H=16 — the
-sparse_attention_speedup_s8k row. Run on hardware:
+(real chip): Longformer w=3 (class default), block=128, S=8192, H=16 —
+the sparse_attention_speedup_s8k row. Run on hardware:
   PYTHONPATH=/root/repo python tools/ab_coarse_sparse.py
 Prints both times, the speedup, and asserts on-chip grad parity."""
 import numpy as np
@@ -16,8 +16,9 @@ from deepspeed_tpu.ops.sparse_attention import blocksparse as bs
 def main():
     enable_compile_cache(None)
     B, H, S, D = 1, 16, 8192, 64
+    # mirror the bench row's config (class-default window)
     cfg = BSLongformerSparsityConfig(num_heads=H, block=128,
-                                     num_sliding_window_blocks=9)
+                                     num_sliding_window_blocks=3)
     layout = cfg.make_layout(S)
     key = jax.random.PRNGKey(0)
     q, k, v = (jax.random.normal(jax.random.fold_in(key, i), (B, H, S, D),
