@@ -397,6 +397,11 @@ def run_child(metric):
     from deepspeed_tpu.utils.platform import enable_compile_cache
     enable_compile_cache(None)   # shared per-user default dir
     on_tpu = jax.default_backend() == "tpu"
+    if os.environ.get("BENCH_REF_ATTN", "0") == "1":
+        # A/B knob: route attention through the XLA-fused reference path
+        # (bf16 MXU operands) instead of the Pallas flash kernels
+        from deepspeed_tpu.ops.attention import flash as _F
+        _F._FORCE_REFERENCE = True
     rtt = _rtt()
     _beat()
 

@@ -47,6 +47,10 @@ except ImportError:  # pragma: no cover
 
 NEG_INF = -1e30
 _WARNED_IRREGULAR_FALLBACK = False
+# Route EVERY call through attention_reference (the XLA-fused O(S^2)
+# path): A/B knob — at short sequences (e.g. BERT seq128) XLA's batched
+# fused attention may beat the per-(b,h,row) Pallas launch grid.
+_FORCE_REFERENCE = False
 _WARNED_IRREGULAR_STREAM = False
 
 
@@ -100,18 +104,27 @@ def dropout_mask_reference(seed, b, h, sq, sk, rate):
 # --------------------------------------------------------------------- #
 def attention_reference(q, k, v, mask=None, causal=False,
                         sm_scale: Optional[float] = None,
-                        dropout_rate: float = 0.0, dropout_seed=None):
+                        dropout_rate: float = 0.0, dropout_seed=None,
+                        mxu_bf16: bool = False):
     """Plain jnp attention. q,k,v: (B, H, S, D); mask: additive, broadcastable
     to (B, H, Sq, Sk). With dropout_rate > 0 applies the same hash keep-mask
-    the Pallas kernels use (seed: scalar). GQA: k/v may carry H/G heads."""
+    the Pallas kernels use (seed: scalar). GQA: k/v may carry H/G heads.
+    mxu_bf16: keep MXU operands in the input dtype with fp32 accumulation
+    (the Pallas kernels' precision) instead of the oracle's fp32 operands
+    — used when this path serves as a PERFORMANCE alternative
+    (_FORCE_REFERENCE), not as the accuracy oracle."""
     if sm_scale is None:
         sm_scale = 1.0 / np.sqrt(q.shape[-1])
     if k.shape[1] != q.shape[1]:
         rep = q.shape[1] // k.shape[1]
         k = jnp.repeat(k, rep, axis=1)
         v = jnp.repeat(v, rep, axis=1)
-    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * sm_scale
+    if mxu_bf16:
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                       preferred_element_type=jnp.float32) * sm_scale
+    else:
+        s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) * sm_scale
     if mask is not None:
         s = s + mask.astype(jnp.float32)
     if causal:
@@ -125,6 +138,10 @@ def attention_reference(q, k, v, mask=None, causal=False,
         keep = dropout_mask_reference(dropout_seed, b_, h_, sq_, sk_,
                                       dropout_rate)
         p = jnp.where(keep, p, 0.0) / (1.0 - dropout_rate)
+    if mxu_bf16:
+        return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v,
+                          preferred_element_type=jnp.float32
+                          ).astype(q.dtype)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)
                       ).astype(q.dtype)
 
@@ -859,8 +876,9 @@ def flash_attention(q, k, v, mask=None, causal: bool = False,
     else:
         seed = jnp.zeros((1, 1), jnp.int32)
     sq, sk = q.shape[2], k.shape[2]
-    if force_reference or sq % 16 != 0 or sk % 16 != 0:
-        if not force_reference and max(sq, sk) > 2048:
+    if force_reference or _FORCE_REFERENCE or sq % 16 != 0 or sk % 16 != 0:
+        if not force_reference and not _FORCE_REFERENCE \
+                and max(sq, sk) > 2048:
             global _WARNED_IRREGULAR_FALLBACK
             if not _WARNED_IRREGULAR_FALLBACK:
                 _WARNED_IRREGULAR_FALLBACK = True
@@ -874,7 +892,12 @@ def flash_attention(q, k, v, mask=None, causal: bool = False,
                                    sm_scale=sm_scale,
                                    dropout_rate=dropout_rate,
                                    dropout_seed=seed.reshape(())
-                                   if dropout_rate > 0.0 else None)
+                                   if dropout_rate > 0.0 else None,
+                                   # perf knob only: an explicit
+                                   # force_reference caller gets the
+                                   # fp32 accuracy oracle
+                                   mxu_bf16=_FORCE_REFERENCE
+                                   and not force_reference)
     if (max(sq, sk) >= STREAM_THRESHOLD
             and (sq % 128 != 0 or sk % 128 != 0)):
         # long irregular sequences: the resident path may fail to compile

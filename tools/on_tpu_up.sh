@@ -44,6 +44,11 @@ BENCH_MASTER_FREE=1 timeout 2400 python bench.py --metric gpt2_train_mfu \
   2>&1 | tee "$OUT/headline_master_free.log"
 BENCH_SCAN_LAYERS=1 timeout 2400 python bench.py --metric gpt2_train_mfu \
   2>&1 | tee "$OUT/headline_scan_layers.log"
+# XLA-fused attention vs Pallas flash at short seq (BERT s128) and s1024
+BENCH_REF_ATTN=1 timeout 2400 python bench.py \
+  --metric bert_large_samples_per_s 2>&1 | tee "$OUT/bert_ref_attn.log"
+BENCH_REF_ATTN=1 timeout 2400 python bench.py --metric gpt2_train_mfu \
+  2>&1 | tee "$OUT/headline_ref_attn.log"
 
 echo "== autotune block table (writes deepspeed_tpu/ops/attention/block_table.json)"
 timeout 3600 python tools/autotune_blocks.py 2>&1 | tee "$OUT/autotune.log"
