@@ -11,10 +11,11 @@
 //  - Stateless bias correction: the step count is an argument and
 //    beta^t is computed per call, so the same optimizer handle can serve
 //    many parameter leaves (the reference tracks _betta1_t incrementally).
-//  - Runtime SIMD dispatch: the file is compiled WITHOUT -mavx2; the AVX2
-//    path is a target("avx2,fma") multiversioned function selected via
-//    __builtin_cpu_supports, so the same .so is safe on any x86-64 host
-//    (the reference selects AVX512/AVX2 at compile time).
+//  - Runtime SIMD dispatch: the file is compiled WITHOUT -mavx*; the
+//    AVX-512 (16-lane) and AVX2 (8-lane) paths are target-attributed
+//    multiversioned functions selected via __builtin_cpu_supports, so the
+//    same .so is safe on any x86-64 host (the reference selects
+//    AVX512/AVX2 at compile time; its SIMD_WIDTH tiers are mirrored).
 //
 // Build: make -C csrc  →  libdstpu_adam.so
 
@@ -143,6 +144,66 @@ void step_avx2(const StepScalars& s, float* params, const float* grads,
                       out_bf16);
 }
 
+// GCC 12 false positive: _mm512_sqrt_ps's undef passthrough operand
+// trips -Wmaybe-uninitialized when inlined under OpenMP
+#pragma GCC diagnostic push
+#pragma GCC diagnostic ignored "-Wmaybe-uninitialized"
+__attribute__((target("avx512f,avx512bw")))
+void step_avx512(const StepScalars& s, float* params, const float* grads,
+                 float* exp_avg, float* exp_avg_sq, long long n,
+                 uint16_t* out_bf16) {
+    const __m512 v_b1 = _mm512_set1_ps(s.b1);
+    const __m512 v_b2 = _mm512_set1_ps(s.b2);
+    const __m512 v_1mb1 = _mm512_set1_ps(s.one_m_b1);
+    const __m512 v_1mb2 = _mm512_set1_ps(s.one_m_b2);
+    const __m512 v_eps = _mm512_set1_ps(s.eps);
+    const __m512 v_step = _mm512_set1_ps(s.step_size);
+    const __m512 v_isbc2 = _mm512_set1_ps(s.inv_sqrt_bc2);
+    const __m512 v_wd = _mm512_set1_ps(s.wd);
+    const __m512 v_neg_lr_wd = _mm512_set1_ps(-s.lr * s.wd);
+    const long long vec_end = n - (n % 16);
+#pragma omp parallel for schedule(static)
+    for (long long i = 0; i < vec_end; i += 16) {
+        __m512 g = _mm512_loadu_ps(grads + i);
+        __m512 p = _mm512_loadu_ps(params + i);
+        __m512 m = _mm512_loadu_ps(exp_avg + i);
+        __m512 v = _mm512_loadu_ps(exp_avg_sq + i);
+
+        if (s.wd > 0.f && !s.adamw) g = _mm512_fmadd_ps(p, v_wd, g);
+
+        m = _mm512_mul_ps(m, v_b1);
+        m = _mm512_fmadd_ps(g, v_1mb1, m);
+        v = _mm512_mul_ps(v, v_b2);
+        v = _mm512_fmadd_ps(_mm512_mul_ps(g, g), v_1mb2, v);
+
+        __m512 denom = _mm512_fmadd_ps(_mm512_sqrt_ps(v), v_isbc2, v_eps);
+        __m512 upd = _mm512_div_ps(m, denom);
+        if (s.wd > 0.f && s.adamw) p = _mm512_fmadd_ps(p, v_neg_lr_wd, p);
+        p = _mm512_fmadd_ps(upd, v_step, p);
+
+        _mm512_storeu_ps(params + i, p);
+        _mm512_storeu_ps(exp_avg + i, m);
+        _mm512_storeu_ps(exp_avg_sq + i, v);
+        if (out_bf16) {
+            // same RNE+NaN-guard semantics as the scalar path (the bf16
+            // output is pinned BIT-EXACT against ml_dtypes by tests)
+            alignas(64) float tmp[16];
+            _mm512_store_ps(tmp, p);
+            for (int k = 0; k < 16; ++k)
+                out_bf16[i + k] = f32_to_bf16(tmp[k]);
+        }
+    }
+    step_scalar_range(s, params, grads, exp_avg, exp_avg_sq, vec_end, n,
+                      out_bf16);
+}
+#pragma GCC diagnostic pop
+
+bool cpu_has_avx512() {
+    __builtin_cpu_init();
+    return __builtin_cpu_supports("avx512f") &&
+           __builtin_cpu_supports("avx512bw");
+}
+
 bool cpu_has_avx2() {
     __builtin_cpu_init();
     return __builtin_cpu_supports("avx2") && __builtin_cpu_supports("fma");
@@ -201,7 +262,12 @@ int ds_adam_step(int id, long long step, float lr_in, float* params,
     s.step_size = -s.lr / bc1;
 
 #if DS_X86
+    static const bool use_avx512 = cpu_has_avx512();
     static const bool use_avx2 = cpu_has_avx2();
+    if (use_avx512) {
+        step_avx512(s, params, grads, exp_avg, exp_avg_sq, n, out_bf16);
+        return 0;
+    }
     if (use_avx2) {
         step_avx2(s, params, grads, exp_avg, exp_avg_sq, n, out_bf16);
         return 0;
@@ -214,7 +280,7 @@ int ds_adam_step(int id, long long step, float lr_in, float* params,
 // simd width actually used at runtime (for tests / introspection)
 int ds_adam_simd_width() {
 #if DS_X86
-    return cpu_has_avx2() ? 8 : 1;
+    return cpu_has_avx512() ? 16 : (cpu_has_avx2() ? 8 : 1);
 #else
     return 1;
 #endif
