@@ -296,15 +296,19 @@ def bert_mlm_sp_loss_fn(config: BertConfig, mesh, dtype=jnp.bfloat16,
 
 
 def bert_mlm_loss_fn(config: BertConfig, dtype=jnp.bfloat16,
-                     remat: bool = False, deterministic: bool = False):
+                     remat: bool = False, deterministic: bool = False,
+                     sparsity_config=None):
     """Engine-contract MLM loss. batch: input_ids (B,S), labels (B,S) with
-    -100 = unmasked (ignored), attention_mask (B,S) optional."""
+    -100 = unmasked (ignored), attention_mask (B,S) optional.
+    sparsity_config: optional SparsityConfig — block-sparse attention in
+    every layer (see bert_encoder; build one from the JSON
+    ``sparse_attention`` section with ``sparsity_config_from_dict``)."""
     def loss_fn(params, batch, rng):
         x = bert_encoder(params, config, batch["input_ids"],
                          attention_mask=batch.get("attention_mask"),
                          token_type_ids=batch.get("token_type_ids"),
                          rng=rng, deterministic=deterministic, dtype=dtype,
-                         remat=remat)
+                         remat=remat, sparsity_config=sparsity_config)
         # MLM head: dense+gelu+LN then decode against tied embeddings
         mh = x @ params["mlm_dense"]["w"].astype(dtype) + \
             params["mlm_dense"]["b"].astype(dtype)

@@ -5,7 +5,7 @@ the fused Pallas block-sparse kernel, and attention modules."""
 from deepspeed_tpu.ops.sparse_attention.sparsity_config import (  # noqa
     SparsityConfig, DenseSparsityConfig, FixedSparsityConfig,
     VariableSparsityConfig, BigBirdSparsityConfig,
-    BSLongformerSparsityConfig)
+    BSLongformerSparsityConfig, sparsity_config_from_dict)
 from deepspeed_tpu.ops.sparse_attention.blocksparse import (  # noqa
     block_sparse_attention, block_sparse_attention_reference,
     build_row_luts, build_col_luts, layout_additive_mask)

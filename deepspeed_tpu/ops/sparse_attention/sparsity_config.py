@@ -378,3 +378,31 @@ class BSLongformerSparsityConfig(SparsityConfig):
                 layout[h, s:e, :] = 1   # global rows
                 layout[h, :, s:e] = 1   # global columns
         return self.propagate_first_head(layout)
+
+
+def sparsity_config_from_dict(cfg, num_heads: int):
+    """Build a SparsityConfig from the parsed ``sparse_attention`` JSON
+    sub-config (runtime/config.py get_sparse_attention, mirroring the
+    reference's key schema, deepspeed/runtime/config.py:156-317).
+
+    The reference leaves this glue to client model code (its examples
+    repo); here it is part of the framework so a JSON config alone can
+    turn on block-sparse attention: the dict's keys ARE the class
+    constructor keywords, ``mode`` selects the class, and ``num_heads``
+    comes from the model.
+    """
+    if cfg is None:
+        return None
+    kwargs = {k: v for k, v in cfg.items() if k != "mode" and v is not None}
+    classes = {
+        "dense": DenseSparsityConfig,
+        "fixed": FixedSparsityConfig,
+        "variable": VariableSparsityConfig,
+        "bigbird": BigBirdSparsityConfig,
+        "bslongformer": BSLongformerSparsityConfig,
+    }
+    mode = cfg.get("mode", "fixed")
+    if mode not in classes:
+        raise ValueError(
+            f"sparse_attention mode {mode!r} not in {sorted(classes)}")
+    return classes[mode](num_heads=num_heads, **kwargs)

@@ -44,8 +44,11 @@ def main():
     ds.add_config_arguments(parser)
     parser.add_argument("--model", choices=["tiny", "base", "large"],
                         default="base")
-    parser.add_argument("--mode", choices=["dense", "sp"], default="dense",
-                        help="sp: sequence-parallel over the 'seq' mesh axis")
+    parser.add_argument("--mode", choices=["dense", "sp", "sparse"],
+                        default="dense",
+                        help="sp: sequence-parallel over the 'seq' mesh "
+                             "axis; sparse: block-sparse attention from "
+                             "the config's sparse_attention section")
     parser.add_argument("--seq", type=int, default=128)
     parser.add_argument("--steps", type=int, default=20)
     args = parser.parse_args()
@@ -70,6 +73,25 @@ def main():
         from deepspeed_tpu.parallel.mesh import build_mesh
         mesh = build_mesh(config["mesh"]["axes"])
         loss_fn = bert_mlm_sp_loss_fn(cfg, mesh)
+    elif args.mode == "sparse":
+        # block-sparse attention driven purely by the JSON config (the
+        # reference's bing_bert + sparse_attention configuration; its
+        # BERT sparse runs used `fixed` sparsity)
+        from deepspeed_tpu.ops.sparse_attention import (
+            sparsity_config_from_dict)
+        from deepspeed_tpu.runtime.config import get_sparse_attention
+        # parse first: the JSON schema's defaults (e.g. block=16) and
+        # per-mode key filtering live in get_sparse_attention
+        sa = get_sparse_attention(config)
+        if sa is None:
+            raise SystemExit("--mode sparse requires a sparse_attention "
+                             "section in the deepspeed config")
+        sc = sparsity_config_from_dict(sa, num_heads=cfg.num_heads)
+        if args.seq % sc.block:
+            raise SystemExit(f"--seq {args.seq} must be a multiple of the "
+                             f"sparsity block ({sc.block}); see "
+                             "SparseAttentionUtils.pad_to_block_size")
+        loss_fn = bert_mlm_loss_fn(cfg, sparsity_config=sc)
     else:
         loss_fn = bert_mlm_loss_fn(cfg)
     engine, _, _, _ = ds.initialize(model=loss_fn, model_parameters=params,

@@ -92,6 +92,15 @@ def test_bing_bert_sp_example(capsys):
     assert "done" in capsys.readouterr().out
 
 
+def test_bing_bert_sparse_example(capsys):
+    """JSON-config-driven block-sparse attention (the reference's
+    bing_bert + sparse_attention deployment path)."""
+    _run("examples/bing_bert/train.py", "--model", "tiny", "--mode",
+         "sparse", "--steps", "2", "--seq", "64", "--deepspeed_config",
+         os.path.join(_ROOT, "examples/bing_bert/ds_config_sparse.json"))
+    assert "done" in capsys.readouterr().out
+
+
 def test_llama_tp_example(capsys):
     _run("examples/llama/train.py", "--mode", "tp", "--tiny",
          "--scan-layers", "--steps", "4", "--generate", "4")

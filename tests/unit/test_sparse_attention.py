@@ -14,7 +14,7 @@ from deepspeed_tpu.ops.sparse_attention import (
     SparseAttentionUtils, SparseSelfAttention, SparsityConfig,
     VariableSparsityConfig, block_sparse_attention,
     block_sparse_attention_reference, build_col_luts, build_row_luts,
-    layout_additive_mask)
+    layout_additive_mask, sparsity_config_from_dict)
 
 
 # --------------------------------------------------------------------- #
@@ -719,3 +719,50 @@ def test_pick_coarse_block_model():
         assert bs._pick_coarse_block(layout, 128, False) is None
     finally:
         bs._COARSE_TILE_BUDGET = old_budget
+
+
+# --------------------------------------------------------------------- #
+# JSON sub-config -> SparsityConfig (runtime/config.py get_sparse_attention
+# produces the dict; the reference left this glue to its examples repo)
+# --------------------------------------------------------------------- #
+class TestSparsityConfigFromDict:
+
+    def test_every_mode_builds_and_roundtrips_layout(self):
+        from deepspeed_tpu.runtime.config import get_sparse_attention
+        configs = [
+            ({"mode": "dense"}, DenseSparsityConfig),
+            ({"mode": "fixed", "block": 16, "num_local_blocks": 4,
+              "num_global_blocks": 1,
+              "different_layout_per_head": True,
+              "num_different_global_patterns": 4},
+             FixedSparsityConfig),
+            ({"mode": "variable", "block": 16,
+              "local_window_blocks": [2, 2],
+              "global_block_indices": [0]}, VariableSparsityConfig),
+            ({"mode": "bigbird", "block": 16, "num_random_blocks": 1,
+              "num_sliding_window_blocks": 3}, BigBirdSparsityConfig),
+            ({"mode": "bslongformer", "block": 16,
+              "num_sliding_window_blocks": 3}, BSLongformerSparsityConfig),
+        ]
+        for raw, klass in configs:
+            parsed = get_sparse_attention({"sparse_attention": raw})
+            sc = sparsity_config_from_dict(parsed, num_heads=4)
+            assert isinstance(sc, klass), (raw, type(sc))
+            layout = sc.make_layout(256)
+            assert layout.shape == (4, 256 // sc.block, 256 // sc.block)
+            assert layout.sum() > 0
+
+    def test_parsed_defaults_match_class_defaults(self):
+        # a bare {"mode": "fixed"} through the config parser must build
+        # the same layout as FixedSparsityConfig() defaults (block 16 is
+        # the JSON schema default, reference constants.py:32)
+        from deepspeed_tpu.runtime.config import get_sparse_attention
+        parsed = get_sparse_attention({"sparse_attention": {"mode": "fixed"}})
+        sc = sparsity_config_from_dict(parsed, num_heads=2)
+        ref = FixedSparsityConfig(num_heads=2, block=16)
+        np.testing.assert_array_equal(sc.make_layout(128), ref.make_layout(128))
+
+    def test_none_passthrough_and_bad_mode(self):
+        assert sparsity_config_from_dict(None, num_heads=2) is None
+        with pytest.raises(ValueError, match="not in"):
+            sparsity_config_from_dict({"mode": "nope"}, num_heads=2)
