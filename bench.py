@@ -103,6 +103,23 @@ def _emit(metric, value, unit, vs_baseline, detail):
     return row
 
 
+def _hbm_peak_mb():
+    """Child-process-wide device peak memory, recorded by each metric
+    function AFTER its measurements (the device is known alive there —
+    _emit itself must stay device-free: it also serves the dead-tunnel
+    error paths, where a memory_stats() call would hang in C++ past
+    every watchdog). Each metric runs in its own subprocess, so this is
+    the peak across everything that row measured (for the sparse row:
+    incl. its vanilla/flash baselines and the S=16k detail)."""
+    try:
+        import jax
+        stats = jax.local_devices()[0].memory_stats() or {}
+        peak = stats.get("peak_bytes_in_use")
+        return round(peak / 2**20, 1) if peak else None
+    except Exception:
+        return None
+
+
 # ---------------------------------------------------------------- metrics
 
 
@@ -161,7 +178,8 @@ def bench_bert_large(on_tpu, rtt):
     return _emit("bert_large_samples_per_s", round(sps / max(n_dev, 1), 2),
                  "samples_per_s_per_chip", round(sps / max(n_dev, 1) / 272.0, 4),
                  {"seq": seq, "batch": batch, "dropout": 0.1,
-                  "step_ms": round(dt / steps * 1000, 2), "loss": float(loss)})
+                  "step_ms": round(dt / steps * 1000, 2), "loss": float(loss),
+                  "hbm_peak_mb_child": _hbm_peak_mb()})
 
 
 def bench_sparse_attention(on_tpu, rtt):
@@ -285,7 +303,8 @@ def bench_sparse_attention(on_tpu, rtt):
                   "vanilla_ms": round(t_vanilla * 1000, 2) if t_vanilla else None,
                   "flash_ms": round(t_dense * 1000, 2),
                   "vs_flash": round(t_dense / t_sparse, 3),
-                  "sparse_ms": round(t_sparse * 1000, 2), **s16k})
+                  "sparse_ms": round(t_sparse * 1000, 2), **s16k,
+                  "hbm_peak_mb_child": _hbm_peak_mb()})
 
 
 def bench_gpt2(on_tpu, rtt, dropout: float, metric: str):
@@ -363,7 +382,8 @@ def bench_gpt2(on_tpu, rtt, dropout: float, metric: str):
                  {"model": f"gpt2-{n_params/1e6:.0f}M", "dropout": dropout,
                   "tokens_per_s_per_chip": round(tokens_per_s / max(n_dev, 1), 1),
                   "tflops_per_chip": round(tflops / max(n_dev, 1), 2),
-                  "step_ms": round(dt / steps * 1000, 2), "loss": float(loss)})
+                  "step_ms": round(dt / steps * 1000, 2), "loss": float(loss),
+                  "hbm_peak_mb_child": _hbm_peak_mb()})
 
 
 # ------------------------------------------------------------- child mode
