@@ -3,10 +3,12 @@
 #   PYTHONPATH=/root/repo:/root/.axon_site bash tools/on_tpu_up.sh
 # (keep the axon site dir on PYTHONPATH — it registers the TPU plugin)
 # Ordered by value per minute of tunnel time (windows have been
-# 20-45 min): 1. probe; 2. bench ladder (the driver-protocol artifact;
-# resumable — partial rows survive tunnel drops); 3. coarse-sparse A/B;
-# 4. headline variant A/Bs (master-free, scan_layers); 5. autotune
-# merge-sweep (table already hardware-validated; re-sweep is a refresh).
+# 20-45 min): 1. probe; 2. on-chip kernel parity sweep (~5 min — the
+# go/no-go that the kernels the ladder times are CORRECT on hardware);
+# 3. bench ladder (the driver-protocol artifact; resumable — partial
+# rows survive tunnel drops); 4. coarse-sparse A/B; 5. headline variant
+# A/Bs (master-free, scan_layers, ref-attn); 6. autotune merge-sweep
+# (table already hardware-validated; re-sweep is a refresh).
 # Outputs land in /tmp/tpu_round/.
 set -u -o pipefail   # tee must not mask the bench exit code
 OUT=/tmp/tpu_round
@@ -21,6 +23,10 @@ x = jnp.ones((256,256), jnp.bfloat16); np.asarray(x @ x); print('alive')
   echo "chip unreachable; aborting" >&2
   exit 1
 fi
+
+echo "== on-chip kernel parity sweep"
+timeout 1800 python tools/hw_kernel_checks.py 2>&1 | tee "$OUT/kernel_checks.log"
+kc_rc=$?
 
 echo "== bench ladder"
 # Remote compiles through the tunnel can be slow: give each metric child
@@ -54,9 +60,10 @@ echo "== autotune block table (writes deepspeed_tpu/ops/attention/block_table.js
 timeout 3600 python tools/autotune_blocks.py 2>&1 | tee "$OUT/autotune.log"
 at_rc=$?
 
-echo "== done (bench rc=$rc, coarse A/B rc=$ab_rc, autotune rc=$at_rc); review $OUT and commit block_table.json + BENCH_NOTES update"
+echo "== done (kernel checks rc=$kc_rc, bench rc=$rc, coarse A/B rc=$ab_rc, autotune rc=$at_rc); review $OUT and commit block_table.json + BENCH_NOTES update"
 # an autotune or A/B failure must not read as a complete round either
 # (the watcher re-arms; bench rows resume from the partial file on retry)
 [ "$rc" -eq 0 ] && rc=$at_rc
 [ "$rc" -eq 0 ] && rc=$ab_rc
+[ "$rc" -eq 0 ] && rc=$kc_rc
 exit $rc
