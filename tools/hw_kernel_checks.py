@@ -159,8 +159,8 @@ def _coarse_parity():
     q, k, v = _qkv(1, H, S, 64, seed=5)
 
     def run(force):
+        # _FN_CACHE keys on _FORCE_COARSE_BLOCK: no clear() needed
         bs._FORCE_COARSE_BLOCK = force
-        bs._FN_CACHE.clear()
         try:
             g = jax.jit(jax.grad(
                 lambda q, k, v: jnp.sum(

@@ -27,6 +27,12 @@ fi
 echo "== on-chip kernel parity sweep"
 timeout 1800 python tools/hw_kernel_checks.py 2>&1 | tee "$OUT/kernel_checks.log"
 kc_rc=$?
+if [ "$kc_rc" -ne 0 ]; then
+  # go/no-go: do not spend the window benchmarking kernels just proven
+  # wrong (or a tunnel that died mid-sweep); the watcher re-arms
+  echo "kernel parity sweep failed (rc=$kc_rc); aborting round" >&2
+  exit "$kc_rc"
+fi
 
 echo "== bench ladder"
 # Remote compiles through the tunnel can be slow: give each metric child
