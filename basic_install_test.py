@@ -1,0 +1,31 @@
+"""Install sanity check (reference basic_install_test.py analog): import
+the package, report versions/backend, and probe the native host kernel.
+
+    PYTHONPATH=/root/repo python basic_install_test.py
+"""
+
+import jax
+
+try:
+    import deepspeed_tpu
+    print("deepspeed_tpu successfully imported")
+except ImportError as err:
+    raise err
+
+print(f"jax version: {jax.__version__}")
+print(f"deepspeed_tpu install path: {deepspeed_tpu.__path__}")
+print(f"deepspeed_tpu info: {deepspeed_tpu.__version__}, "
+      f"{deepspeed_tpu.__git_hash__}, {deepspeed_tpu.__git_branch__}")
+
+try:
+    from deepspeed_tpu.ops.adam.cpu_adam import load_library
+    lib = load_library()
+    print("native host Adam successfully loaded "
+          f"(simd width {lib.ds_adam_simd_width()})"
+          if lib else "native host Adam NOT built (numpy fallback active)")
+except Exception as e:  # the runtime has a numpy fallback either way
+    print(f"native host Adam probe failed ({type(e).__name__}: {e}); "
+          "numpy fallback active")
+
+print(f"default backend: {jax.default_backend()} "
+      f"(devices: {jax.local_device_count()})")

@@ -30,8 +30,29 @@ from deepspeed_tpu.ops.transformer import (
 from deepspeed_tpu.runtime.activation_checkpointing import checkpointing
 
 __version__ = "0.1.0"
-__git_hash__ = None
-__git_branch__ = None
+
+
+def _git_info():
+    """Best-effort (hash, branch) — the reference bakes these at install
+    (setup.py writes git_version_info consumed by basic_install_test.py);
+    here they read from the working tree and fall back to 'unknown'."""
+    import os
+    head = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), ".git", "HEAD")
+    try:
+        with open(head) as f:
+            ref = f.read().strip()
+        if ref.startswith("ref:"):
+            branch = ref.split("/")[-1]
+            with open(os.path.join(os.path.dirname(head),
+                                   *ref.split()[1].split("/"))) as f:
+                return f.read().strip()[:9], branch
+        return ref[:9], "detached"
+    except OSError:
+        return "unknown", "unknown"
+
+
+__git_hash__, __git_branch__ = _git_info()
 
 
 def initialize(args=None,
