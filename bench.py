@@ -290,6 +290,15 @@ def bench_sparse_attention(on_tpu, rtt):
         except Exception as e:
             s16k = {"s16k_error": f"{type(e).__name__}: {e}"[:120]}
 
+    # which walk the cost model actually picked for this layout
+    try:
+        from deepspeed_tpu.ops.sparse_attention import blocksparse as _bs
+        coarse_pick = _bs._pick_coarse_block(
+            np.asarray(sp.sparsity_config.make_layout(S)), block,
+            has_am=False)
+    except Exception:
+        coarse_pick = "unknown"
+
     speedup = (t_vanilla / t_sparse) if t_vanilla else t_dense / t_sparse
     unit = ("vanilla_time_over_sparse_time" if t_vanilla
             else "flash_time_over_sparse_time")
@@ -298,7 +307,7 @@ def bench_sparse_attention(on_tpu, rtt):
     return _emit("sparse_attention_speedup_s8k", round(speedup, 3),
                  unit, round(speedup / 6.3, 4) if t_vanilla else None,
                  {"seq": S, "heads": H, "block": block, "window_blocks": win,
-                  "kernel": kernel,
+                  "kernel": kernel, "coarse_block": coarse_pick,
                   "baseline": "vanilla" if t_vanilla else "flash",
                   "vanilla_ms": round(t_vanilla * 1000, 2) if t_vanilla else None,
                   "flash_ms": round(t_dense * 1000, 2),
