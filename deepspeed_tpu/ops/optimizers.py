@@ -93,6 +93,20 @@ class Adam(Optimizer):
             exp_avg_sq=_tree_zeros_like(params, jnp.float32),
         )
 
+    def _preamble(self, state, lr, momentum):
+        """(lr, b1, step, bc1, bc2) shared by the fp32- and 8-bit-state
+        updates — bias correction and the OneCycle beta1 override must
+        never diverge between them."""
+        lr = self.lr if lr is None else lr
+        b1 = self.b1 if momentum is None else momentum
+        step = state.step + 1
+        if self.bias_correction:
+            bc1 = 1.0 - b1 ** step.astype(jnp.float32)
+            bc2 = 1.0 - self.b2 ** step.astype(jnp.float32)
+        else:
+            bc1 = bc2 = jnp.float32(1.0)
+        return lr, b1, step, bc1, bc2
+
     def update(self, grads, state, params, lr=None, momentum=None,
                sr_key=None):
         """``momentum``: optional (traced) beta1 override — the OneCycle
@@ -100,16 +114,8 @@ class Adam(Optimizer):
         param_groups betas every step; here the scheduled value flows
         into the compiled update like the lr does). ``sr_key``: PRNG key
         enabling stochastic rounding of bf16 params (see _cast_out)."""
-        lr = self.lr if lr is None else lr
-        b1 = self.b1 if momentum is None else momentum
-        step = state.step + 1
+        lr, b1, step, bc1, bc2 = self._preamble(state, lr, momentum)
         b2, eps, wd = self.b2, self.eps, self.weight_decay
-
-        if self.bias_correction:
-            bc1 = 1.0 - b1 ** step.astype(jnp.float32)
-            bc2 = 1.0 - b2 ** step.astype(jnp.float32)
-        else:
-            bc1 = bc2 = jnp.float32(1.0)
 
         def leaf(i, p, g, m, v):
             g = g.astype(jnp.float32)
@@ -136,6 +142,117 @@ class Adam(Optimizer):
         new_m = treedef.unflatten([o[1] for o in out])
         new_v = treedef.unflatten([o[2] for o in out])
         return new_params, AdamState(step=step, exp_avg=new_m, exp_avg_sq=new_v)
+
+
+class Adam8bitState(NamedTuple):
+    step: jnp.ndarray
+    m_codes: Params    # int8 (nblocks, block) per leaf
+    m_scales: Params   # fp32 (nblocks, 1) per leaf
+    v_codes: Params    # int8 codes of SQRT(exp_avg_sq) — see Adam8bit
+    v_scales: Params
+
+
+def _quantize8(x32, block):
+    """Block-wise symmetric absmax int8: (codes, scales). x32 is the
+    flattened-and-padded (nblocks*block,) fp32 tensor. An all-zero block
+    stores scale 0 (NOT a placeholder): downstream the scale doubles as
+    'was anything ever observed here' — a phantom scale would let the
+    code-0 dequant floor inject a fake second moment into frozen/unused
+    blocks and suppress their first real update ~60x."""
+    xb = x32.reshape(-1, block)
+    absmax = jnp.max(jnp.abs(xb), axis=1, keepdims=True)
+    scale = absmax / 127.0
+    codes = jnp.clip(jnp.round(xb / jnp.where(scale > 0.0, scale, 1.0)),
+                     -127.0, 127.0).astype(jnp.int8)
+    return codes, scale.astype(jnp.float32)
+
+
+def _dequantize8(codes, scales):
+    return (codes.astype(jnp.float32) * scales).reshape(-1)
+
+
+class Adam8bit(Adam):
+    """Adam/AdamW with block-wise 8-bit optimizer states (TPU extension
+    beyond the reference; the 8-bit-optimizer idea of Dettmers et al.
+    re-done the XLA way — no custom CUDA, the de/re-quantization fuses
+    into the compiled update). exp_avg/exp_avg_sq live as int8 codes +
+    per-block fp32 absmax scales: ~4x less optimizer-state HBM (capacity
+    AND update-step bandwidth) than fp32 moments, with dynamics
+    preserved by block-local scaling. Composes with ZeRO sharding, the
+    OneCycle momentum override, and stochastic-rounded bf16 params like
+    the fp32-state Adam.
+
+    The second moment is stored as int8 codes of SQRT(v), not v: linear
+    int8 spans only a 127:1 range per block, so any v below
+    absmax/254 quantized to exact zero — and a zero denominator turns a
+    surviving first moment into an exploding update (observed: a +2.36
+    parameter jump in one step). sqrt-space squares the representable
+    range (~64k:1 in v), and code-0 entries dequantize to a
+    quarter-granularity floor instead of zero (damps the rare
+    under-resolved coordinate rather than dividing by eps)."""
+
+    def __init__(self, *args, block_size: int = 256, **kw):
+        super().__init__(*args, **kw)
+        self.block_size = block_size
+
+    def _padded(self, n):
+        b = self.block_size
+        return (n + b - 1) // b * b
+
+    def init(self, params):
+        def zc(p):
+            return jnp.zeros((self._padded(p.size) // self.block_size,
+                              self.block_size), jnp.int8)
+
+        def zs(p):
+            return jnp.zeros((self._padded(p.size) // self.block_size, 1),
+                             jnp.float32)
+        return Adam8bitState(
+            step=jnp.zeros((), jnp.int32),
+            m_codes=jax.tree_util.tree_map(zc, params),
+            m_scales=jax.tree_util.tree_map(zs, params),
+            v_codes=jax.tree_util.tree_map(zc, params),
+            v_scales=jax.tree_util.tree_map(zs, params),
+        )
+
+    def update(self, grads, state, params, lr=None, momentum=None,
+               sr_key=None):
+        lr, b1, step, bc1, bc2 = self._preamble(state, lr, momentum)
+        b2, eps, wd = self.b2, self.eps, self.weight_decay
+
+        def leaf(i, p, g, mc, ms, vc, vs):
+            n, np_ = p.size, self._padded(p.size)
+            g32 = jnp.pad(g.astype(jnp.float32).reshape(-1), (0, np_ - n))
+            p32 = p.astype(jnp.float32).reshape(-1)
+            if wd != 0.0 and not self.adamw_mode:
+                g32 = g32 + wd * jnp.pad(p32, (0, np_ - n))
+            m = b1 * _dequantize8(mc, ms) + (1.0 - b1) * g32
+            # v codes encode sqrt(v); code 0 floors at quarter-granularity
+            # (init scales are 0, so the true-zero initial state is exact)
+            r_prev = jnp.maximum(_dequantize8(vc, vs),
+                                 jnp.broadcast_to(vs * 0.25,
+                                                  vc.shape).reshape(-1))
+            v = b2 * (r_prev * r_prev) + (1.0 - b2) * (g32 * g32)
+            denom = jnp.sqrt(v[:n] / bc2) + eps
+            update = (m[:n] / bc1) / denom
+            if wd != 0.0 and self.adamw_mode:
+                update = update + wd * p32
+            new_p = (p32 - lr * update).reshape(p.shape)
+            mc2, ms2 = _quantize8(m, self.block_size)
+            vc2, vs2 = _quantize8(jnp.sqrt(v), self.block_size)
+            return _cast_out(new_p, p, sr_key, i), mc2, ms2, vc2, vs2
+
+        flat_p, treedef = jax.tree_util.tree_flatten(params)
+        flat = zip(flat_p, treedef.flatten_up_to(grads),
+                   treedef.flatten_up_to(state.m_codes),
+                   treedef.flatten_up_to(state.m_scales),
+                   treedef.flatten_up_to(state.v_codes),
+                   treedef.flatten_up_to(state.v_scales))
+        out = [leaf(i, *args) for i, args in enumerate(flat)]
+        unf = lambda j: treedef.unflatten([o[j] for o in out])
+        return unf(0), Adam8bitState(step=step, m_codes=unf(1),
+                                     m_scales=unf(2), v_codes=unf(3),
+                                     v_scales=unf(4))
 
 
 class SGD(Optimizer):
@@ -304,6 +421,15 @@ def build_optimizer(name: str, params_dict: Optional[dict]) -> Optimizer:
                     weight_decay=p.pop("weight_decay", 0.0),
                     adamw_mode=adamw,
                     bias_correction=p.pop("bias_correction", True))
+    if name in ("adam8bit", "adam_8bit", "8bit_adam"):
+        adamw = p.pop("adam_w_mode", True)
+        return Adam8bit(lr=p.pop("lr", 1e-3),
+                        betas=tuple(p.pop("betas", (0.9, 0.999))),
+                        eps=p.pop("eps", 1e-8),
+                        weight_decay=p.pop("weight_decay", 0.0),
+                        adamw_mode=adamw,
+                        bias_correction=p.pop("bias_correction", True),
+                        block_size=p.pop("block_size", 256))
     if name == "adamw":
         return Adam(lr=p.pop("lr", 1e-3),
                     betas=tuple(p.pop("betas", (0.9, 0.999))),

@@ -259,6 +259,20 @@ class PipelineEngine(DeepSpeedEngine):
                                              saved["virtual_stages"])
         cur_order = self._stage_order()
         if saved_order != cur_order:
+            from deepspeed_tpu.ops.optimizers import Adam8bitState
+            if isinstance(self.state.opt_state, Adam8bitState):
+                # the quantized moments are flattened (nblocks, block)
+                # arrays — axis 0 is quantization blocks, NOT the stage
+                # axis, so they cannot be re-permuted across layouts
+                raise ValueError(
+                    "pipeline layout changed (saved "
+                    f"{saved['pipe_axis']}x{saved['virtual_stages']} vs "
+                    f"current {self.pipeline_spec.num_stages}x"
+                    f"{getattr(self, 'virtual_stages', 1)}) but Adam8bit "
+                    "stores stage-stacked moments as flattened "
+                    "quantization blocks and cannot re-permute them; "
+                    "resume with the same layout, or use Adam for "
+                    "layout-change resumes")
             # slot j currently holds global stage saved_order[j]; we need
             # it to hold cur_order[j]
             pos = {g: j for j, g in enumerate(saved_order)}

@@ -8,10 +8,11 @@ build, so the group helper returns the axis name it would shard over.
 """
 
 from deepspeed_tpu.ops.adam.cpu_adam import DeepSpeedCPUAdam
-from deepspeed_tpu.ops.optimizers import Adam, FusedAdam, Lamb, SGD
+from deepspeed_tpu.ops.optimizers import Adam, Adam8bit, FusedAdam, Lamb, SGD
 from deepspeed_tpu.utils.logging import logger
 
-ZERO_SUPPORTED_OPTIMIZERS = [Adam, FusedAdam, Lamb, SGD, DeepSpeedCPUAdam]
+ZERO_SUPPORTED_OPTIMIZERS = [Adam, Adam8bit, FusedAdam, Lamb, SGD,
+                             DeepSpeedCPUAdam]
 
 
 def is_zero_supported_optimizer(optimizer) -> bool:

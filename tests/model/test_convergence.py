@@ -221,3 +221,17 @@ def test_convergence_bert_mlm_sparse_attention():
     sc = sparsity_config_from_dict(parsed, num_heads=2)
     losses = _train_bert(sparsity_config=sc)
     assert losses[-1] < THRESHOLD, losses[::10]
+
+
+def test_convergence_zero2_adam8bit():
+    """8-bit optimizer states (TPU extension): the quantized-moment Adam
+    must learn the task under ZeRO-2 like the fp32-state gate above."""
+    params = init_gpt2_params(CFG, jax.random.PRNGKey(0))
+    losses = _train(gpt2_loss_fn(CFG, dtype=jnp.float32,
+                                 deterministic=True),
+                    params, _base_config(
+                        zero_optimization={"stage": 2},
+                        mesh={"axes": {"data": 8}},
+                        optimizer={"type": "Adam8bit",
+                                   "params": {"lr": 3e-3}}))
+    assert losses[-1] < THRESHOLD, losses[::10]
