@@ -223,6 +223,12 @@ class DeepSpeedEngine:
                 "ZeRO-Offload requires a plain Adam-family optimizer (the " \
                 "reference drives DeepSpeedCPUAdam, stage2.py:1418); " \
                 "OnebitAdam does not compose with ZeRO/offload"
+            assert "8bit" not in name and "8_bit" not in name, \
+                "Adam8bit does not compose with cpu_offload: offload " \
+                "keeps fp32 moments in HOST memory (the native CPU Adam " \
+                "owns them), so quantized device states would be " \
+                "silently replaced — drop cpu_offload to use 8-bit " \
+                "states, or keep offload with the host fp32 states"
             self.optimizer = None  # built below, once master params exist
         elif optimizer is not None:
             self.optimizer = optimizer
