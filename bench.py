@@ -353,6 +353,11 @@ def bench_gpt2(on_tpu, rtt, dropout: float, metric: str):
         # A/B the fp32-master-less update (no fp32 param copy to stream
         # through HBM at the optimizer boundary; same compute path)
         bf16_cfg.update(master_weights=False, stochastic_rounding=True)
+    # BENCH_ADAM8BIT=1: quantized moments — ~4x less optimizer-state
+    # HBM traffic at the update boundary (A/B knob)
+    opt_type = ("Adam8bit"
+                if os.environ.get("BENCH_ADAM8BIT", "0") == "1"
+                else "Adam")
     engine, *_ = deepspeed_tpu.initialize(
         model=loss_fn, model_parameters=params,
         config={
@@ -361,7 +366,7 @@ def bench_gpt2(on_tpu, rtt, dropout: float, metric: str):
             "bf16": bf16_cfg,
             "steps_per_print": 10**9,
             "zero_optimization": {"stage": 2 if n_dev > 1 else 0},
-            "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+            "optimizer": {"type": opt_type, "params": {"lr": 1e-4}},
         })
 
     rng = np.random.RandomState(0)

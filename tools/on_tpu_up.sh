@@ -61,6 +61,9 @@ BENCH_REF_ATTN=1 timeout 2400 python bench.py \
   --metric bert_large_samples_per_s 2>&1 | tee "$OUT/bert_ref_attn.log"
 BENCH_REF_ATTN=1 timeout 2400 python bench.py --metric gpt2_train_mfu \
   2>&1 | tee "$OUT/headline_ref_attn.log"
+# 8-bit optimizer states: ~4x less optimizer-state HBM at the update
+BENCH_ADAM8BIT=1 timeout 2400 python bench.py --metric gpt2_train_mfu \
+  2>&1 | tee "$OUT/headline_adam8bit.log"
 
 echo "== autotune block table (writes deepspeed_tpu/ops/attention/block_table.json)"
 timeout 3600 python tools/autotune_blocks.py 2>&1 | tee "$OUT/autotune.log"
