@@ -198,3 +198,28 @@ class TestSREngine:
                 model=simple_loss_fn, model_parameters=params,
                 config=sr_config(
                     zero_optimization={"stage": 2, "cpu_offload": True}))
+
+
+class TestMinimalMemoryCompose:
+    """Adam8bit x master-free bf16 x stochastic rounding — the
+    minimal-memory training configuration (int8 moments, bf16 params,
+    no fp32 master) must train end to end through the engine."""
+
+    def test_adam8bit_master_free_trains(self):
+        import deepspeed_tpu as ds
+        params = init_simple_params(jax.random.PRNGKey(0), HIDDEN)
+        cfg = sr_config(optimizer={"type": "Adam8bit",
+                                   "params": {"lr": 1e-2}})
+        eng, *_ = ds.initialize(model=simple_loss_fn,
+                                model_parameters=params, config=cfg)
+        losses = [float(eng.train_batch(iter([b])))
+                  for b in random_batches(40, 8, HIDDEN, seed=0)]
+        assert losses[-1] < 0.5 * losses[0], (losses[0], losses[-1])
+        # params stayed bf16 and the quantized moments are int8
+        leaves = jax.tree_util.tree_leaves(eng.state.params)
+        assert all(x.dtype == jnp.bfloat16 for x in leaves)
+        from deepspeed_tpu.ops.optimizers import Adam8bitState
+        st = eng.state.opt_state
+        assert isinstance(st, Adam8bitState)
+        assert all(x.dtype == jnp.int8
+                   for x in jax.tree_util.tree_leaves(st.m_codes))
