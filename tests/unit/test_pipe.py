@@ -588,3 +588,49 @@ def test_interleaved_eval_batch():
     ref = np.mean([float(_mse(module.forward(params, m["x"]), m))
                    for m in micros])
     np.testing.assert_allclose(ev, ref, rtol=2e-4)
+
+
+def test_adam8bit_pipeline_same_layout_resume_and_layout_change_guard(
+        tmp_path):
+    """Quantized optimizer states compose with the pipeline at a FIXED
+    layout (train, save, resume, continue); a layout-change resume must
+    raise (axis 0 of the int8 code leaves is quantization blocks, not
+    the stage axis, so re-permutation would corrupt state silently)."""
+    micros = _micro_batches(12, global_mb=4)
+    mk = lambda: ds.PipelineModule(
+        [ds.LayerSpec(Linear, H) for _ in range(8)],
+        num_stages=8, loss_fn=_mse, partition_method="uniform")
+    module_a = mk()
+    params = module_a.init_params(jax.random.PRNGKey(0))
+    cfg = _pipe_config(pipeline={"virtual_stages": 2},
+                       optimizer={"type": "Adam8bit",
+                                  "params": {"lr": 1e-2}})
+    eng_a, *_ = ds.initialize(model=module_a, model_parameters=params,
+                              config=cfg)
+    it = iter(micros)
+    for _ in range(2):
+        eng_a.train_batch(it)
+    eng_a.save_checkpoint(str(tmp_path), tag="ck")
+    loss_a = float(eng_a.train_batch(it))
+
+    # same layout: resume must reproduce the trajectory
+    module_b = mk()
+    eng_b, *_ = ds.initialize(
+        model=module_b,
+        model_parameters=module_b.init_params(jax.random.PRNGKey(42)),
+        config=cfg)
+    eng_b.load_checkpoint(str(tmp_path), tag="ck")
+    loss_b = float(eng_b.train_batch(iter(micros[8:])))
+    np.testing.assert_allclose(loss_b, loss_a, rtol=2e-4)
+
+    # different layout: explicit refusal, not silent corruption
+    module_c = mk()
+    eng_c, *_ = ds.initialize(
+        model=module_c,
+        model_parameters=module_c.init_params(jax.random.PRNGKey(7)),
+        config=_pipe_config(mesh={"axes": {"pipe": 8, "data": 1}},
+                            train_micro_batch_size_per_gpu=4,
+                            optimizer={"type": "Adam8bit",
+                                       "params": {"lr": 1e-2}}))
+    with pytest.raises(ValueError, match="Adam8bit"):
+        eng_c.load_checkpoint(str(tmp_path), tag="ck")
