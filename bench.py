@@ -524,6 +524,34 @@ def _load_partial(head):
     return rows
 
 
+def _stale_partial(head):
+    """Rows from a previous COMPLETED ladder at a DIFFERENT source
+    digest. Never resumed as measurements — attached to dead-tunnel
+    error rows (clearly labeled) so the audit trail points at the most
+    recent hardware data instead of a bare error."""
+    try:
+        with open(PARTIAL_PATH) as f:
+            header = json.loads(f.readline())
+            if header.get("head") == head:
+                return None
+            rows = {}
+            for line in f:
+                row = json.loads(line)
+                if row.get("unit") != "error":
+                    rows[row["metric"]] = {
+                        "value": row["value"], "unit": row["unit"],
+                        "vs_baseline": row["vs_baseline"]}
+            if not rows:
+                return None
+            return {"source_digest": header.get("head"),
+                    "note": "measured by an EARLIER source revision; "
+                            "NOT a current measurement — see "
+                            "BENCH_NOTES.md for the full rows",
+                    "rows": rows}
+    except Exception:
+        return None
+
+
 def _append_partial(head, row, fresh):
     """Returns the next value of ``fresh``: stays True if the header
     write failed (appending under a stale different-commit header would
@@ -604,6 +632,10 @@ def main():
         # rows in minutes
         if not _probe_tunnel() and (time.sleep(60) or not _probe_tunnel()):
             err = "device unreachable at bench start (2 probes failed)"
+            stale = _stale_partial(head)
+            detail = {"error": err}
+            if stale:
+                detail["last_completed_ladder"] = stale
             for metric in METRICS:
                 if metric not in done:
                     failed[metric] = err
@@ -613,11 +645,11 @@ def main():
                 if metric in done:
                     _emit_row(done[metric])
                 else:
-                    _emit(metric, 0.0, "error", 0.0, {"error": err})
+                    _emit(metric, 0.0, "error", 0.0, detail)
             if HEADLINE in done:
                 _emit_row(done[HEADLINE])
             else:
-                _emit(HEADLINE, 0.0, "error", 0.0, {"error": err})
+                _emit(HEADLINE, 0.0, "error", 0.0, detail)
             return
 
     for metric in METRICS:
