@@ -54,6 +54,12 @@ HEADLINE = "gpt2_train_mfu"
 
 PARTIAL_PATH = os.environ.get(
     "BENCH_PARTIAL", "/tmp/dstpu_bench_partial.jsonl")
+if os.environ.get("JAX_PLATFORMS", "").startswith("cpu") and \
+        "BENCH_PARTIAL" not in os.environ:
+    # forced-CPU smoke runs must not clobber the TPU ladder's checkpoint
+    # (a CPU parent run once overwrote the hardware rows the stale-
+    # pointer audit trail depends on)
+    PARTIAL_PATH += ".cpu"
 # First metric in a cold child pays remote compile time; give headroom.
 METRIC_TIMEOUT = int(os.environ.get("BENCH_METRIC_TIMEOUT", "1500"))
 METRIC_RETRIES = int(os.environ.get("BENCH_METRIC_RETRIES", "1"))
