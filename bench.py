@@ -506,6 +506,12 @@ def _git_head():
         for k in sorted(os.environ):
             if k.startswith("BENCH_") and k not in control:
                 h.update(f"{k}={os.environ[k]}".encode())
+        # the measurement platform is part of the resume key: rows from
+        # a forced-CPU run must never resume as hardware rows (the .cpu
+        # partial-path suffix only protects the DEFAULT path)
+        if os.environ.get("JAX_PLATFORMS"):
+            h.update(f"JAX_PLATFORMS={os.environ['JAX_PLATFORMS']}"
+                     .encode())
         return "src-" + h.hexdigest()[:16]
     except Exception:
         return None
