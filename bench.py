@@ -243,13 +243,17 @@ def bench_sparse_attention(on_tpu, rtt):
     t_dense = timed(dense_loss)
     try:
         t_sparse = timed(sparse_loss)
-        kernel = "v2"
+        from deepspeed_tpu.ops.sparse_attention import blocksparse as _bsk
+        kernel = _bsk.planned_kernel(sp.get_layout(S), block)
     except NoiseFloorError:
         raise   # measurement failure, not a kernel failure: error row
     except Exception:
-        # fall back to the per-triple v1 kernels rather than losing the row
+        # fall back to the per-triple v1 kernels rather than losing the
+        # row (banded must drop too or the retry re-dispatches the very
+        # kernel that failed)
         from deepspeed_tpu.ops.sparse_attention import blocksparse as bs
         bs.USE_SPLASH_V2 = False
+        bs.USE_BANDED = False
         bs._FN_CACHE.clear()
         t_sparse = timed(sparse_loss)
         kernel = "v1-fallback"
@@ -308,12 +312,20 @@ def bench_sparse_attention(on_tpu, rtt):
     speedup = (t_vanilla / t_sparse) if t_vanilla else t_dense / t_sparse
     unit = ("vanilla_time_over_sparse_time" if t_vanilla
             else "flash_time_over_sparse_time")
+    # record the A/B knob state: with BENCH_REF_ATTN=1 the 'flash'
+    # baseline is the XLA reference path below the streaming threshold
+    # (ADVICE r3 #2 — never leave that attribution implicit)
+    from deepspeed_tpu.ops.attention import flash as _F
     # the 6.3x reference target is vanilla-relative: a flash-relative
     # fallback ratio is not comparable to it, so report no vs_baseline
     return _emit("sparse_attention_speedup_s8k", round(speedup, 3),
                  unit, round(speedup / 6.3, 4) if t_vanilla else None,
                  {"seq": S, "heads": H, "block": block, "window_blocks": win,
                   "kernel": kernel, "coarse_block": coarse_pick,
+                  # EFFECTIVE state at this row's S: above the streaming
+                  # threshold flash_attention ignores the force knob
+                  "ref_attn_forced": bool(_F._FORCE_REFERENCE
+                                          and S < _F.STREAM_THRESHOLD),
                   "baseline": "vanilla" if t_vanilla else "flash",
                   "vanilla_ms": round(t_vanilla * 1000, 2) if t_vanilla else None,
                   "flash_ms": round(t_dense * 1000, 2),
@@ -442,6 +454,11 @@ def run_child(metric):
         # (bf16 MXU operands) instead of the Pallas flash kernels
         from deepspeed_tpu.ops.attention import flash as _F
         _F._FORCE_REFERENCE = True
+    if os.environ.get("BENCH_DROPOUT_HASH1", "0") == "1":
+        # A/B knob: single-round dropout-hash finalizer (same keep
+        # statistics, ~half the tile-wide VPU hash work)
+        from deepspeed_tpu.ops.attention import flash as _F
+        _F._HASH_FINAL_ROUNDS = 1
     rtt = _rtt()
     _beat()
 

@@ -43,10 +43,25 @@ def _git_info():
         with open(head) as f:
             ref = f.read().strip()
         if ref.startswith("ref:"):
-            branch = ref.split("/")[-1]
-            with open(os.path.join(os.path.dirname(head),
-                                   *ref.split()[1].split("/"))) as f:
-                return f.read().strip()[:9], branch
+            refname = ref.split()[1]
+            branch = refname.split("/")[-1]
+            try:
+                with open(os.path.join(os.path.dirname(head),
+                                       *refname.split("/"))) as f:
+                    return f.read().strip()[:9], branch
+            except OSError:
+                # after git gc/pack-refs the loose ref file is gone —
+                # the hash lives in .git/packed-refs (ADVICE r3 #1)
+                try:
+                    with open(os.path.join(os.path.dirname(head),
+                                           "packed-refs")) as f:
+                        for line in f:
+                            parts = line.strip().split(" ", 1)
+                            if len(parts) == 2 and parts[1] == refname:
+                                return parts[0][:9], branch
+                except OSError:
+                    pass
+                return "unknown", branch
         return ref[:9], "detached"
     except OSError:
         return "unknown", "unknown"

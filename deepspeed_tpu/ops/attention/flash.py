@@ -46,6 +46,10 @@ except ImportError:  # pragma: no cover
     pltpu = None
 
 NEG_INF = -1e30
+# dropout-hash finalizer rounds: 2 = lowbias32-quality (default), 1 =
+# single multiply-xorshift round (A/B knob BENCH_DROPOUT_HASH1=1 via
+# bench.py; same keep statistics, cheaper tile-wide VPU work)
+_HASH_FINAL_ROUNDS = 2
 _WARNED_IRREGULAR_FALLBACK = False
 # Route EVERY call through attention_reference (the XLA-fused O(S^2)
 # path): A/B knob — at short sequences (e.g. BERT seq128) XLA's batched
@@ -80,10 +84,24 @@ def dropout_keep_mask(seed, bh, q_idx, k_idx, seq_k, rate):
         x = (x ^ (x >> 15)) * jnp.uint32(0x846CA68B)
         return x ^ (x >> 16)
 
+    # pass q_idx/k_idx as broadcastable (bq, 1)/(1, bk) VECTORS: the row
+    # round then costs O(bq), and only the final round runs on the full
+    # (bq, bk) tile
     row = mix(q_idx.astype(jnp.uint32)
               ^ (jnp.uint32(bh) * jnp.uint32(0x9E3779B9))
               ^ seed.astype(jnp.uint32))
-    x = mix(row ^ k_idx.astype(jnp.uint32))
+    x = row ^ k_idx.astype(jnp.uint32)
+    if _HASH_FINAL_ROUNDS == 1:
+        # cheaper tile-wide finalizer (half the multiplies): one
+        # multiply-xorshift round on top of an already-mixed row hash.
+        # Keep-rate statistics and fwd/bwd bit-consistency are unchanged
+        # (tests pin both); only the mask pattern differs. A/B knob —
+        # promote to default if the hardware ladder shows dropout-MFU
+        # gains without convergence drift.
+        x = (x ^ (x >> 16)) * jnp.uint32(0x7FEB352D)
+        x = x ^ (x >> 15)
+    else:
+        x = mix(x)
     keep_thresh = min(int(round((1.0 - rate) * 2.0**32)), 2**32 - 1)
     return x < jnp.uint32(keep_thresh)
 
@@ -150,8 +168,13 @@ def attention_reference(q, k, v, mask=None, causal=False,
 # pallas kernels
 # --------------------------------------------------------------------- #
 def _tile_idx(q0, k0, block_q, block_k):
-    q_idx = q0 + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-    k_idx = k0 + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    # (bq, 1) and (1, bk) VECTORS, not full tiles: every consumer (the
+    # causal compare and the dropout hash) broadcasts, and the hash's
+    # row-mixing round then runs on bq elements instead of bq*bk — the
+    # dominant share of the in-kernel dropout tax (VERDICT r3 #3). The
+    # generated bits are identical to the full-tile form.
+    q_idx = q0 + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+    k_idx = k0 + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
     return q_idx, k_idx
 
 
@@ -500,48 +523,106 @@ def _block_cap(seq, stream):
 
 # measured block-size table (VERDICT r2 #6: the reference ships a GemmTest
 # autotuner, csrc/includes/gemm_test.h:27). tools/autotune_blocks.py sweeps
-# (bq, bk) combinations per (seq_q, seq_k, d, stream) shape class on the
-# real chip and writes block_table.json next to this module; unknown
-# shapes fall back to the hand-measured heuristic below.
-_BLOCK_TABLE = None
+# (bq, bk) combinations per shape class on the real chip and writes
+# block_table.json next to this module; unknown shapes fall back to the
+# hand-measured heuristic below. Entries carry:
+#   kind: "flash" (default) keyed (seq_q, seq_k, d, stream, gqa)
+#         "banded" keyed (seq, fine_block, band_w, causal)
+#   device_kind: jax device_kind the entry was measured on. An entry with
+#         device_kind applies ONLY on that exact chip generation (a v5p
+#         must never consume v5e-tuned blocks); entries without it are a
+#         legacy global fallback, used when no exact-device entry matches.
+_BLOCK_ENTRIES = None
+_BLOCK_TABLE = None      # test hook: when set, overrides entry matching
 _FORCE_BLOCKS = None     # (bq, bk) override used by the autotune sweep
 
 
-def _load_block_table():
-    global _BLOCK_TABLE
-    if _BLOCK_TABLE is None:
+def _load_block_entries():
+    global _BLOCK_ENTRIES
+    if _BLOCK_ENTRIES is None:
         import json
         import os
         path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "block_table.json")
-        table = {}
         try:
             with open(path) as f:
-                for e in json.load(f):
-                    key = (e["seq_q"], e["seq_k"], e["d"], bool(e["stream"]))
-                    # ms <= 0 is an RTT-subtraction artifact from an old
-                    # sweep harness, never a real measurement — skip it
-                    if e["seq_q"] % e["bq"] == 0 and \
-                            e["seq_k"] % e["bk"] == 0 and \
-                            e.get("ms", 1.0) > 0.0:
-                        table[key] = (e["bq"], e["bk"])
-        except (OSError, ValueError, KeyError):
-            pass
-        _BLOCK_TABLE = table
-    return _BLOCK_TABLE
+                _BLOCK_ENTRIES = [e for e in json.load(f)
+                                  if isinstance(e, dict)]
+        except (OSError, ValueError):
+            _BLOCK_ENTRIES = []
+    return _BLOCK_ENTRIES
 
 
-def _pick_blocks(seq_q, seq_k, d=None):
+def _device_kind():
+    try:
+        return jax.devices()[0].device_kind
+    except Exception:
+        return None
+
+
+def _table_lookup(match):
+    """Best matching table entry for the current device: exact
+    device_kind match wins; entries without device_kind are the global
+    (legacy) fallback; a wrong-device entry never matches."""
+    kind = _device_kind()
+    fallback = None
+    for e in _load_block_entries():
+        try:
+            # ms <= 0 is an RTT-subtraction artifact from an old sweep
+            # harness, never a real measurement — skip it
+            if e.get("ms", 1.0) <= 0.0 or not match(e):
+                continue
+        except (KeyError, TypeError):
+            continue
+        dk = e.get("device_kind")
+        if dk is not None:
+            if dk == kind:
+                return e
+        elif fallback is None:
+            fallback = e
+    return fallback
+
+
+def _pick_blocks(seq_q, seq_k, d=None, gqa=1):
     if _FORCE_BLOCKS is not None:
         return _FORCE_BLOCKS
     stream = _use_stream(seq_q, seq_k)
     if d is not None:
-        hit = _load_block_table().get((seq_q, seq_k, d, stream))
-        if hit is not None:
-            return hit
+        if _BLOCK_TABLE is not None:                    # test hook
+            hit = _BLOCK_TABLE.get((seq_q, seq_k, d, stream))
+            if hit is not None:
+                return hit
+        else:
+            e = _table_lookup(
+                lambda e: e.get("kind", "flash") == "flash"
+                and e["seq_q"] == seq_q and e["seq_k"] == seq_k
+                and e["d"] == d and bool(e["stream"]) == stream
+                and e.get("gqa", 1) == gqa
+                and seq_q % e["bq"] == 0 and seq_k % e["bk"] == 0)
+            if e is not None:
+                return (e["bq"], e["bk"])
     cap = _block_cap(max(seq_q, seq_k), stream)
     return (_largest_divisor_block(seq_q, cap),
             _largest_divisor_block(seq_k, cap))
+
+
+def lookup_banded_blocks(seq, fine_block, band_w=None, causal=None):
+    """Measured walk-tile sizes for the banded sparse kernels
+    (ops/sparse_attention/banded.py), or None. band_w/causal narrow the
+    match when given; an entry without those fields matches any."""
+    def m(e):
+        if e.get("kind") != "banded" or e["seq"] != seq or \
+                e["fine_block"] != fine_block:
+            return False
+        if band_w is not None and e.get("band_w") is not None and \
+                e["band_w"] != band_w:
+            return False
+        if causal is not None and e.get("causal") is not None and \
+                bool(e["causal"]) != causal:
+            return False
+        return seq % e["bq"] == 0 and seq % e["bk"] == 0
+    e = _table_lookup(m)
+    return (e["bq"], e["bk"]) if e is not None else None
 
 
 def _seed_spec():
@@ -555,7 +636,7 @@ def _flash_fwd(q, k, v, mask, causal, sm_scale, interpret,
     hkv = k.shape[1]
     G = h // hkv       # GQA group size (1 = MHA); validated in the API
     sk = k.shape[2]
-    bq, bk = _pick_blocks(sq, sk, d)
+    bq, bk = _pick_blocks(sq, sk, d, gqa=G)
     assert sq % bq == 0 and sk % bk == 0, (sq, sk, bq, bk)
     qr = q.reshape(b * h, sq, d)
     kr = k.reshape(b * hkv, sk, d)
@@ -631,7 +712,7 @@ def _flash_bwd(res, g, causal, sm_scale, interpret,
     hkv = k.shape[1]
     G = h // hkv
     sk = k.shape[2]
-    bq, bk = _pick_blocks(sq, sk, d)
+    bq, bk = _pick_blocks(sq, sk, d, gqa=G)
     do = g
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)                               # (b,h,sq)
@@ -876,7 +957,23 @@ def flash_attention(q, k, v, mask=None, causal: bool = False,
     else:
         seed = jnp.zeros((1, 1), jnp.int32)
     sq, sk = q.shape[2], k.shape[2]
-    if force_reference or _FORCE_REFERENCE or sq % 16 != 0 or sk % 16 != 0:
+    force_ref = _FORCE_REFERENCE
+    if force_ref and max(sq, sk) >= STREAM_THRESHOLD:
+        # the A/B knob must never silently re-route a long-context
+        # measurement onto the O(S^2) path (it would OOM or be
+        # mis-attributed as the flash baseline — ADVICE r3 #2): above
+        # the streaming threshold the knob is ignored, loudly
+        global _WARNED_REF_STREAM
+        if not globals().get("_WARNED_REF_STREAM"):
+            _WARNED_REF_STREAM = True
+            import warnings
+            warnings.warn(
+                f"flash_attention: _FORCE_REFERENCE ignored at seq "
+                f"({sq}, {sk}) >= {STREAM_THRESHOLD} — the O(S^2) "
+                "reference path is not meaningful (or feasible) in the "
+                "DMA-streaming regime.", stacklevel=2)
+        force_ref = False
+    if force_reference or force_ref or sq % 16 != 0 or sk % 16 != 0:
         if not force_reference and not _FORCE_REFERENCE \
                 and max(sq, sk) > 2048:
             global _WARNED_IRREGULAR_FALLBACK
@@ -896,7 +993,7 @@ def flash_attention(q, k, v, mask=None, causal: bool = False,
                                    # perf knob only: an explicit
                                    # force_reference caller gets the
                                    # fp32 accuracy oracle
-                                   mxu_bf16=_FORCE_REFERENCE
+                                   mxu_bf16=force_ref
                                    and not force_reference)
     if (max(sq, sk) >= STREAM_THRESHOLD
             and (sq % 128 != 0 or sk % 128 != 0)):

@@ -317,6 +317,11 @@ _FN_CACHE = {}
 USE_SPLASH_V2 = True
 _WARNED_V1_BLOCK = False
 
+# banded fast path (banded.py): layouts that match the global-prefix +
+# sliding-window predicate (BSLongformer-class) skip all CSR/DMA-stream
+# machinery — masks are computed from iota block arithmetic in registers
+USE_BANDED = True
+
 # layout coarsening (blocksparse_v2.build_coarse_index): walk coarse
 # tiles, express fine structure as streamed NEG_INF mask tiles. Auto by
 # cost model; _FORCE_COARSE_BLOCK: None = auto, 0 = off, N = force N.
@@ -378,6 +383,23 @@ def _pick_coarse_block(layout: np.ndarray, block: int, has_am: bool):
     return best[1] if best else None
 
 
+def planned_kernel(layout, block, has_am=False, interpret=False) -> str:
+    """Which kernel family _sparse_attention_fn would build for this
+    layout — diagnostic/bench reporting only: 'banded' | 'v2-coarse<N>'
+    | 'v2' | 'v1'."""
+    layout = np.asarray(layout)
+    if USE_BANDED and not has_am:
+        from deepspeed_tpu.ops.sparse_attention import banded as _b
+        if _b.plan(layout, block, interpret) is not None:
+            return "banded"
+    coarse = (_pick_coarse_block(layout, block, has_am)
+              if USE_SPLASH_V2 else None)
+    if USE_SPLASH_V2 and (interpret or block % 128 == 0
+                          or coarse is not None):
+        return f"v2-coarse{coarse}" if coarse else "v2"
+    return "v1"
+
+
 def _use_pallas():
     try:
         return jax.default_backend() == "tpu"
@@ -391,11 +413,22 @@ def _sparse_attention_fn(layout: np.ndarray, block: int, sm_scale: float,
     (B, H, S, D), kpm a pre-blocked additive (B, nk, 1, block) mask and am a
     pre-blocked additive (nq, nk, block, block) mask. Nonzero-block triples
     are closed over as static data and fed to Mosaic via scalar prefetch."""
+    from deepspeed_tpu.ops.sparse_attention import banded as _banded
     key = (layout.shape, layout.tobytes(), block, float(sm_scale), has_am,
            interpret, USE_SPLASH_V2, USE_COARSE, _FORCE_COARSE_BLOCK,
-           _COARSE_TILE_BUDGET)
+           _COARSE_TILE_BUDGET, USE_BANDED, _banded._FORCE_BLOCKS)
     if key in _FN_CACHE:
         return _FN_CACHE[key]
+
+    if USE_BANDED and not has_am:
+        planned = _banded.plan(layout, block, interpret)
+        if planned is not None:
+            bp, blocks = planned
+            fb = _banded.build_banded_fn(layout.shape, block, bp,
+                                         float(sm_scale), blocks,
+                                         interpret)
+            _FN_CACHE[key] = fb
+            return fb
 
     H, nq, nk = layout.shape
     coarse_block = (_pick_coarse_block(layout, block, has_am)

@@ -405,4 +405,13 @@ def sparsity_config_from_dict(cfg, num_heads: int):
     if mode not in classes:
         raise ValueError(
             f"sparse_attention mode {mode!r} not in {sorted(classes)}")
+    if "block" not in cfg:
+        # the parse-first contract, enforced (ADVICE r3 #3): a raw
+        # (unparsed) dict would silently get the CLASS defaults
+        # (block=64) instead of the JSON-schema defaults (block=16)
+        # that runtime/config.py get_sparse_attention applies
+        raise ValueError(
+            "sparsity_config_from_dict expects the PARSED sparse_attention "
+            "sub-config (runtime/config.py get_sparse_attention), which "
+            "always carries 'block'; got a raw dict without it")
     return classes[mode](num_heads=num_heads, **kwargs)

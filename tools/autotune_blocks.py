@@ -1,18 +1,28 @@
-"""Flash-attention block-size autotune sweep (VERDICT r2 #6).
+"""Attention block-size autotune sweep (VERDICT r2 #6, r3 #6).
 
 TPU-native analog of the reference's GemmTest autotuner
 (/root/reference/csrc/includes/gemm_test.h:27): instead of per-GEMM
-algorithm search at engine construction, this offline harness times the
-Pallas flash kernel's (block_q, block_k) combinations per shape class
-(seq_q, seq_k, head_dim, stream) on the REAL chip and writes the winners
-to ``deepspeed_tpu/ops/attention/block_table.json``, which
-``flash._pick_blocks`` consults at trace time (unknown shapes keep the
-hand-measured heuristic).
+algorithm search at engine construction, this offline harness times
+kernel block combinations per shape class on the REAL chip and writes
+the winners to ``deepspeed_tpu/ops/attention/block_table.json``,
+consulted at trace time by ``flash._pick_blocks`` (kind="flash": keys
+seq_q/seq_k/d/stream/gqa) and ``flash.lookup_banded_blocks``
+(kind="banded": keys seq/fine_block/band_w/causal for the banded sparse
+walk). Unknown shapes keep the hand-measured heuristics.
+
+Every entry is stamped with the measuring chip's ``device_kind``; the
+lookups only consume same-device entries (legacy unstamped entries act
+as a global fallback), so a v5p never consumes v5e-tuned blocks. On a
+hardware run this tool also stamps any legacy unstamped entries with the
+current device_kind — this rig has only ever measured on its one chip.
 
 Run on hardware:  PYTHONPATH=/root/repo python tools/autotune_blocks.py
-(~minutes; each combo pays one compile). Timing: value-fetch completion
-barrier + RTT subtraction, min-of-3 windows (the device tunnel adds
-large variable latency — see bench.py).
+(~minutes; each combo pays one compile, amortized by the persistent
+compile cache). Timing: value-fetch completion barrier + RTT
+subtraction via the shared scan-amortized protocol (utils/benchtime.py).
+Idempotent: shapes that already have an entry for this device_kind are
+skipped (pass --force to re-measure) so a re-run in a later tunnel
+window costs nothing and keeps the bench source digest stable.
 """
 
 import argparse
@@ -25,23 +35,45 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "deepspeed_tpu", "ops", "attention",
                    "block_table.json")
 
-# the bench/model ladder's attention shapes (seq_q, seq_k, head_dim)
-SHAPES = [
-    (128, 128, 64),        # BERT-large seq128 (bench headline row)
-    (512, 512, 64),        # BERT seq512
-    (1024, 1024, 64),      # GPT-2 345M / 1.5B pretraining
-    (2048, 2048, 64),
-    (8192, 8192, 64),      # long-context / sparse-vs-dense row
-    (16384, 16384, 64),    # streamed
-    (32768, 32768, 64),    # streamed
-    (1024, 1024, 80),      # 80-dim heads (e.g. 2560/32-style configs)
+# flash shape classes: (seq_q, seq_k, head_dim, gqa_group)
+FLASH_SHAPES = [
+    (128, 128, 64, 1),         # BERT-large seq128 (bench headline row)
+    (512, 512, 64, 1),         # BERT seq512
+    (1024, 1024, 64, 1),       # GPT-2 345M / 1.5B pretraining
+    (2048, 2048, 64, 1),
+    (8192, 8192, 64, 1),       # long-context / sparse-vs-dense row
+    (16384, 16384, 64, 1),     # streamed
+    (32768, 32768, 64, 1),     # streamed
+    (1024, 1024, 80, 1),       # 80-dim heads (e.g. 2560/32-style configs)
+    (1024, 1024, 128, 1),      # llama-family head_dim
+    (2048, 2048, 128, 4),      # llama GQA (kv_heads = heads/4)
+    (4096, 4096, 128, 4),
+    (2048, 2048, 64, 4),       # GQA at d=64
 ]
 CANDIDATES = (64, 128, 256, 512)
+
+# banded sparse walk shape classes: (S, fine_block, window_blocks)
+# — the bench row (S=8192, fb=128, win=3 BSLongformer), its s16k
+# long-context detail, and the class-default fb=64 geometry
+BANDED_SHAPES = [
+    (8192, 128, 3),
+    (16384, 128, 3),
+    (8192, 64, 3),
+]
+BANDED_CANDIDATES = (128, 256, 512)
 
 
 def _rtt():
     from deepspeed_tpu.utils.benchtime import measure_rtt
     return measure_rtt()
+
+
+def _device_kind():
+    import jax
+    try:
+        return jax.devices()[0].device_kind
+    except Exception:
+        return None
 
 
 def _shape_plan(sq):
@@ -58,7 +90,7 @@ def _shape_plan(sq):
     return 1, 4, 3
 
 
-def time_combo(sq, sk, d, bq, bk, rtt, iters=None, heads=None):
+def time_combo(sq, sk, d, bq, bk, rtt, iters=None, heads=None, gqa=1):
     # iters/heads are debug-only overrides (smoke tests); the sweep itself
     # always lets _shape_plan pick them so winners aren't latency-noise.
     import jax
@@ -70,10 +102,13 @@ def time_combo(sq, sk, d, bq, bk, rtt, iters=None, heads=None):
         h = heads
     if iters is not None:
         n = iters
+    h = max(h, gqa)
     key = jax.random.PRNGKey(0)
-    q, k, v = (jax.random.normal(jax.random.fold_in(key, i),
-                                 (batch, h, s, d), jnp.bfloat16)
-               for i, s in enumerate((sq, sk, sk)))
+    q = jax.random.normal(jax.random.fold_in(key, 0), (batch, h, sq, d),
+                          jnp.bfloat16)
+    k, v = (jax.random.normal(jax.random.fold_in(key, i),
+                              (batch, h // gqa, sk, d), jnp.bfloat16)
+            for i in (1, 2))
 
     def loss(q, k, v):
         return jnp.sum(F.flash_attention(q, k, v, causal=True)
@@ -96,11 +131,64 @@ def time_combo(sq, sk, d, bq, bk, rtt, iters=None, heads=None):
         F._FORCE_BLOCKS = None
 
 
-def _merge_write(out_path, rows, backend):
-    """Merge-write the table keyed by shape class: entries measured in THIS
-    run replace same-shape entries, every other existing entry survives —
-    a sweep that dies mid-ladder (tunnel drop) must never erase the shapes
-    a previous window already paid for."""
+def time_banded_combo(S, fb, win, bq, bk, rtt, iters=None):
+    """One banded-walk grad eval at the bench row's geometry."""
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.ops.sparse_attention import banded
+    from deepspeed_tpu.ops.sparse_attention import blocksparse as bs
+    from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
+        BSLongformerSparsityConfig)
+    from deepspeed_tpu.utils.benchtime import scan_grad_seconds
+
+    H = 16 if S <= 8192 else 8
+    _, _, n = _shape_plan(S)
+    if iters is not None:
+        n = iters
+    cfg = BSLongformerSparsityConfig(num_heads=H, block=fb,
+                                     num_sliding_window_blocks=win)
+    layout = cfg.make_layout(S)
+    key = jax.random.PRNGKey(0)
+    q, k, v = (jax.random.normal(jax.random.fold_in(key, i),
+                                 (1, H, S, 64), jnp.bfloat16)
+               for i in range(3))
+
+    def loss(q, k, v):
+        return jnp.sum(bs.block_sparse_attention(q, k, v, layout)
+                       .astype(jnp.float32))
+
+    banded._FORCE_BLOCKS = (bq, bk)
+    bs._FN_CACHE.clear()
+    try:
+        if bs.planned_kernel(layout, fb) != "banded":
+            raise RuntimeError("banded path did not engage")
+        sec, _n2 = scan_grad_seconds(jax.grad(loss, argnums=(0, 1, 2)),
+                                     (q, k, v), rtt, start_len=n,
+                                     max_len=n * 4096)
+        return sec * 8.0 / H
+    finally:
+        banded._FORCE_BLOCKS = None
+        bs._FN_CACHE.clear()
+
+
+def _entry_key(r):
+    """Merge identity: shape class + measuring device."""
+    if r.get("kind") == "banded":
+        shape = ("banded", r["seq"], r["fine_block"], r.get("band_w"),
+                 bool(r.get("causal", False)))
+    else:
+        shape = ("flash", r["seq_q"], r["seq_k"], r["d"],
+                 bool(r.get("stream")), r.get("gqa", 1))
+    return shape + (r.get("device_kind"),)
+
+
+def _merge_write(out_path, rows, backend, device_kind):
+    """Merge-write the table keyed by shape class + device: entries
+    measured in THIS run replace same-shape-same-device entries, every
+    other existing entry survives — a sweep that dies mid-ladder (tunnel
+    drop) must never erase the shapes a previous window already paid
+    for. On hardware, legacy unstamped entries get stamped with the
+    current device_kind (see module docstring)."""
     if backend != "tpu":
         return
     existing = []
@@ -109,15 +197,33 @@ def _merge_write(out_path, rows, backend):
             existing = json.load(f)
     except (OSError, ValueError):
         pass
-    key = lambda r: (r["seq_q"], r["seq_k"], r["d"], bool(r.get("stream")))
-    merged = {key(r): r for r in existing}
-    merged.update({key(r): r for r in rows})
+    if device_kind:
+        for r in existing:
+            r.setdefault("device_kind", device_kind)
+    merged = {}
+    for r in existing:
+        try:
+            merged[_entry_key(r)] = r
+        except KeyError:
+            continue                      # malformed row: drop
+    for r in rows:
+        merged[_entry_key(r)] = r
     tmp = out_path + ".tmp"
     with open(tmp, "w") as f:
-        json.dump(sorted(merged.values(),
-                         key=lambda r: (r["seq_q"], r["seq_k"], r["d"])),
-                  f, indent=1)
+        json.dump(sorted(merged.values(), key=lambda r: json.dumps(
+            _entry_key(r), default=str)), f, indent=1)
     os.replace(tmp, out_path)
+
+
+def _covered(existing, key_wo_device, device_kind):
+    for r in existing:
+        try:
+            k = _entry_key(r)
+        except KeyError:
+            continue
+        if k[:-1] == key_wo_device and k[-1] in (device_kind, None):
+            return True
+    return False
 
 
 def main():
@@ -126,6 +232,9 @@ def main():
     ap.add_argument("--iters", type=int, default=None,
                     help="override the per-shape scan length (debug only; "
                          "default: _shape_plan governs)")
+    ap.add_argument("--force", action="store_true",
+                    help="re-measure shapes already covered for this "
+                         "device_kind")
     ap.add_argument("--stall-timeout", type=int, default=1200,
                     help="seconds without a completed combo before the "
                          "watchdog flushes measured shapes and exits (a "
@@ -139,6 +248,7 @@ def main():
     # never start at all.
     rows = []
     backend = [None]
+    kind_box = [None]
     last_beat = [time.monotonic()]
 
     def _watchdog():
@@ -148,7 +258,7 @@ def main():
                 print(f"# WATCHDOG: no combo finished in "
                       f"{args.stall_timeout}s - flushing "
                       f"{len(rows)} shapes and exiting", flush=True)
-                _merge_write(args.out, rows, backend[0])
+                _merge_write(args.out, rows, backend[0], kind_box[0])
                 os._exit(3)
 
     import threading
@@ -159,13 +269,65 @@ def main():
     from deepspeed_tpu.utils.platform import enable_compile_cache
     enable_compile_cache(None)   # shared per-user default dir
     backend[0] = jax.default_backend()
-    print(f"# backend: {backend[0]} (results are only meaningful on tpu)")
+    kind_box[0] = device_kind = _device_kind()
+    print(f"# backend: {backend[0]} device_kind: {device_kind} "
+          "(results are only meaningful on tpu)")
     rtt = _rtt()
     print(f"# rtt: {rtt*1e3:.2f} ms")
     last_beat[0] = time.monotonic()
 
-    for sq, sk, d in SHAPES:
+    existing = []
+    try:
+        with open(args.out) as f:
+            existing = json.load(f)
+    except (OSError, ValueError):
+        pass
+    # stamp legacy entries even if every shape below is already covered
+    if backend[0] == "tpu":
+        _merge_write(args.out, [], backend[0], device_kind)
+
+    # ---- banded sparse walk first: it feeds the scored bench row ----
+    for S, fb, win in BANDED_SHAPES:
+        key_wo = ("banded", S, fb, win // 2, False)
+        if not args.force and _covered(existing, key_wo, device_kind):
+            print(f"# banded S={S} fb={fb} already covered - skip")
+            continue
+        results = {}
+        for bq in BANDED_CANDIDATES:
+            for bk in BANDED_CANDIDATES:
+                if S % bq or S % bk:
+                    continue
+                try:
+                    dt = time_banded_combo(S, fb, win, bq, bk, rtt,
+                                           iters=args.iters)
+                    results[(bq, bk)] = dt
+                    print(f"banded S={S} fb={fb} bq={bq} bk={bk}: "
+                          f"{dt*1e3:.2f} ms", flush=True)
+                except Exception as e:
+                    print(f"banded S={S} fb={fb} bq={bq} bk={bk}: "
+                          f"FAILED {type(e).__name__}", flush=True)
+                last_beat[0] = time.monotonic()
+        if not results:
+            continue
+        (bq, bk), dt = min(results.items(), key=lambda kv: kv[1])
+        print(f"--> best banded (S={S}, fb={fb}): bq={bq} bk={bk} "
+              f"{dt*1e3:.2f} ms", flush=True)
+        rows.append({"kind": "banded", "seq": S, "fine_block": fb,
+                     "band_w": win // 2, "causal": False,
+                     "bq": bq, "bk": bk, "ms": round(dt * 1e3, 3),
+                     "backend": backend[0], "device_kind": device_kind})
+        # incremental: each finished shape lands immediately, so a later
+        # tunnel drop costs only the in-flight shape
+        _merge_write(args.out, rows, backend[0], device_kind)
+
+    # ---- flash shape classes ----
+    for sq, sk, d, gqa in FLASH_SHAPES:
         stream = F._use_stream(sq, sk)
+        key_wo = ("flash", sq, sk, d, stream, gqa)
+        if not args.force and _covered(existing, key_wo, device_kind):
+            print(f"# flash ({sq},{sk},{d},gqa{gqa}) already covered - "
+                  "skip")
+            continue
         combos = [
             (bq, bk) for bq in CANDIDATES for bk in CANDIDATES
             if sq % bq == 0 and sk % bk == 0
@@ -175,32 +337,32 @@ def main():
         results = {}
         for bq, bk in combos:
             try:
-                dt = time_combo(sq, sk, d, bq, bk, rtt, iters=args.iters)
+                dt = time_combo(sq, sk, d, bq, bk, rtt, iters=args.iters,
+                                gqa=gqa)
                 results[(bq, bk)] = dt
-                print(f"S=({sq},{sk}) d={d} stream={stream} "
+                print(f"S=({sq},{sk}) d={d} gqa={gqa} stream={stream} "
                       f"bq={bq} bk={bk}: {dt*1e3:.2f} ms", flush=True)
             except Exception as e:  # combo may not compile (VMEM, Mosaic)
-                print(f"S=({sq},{sk}) d={d} bq={bq} bk={bk}: "
+                print(f"S=({sq},{sk}) d={d} gqa={gqa} bq={bq} bk={bk}: "
                       f"FAILED {type(e).__name__}", flush=True)
             last_beat[0] = time.monotonic()
         if not results:
             continue
         (bq, bk), dt = min(results.items(), key=lambda kv: kv[1])
         default = F._pick_blocks(sq, sk)   # heuristic, table not loaded
-        print(f"--> best ({sq},{sk},{d}): bq={bq} bk={bk} "
+        print(f"--> best ({sq},{sk},{d},gqa{gqa}): bq={bq} bk={bk} "
               f"{dt*1e3:.2f} ms (heuristic would pick {default})",
               flush=True)
         rows.append({"seq_q": sq, "seq_k": sk, "d": d, "stream": stream,
-                     "bq": bq, "bk": bk, "ms": round(dt * 1e3, 3),
-                     "backend": backend[0]})
-        # incremental: each finished shape lands immediately, so a later
-        # tunnel drop costs only the in-flight shape
-        _merge_write(args.out, rows, backend[0])
+                     "gqa": gqa, "bq": bq, "bk": bk,
+                     "ms": round(dt * 1e3, 3), "backend": backend[0],
+                     "device_kind": device_kind})
+        _merge_write(args.out, rows, backend[0], device_kind)
 
     if backend[0] != "tpu":
         print("# not on TPU - NOT writing the table")
         return
-    _merge_write(args.out, rows, backend[0])
+    _merge_write(args.out, rows, backend[0], device_kind)
     print(f"# wrote/merged {len(rows)} entries into {args.out}")
 
 

@@ -118,18 +118,20 @@ def _flash_streamed():
     _close(kern(q, k, v), orac(q, k, v), msg="fwd")
 
 
-@check("splash v2 Longformer w=3 fwd+grad vs dense-masked oracle (S=2048)")
-def _splash_v2():
+@check("banded Longformer w=3 fwd+grad vs dense-masked oracle (S=2048)")
+def _splash_banded():
     import jax.numpy as jnp
     from deepspeed_tpu.ops.sparse_attention import (
         BSLongformerSparsityConfig, block_sparse_attention)
     from deepspeed_tpu.ops.sparse_attention.blocksparse import (
-        layout_additive_mask)
+        layout_additive_mask, planned_kernel)
     from deepspeed_tpu.ops.attention.flash import attention_reference
     H, S = 4, 2048
     cfg = BSLongformerSparsityConfig(num_heads=H, block=128,
                                      num_sliding_window_blocks=3)
     layout = cfg.make_layout(S)
+    assert planned_kernel(layout, 128) == "banded", \
+        "Longformer layout no longer dispatches to the banded fast path"
     q, k, v = _qkv(1, H, S, 64, seed=3)
     am = jnp.asarray(layout_additive_mask(layout, 128))[None]
 
@@ -145,6 +147,39 @@ def _splash_v2():
         _close(a, b, msg=f"d{n}")
 
 
+@check("splash v2 (banded forced off) Longformer vs oracle (S=2048)")
+def _splash_v2():
+    import jax.numpy as jnp
+    from deepspeed_tpu.ops.sparse_attention import (
+        BSLongformerSparsityConfig, block_sparse_attention)
+    from deepspeed_tpu.ops.sparse_attention import blocksparse as bs
+    from deepspeed_tpu.ops.sparse_attention.blocksparse import (
+        layout_additive_mask)
+    from deepspeed_tpu.ops.attention.flash import attention_reference
+    H, S = 4, 2048
+    cfg = BSLongformerSparsityConfig(num_heads=H, block=128,
+                                     num_sliding_window_blocks=3)
+    layout = cfg.make_layout(S)
+    q, k, v = _qkv(1, H, S, 64, seed=3)
+    am = jnp.asarray(layout_additive_mask(layout, 128))[None]
+
+    old = bs.USE_BANDED
+    bs.USE_BANDED = False
+    try:
+        def kern(q, k, v):
+            return block_sparse_attention(q, k, v, layout)
+
+        def orac(q, k, v):
+            return attention_reference(q, k, v, mask=am)
+
+        _close(kern(q, k, v), orac(q, k, v), msg="fwd")
+        ga, gb = _grad_pair(kern, orac, (q, k, v))
+        for a, b, n in zip(ga, gb, "qkv"):
+            _close(a, b, msg=f"d{n}")
+    finally:
+        bs.USE_BANDED = old
+
+
 @check("coarse walk (forced 512) == fine walk, grads (S=2048)")
 def _coarse_parity():
     import jax
@@ -158,20 +193,25 @@ def _coarse_parity():
     layout = cfg.make_layout(S)
     q, k, v = _qkv(1, H, S, 64, seed=5)
 
-    def run(force):
-        # _FN_CACHE keys on _FORCE_COARSE_BLOCK: no clear() needed
-        bs._FORCE_COARSE_BLOCK = force
-        try:
-            g = jax.jit(jax.grad(
-                lambda q, k, v: jnp.sum(
-                    block_sparse_attention(q, k, v, layout)
-                    .astype(jnp.float32)), argnums=(0, 1, 2)))
-            return jax.tree_util.tree_map(np.asarray, g(q, k, v))
-        finally:
-            bs._FORCE_COARSE_BLOCK = None
-    fine, coarse = run(0), run(512)
-    for a, b, n in zip(fine, coarse, "qkv"):
-        _close(a, b, msg=f"d{n}")
+    old = bs.USE_BANDED
+    bs.USE_BANDED = False          # the coarse/fine walk is the v2 path
+    try:
+        def run(force):
+            # _FN_CACHE keys on _FORCE_COARSE_BLOCK: no clear() needed
+            bs._FORCE_COARSE_BLOCK = force
+            try:
+                g = jax.jit(jax.grad(
+                    lambda q, k, v: jnp.sum(
+                        block_sparse_attention(q, k, v, layout)
+                        .astype(jnp.float32)), argnums=(0, 1, 2)))
+                return jax.tree_util.tree_map(np.asarray, g(q, k, v))
+            finally:
+                bs._FORCE_COARSE_BLOCK = None
+        fine, coarse = run(0), run(512)
+        for a, b, n in zip(fine, coarse, "qkv"):
+            _close(a, b, msg=f"d{n}")
+    finally:
+        bs.USE_BANDED = old
 
 
 @check("fine block=16 rides the coarse streamed path (S=2048)")

@@ -5,10 +5,15 @@
 # Ordered by value per minute of tunnel time (windows have been
 # 20-45 min): 1. probe; 2. on-chip kernel parity sweep (~5 min — the
 # go/no-go that the kernels the ladder times are CORRECT on hardware);
-# 3. bench ladder (the driver-protocol artifact; resumable — partial
-# rows survive tunnel drops); 4. coarse-sparse A/B; 5. headline variant
-# A/Bs (master-free, scan_layers, ref-attn); 6. autotune merge-sweep
-# (table already hardware-validated; re-sweep is a refresh).
+# 3. autotune sweep — BEFORE the ladder because it writes
+#    block_table.json, which is bench-visible source: the ladder must
+#    measure the final table. Idempotent (covered shapes skip), so once
+#    the table has this round's entries the digest stays stable and
+#    later windows resume the ladder's partial rows untouched;
+# 4. bench ladder (the driver-protocol artifact; resumable);
+# 5. sparse kernel A/B matrix (banded/v2/flash/vanilla + fwd/bwd split);
+# 6. headline variant A/Bs (master-free, scan_layers, ref-attn,
+#    adam8bit, dropout-hash1).
 # Outputs land in /tmp/tpu_round/.
 set -u -o pipefail   # tee must not mask the bench exit code
 OUT=/tmp/tpu_round
@@ -18,7 +23,8 @@ cd "$(dirname "$0")/.."
 echo "== probe"
 if ! timeout 300 python -c "
 import jax, numpy as np, jax.numpy as jnp
-x = jnp.ones((256,256), jnp.bfloat16); np.asarray(x @ x); print('alive')
+x = jnp.ones((256,256), jnp.bfloat16); np.asarray(x @ x)
+print('alive:', jax.devices()[0].device_kind)
 "; then
   echo "chip unreachable; aborting" >&2
   exit 1
@@ -34,6 +40,10 @@ if [ "$kc_rc" -ne 0 ]; then
   exit "$kc_rc"
 fi
 
+echo "== autotune block table (idempotent; writes deepspeed_tpu/ops/attention/block_table.json)"
+timeout 5400 python tools/autotune_blocks.py 2>&1 | tee "$OUT/autotune.log"
+at_rc=$?
+
 echo "== bench ladder"
 # Remote compiles through the tunnel can be slow: give each metric child
 # 40 min (first child pays the model compile) and the ladder 4 h — the
@@ -47,8 +57,8 @@ rc=$?
 export BENCH_METRIC_TIMEOUT=${BENCH_METRIC_TIMEOUT:-2400}
 export BENCH_STALL_TIMEOUT=${BENCH_STALL_TIMEOUT:-2280}
 
-echo "== coarse sparse A/B"
-timeout 1800 python tools/ab_coarse_sparse.py 2>&1 | tee "$OUT/coarse_ab.log"
+echo "== sparse kernel A/B matrix"
+timeout 3600 python tools/ab_coarse_sparse.py 2>&1 | tee "$OUT/sparse_ab.log"
 ab_rc=$?
 
 echo "== headline variant A/Bs (log-only; the ladder rows above are canonical)"
@@ -56,6 +66,9 @@ BENCH_MASTER_FREE=1 timeout 2400 python bench.py --metric gpt2_train_mfu \
   2>&1 | tee "$OUT/headline_master_free.log"
 BENCH_SCAN_LAYERS=1 timeout 2400 python bench.py --metric gpt2_train_mfu \
   2>&1 | tee "$OUT/headline_scan_layers.log"
+# single-round dropout-hash finalizer vs default on the dropout row
+BENCH_DROPOUT_HASH1=1 timeout 2400 python bench.py \
+  --metric gpt2_train_mfu_dropout 2>&1 | tee "$OUT/dropout_hash1.log"
 # XLA-fused attention vs Pallas flash at short seq (BERT s128) and s1024
 BENCH_REF_ATTN=1 timeout 2400 python bench.py \
   --metric bert_large_samples_per_s 2>&1 | tee "$OUT/bert_ref_attn.log"
@@ -65,11 +78,7 @@ BENCH_REF_ATTN=1 timeout 2400 python bench.py --metric gpt2_train_mfu \
 BENCH_ADAM8BIT=1 timeout 2400 python bench.py --metric gpt2_train_mfu \
   2>&1 | tee "$OUT/headline_adam8bit.log"
 
-echo "== autotune block table (writes deepspeed_tpu/ops/attention/block_table.json)"
-timeout 3600 python tools/autotune_blocks.py 2>&1 | tee "$OUT/autotune.log"
-at_rc=$?
-
-echo "== done (kernel checks rc=$kc_rc, bench rc=$rc, coarse A/B rc=$ab_rc, autotune rc=$at_rc); review $OUT and commit block_table.json + BENCH_NOTES update"
+echo "== done (kernel checks rc=$kc_rc, autotune rc=$at_rc, bench rc=$rc, sparse A/B rc=$ab_rc); review $OUT and commit block_table.json + BENCH_NOTES update"
 # an autotune or A/B failure must not read as a complete round either
 # (the watcher re-arms; bench rows resume from the partial file on retry)
 [ "$rc" -eq 0 ] && rc=$at_rc
