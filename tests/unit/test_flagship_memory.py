@@ -1,0 +1,90 @@
+"""Flagship-shaped compile + memory proof (VERDICT r3 #5).
+
+Compiles (never runs) the REAL GPT-2 1.5B 3D step — the
+examples/megatron_gpt2/ds_config_3d.json workload: pipe=2 x data=2 x
+model=2, bf16 compute, interleaved virtual stages — on the virtual
+8-device CPU mesh via ABSTRACT avals (no 6 GB param materialization),
+and asserts the compiler's own per-device memory analysis fits v5p HBM
+(the test_zero3.py technique at full scale). Reference workload:
+BASELINE.md ladder (GPT-2 1.5B pipeline 3D-parallel).
+
+Also records the V=2 vs V=4 interleave trade the docs commit to
+(docs/pipeline.md): at pipe=2 the normalized bubble is V-invariant
+(bubble = S + (S-2)/V ticks), so V buys ONLY memory — the V=4
+recompute window is half the V=2 one — at the price of 2x the
+collective-permute traffic. v5p (95 GB HBM) therefore runs the
+flagship at V=2; V=4 is the HBM-bound fallback (it is what fits
+comfortably on a 16 GB v5e).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models.gpt2 import GPT2Config, gpt2_pipeline_spec
+from deepspeed_tpu.runtime.pipe.spmd import (build_pipeline_grad_fn,
+                                             microbatch_sharding,
+                                             pipeline_param_specs,
+                                             pipeline_tick_counts)
+
+pytestmark = pytest.mark.slow      # ~30 s compile per interleave factor
+
+V5P_HBM = 95 * 2**30               # bytes per v5p chip
+HEADROOM = 0.85                    # leave 15% for runtime/fragmentation
+
+# GPT-2 1.5B: 48 layers x hidden 1600 (20 heads, d=80 — a tuned block
+# table shape), 50304-aligned vocab, seq 1024 — 1.56e9 params
+CFG = GPT2Config(vocab_size=50304, max_position_embeddings=1024,
+                 hidden_size=1600, num_layers=48, num_heads=20,
+                 embd_dropout=0.0, attn_dropout=0.0, resid_dropout=0.0)
+S, M, SEQ, MB = 2, 4, 1024, 4      # ds_config_3d: micro 2/gpu x data 2
+
+
+def _flagship_memory(V):
+    mesh = ds.build_mesh({"pipe": S, "data": 2, "model": 2})
+    spec = gpt2_pipeline_spec(CFG, num_stages=S * V, dtype=jnp.bfloat16)
+    ap = jax.eval_shape(spec.init, jax.random.PRNGKey(0))
+    n_params = sum(int(np.prod(s.shape))
+                   for s in jax.tree_util.tree_leaves(ap))
+    pspecs = pipeline_param_specs(spec, ap)
+    aparams = jax.tree_util.tree_map(
+        lambda s, sp: jax.ShapeDtypeStruct(
+            s.shape, s.dtype, sharding=NamedSharding(mesh, sp)),
+        ap, pspecs)
+    gf = build_pipeline_grad_fn(spec, mesh, num_micro=M, num_virtual=V)
+    batch = {"input_ids": jax.ShapeDtypeStruct(
+        (M, MB, SEQ + 1), jnp.int32, sharding=microbatch_sharding(mesh))}
+    ma = (jax.jit(gf)
+          .lower(aparams, batch, jax.random.PRNGKey(1), 1.0)
+          .compile().memory_analysis())
+    if ma is None or not hasattr(ma, "temp_size_in_bytes"):
+        pytest.skip("backend provides no memory analysis")
+    return n_params, {
+        "args": ma.argument_size_in_bytes,
+        "out": ma.output_size_in_bytes,
+        "temp": ma.temp_size_in_bytes,
+    }
+
+
+def test_flagship_1p5b_fits_v5p_hbm():
+    sizes = {}
+    for V in (2, 4):
+        n_params, m = _flagship_memory(V)
+        assert n_params >= 1.4e9, n_params       # actually flagship-sized
+        # per-device grad step footprint (outputs counted alias-less,
+        # worst case) + the engine's ZeRO-1 state the grad fn does not
+        # see: fp32 master + Adam m/v, sharded pipe x model x data = /8
+        state = 3 * n_params * 4 // 8
+        total = m["args"] + m["out"] + m["temp"] + state
+        sizes[V] = (m, total)
+        assert total <= HEADROOM * V5P_HBM, (V, total / 2**30, m)
+    # the documented interleave trade: V=4 halves the recompute window
+    assert sizes[4][0]["temp"] < sizes[2][0]["temp"], sizes
+    # at pipe=2 the normalized bubble is V-invariant: V buys memory only
+    t2, n2 = pipeline_tick_counts(S, M, 2)
+    t4, n4 = pipeline_tick_counts(S, M, 4)
+    assert n2 == n4
+    assert t4 == 2 * t2                          # 2x permute traffic
