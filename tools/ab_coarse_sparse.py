@@ -133,6 +133,23 @@ def main():
                   f"{(t_g - t_f)*1e3:.2f} ms (flash fwd {t_flash_f*1e3:.2f},"
                   f" bwd {(t_flash - t_flash_f)*1e3:.2f})", flush=True)
 
+    # ---- optional device trace of one banded dispatch (VERDICT r3
+    # weak #1: profile a splash dispatch on hardware). AB_TRACE=1
+    # writes a jax.profiler trace to /tmp/tpu_round/splash_trace for
+    # per-phase decomposition in xprof/tensorboard.
+    import os as _os
+    if _os.environ.get("AB_TRACE", "0") == "1":
+        try:
+            bs._FN_CACHE.clear()
+            gfn = jax.jit(jax.grad(sparse_loss, argnums=(0, 1, 2)))
+            jax.tree_util.tree_map(np.asarray, gfn(q, k, v))  # compile
+            with jax.profiler.trace("/tmp/tpu_round/splash_trace"):
+                jax.tree_util.tree_map(np.asarray, gfn(q, k, v))
+            print("trace written to /tmp/tpu_round/splash_trace",
+                  flush=True)
+        except Exception as e:
+            print(f"trace FAILED {type(e).__name__}: {e}", flush=True)
+
     # ---- generic kernels (banded off) ----
     def setup_coarse():
         bs.USE_BANDED = False

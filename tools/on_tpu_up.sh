@@ -57,8 +57,8 @@ rc=$?
 export BENCH_METRIC_TIMEOUT=${BENCH_METRIC_TIMEOUT:-2400}
 export BENCH_STALL_TIMEOUT=${BENCH_STALL_TIMEOUT:-2280}
 
-echo "== sparse kernel A/B matrix"
-timeout 3600 python tools/ab_coarse_sparse.py 2>&1 | tee "$OUT/sparse_ab.log"
+echo "== sparse kernel A/B matrix (+ one traced dispatch)"
+AB_TRACE=1 timeout 3600 python tools/ab_coarse_sparse.py 2>&1 | tee "$OUT/sparse_ab.log"
 ab_rc=$?
 
 echo "== headline variant A/Bs (log-only; the ladder rows above are canonical)"
