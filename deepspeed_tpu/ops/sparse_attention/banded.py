@@ -174,6 +174,44 @@ def pick_blocks(S: int, fine_block: int, params: "BandedParams",
     return bq, bkv
 
 
+def _band_extents(S, fb, w, causal, bq, bkv):
+    """(bstart, bend, WT): per-q-tile kv-tile range of the band walk —
+    the ONE definition shared by the builder's index maps/grids and
+    walk_stats' cost accounting (they must never drift)."""
+    NQ = S // bq
+    bstart = np.zeros(NQ, np.int32)
+    bend = np.zeros(NQ, np.int32)
+    for i in range(NQ):
+        lo = max(((i * bq) // fb - w) * fb, 0)
+        hi = min(((i * bq + bq - 1) // fb + (0 if causal else w)) * fb
+                 + fb - 1, S - 1)
+        bstart[i] = lo // bkv
+        bend[i] = hi // bkv
+    return bstart, bend, int((bend - bstart).max()) + 1
+
+
+def _band_dkv_extents(S, fb, w, causal, bq, bkv):
+    """(qstart, qend, J2): per-kv-tile q-tile range of the transposed
+    band walk (dkv)."""
+    NK = S // bkv
+    qstart = np.zeros(NK, np.int32)
+    qend = np.zeros(NK, np.int32)
+    for t in range(NK):
+        lo = max(((t * bkv) // fb - (0 if causal else w)) * fb, 0)
+        hi = min(((t * bkv + bkv - 1) // fb + w) * fb + fb - 1, S - 1)
+        qstart[t] = lo // bq
+        qend[t] = hi // bq
+    return qstart, qend, int((qend - qstart).max()) + 1
+
+
+def _gr_kv_walk(S, fb, g_r, causal, bkv):
+    """kv-tile walk length of the global-rows instance (0 when g_r=0;
+    causal global rows only reach cols < g_r*fb)."""
+    if not g_r:
+        return 0
+    return _ceil_div(g_r * fb, bkv) if causal else S // bkv
+
+
 def _cparams(interpret):
     if pltpu is None or interpret:
         return None
@@ -329,32 +367,13 @@ def build_banded_impls(H: int, S: int, fb: int, params: BandedParams,
     GQ = _ceil_div(g_r * fb, bq) if g_r else 0     # q tiles holding g-rows
     GT = _ceil_div(g_c * fb, bkv) if g_c else 0    # kv tiles holding g-cols
 
-    # ---- static walk extents (band instances) ----
-    bstart = np.zeros(NQ, np.int32)
-    bend = np.zeros(NQ, np.int32)
-    for i in range(NQ):
-        lo_b = (i * bq) // fb - w
-        hi_b = (i * bq + bq - 1) // fb + (0 if causal else w)
-        lo = max(lo_b * fb, 0)
-        hi = min(hi_b * fb + fb - 1, S - 1)
-        bstart[i] = lo // bkv
-        bend[i] = hi // bkv
-    WT = int((bend - bstart).max()) + 1
+    # ---- static walk extents (shared with walk_stats — ONE source) ----
+    bstart, bend, WT = _band_extents(S, fb, w, causal, bq, bkv)
     J_band = GT + WT
-
-    qstart = np.zeros(NK, np.int32)
-    qend = np.zeros(NK, np.int32)
-    for t in range(NK):
-        lo_b = (t * bkv) // fb - (0 if causal else w)
-        hi_b = (t * bkv + bkv - 1) // fb + w
-        lo = max(lo_b * fb, 0)
-        hi = min(hi_b * fb + fb - 1, S - 1)
-        qstart[t] = lo // bq
-        qend[t] = hi // bq
-    J2 = int((qend - qstart).max()) + 1
+    qstart, qend, J2 = _band_dkv_extents(S, fb, w, causal, bq, bkv)
 
     # global-row instances: causal global rows only reach cols < g_r*fb
-    GRK = _ceil_div(g_r * fb, bkv) if causal else NK   # kv walk for gr
+    GRK = _gr_kv_walk(S, fb, g_r, causal, bkv)         # kv walk for gr
     # global-col dkv: first contributing q tile (rows >= g_r only)
     gc_q0 = (g_r * fb) // bq
     J_gc = NQ - gc_q0
@@ -599,6 +618,47 @@ def build_banded_impls(H: int, S: int, fb: int, params: BandedParams,
                 dv.reshape(v.shape))
 
     return fwd_impl, bwd_impl
+
+
+def walk_stats(S: int, fb: int, params: BandedParams, bq: int, bkv: int,
+               n_active_blocks: Optional[int] = None):
+    """Static cost accounting for the banded walk at a geometry: grid
+    step counts per instance and total fwd/bwd MXU MACs per (batch,
+    head), plus the exact-sparse bound from the layout cell count.
+    Pure arithmetic on the same extent formulas the builder uses — lets
+    tests pin the kernel's FLOP overhead (waste = computed/bound) and
+    the A/B tool print an honest roofline without hardware."""
+    g_r, g_c, w, causal = params
+    NQ, NK = S // bq, S // bkv
+    GQ = _ceil_div(g_r * fb, bq) if g_r else 0
+    GT = _ceil_div(g_c * fb, bkv) if g_c else 0
+    _, _, WT = _band_extents(S, fb, w, causal, bq, bkv)
+    _, _, J2 = _band_dkv_extents(S, fb, w, causal, bq, bkv)
+    GRK = _gr_kv_walk(S, fb, g_r, causal, bkv)
+    steps = {
+        "band_fwd": NQ * (GT + WT),
+        "gr_fwd": GQ * GRK,
+        "band_dq": NQ * (GT + WT),
+        "gr_dq": GQ * GRK,
+        "band_dkv": NK * J2,
+        "gc_dkv": GT * (NQ - (g_r * fb) // bq) if GT else 0,
+        "gr_dkv": GRK * GQ,
+    }
+    tile = bq * bkv
+    # tile dots per step per (b, h): fwd 2 (s, pv), dq 3 (s, dp, dq),
+    # dkv 4 (s, dv, dp, dk) — matches the kernel bodies
+    macs = (2 * (steps["band_fwd"] + steps["gr_fwd"]) +
+            3 * (steps["band_dq"] + steps["gr_dq"]) +
+            4 * (steps["band_dkv"] + steps["gc_dkv"] + steps["gr_dkv"]))
+    computed_cells = macs * tile
+    bound = None
+    if n_active_blocks is not None:
+        # exact sparse bound: 9 tile dots per active fine block
+        # (fwd s/pv = 2, dq s/dp/dq = 3, dkv s/dv/dp/dk = 4)
+        bound = 9 * n_active_blocks * fb * fb
+    return {"steps": steps, "computed_cell_dots": computed_cells,
+            "exact_cell_dots": bound,
+            "waste": (computed_cells / bound) if bound else None}
 
 
 def plan(layout, fine_block: int, interpret: bool):

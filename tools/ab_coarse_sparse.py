@@ -197,6 +197,17 @@ def main():
               f"; FLOP bound vs flash ~{0.5/density:.1f}x "
               f"-> achieving {(t_flash/best_t)/(0.5/density)*100:.0f}% "
               "of bound", flush=True)
+    # static roofline per banded tile choice (walk_stats is pure
+    # arithmetic): names where the remaining gap to the bound goes.
+    # Params come from the SAME plan() the dispatch used above.
+    p = plan[0] if plan else None
+    if p is not None:
+        nnz = int(np.count_nonzero(np.asarray(layout)[0]))
+        for blocks in [(128, 128), (256, 256), (256, 512), (512, 512)]:
+            st = bd.walk_stats(S, 128, p, *blocks, n_active_blocks=nnz)
+            print(f"walk_stats{blocks}: {sum(st['steps'].values())} "
+                  f"steps, waste {st['waste']:.2f}x of exact-sparse",
+                  flush=True)
 
 
 if __name__ == "__main__":
