@@ -53,14 +53,19 @@ FLASH_SHAPES = [
 CANDIDATES = (64, 128, 256, 512)
 
 # banded sparse walk shape classes: (S, fine_block, window_blocks)
-# — the bench row (S=8192, fb=128, win=3 BSLongformer), its s16k
-# long-context detail, and the class-default fb=64 geometry
+# — the bench row (S=8192, fb=128, win=3 BSLongformer) FIRST (sweep is
+# incremental; a short window should land the scored shape), then its
+# s16k long-context detail and the class-default fb=64 geometry
 BANDED_SHAPES = [
     (8192, 128, 3),
     (16384, 128, 3),
     (8192, 64, 3),
 ]
-BANDED_CANDIDATES = (128, 256, 512)
+# each combo compiles 7 pallas kernels through the tunnel (~20-40s per
+# fresh compile): keep the candidate list small — static walk_stats
+# says the FLOP spread (128,128) 1.0x -> (512,512) 4.1x of bound, so
+# these four bracket the overhead-vs-waste trade
+BANDED_COMBOS = ((128, 128), (256, 256), (256, 512), (512, 512))
 
 
 def _rtt():
@@ -293,20 +298,19 @@ def main():
             print(f"# banded S={S} fb={fb} already covered - skip")
             continue
         results = {}
-        for bq in BANDED_CANDIDATES:
-            for bk in BANDED_CANDIDATES:
-                if S % bq or S % bk:
-                    continue
-                try:
-                    dt = time_banded_combo(S, fb, win, bq, bk, rtt,
-                                           iters=args.iters)
-                    results[(bq, bk)] = dt
-                    print(f"banded S={S} fb={fb} bq={bq} bk={bk}: "
-                          f"{dt*1e3:.2f} ms", flush=True)
-                except Exception as e:
-                    print(f"banded S={S} fb={fb} bq={bq} bk={bk}: "
-                          f"FAILED {type(e).__name__}", flush=True)
-                last_beat[0] = time.monotonic()
+        for bq, bk in BANDED_COMBOS:
+            if S % bq or S % bk:
+                continue
+            try:
+                dt = time_banded_combo(S, fb, win, bq, bk, rtt,
+                                       iters=args.iters)
+                results[(bq, bk)] = dt
+                print(f"banded S={S} fb={fb} bq={bq} bk={bk}: "
+                      f"{dt*1e3:.2f} ms", flush=True)
+            except Exception as e:
+                print(f"banded S={S} fb={fb} bq={bq} bk={bk}: "
+                      f"FAILED {type(e).__name__}", flush=True)
+            last_beat[0] = time.monotonic()
         if not results:
             continue
         (bq, bk), dt = min(results.items(), key=lambda kv: kv[1])
