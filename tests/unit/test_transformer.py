@@ -500,6 +500,26 @@ class TestTransformerLayerGrid:
                                    np.asarray(o32), atol=5e-2, rtol=5e-2)
 
 
+def test_shipped_block_table_resolves():
+    """Every entry in the checked-in block_table.json must resolve
+    through the REAL loader path (entries list + device_kind matching),
+    not just the _BLOCK_TABLE test hook — guards loader rewrites against
+    silently orphaning the hardware-measured winners (r4 loader added
+    device_kind/gqa/kind fields)."""
+    import json
+    import os
+    from deepspeed_tpu.ops.attention import flash as F
+    path = os.path.join(os.path.dirname(F.__file__), "block_table.json")
+    entries = json.load(open(path))
+    assert entries, "shipped block table is empty?"
+    for e in entries:
+        if e.get("kind", "flash") != "flash":
+            continue
+        got = F._pick_blocks(e["seq_q"], e["seq_k"], e["d"],
+                             gqa=e.get("gqa", 1))
+        assert got == (e["bq"], e["bk"]), (e, got)
+
+
 def test_block_table_lookup_and_fallback():
     """Autotuned block table (tools/autotune_blocks.py): exact shape hits
     override the heuristic; unknown shapes keep it; the sweep override
