@@ -295,6 +295,21 @@ def test_bench_geometry_flop_accounting():
     st = banded.walk_stats(8192, 128, p, *db, n_active_blocks=nnz)
     assert st["waste"] <= 2.5, (db, st)
     assert st["computed_cell_dots"] <= 0.35 * dense, (db, st)
+    # long-context scaling (the reference's 10x-longer-sequences axis):
+    # at S=32k the banded work stays O(S) — the dense-causal ratio
+    # keeps improving ~linearly with S
+    L32 = BSLongformerSparsityConfig(
+        num_heads=1, block=128,
+        num_sliding_window_blocks=3).make_layout(32768)
+    p32 = banded.detect_banded(L32)
+    nnz32 = int(np.count_nonzero(L32[0]))
+    nb32 = 32768 // 128
+    st32 = banded.walk_stats(32768, 128, p32, 256, 256,
+                             n_active_blocks=nnz32)
+    dense32 = 9 * (nb32 * nb32 // 2 + nb32 // 2) * 128 * 128
+    assert st32["waste"] <= 2.5, st32
+    assert st32["computed_cell_dots"] <= 0.12 * dense32, (
+        st32["computed_cell_dots"] / dense32)
 
 
 def test_zero_coverage_rows_zero_output():
