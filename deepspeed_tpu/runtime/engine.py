@@ -796,7 +796,7 @@ class DeepSpeedEngine:
             return sch.mom_at(step)
         return None
 
-    def _cast_for_loss(self, params):
+    def _cast_for_loss(self, params, constrain=True):
         """fp32 master -> compute dtype, unless the loss fn owns the cast
         (pipeline loss fns cast inside shard_map so grad psums stay fp32).
 
@@ -815,15 +815,32 @@ class DeepSpeedEngine:
             return params
         if self.zero_stage >= 3:
             return params
-        return _tree_cast(params, self.compute_dtype)
+        cast = _tree_cast(params, self.compute_dtype)
+        if constrain and self.compute_dtype is not None \
+                and self.zero_stage >= 1:
+            # Pin the compute-dtype copy to the MASTER's sharded layout so
+            # the cast runs shard-local and the forward's param all-gather
+            # moves compute-dtype (bf16) elements. Without this GSPMD may
+            # gather the f32 masters and cast downstream — 2x wire traffic
+            # on the per-micro gather (the docs/performance.md caveat,
+            # now asserted in test_hlo_collectives.py).
+            cast = jax.lax.with_sharding_constraint(cast,
+                                                    self._param_shardings)
+        return cast
 
-    def _compute_loss_and_grads(self, params, batch, rng, scale):
+    def _compute_loss_and_grads(self, params, batch, rng, scale,
+                                constrain_cast=True):
         """value_and_grad of the (scaled) loss in the compute dtype.
 
         Pipelined models bypass autodiff: the 1F1B executor
         (runtime/pipe/spmd.py build_pipeline_grad_fn) returns explicit
         fp32 grads with the loss-scale folded in, attached as
-        ``loss_fn.grad_fn``."""
+        ``loss_fn.grad_fn``.
+
+        ``constrain_cast=False`` is passed by the shard_map gradient
+        paths (CSR / quantized / 1-bit): there 'data' is a manual axis,
+        params are replicated per rank, and the cast's sharding
+        constraint would be both illegal and meaningless."""
         explicit_grad = getattr(self._loss_fn, "grad_fn", None)
         if explicit_grad is not None:
             loss, grads = explicit_grad(
@@ -832,7 +849,7 @@ class DeepSpeedEngine:
             return loss, None, grads
 
         def scaled_loss_fn(p):
-            cp = self._cast_for_loss(p)
+            cp = self._cast_for_loss(p, constrain=constrain_cast)
             if self._loss_takes_rng:
                 out = self._loss_fn(cp, batch, rng)
             else:
@@ -874,7 +891,8 @@ class DeepSpeedEngine:
 
         def inner(p, b, r, s):
             r = jax.random.fold_in(r, jax.lax.axis_index("data"))
-            loss, aux, g = self._compute_loss_and_grads(p, b, r, s)
+            loss, aux, g = self._compute_loss_and_grads(
+                p, b, r, s, constrain_cast=False)
             loss = jax.lax.pmean(loss, "data")
             # capacity: one grad row per token index in the local batch
             tokens = sum(int(np.prod(x.shape))
@@ -935,7 +953,8 @@ class DeepSpeedEngine:
 
         def inner(p, b, r, s):
             r = jax.random.fold_in(r, jax.lax.axis_index("data"))
-            loss, _aux, g = self._compute_loss_and_grads(p, b, r, s)
+            loss, _aux, g = self._compute_loss_and_grads(
+                p, b, r, s, constrain_cast=False)
             loss = jax.lax.pmean(loss, "data")
 
             # fp16 overflow sentinel: quantization destroys inf/nan (the
@@ -982,7 +1001,8 @@ class DeepSpeedEngine:
 
         def inner(p, b, r, s):
             r = jax.random.fold_in(r, jax.lax.axis_index("data"))
-            loss, _aux, g = self._compute_loss_and_grads(p, b, r, s)
+            loss, _aux, g = self._compute_loss_and_grads(
+                p, b, r, s, constrain_cast=False)
             loss = jax.lax.pmean(loss, "data")
             return loss, jax.tree_util.tree_map(lambda x: x[None], g)
 

@@ -215,6 +215,29 @@ def test_sparse_updates_match_dense(ga):
     assert not bool(es._csr_overflow)
 
 
+def test_sparse_composes_with_zero2_and_bf16():
+    """bf16 + ZeRO-2 + sparse_gradients: the compute-dtype cast runs
+    inside the CSR shard_map path, where 'data' is a MANUAL axis — the
+    ZeRO cast sharding-constraint must not be emitted there (round-5
+    regression, same class as the quantized-path pin in
+    test_quantized_allreduce.py)."""
+    import deepspeed_tpu as ds
+    params = _init_embed_params(jax.random.PRNGKey(5))
+    engine, *_ = ds.initialize(
+        model=_embed_loss_fn, model_parameters=params,
+        config={"train_micro_batch_size_per_gpu": 2,
+                "gradient_accumulation_steps": 2,
+                "bf16": {"enabled": True},
+                "zero_optimization": {"stage": 2},
+                "sparse_gradients": True,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}}})
+    losses = [float(engine.train_batch(iter(_embed_batches(2, 16, seed=0))))
+              for _ in range(4)]
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]
+    assert not bool(engine._csr_overflow)
+
+
 def test_sparse_overflow_flag_on_dense_embedding_grad(caplog):
     """A leaf named 'embedding' that receives DENSE grads (tied-head style
     regularizer touching every row) must trip the in-jit overflow flag and

@@ -192,6 +192,88 @@ def test_zero2_grad_accumulation_boundary_split():
                                  [c[:2] for c in on_boundary])
 
 
+def _hlo_computation_body(hlo_text, comp_name):
+    """Lines of one named HLO computation's body."""
+    lines = hlo_text.splitlines()
+    out, inside = [], False
+    pat = re.compile(r"^\s*(?:ENTRY\s+)?%?" + re.escape(comp_name) +
+                     r"\s*\(")
+    for line in lines:
+        if not inside and pat.match(line) and "{" in line:
+            inside = True
+            continue
+        if inside:
+            if line.strip() == "}" or line.strip().startswith("}"):
+                break
+            out.append(line)
+    return out
+
+
+def test_zero2_param_gather_rides_compute_dtype_cast():
+    """The compute-dtype cast sits AHEAD of the per-micro param
+    all-gather — the bf16 value is what crosses the wire.
+
+    With fp32 masters sharded ZeRO-style, GSPMD is in principle free to
+    gather the f32 master values and cast downstream — 2x the wire
+    bytes of a bf16 gather (the former docs/performance.md caveat).
+    engine._cast_for_loss pins the compute-dtype cast to the master's
+    sharded layout (with_sharding_constraint) so the cast runs
+    shard-local. Two backend-invariant checks:
+
+    1. StableHLO (pre-partitioning): every param leaf has an
+       ``sdy.sharding_constraint`` on a BF16 tensor of its shape with a
+       non-empty axis binding — the cast-then-constrain order is in the
+       program, so the partitioner reshards the bf16 value.
+    2. Partitioned HLO: no param-scale all-gather consumes a raw state
+       parameter; each gather's operand chain contains the bf16
+       rounding (the cast scheduled ahead of the wire).
+
+    Byte-level dtype cannot be asserted on the CPU audit backend:
+    FloatNormalization re-expands bf16 math to f32 (dots, tanh have no
+    CPU bf16 kernels), so the gather result prints f32 here while the
+    same program moves bf16 on TPU, where the constrained bf16 value
+    feeds the MXU directly."""
+    engine, batch, P = _mlp_engine(gas=4)
+    lowered = (engine._get_compiled_micro_step()
+               .lower(engine.state, batch))
+    stable = lowered.as_text()
+    for shape in ("256x512", "512x128"):
+        pat = (r"sdy\.sharding_constraint[^\n]*<@mesh, \[\{\"data\"\}"
+               r"[^\n]*tensor<" + shape + r"xbf16>")
+        assert re.search(pat, stable), \
+            f"no sharded bf16 constraint for param {shape} in StableHLO"
+
+    txt = lowered.compile().as_text()
+    colls = collect_collectives(txt)
+    param_gathers = [c for c in colls
+                     if c[0] == "all-gather" and c[1] >= 0.2 * P]
+    assert param_gathers, \
+        "no param-scale all-gather in the compiled step?"
+    defn = {m.group(1): line for line in txt.splitlines()
+            for m in [re.match(r"^\s*(?:ROOT\s+)?%([\w.\-]+) = ",
+                               line.strip())] if m}
+    for op, e, line, _ in param_gathers:
+        # collect_collectives returns sync `all-gather(` or async
+        # `all-gather-done(` lines; for async, hop -done -> -start ->
+        # the real data operand
+        m = re.search(r"all-gather(?:-done)?\(%?([\w.\-]+)", line)
+        assert m, line[:160]
+        opd_line = defn.get(m.group(1), "")
+        sm = re.search(r"all-gather-start\(%?([\w.\-]+)", opd_line)
+        if sm:
+            opd_line = defn.get(sm.group(1), "")
+        # a raw master crossing the wire would be parameter/gte directly
+        assert (" parameter(" not in opd_line
+                and "get-tuple-element(" not in opd_line), \
+            (line[:120], opd_line[:120])
+        cm = re.search(r"calls=%([\w.\-]+)", opd_line)
+        body = (_hlo_computation_body(txt, cm.group(1))
+                if cm else [opd_line])
+        assert any("bf16[" in b for b in body), \
+            ("gather operand has no bf16 rounding ahead of the wire",
+             line[:120], opd_line[:120])
+
+
 import functools
 
 

@@ -113,3 +113,28 @@ def test_quantized_composes_with_zero2_and_accumulation():
         losses.append(float(e.train_batch(iter(bs))))
     assert all(np.isfinite(l) for l in losses)
     assert losses[-1] < losses[0]
+
+
+def test_quantized_composes_with_zero2_and_bf16():
+    """bf16 + ZeRO-2 + compressed_allreduce: the engine's compute-dtype
+    cast runs inside the quantized shard_map path, where 'data' is a
+    MANUAL axis — the ZeRO cast sharding-constraint must not be emitted
+    there (round-5 regression: with_sharding_constraint referencing a
+    manual mesh axis is a trace-time error)."""
+    from tests.unit.simple_model import (init_simple_params, simple_loss_fn,
+                                         random_batches)
+    params = init_simple_params(jax.random.PRNGKey(0), hidden_dim=8)
+    e, *_ = ds.initialize(
+        model=simple_loss_fn, model_parameters=params,
+        config={"train_micro_batch_size_per_gpu": 4,
+                "gradient_accumulation_steps": 2,
+                "bf16": {"enabled": True},
+                "compressed_allreduce": {"enabled": True},
+                "zero_optimization": {"stage": 2},
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}}})
+    losses = []
+    for i in range(4):
+        bs = random_batches(2, 32, 8, seed=i)
+        losses.append(float(e.train_batch(iter(bs))))
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]
