@@ -322,6 +322,12 @@ _WARNED_V1_BLOCK = False
 # machinery — masks are computed from iota block arithmetic in registers
 USE_BANDED = True
 
+# hybrid banded+residual path (hybrid.py): mostly-banded layouts with a
+# small non-banded residue (BigBird random blocks) run the banded
+# kernels on the banded sub-pattern and the v2 walk on the residue,
+# merged by per-part log-sum-exp (flash-decoding style)
+USE_HYBRID = True
+
 # layout coarsening (blocksparse_v2.build_coarse_index): walk coarse
 # tiles, express fine structure as streamed NEG_INF mask tiles. Auto by
 # cost model; _FORCE_COARSE_BLOCK: None = auto, 0 = off, N = force N.
@@ -392,6 +398,10 @@ def planned_kernel(layout, block, has_am=False, interpret=False) -> str:
         from deepspeed_tpu.ops.sparse_attention import banded as _b
         if _b.plan(layout, block, interpret) is not None:
             return "banded"
+        if USE_HYBRID and USE_SPLASH_V2:
+            from deepspeed_tpu.ops.sparse_attention import hybrid as _h
+            if _h.plan_hybrid(layout, block, interpret) is not None:
+                return "hybrid"
     coarse = (_pick_coarse_block(layout, block, has_am)
               if USE_SPLASH_V2 else None)
     if USE_SPLASH_V2 and (interpret or block % 128 == 0
@@ -416,7 +426,8 @@ def _sparse_attention_fn(layout: np.ndarray, block: int, sm_scale: float,
     from deepspeed_tpu.ops.sparse_attention import banded as _banded
     key = (layout.shape, layout.tobytes(), block, float(sm_scale), has_am,
            interpret, USE_SPLASH_V2, USE_COARSE, _FORCE_COARSE_BLOCK,
-           _COARSE_TILE_BUDGET, USE_BANDED, _banded._FORCE_BLOCKS)
+           _COARSE_TILE_BUDGET, USE_BANDED, USE_HYBRID,
+           _banded._FORCE_BLOCKS)
     if key in _FN_CACHE:
         return _FN_CACHE[key]
 
@@ -429,6 +440,14 @@ def _sparse_attention_fn(layout: np.ndarray, block: int, sm_scale: float,
                                          interpret)
             _FN_CACHE[key] = fb
             return fb
+        if USE_HYBRID and USE_SPLASH_V2:
+            from deepspeed_tpu.ops.sparse_attention import hybrid as _h
+            hplan = _h.plan_hybrid(layout, block, interpret)
+            if hplan is not None:
+                fh = _h.build_hybrid_fn(layout, block, hplan,
+                                        float(sm_scale), interpret)
+                _FN_CACHE[key] = fh
+                return fh
 
     H, nq, nk = layout.shape
     coarse_block = (_pick_coarse_block(layout, block, has_am)

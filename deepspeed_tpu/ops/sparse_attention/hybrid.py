@@ -1,0 +1,242 @@
+"""Hybrid banded + residual block-sparse attention (BigBird fast path).
+
+BigBird layouts (reference
+deepspeed/ops/sparse_attention/sparsity_config.py:421: random blocks +
+sliding window + ITC globals) are MOSTLY banded: the window and the
+global prefix are exactly the structure banded.py runs at dense-flash
+per-step cost, and only the ~1-block-per-row random residue needs the
+generic machinery. Routing the whole layout to the generic v2 walk —
+round-4 status — priced every cell at the overhead-bound generic rate.
+
+The hybrid splits the layout exactly:
+
+    banded part   = the maximal global-prefix + band predicate UNDER the
+                    head-INTERSECTION of the layout (so the banded
+                    kernels stay head-uniform even when random blocks
+                    differ per head)
+    residual part = layout & ~banded  (per head; the random blocks)
+
+and runs each part's existing kernels unchanged. Because the parts
+partition the kept cells, the full softmax is recovered with the
+flash-decoding merge on the per-part log-sum-exp:
+
+    L   = logaddexp(lse_banded, lse_residual)
+    out = exp(lse_banded - L) * o_banded + exp(lse_residual - L) * o_res
+
+Backward needs no new kernels either: the flash backward identity
+ds = p * (dp - delta) only consumes the GLOBAL row statistics — the
+merged L (for p = exp(s - L)) and delta = sum(do * o_merged) — so each
+part's existing bwd impl is called with the merged L and merged output,
+and their dq/dk/dv contributions add (each part touches exactly its own
+cells).
+
+Dispatch (blocksparse._sparse_attention_fn) tries: exact banded ->
+hybrid -> coarse/v2/v1. The hybrid engages only when the banded part
+covers enough of the layout to pay for the second kernel pass
+(_MIN_COVERAGE) and when the v2 walk can actually stream the residual
+(128-multiple blocks, same constraint v2 itself has).
+"""
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.ops.sparse_attention.banded import (
+    NEG_INF, BandedParams, _blocks_valid, _ceil_div, build_banded_impls,
+    pick_blocks, walk_stats)
+
+# the banded part must cover at least this fraction of the layout's
+# active cells: below it the residual walk dominates anyway and the
+# extra banded pass + merge is pure overhead
+_MIN_COVERAGE = 0.5
+
+
+class HybridPlan(NamedTuple):
+    params: BandedParams
+    blocks: tuple             # (bq, bkv) banded walk tiles
+    residual: np.ndarray      # (H, nb, nb) 0/1 residual layout
+    coverage: float           # banded cells / total active cells
+
+
+def detect_banded_subpattern(layout: np.ndarray) \
+        -> Optional[tuple]:
+    """Maximal (BandedParams, residual, coverage) with the banded
+    predicate a SUBSET of every head's layout. Unlike
+    banded.detect_banded this never demands equality — the leftover
+    cells become the residual — and per-head layouts are fine (the
+    predicate is fit under the head intersection)."""
+    L = np.asarray(layout).astype(bool)
+    if L.ndim != 3 or L.shape[1] != L.shape[2] or L.shape[1] == 0:
+        return None
+    base = L.all(axis=0)                  # head-intersection
+    n = base.shape[0]
+    idx = np.arange(n)
+    rb, cb = idx[:, None], idx[None, :]
+    best = None
+    for causal in (False, True):
+        clip = (cb <= rb) if causal else np.ones((n, n), bool)
+        covered = base | ~clip            # cells set-or-clipped-away
+        row_full = covered.all(axis=1)
+        col_full = covered.all(axis=0)
+        g_r = 0
+        while g_r < n and row_full[g_r]:
+            g_r += 1
+        g_c = 0
+        while g_c < n and col_full[g_c]:
+            g_c += 1
+        if g_r >= n:                      # fully dense under this clip
+            continue
+        # max w with every |rb-cb| <= w diagonal fully set inside the
+        # non-global region (w = -1: no full diagonal -> no band)
+        region = (rb >= g_r) & (cb >= g_c) & clip
+        w = -1
+        for cand in range(n):
+            diag = region & (np.abs(rb - cb) == cand)
+            if not base[diag].all():
+                break
+            w = cand
+        if w < 0:
+            continue
+        pred = ((rb < g_r) | (cb < g_c) | (np.abs(rb - cb) <= w)) & clip
+        total = int(L.sum())
+        if total == 0:
+            continue
+        coverage = L.shape[0] * int(pred.sum()) / total
+        if best is None or coverage > best[2]:
+            residual = (L & ~pred[None]).astype(np.int32)
+            best = (BandedParams(g_r, g_c, w, bool(causal)),
+                    residual, coverage)
+    return best
+
+
+def plan_hybrid(layout: np.ndarray, fine_block: int,
+                interpret: bool) -> Optional[HybridPlan]:
+    """THE hybrid-dispatch decision (mirrors banded.plan): a HybridPlan
+    when the split pays, else None. Declines when the residual is empty
+    (the exact banded path owns that), when coverage is too low, or
+    when the v2 walk could not stream the residual (non-128-multiple
+    block, compiled)."""
+    if not interpret and fine_block % 128 != 0:
+        return None
+    det = detect_banded_subpattern(layout)
+    if det is None:
+        return None
+    params, residual, coverage = det
+    if residual.sum() == 0 or coverage < _MIN_COVERAGE:
+        return None
+    S = np.asarray(layout).shape[1] * fine_block
+    blocks = pick_blocks(S, fine_block, params, interpret)
+    if blocks is None or not _blocks_valid(S, *blocks, interpret):
+        return None
+    return HybridPlan(params, blocks, residual, coverage)
+
+
+def build_hybrid_fn(layout: np.ndarray, fine_block: int,
+                    plan: HybridPlan, sm_scale: float, interpret: bool):
+    """Differentiable f(q, k, v, kpm_blocked) -> o for the hybrid path;
+    public signature identical to the banded/v2 builders (kpm arrives
+    pre-blocked (B, nk, 1, fine_block) additive)."""
+    from deepspeed_tpu.ops.sparse_attention.blocksparse_v2 import (
+        build_v2_impls)
+    H, nb, _ = np.asarray(layout).shape
+    S = nb * fine_block
+    bq, bkv = plan.blocks
+    params = plan.params
+    fwd_b, bwd_b = build_banded_impls(H, S, fine_block, params,
+                                      sm_scale, bq, bkv, interpret)
+    fwd_r, bwd_r = build_v2_impls(plan.residual, fine_block, sm_scale,
+                                  interpret, has_am=False,
+                                  coarse_block=None)
+    GQ = _ceil_div(params.g_r * fine_block, bq) if params.g_r else 0
+
+    def _flat_kpm(kpm):
+        B = kpm.shape[0]
+        return kpm.transpose(0, 2, 1, 3).reshape(B, S)
+
+    def _merged_fwd(q, k, v, kpm):
+        B = q.shape[0]
+        o_b, lse_b, lse_g = fwd_b(q, k, v, _flat_kpm(kpm))
+        o_r, lse_r = fwd_r(q, k, v, kpm, None)
+        # fold the global-rows instance lse into a full-length banded
+        # lse: per row exactly one of (band, gr) holds real mass, the
+        # other is NEG_INF, so logaddexp selects it
+        if GQ:
+            pad = jnp.full((lse_b.shape[0], S - GQ * bq, 1), NEG_INF,
+                           jnp.float32)
+            lse_bf = jnp.logaddexp(lse_b,
+                                   jnp.concatenate([lse_g, pad], axis=1))
+        else:
+            lse_bf = lse_b
+        L = jnp.logaddexp(lse_bf, lse_r)
+        wb = jnp.exp(lse_bf - L).reshape(B, H, S, 1)
+        wr = jnp.exp(lse_r - L).reshape(B, H, S, 1)
+        o = (wb * o_b.astype(jnp.float32) +
+             wr * o_r.astype(jnp.float32)).astype(q.dtype)
+        return o, L
+
+    @jax.custom_vjp
+    def f(q, k, v, kpm):
+        return _merged_fwd(q, k, v, kpm)[0]
+
+    def f_fwd(q, k, v, kpm):
+        o, L = _merged_fwd(q, k, v, kpm)
+        return o, (q, k, v, kpm, o, L)
+
+    def f_bwd(res, g):
+        q, k, v, kpm, o, L = res
+        # both parts get the MERGED row stats: p = exp(s - L) inside
+        # each kernel is then the true global probability of its cells,
+        # and delta = sum(do * o_merged) is computed from the merged
+        # output each impl receives
+        L_g = L[:, :GQ * bq] if GQ else L[:, :0]
+        dq_b, dk_b, dv_b = bwd_b(q, k, v, _flat_kpm(kpm), o, L, L_g, g)
+        dq_r, dk_r, dv_r = bwd_r(q, k, v, kpm, None, o, L, g)
+        dq = (dq_b.astype(jnp.float32) +
+              dq_r.astype(jnp.float32)).astype(q.dtype)
+        dk = (dk_b.astype(jnp.float32) +
+              dk_r.astype(jnp.float32)).astype(k.dtype)
+        dv = (dv_b.astype(jnp.float32) +
+              dv_r.astype(jnp.float32)).astype(v.dtype)
+        return dq, dk, dv, jnp.zeros_like(kpm)
+
+    f.defvjp(f_fwd, f_bwd)
+    f.kernel_kind = "hybrid"
+    f.banded_blocks = (bq, bkv)
+    f.hybrid_coverage = plan.coverage
+    return f
+
+
+def hybrid_stats(layout: np.ndarray, fine_block: int, plan: HybridPlan):
+    """Static FLOP accounting for the hybrid at a geometry (the
+    walk_stats analog): banded walk cost + residual v2 cost vs the
+    exact-sparse bound of the WHOLE layout. Lets tests pin the waste
+    factor (computed/exact) without hardware."""
+    H, nb, _ = np.asarray(layout).shape
+    S = nb * fine_block
+    bq, bkv = plan.blocks
+    # banded part: uniform across heads -> use one head's pred count
+    L = np.asarray(layout).astype(bool)
+    pred = L[0] & ~plan.residual[0].astype(bool)
+    banded = walk_stats(S, fine_block, plan.params, bq, bkv,
+                        n_active_blocks=int(pred.sum()))
+    # residual v2 walk: 9 tile dots per active fine block per head
+    # (fwd s/pv = 2, dq s/dp/dq = 3, dkv s/dv/dp/dk = 4) — the v2 walk
+    # computes exactly the active cells, its overhead is per-step, not
+    # per-cell
+    res_nnz = int(plan.residual.sum())
+    res_cells = 9 * res_nnz * fine_block * fine_block
+    total_nnz = int(L.sum())
+    exact = 9 * total_nnz * fine_block * fine_block
+    computed = H * banded["computed_cell_dots"] + res_cells
+    return {
+        "banded_steps": banded["steps"],
+        "banded_cell_dots_per_head": banded["computed_cell_dots"],
+        "residual_nnz_blocks": res_nnz,
+        "residual_cell_dots": res_cells,
+        "computed_cell_dots": computed,
+        "exact_cell_dots": exact,
+        "waste": computed / exact if exact else None,
+        "coverage": plan.coverage,
+    }

@@ -1,0 +1,256 @@
+"""Hybrid banded+residual sparse attention (BigBird fast path).
+
+deepspeed_tpu/ops/sparse_attention/hybrid.py: the banded kernels run
+the maximal global-prefix + band sub-pattern, the v2 walk runs the
+random-block residue, and the parts merge by per-part log-sum-exp.
+Reference capability being matched: BigBirdSparsityConfig layouts
+(deepspeed/ops/sparse_attention/sparsity_config.py:421) at sparse — not
+overhead-bound generic — cost. Numerics are pinned against the
+dense-masked oracle, including backward through the merged lse.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.sparse_attention import blocksparse as bs
+from deepspeed_tpu.ops.sparse_attention import hybrid as hy
+from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
+    BigBirdSparsityConfig, BSLongformerSparsityConfig,
+    VariableSparsityConfig)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    bs._FN_CACHE.clear()
+    yield
+    bs._FN_CACHE.clear()
+
+
+def _rand_qkv(B, H, S, D, seed=0, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return [jax.random.normal(k, (B, H, S, D), dtype) for k in ks]
+
+
+def _bigbird(H=2, block=32, per_head=False, seed=0):
+    return BigBirdSparsityConfig(
+        num_heads=H, block=block, different_layout_per_head=per_head,
+        num_random_blocks=1, num_sliding_window_blocks=3,
+        num_global_blocks=1, seed=seed)
+
+
+# --------------------------------------------------------------------- #
+# detection / planning
+# --------------------------------------------------------------------- #
+def test_detect_subpattern_bigbird():
+    L = _bigbird().make_layout(512)
+    params, residual, coverage = hy.detect_banded_subpattern(L)
+    assert (params.g_r, params.g_c, params.w, params.causal) == \
+        (1, 1, 1, False)
+    # the predicate + residual must reconstruct the layout exactly, and
+    # be disjoint
+    n = L.shape[1]
+    idx = np.arange(n)
+    rb, cb = idx[:, None], idx[None, :]
+    pred = ((rb < params.g_r) | (cb < params.g_c) |
+            (np.abs(rb - cb) <= params.w))
+    rec = pred[None] | residual.astype(bool)
+    assert (rec == L.astype(bool)).all()
+    assert not (pred[None] & residual.astype(bool)).any()
+    assert coverage > 0.8
+
+
+def test_detect_subpattern_per_head_random():
+    """Per-head random blocks: the banded part is fit under the head
+    INTERSECTION; each head's residual keeps its own random blocks."""
+    L = _bigbird(H=4, per_head=True).make_layout(512)
+    params, residual, _cov = hy.detect_banded_subpattern(L)
+    assert (params.g_r, params.g_c, params.w) == (1, 1, 1)
+    n = L.shape[1]
+    idx = np.arange(n)
+    rb, cb = idx[:, None], idx[None, :]
+    pred = ((rb < 1) | (cb < 1) | (np.abs(rb - cb) <= 1))
+    for h in range(4):
+        assert ((pred | residual[h].astype(bool))
+                == L[h].astype(bool)).all()
+
+
+def test_plan_declines_when_banded_owns_it():
+    """Pure Longformer (no residual) must go to the exact banded path,
+    not the hybrid."""
+    L = BSLongformerSparsityConfig(num_heads=2, block=32).make_layout(512)
+    assert hy.plan_hybrid(L, 32, True) is None
+    assert bs.planned_kernel(L, 32, interpret=True) == "banded"
+
+
+def test_plan_declines_low_coverage():
+    """Random-heavy layout (residual dominates): the banded pass would
+    be pure overhead."""
+    rng = np.random.default_rng(0)
+    n = 16
+    L = (rng.random((1, n, n)) < 0.5).astype(np.int32)
+    L |= np.eye(n, dtype=np.int32)[None]          # keep a w=0 diagonal
+    det = hy.detect_banded_subpattern(L)
+    if det is not None:
+        assert det[2] < hy._MIN_COVERAGE
+    assert hy.plan_hybrid(L, 32, True) is None
+
+
+def test_plan_declines_unstreamable_block_compiled():
+    """Compiled mode requires the v2 walk to DMA-stream the residual:
+    non-128-multiple fine blocks decline (same constraint as v2)."""
+    L = _bigbird(block=64).make_layout(4096)
+    assert hy.plan_hybrid(L, 64, interpret=False) is None
+    assert hy.plan_hybrid(L, 64, interpret=True) is not None
+
+
+def test_dispatch_plans_hybrid_for_bigbird():
+    L = _bigbird().make_layout(512)
+    assert bs.planned_kernel(L, 32, interpret=True) == "hybrid"
+    f = bs._sparse_attention_fn(L, 32, 0.25, has_am=False, interpret=True)
+    assert getattr(f, "kernel_kind", None) == "hybrid"
+    assert f.hybrid_coverage > 0.8
+    # flipping the switch falls back to the generic family
+    old = bs.USE_HYBRID
+    try:
+        bs.USE_HYBRID = False
+        bs._FN_CACHE.clear()
+        assert bs.planned_kernel(L, 32, interpret=True) != "hybrid"
+    finally:
+        bs.USE_HYBRID = old
+
+
+# --------------------------------------------------------------------- #
+# numerics vs the dense oracle
+# --------------------------------------------------------------------- #
+def _check_fwd_bwd(L, B=1, H=2, S=512, D=16, dtype=jnp.float32,
+                   atol=5e-6, seed=0, **kw):
+    assert bs.planned_kernel(L, S // L.shape[1], interpret=True) == \
+        "hybrid"
+    q, k, v = _rand_qkv(B, H, S, D, seed=seed, dtype=dtype)
+
+    def loss_h(q, k, v):
+        return jnp.sum(bs.block_sparse_attention(
+            q, k, v, L, interpret=True, **kw).astype(jnp.float32) ** 2)
+
+    def loss_r(q, k, v):
+        return jnp.sum(bs.block_sparse_attention_reference(
+            q, k, v, L, **kw).astype(jnp.float32) ** 2)
+
+    o = bs.block_sparse_attention(q, k, v, L, interpret=True, **kw)
+    o_ref = bs.block_sparse_attention_reference(q, k, v, L, **kw)
+    np.testing.assert_allclose(np.asarray(o, np.float32),
+                               np.asarray(o_ref, np.float32),
+                               atol=atol, rtol=atol)
+    gh = jax.grad(loss_h, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", gh, gr):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            atol=atol * 20, rtol=atol * 20, err_msg=f"d{name}")
+
+
+def test_hybrid_matches_oracle_bigbird():
+    _check_fwd_bwd(_bigbird().make_layout(512))
+
+
+def test_hybrid_matches_oracle_per_head():
+    _check_fwd_bwd(_bigbird(H=2, per_head=True, seed=3).make_layout(512))
+
+
+def test_hybrid_matches_oracle_more_random():
+    cfg = BigBirdSparsityConfig(num_heads=2, block=32,
+                                num_random_blocks=2,
+                                num_sliding_window_blocks=5,
+                                num_global_blocks=2, seed=7)
+    _check_fwd_bwd(cfg.make_layout(512), seed=5)
+
+
+def test_hybrid_matches_oracle_causal_residual():
+    """Causal band + random lower-triangle residue: the clip flows into
+    both the banded predicate and the merge."""
+    n = 16
+    idx = np.arange(n)
+    rb, cb = idx[:, None], idx[None, :]
+    pred = (((rb < 1) | (cb < 1) | (np.abs(rb - cb) <= 1)) &
+            (cb <= rb))
+    L = np.broadcast_to(pred, (2, n, n)).copy()
+    rng = np.random.default_rng(11)
+    for h in range(2):
+        for r in range(4, n):
+            c = rng.integers(1, r - 1)
+            L[h, r, c] = True
+    L = L.astype(np.int32)
+    det = hy.detect_banded_subpattern(L)
+    assert det is not None and det[0].causal
+    _check_fwd_bwd(L, seed=2)
+
+
+def test_variable_chunked_windows_decline_hybrid():
+    """VariableSparsityConfig's local windows are block-diagonal CHUNKS,
+    not a sliding band — only the w=0 diagonal survives the subpattern
+    fit, coverage lands under _MIN_COVERAGE, and the layout stays on
+    the generic family (which still matches the oracle)."""
+    cfg = VariableSparsityConfig(num_heads=2, block=32,
+                                 num_random_blocks=1,
+                                 local_window_blocks=[3],
+                                 global_block_indices=[0])
+    L = cfg.make_layout(512)
+    det = hy.detect_banded_subpattern(L)
+    assert det is not None and det[2] < hy._MIN_COVERAGE
+    assert hy.plan_hybrid(L, 32, True) is None
+    planned = bs.planned_kernel(L, 32, interpret=True)
+    assert planned != "hybrid"
+    q, k, v = _rand_qkv(1, 2, 512, 16, seed=4)
+    o = bs.block_sparse_attention(q, k, v, L, interpret=True)
+    o_ref = bs.block_sparse_attention_reference(q, k, v, L)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(o_ref),
+                               atol=5e-5, rtol=5e-5)
+
+
+def test_hybrid_with_key_padding_mask():
+    L = _bigbird().make_layout(512)
+    kpm = np.zeros((1, 512), np.float32)
+    kpm[:, 480:] = -1e9
+    _check_fwd_bwd(L, key_padding_mask=jnp.asarray(kpm),
+                   key_padding_mask_mode="add")
+
+
+def test_hybrid_bf16():
+    L = _bigbird().make_layout(512)
+    q, k, v = _rand_qkv(1, 2, 512, 16, seed=6, dtype=jnp.bfloat16)
+    o = bs.block_sparse_attention(q, k, v, L, interpret=True)
+    o_ref = bs.block_sparse_attention_reference(q, k, v, L)
+    assert o.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(o, np.float32),
+                               np.asarray(o_ref, np.float32),
+                               atol=3e-2, rtol=3e-2)
+
+
+# --------------------------------------------------------------------- #
+# FLOP accounting (VERDICT r4 #3: <= 2x the exact-sparse bound at
+# BigBird density)
+# --------------------------------------------------------------------- #
+def test_hybrid_stats_bigbird_bench_geometry():
+    """At the bench geometry (S=8192, 128 blocks, BigBird defaults) the
+    hybrid computes <= 2x the exact-sparse cell-dot bound — the
+    overhead is banded band-edge waste plus nothing per-residual-cell
+    (the v2 walk computes exactly its active cells)."""
+    L = _bigbird(H=16, block=128).make_layout(8192)
+    plan = hy.plan_hybrid(L, 128, interpret=False)
+    assert plan is not None, "hybrid must engage at the bench geometry"
+    stats = hy.hybrid_stats(L, 128, plan)
+    assert stats["exact_cell_dots"] > 0
+    assert stats["waste"] <= 2.0, stats
+    # and the hybrid is the planned kernel there
+    assert bs.planned_kernel(L, 128, interpret=False) == "hybrid"
+
+
+def test_hybrid_stats_account_all_parts():
+    L = _bigbird().make_layout(512)
+    plan = hy.plan_hybrid(L, 32, True)
+    stats = hy.hybrid_stats(L, 32, plan)
+    assert stats["residual_nnz_blocks"] == int(plan.residual.sum())
+    assert stats["computed_cell_dots"] >= stats["exact_cell_dots"]
+    assert stats["coverage"] == plan.coverage
