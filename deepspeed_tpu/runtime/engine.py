@@ -347,9 +347,16 @@ class DeepSpeedEngine:
                     lambda _: data_shd, opt_state.server_error))
 
         self.gradient_accumulation_steps = self._config.gradient_accumulation_steps
-        # offload always accumulates on device, then applies host-side at
-        # the boundary (one D2H of summed grads per optimizer step)
-        if self.gradient_accumulation_steps > 1 or self.zero_cpu_offload:
+        # With real accumulation (ga>1) grads sum on device in fp32 and
+        # apply at the boundary (offload: one D2H of the summed grads).
+        # cpu_offload at ga=1 allocates NO accumulator at all: the grads
+        # leave the micro step as a compute-dtype OUTPUT and the host
+        # snapshots them right after the dispatch — the reference's
+        # transfer-grads-as-produced design (zero/stage2.py cpu_offload
+        # 16-bit grad buckets) without a params-sized staging buffer
+        # resident in HBM (the saving that lets a 2.5B model fit v5e,
+        # test_offload_memory.py).
+        if self.gradient_accumulation_steps > 1:
             if self._onebit_dist:
                 # stacked per-rank local-grad accumulators
                 dp = self.dp_world_size
@@ -369,6 +376,7 @@ class DeepSpeedEngine:
                     accum_shardings = replicated_shardings(accum, self.mesh)
         else:
             accum, accum_shardings = (), ()
+        self._offload_grads_device = None   # offload ga=1 grad output
 
         state = TrainState(
             params=params,
@@ -1152,6 +1160,15 @@ class DeepSpeedEngine:
             loss, aux, grads = self._compute_loss_and_grads(
                 state.params, batch, sub, state.loss_scale.scale)
 
+        out = loss if csr_ovf is None else (loss, csr_ovf)
+        if self.zero_cpu_offload and self.gradient_accumulation_steps == 1:
+            # no accumulator: the compute-dtype grads are an OUTPUT of
+            # the dispatch (half the D2H bytes of fp32 — the
+            # reference's 16-bit grad transfer to the host optimizer);
+            # train_batch/backward stash them for _host_grad_snapshot
+            state = state._replace(rng=rng,
+                                   micro_step=state.micro_step + 1)
+            return state, (out, _tree_cast(grads, self.compute_dtype))
         if self.zero_cpu_offload or self.gradient_accumulation_steps > 1:
             accum = jax.tree_util.tree_map(jnp.add, state.accum_grads, grads)
             state = state._replace(accum_grads=accum, rng=rng,
@@ -1169,7 +1186,7 @@ class DeepSpeedEngine:
             state = state._replace(rng=rng,
                                    micro_step=state.micro_step + 1)
             state = self._apply_update(state, grads)
-        return state, (loss if csr_ovf is None else (loss, csr_ovf))
+        return state, out
 
     def _get_compiled_micro_step(self):
         if self._compiled_micro_step is None:
@@ -1229,7 +1246,15 @@ class DeepSpeedEngine:
             self.timers("backward").start()
         grads = self._cached_grads
         self._cached_grads = None
-        if self.gradient_accumulation_steps > 1 or self.zero_cpu_offload:
+        if self.zero_cpu_offload and self.gradient_accumulation_steps == 1:
+            # no device accumulator (micro-step parity): stash for the
+            # boundary snapshot, cast to compute dtype like the fused
+            # path so this API moves the same 16-bit D2H bytes
+            self._offload_grads_device = _tree_cast(grads,
+                                                    self.compute_dtype)
+            self.state = self.state._replace(
+                micro_step=self.state.micro_step + 1)
+        elif self.gradient_accumulation_steps > 1 or self.zero_cpu_offload:
             accum = jax.tree_util.tree_map(jnp.add, self.state.accum_grads,
                                            grads)
             self.state = self.state._replace(
@@ -1246,13 +1271,25 @@ class DeepSpeedEngine:
     # -- next window's device compute (reference overlaps D2H/H2D on side
     # -- streams, stage2.py:291-294 + async copy in csrc/adam/cpu_adam.cpp)
     def _host_grad_snapshot(self):
-        """D2H of the summed, unscaled fp32 grads; then reset the device
+        """D2H of the summed, unscaled grads as host fp32. ga=1: the
+        micro step emitted them as a compute-dtype output (no device
+        accumulator to reset); ga>1: drain and zero the fp32
         accumulator so the next window can start immediately."""
         from deepspeed_tpu.runtime.checkpoint import _to_host_global
-        accum = jax.tree_util.tree_map(_to_host_global,
-                                       self.state.accum_grads)
         scale = float(self.state.loss_scale.scale)
         inv = 1.0 / scale
+        if self.gradient_accumulation_steps == 1:
+            assert self._offload_grads_device is not None, \
+                "offload boundary without a completed micro step"
+            src, self._offload_grads_device = \
+                self._offload_grads_device, None
+            self.state = self.state._replace(
+                micro_step=jnp.zeros((), jnp.int32))
+            host = jax.tree_util.tree_map(_to_host_global, src)
+            return jax.tree_util.tree_map(
+                lambda g: np.asarray(g, np.float32) * inv, host)
+        accum = jax.tree_util.tree_map(_to_host_global,
+                                       self.state.accum_grads)
         grads = jax.tree_util.tree_map(
             lambda g: np.asarray(g, np.float32) * inv, accum)
         zero_accum = jax.tree_util.tree_map(
@@ -1437,9 +1474,13 @@ class DeepSpeedEngine:
         self.tput_timer.start()
         _t_step0 = time.perf_counter()
         total = None
+        offload_direct = (self.zero_cpu_offload and
+                          self.gradient_accumulation_steps == 1)
         for _ in range(self.gradient_accumulation_steps):
             batch = next(data_iter)
             self.state, out = step_fn(self.state, batch)
+            if offload_direct:
+                out, self._offload_grads_device = out
             if self._sparse_grad_paths and not self._onebit_dist:
                 loss, self._csr_overflow = out
             else:

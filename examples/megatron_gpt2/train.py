@@ -33,6 +33,16 @@ GPT2_345M = dict(vocab_size=50304, max_position_embeddings=1024,
 # scale point (reference megatron tutorial's 1.5B config)
 GPT2_XL = dict(vocab_size=50304, max_position_embeddings=1024,
                hidden_size=1600, num_layers=48, num_heads=25)
+# 2.1B: the single-chip ZeRO-Offload flagship (reference ZeRO-Offload
+# claim: 13B on one 32 GB V100, docs/_posts/2020-09-09-ZeRO-Offload.md
+# :10). On a 16 GB v5e the offload recipe — bf16 params in HBM, grads
+# as a direct compute-dtype output (no accumulator), fp32 master +
+# Adam moments in host RAM, scan_layers + remat — fits 2.1B under the
+# CONSERVATIVE compiler memory proof in tests/unit/test_offload_memory
+# .py (no buffer-alias credit; with the alias XLA actually performs,
+# ~2.5B fits). Heads of 128 (2048/16) keep flash on tuned block shapes.
+GPT2_2B = dict(vocab_size=50304, max_position_embeddings=1024,
+               hidden_size=2048, num_layers=40, num_heads=16)
 GPT2_TINY = dict(vocab_size=512, max_position_embeddings=128,
                  hidden_size=64, num_layers=4, num_heads=4)
 
@@ -45,9 +55,10 @@ def main():
                         default="zero2")
     parser.add_argument("--tiny", action="store_true",
                         help="Tiny model for smoke runs")
-    parser.add_argument("--size", choices=["tiny", "345m", "xl"],
+    parser.add_argument("--size", choices=["tiny", "345m", "xl", "2b"],
                         default=None,
-                        help="model size (xl = GPT-2 1.5B; --tiny wins)")
+                        help="model size (xl = GPT-2 1.5B; 2b = the "
+                             "single-chip offload flagship; --tiny wins)")
     parser.add_argument("--seq", type=int, default=0)
     parser.add_argument("--steps", type=int, default=10)
     parser.add_argument("--save_dir", type=str, default=None,
@@ -66,13 +77,25 @@ def main():
     with open(config) as f:
         config = json.load(f)
 
-    sizes = {"tiny": GPT2_TINY, "345m": GPT2_345M, "xl": GPT2_XL}
+    sizes = {"tiny": GPT2_TINY, "345m": GPT2_345M, "xl": GPT2_XL,
+             "2b": GPT2_2B}
     size = GPT2_TINY if args.tiny else sizes[args.size or "345m"]
+    # billion-scale single-chip offload needs the memory recipe:
+    # stacked-layer scan (one compiled block) + rematerialized blocks
+    big_offload = args.mode == "offload" and \
+        (args.size or "") in ("xl", "2b")
     cfg = GPT2Config(embd_dropout=0.0, attn_dropout=0.0, resid_dropout=0.0,
-                     **size)
+                     scan_layers=big_offload, **size)
     seq = args.seq or min(cfg.max_position_embeddings, 1024)
 
     rng = np.random.RandomState(0)
+    if big_offload:
+        # one micro per boundary: the engine then allocates no grad
+        # accumulator at all — grads leave the step as a compute-dtype
+        # output (test_offload_memory.py). Pinned BEFORE reading the
+        # batch geometry below.
+        config = dict(config, gradient_accumulation_steps=1,
+                      train_micro_batch_size_per_gpu=1)
     micro = config["train_micro_batch_size_per_gpu"]
     ga = config.get("gradient_accumulation_steps", 1)
 
@@ -127,7 +150,7 @@ def main():
         # overlapped under the next window's compute)
         params = init_gpt2_params(cfg, jax.random.PRNGKey(0))
         print(f"params: {count_params(params)/1e6:.0f}M")
-        loss_fn = gpt2_loss_fn(cfg, deterministic=True)
+        loss_fn = gpt2_loss_fn(cfg, deterministic=True, remat=big_offload)
         engine, *_ = ds.initialize(model=loss_fn, model_parameters=params,
                                    config=config)
         bs = engine.train_batch_size() // ga

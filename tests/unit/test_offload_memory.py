@@ -1,0 +1,170 @@
+"""ZeRO-Offload single-chip scale proof (VERDICT r4 #2).
+
+The reference demonstrates 13B params trained on one 32 GB V100 via
+ZeRO-Offload (docs/_posts/2020-09-09-ZeRO-Offload.md:10): 16-bit params
++ grads in device memory, fp32 master + Adam moments + the optimizer
+step on the host. The TPU analog here is the offload flagship from
+examples/megatron_gpt2 (--mode offload --size 2b): GPT-2 2.1B on one
+16 GB v5e — bf16 params in HBM, grads leaving the micro step as a
+compute-dtype OUTPUT (at ga=1 the engine allocates no accumulator at
+all; the host snapshots the output right after the dispatch — the
+reference's 16-bit grad transfer without a params-sized staging buffer
+resident in HBM), scan_layers + remat activations, host AVX Adam on
+the fp32 master.
+
+Like test_flagship_memory.py, the proof compiles the REAL device
+program at full scale from ABSTRACT avals (no 5 GB materialization) and
+asserts the compiler's own memory analysis fits v5e HBM. The device
+program mirrors engine._micro_step's offload-ga1 branch exactly: one
+fused value_and_grad emitting compute-dtype grads, params untouched
+(the update happens on the host); the tiny-scale composition tests
+below and in test_cpu_adam.py pin that this is the program the engine
+actually runs.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models.gpt2 import (GPT2Config, gpt2_loss_fn,
+                                       init_gpt2_params)
+
+pytestmark = pytest.mark.slow
+
+V5E_HBM = 16 * 2**30
+HEADROOM = 0.85
+
+# GPT-2 2.1B (examples/megatron_gpt2 GPT2_2B): 40 x hidden 2048
+# (16 heads, d=128 — a tuned block-table shape), 50304-aligned vocab
+CFG = GPT2Config(vocab_size=50304, max_position_embeddings=1024,
+                 hidden_size=2048, num_layers=40, num_heads=16,
+                 embd_dropout=0.0, attn_dropout=0.0, resid_dropout=0.0,
+                 scan_layers=True)
+SEQ, MB = 1024, 1
+
+
+def test_offload_2p5b_fits_v5e_hbm():
+    loss_fn = gpt2_loss_fn(CFG, dtype=jnp.bfloat16, remat=True)
+    ap = jax.eval_shape(lambda k: init_gpt2_params(CFG, k),
+                        jax.random.PRNGKey(0))
+    n_params = sum(int(np.prod(s.shape))
+                   for s in jax.tree_util.tree_leaves(ap))
+    assert n_params >= 2.0e9, n_params          # the >=2B bar
+    abf16 = jax.tree_util.tree_map(
+        lambda s: jax.ShapeDtypeStruct(s.shape, jnp.bfloat16), ap)
+    abatch = {"input_ids": jax.ShapeDtypeStruct((MB, SEQ + 1), jnp.int32)}
+    arng = jax.ShapeDtypeStruct((2,), jnp.uint32)
+
+    def micro(params, batch, rng):
+        # engine._micro_step offload-ga1 branch: fwd+bwd fused, grads
+        # leave as a compute-dtype output; params flow through
+        # unchanged — the optimizer step happens on the host
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(p, batch, rng))(params)
+        return loss, jax.tree_util.tree_map(
+            lambda g: g.astype(jnp.bfloat16), grads)
+
+    ma = (jax.jit(micro)
+          .lower(abf16, abatch, arng)
+          .compile().memory_analysis())
+    if ma is None or not hasattr(ma, "temp_size_in_bytes"):
+        pytest.skip("backend provides no memory analysis")
+    args = ma.argument_size_in_bytes
+    temp = ma.temp_size_in_bytes
+    out = ma.output_size_in_bytes
+    # CPU-backend correction, conservative for TPU: FloatNormalization
+    # widens the scan's stacked dgrad buffer to f32 on CPU (no bf16 CPU
+    # kernels), so `temp` carries a 4*N_h copy that compiles as bf16
+    # (2*N_h) on TPU — each stacked slice is written once per scan
+    # step, no f32 accumulation is ever needed. Replace the widened
+    # copy with its bf16 size; do NOT claim the further TPU saving that
+    # this buffer aliases the grad output.
+    n_h = sum(int(np.prod(s.shape))
+              for s in jax.tree_util.tree_leaves(ap["h"]))
+    f32_dgrads = 4 * n_h
+    assert temp > f32_dgrads, (temp, f32_dgrads)   # the copy is there
+    temp_tpu = temp - f32_dgrads + 2 * n_h
+    total = args + temp_tpu + out
+    assert total <= HEADROOM * V5E_HBM, (
+        total / 2**30, dict(args=args / 2**30, temp=temp / 2**30,
+                            temp_tpu=temp_tpu / 2**30, out=out / 2**30))
+    # the recipe really is load-bearing: params + grad output + the
+    # bf16 dgrad buffer are ~6*n_params bytes, so activations (the
+    # remainder) must stay small — catches a remat/scan regression
+    # silently materializing per-layer activations
+    acts = temp - f32_dgrads
+    assert acts <= 1.5 * 2**30, acts / 2**30
+    # host-side state the proof moves off-device: fp32 master + m + v
+    host_state_gb = 3 * n_params * 4 / 2**30
+    assert host_state_gb > 20            # ~24 GB: the reason offload wins
+
+
+def test_offload_ga1_direct_grads_and_training():
+    """At ga=1 + cpu_offload the engine allocates NO device grad
+    accumulator (the params-sized HBM saving): grads leave the micro
+    step as a compute-dtype output, and training still converges. With
+    ga>1 the fp32 accumulator stays (real accumulation)."""
+    from tests.unit.simple_model import (init_simple_params, simple_loss_fn,
+                                         random_batches)
+
+    def build(ga):
+        params = init_simple_params(jax.random.PRNGKey(0), hidden_dim=8)
+        engine, *_ = ds.initialize(
+            model=simple_loss_fn, model_parameters=params,
+            config={"train_micro_batch_size_per_gpu": 4,
+                    "gradient_accumulation_steps": ga,
+                    "bf16": {"enabled": True},
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                    "zero_optimization": {"stage": 2, "cpu_offload": True}})
+        return engine
+
+    e1 = build(1)
+    assert e1.state.accum_grads == ()
+    batches = random_batches(8, 4, 8, seed=0)
+    losses = []
+    for i in range(8):
+        losses.append(float(e1.train_batch(iter(batches[i:i + 1]))))
+    assert losses[-1] < losses[0], losses
+    # the grads crossed as compute dtype (D2H at 16-bit)
+    dts = {g.dtype for g in
+           jax.tree_util.tree_leaves(e1._offload_grads_device)} \
+        if e1._offload_grads_device is not None else None
+    # consumed by the boundary snapshot — the stash must be drained
+    assert e1._offload_grads_device is None, dts
+
+    e2 = build(2)
+    dtypes2 = {a.dtype for a in
+               jax.tree_util.tree_leaves(e2.state.accum_grads)}
+    assert dtypes2 == {np.dtype(np.float32)}, dtypes2
+
+
+def test_offload_ga1_matches_ga1_device_adam_bf16():
+    """Offload-ga1 direct-grad path vs on-device Adam at bf16: same
+    data, trajectories agree to bf16-grad tolerance (the compute-dtype
+    D2H rounds grads exactly once, like the reference's fp16 grad
+    transfer)."""
+    from tests.unit.simple_model import (init_simple_params, simple_loss_fn,
+                                         random_batches)
+    params = init_simple_params(jax.random.PRNGKey(0), hidden_dim=8)
+    batches = random_batches(6, 4, 8, seed=1)
+    runs = {}
+    for mode in ("offload", "device"):
+        cfg = {"train_micro_batch_size_per_gpu": 4,
+               "gradient_accumulation_steps": 1,
+               "bf16": {"enabled": True},
+               "optimizer": {"type": "Adam", "params": {"lr": 1e-2}}}
+        if mode == "offload":
+            cfg["zero_optimization"] = {"stage": 2, "cpu_offload": True}
+        engine, *_ = ds.initialize(model=simple_loss_fn,
+                                   model_parameters=params, config=cfg)
+        for i in range(6):
+            engine.train_batch(iter(batches[i:i + 1]))
+        engine.synchronize()
+        runs[mode] = jax.device_get(engine.state.params)
+    for a, b in zip(jax.tree_util.tree_leaves(runs["offload"]),
+                    jax.tree_util.tree_leaves(runs["device"])):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=2e-2, atol=2e-3)
