@@ -3,6 +3,10 @@
 Metrics (BASELINE.md rows):
 - bert_large_samples_per_s : BERT-large fused-layer training @ seq 128
   (reference: 272 samples/s on 1x V100, fastest-bert post :38-40)
+- bert_onebit_samples_per_s : BERT + 1-bit Adam in the compression
+  phase vs plain Adam at the same geometry (BASELINE.md ladder item 5;
+  vs_baseline = onebit/adam throughput, the single-chip compression
+  tax — the wire saving is pinned by the HLO audit)
 - sparse_attention_speedup_s8k : block-sparse vs dense O(S^2) softmax
   attention fwd+bwd wall time @ S=8192 — the baseline the reference's
   6.3x claim uses (sparse-attention post :28-33); the unit string names
@@ -46,6 +50,7 @@ _EMIT_LOCK = threading.Lock()
 # Canonical ladder order; headline last (the driver reads the final line).
 METRICS = [
     "bert_large_samples_per_s",
+    "bert_onebit_samples_per_s",
     "sparse_attention_speedup_s8k",
     "gpt2_train_mfu_dropout",
     "gpt2_train_mfu",
@@ -188,6 +193,105 @@ def bench_bert_large(on_tpu, rtt):
                   "hbm_peak_mb_child": _hbm_peak_mb()})
 
 
+def bench_bert_onebit(on_tpu, rtt):
+    """BERT + 1-bit Adam, compression phase (BASELINE.md ladder item 5;
+    reference claim: <=5x comm reduction, 3.5x e2e on 40GbE clusters —
+    onebit-adam-blog-post.md:85,135). A single chip cannot show the
+    cluster speedup, so this row measures the COMPRESSION TAX: 1-bit
+    samples/s vs plain-Adam samples/s at the same geometry
+    (vs_baseline = onebit/adam; 1.0 = compression is free). The wire
+    saving itself is pinned backend-invariantly by
+    test_hlo_collectives.py::test_onebit_adam_compressed_wire_traffic
+    (compressed exchange <= 1/5 of the dense exchange in elements,
+    1/32 in payload bytes)."""
+    import jax
+    import deepspeed_tpu
+    from deepspeed_tpu.models.bert import (BERT_LARGE, BertConfig,
+                                           bert_mlm_loss_fn,
+                                           init_bert_params)
+
+    if on_tpu:
+        cfg, batch, seq, steps = BERT_LARGE, 32, 128, 10
+    else:
+        cfg = BertConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                         num_heads=2, intermediate_size=128,
+                         max_position_embeddings=128)
+        batch, seq, steps = 4, 32, 2
+    if os.environ.get("BENCH_SCAN_LAYERS", "0") == "1":
+        cfg = cfg._replace(scan_layers=True)
+    n_dev = jax.device_count()
+    warm = 2  # freeze_step: warmup optimizer steps before compression
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    labels = np.where(rng.rand(batch, seq) < 0.15, ids, -100).astype(np.int32)
+    from jax.sharding import NamedSharding, PartitionSpec
+    shd_spec = PartitionSpec("data" if n_dev > 1 else None)
+
+    def make_engine(opt):
+        params = init_bert_params(cfg, jax.random.PRNGKey(0))
+        loss_fn = bert_mlm_loss_fn(cfg, deterministic=False)
+        engine, *_ = deepspeed_tpu.initialize(
+            model=loss_fn, model_parameters=params,
+            config={
+                "train_micro_batch_size_per_gpu": max(batch // n_dev, 1),
+                "gradient_accumulation_steps": 1,
+                "bf16": {"enabled": True},
+                "steps_per_print": 10**9,
+                # OnebitAdam requires ZeRO stage 0 (reference
+                # is_zero_supported_optimizer); keep Adam comparable
+                "zero_optimization": {"stage": 0},
+                "optimizer": opt,
+            })
+        shd = NamedSharding(engine.mesh, shd_spec)
+        b = {"input_ids": jax.device_put(ids, shd),
+             "labels": jax.device_put(labels, shd)}
+        return engine, b
+
+    def timed_sps(engine, b, n):
+        loss = engine.train_batch(iter([b]))
+        np.asarray(loss)                       # compile + settle
+        t0 = time.perf_counter()
+        for _ in range(n):
+            loss = engine.train_batch(iter([b]))
+        np.asarray(loss)
+        dt = max(time.perf_counter() - t0 - rtt, 1e-9)
+        return batch * n / dt, float(loss)
+
+    # -- 1-bit engine: run past freeze_step so the timed window is the
+    # compression phase (the phase switch recompiles once)
+    engine1, b1 = make_engine(
+        {"type": "OneBitAdam",
+         "params": {"lr": 1e-4, "freeze_step": warm}})
+    for _ in range(warm + 1):                  # cross the phase boundary
+        engine1.train_batch(iter([b1]))
+    assert engine1._onebit_compression, "compression phase not reached"
+    sps1, loss1 = timed_sps(engine1, b1, steps)
+    distributed = bool(engine1._onebit_dist)
+    # free the 1-bit engine's full state (params + master + moments +
+    # error feedback) before the Adam engine allocates its own — the
+    # row must not need 2x one configuration's HBM
+    del engine1, b1
+    _beat()
+
+    # -- plain-Adam reference at the same geometry
+    engine0, b0 = make_engine(
+        {"type": "Adam", "params": {"lr": 1e-4}})
+    sps0, _loss0 = timed_sps(engine0, b0, steps)
+
+    return _emit("bert_onebit_samples_per_s",
+                 round(sps1 / max(n_dev, 1), 2), "samples_per_s_per_chip",
+                 round(sps1 / sps0, 4),
+                 {"seq": seq, "batch": batch, "freeze_step": warm,
+                  "phase": "compression",
+                  "distributed": distributed,
+                  "adam_samples_per_s_per_chip":
+                      round(sps0 / max(n_dev, 1), 2),
+                  "compression_tax": round(1.0 - sps1 / sps0, 4),
+                  "loss": loss1,
+                  "hbm_peak_mb_child": _hbm_peak_mb()})
+
+
 def bench_sparse_attention(on_tpu, rtt):
     import jax
     import jax.numpy as jnp
@@ -250,12 +354,19 @@ def bench_sparse_attention(on_tpu, rtt):
     except Exception:
         # fall back to the per-triple v1 kernels rather than losing the
         # row (banded must drop too or the retry re-dispatches the very
-        # kernel that failed)
+        # kernel that failed; hybrid rides USE_SPLASH_V2).  Restore the
+        # flags afterwards — a later metric in the same process must
+        # not silently measure v1 (ADVICE r4).
         from deepspeed_tpu.ops.sparse_attention import blocksparse as bs
+        old_v2, old_banded = bs.USE_SPLASH_V2, bs.USE_BANDED
         bs.USE_SPLASH_V2 = False
         bs.USE_BANDED = False
         bs._FN_CACHE.clear()
-        t_sparse = timed(sparse_loss)
+        try:
+            t_sparse = timed(sparse_loss)
+        finally:
+            bs.USE_SPLASH_V2, bs.USE_BANDED = old_v2, old_banded
+            bs._FN_CACHE.clear()
         kernel = "v1-fallback"
     # the reference's 6.3x headline compares sparse vs its dense O(S^2)
     # softmax attention (sparse-attention post :28-33) — mirror that
@@ -299,6 +410,30 @@ def bench_sparse_attention(on_tpu, rtt):
                     "s16k_vs_flash": round(t_d2 / t_s2, 3)}
         except Exception as e:
             s16k = {"s16k_error": f"{type(e).__name__}: {e}"[:120]}
+    # BigBird detail (reference sparsity_config.py:421): random blocks
+    # ride the hybrid banded+residual lse-merge path (hybrid.py).
+    # Best-effort like s16k: evidence the non-banded layout family also
+    # leaves the overhead-bound generic walk.
+    bigbird = {}
+    if on_tpu:
+        try:
+            from deepspeed_tpu.ops.sparse_attention import (
+                BigBirdSparsityConfig, SparseSelfAttention as _SSA)
+            from deepspeed_tpu.ops.sparse_attention import blocksparse as _bb
+            sp_bb = _SSA(BigBirdSparsityConfig(
+                num_heads=H, block=block, num_random_blocks=1,
+                num_sliding_window_blocks=win, num_global_blocks=1))
+
+            def bigbird_loss(q, k, v):
+                return jnp.sum(sp_bb(q, k, v).astype(jnp.float32))
+
+            t_bb = timed(bigbird_loss, start_len=max(iters // 2, 1))
+            bigbird = {"bigbird_sparse_ms": round(t_bb * 1000, 2),
+                       "bigbird_vs_flash": round(t_dense / t_bb, 3),
+                       "bigbird_kernel": _bb.planned_kernel(
+                           sp_bb.get_layout(S), block)}
+        except Exception as e:
+            bigbird = {"bigbird_error": f"{type(e).__name__}: {e}"[:120]}
 
     # which walk the cost model actually picked for this layout
     try:
@@ -331,6 +466,7 @@ def bench_sparse_attention(on_tpu, rtt):
                   "flash_ms": round(t_dense * 1000, 2),
                   "vs_flash": round(t_dense / t_sparse, 3),
                   "sparse_ms": round(t_sparse * 1000, 2), **s16k,
+                  **bigbird,
                   "hbm_peak_mb_child": _hbm_peak_mb()})
 
 
@@ -464,6 +600,8 @@ def run_child(metric):
 
     if metric == "bert_large_samples_per_s":
         bench_bert_large(on_tpu, rtt)
+    elif metric == "bert_onebit_samples_per_s":
+        bench_bert_onebit(on_tpu, rtt)
     elif metric == "sparse_attention_speedup_s8k":
         bench_sparse_attention(on_tpu, rtt)
     elif metric == "gpt2_train_mfu_dropout":

@@ -24,15 +24,16 @@ while true; do
       echo "$(date -u +%FT%TZ) probe ok (2/2) — starting hardware round" >> "$LOG"
       bash tools/on_tpu_up.sh >> "$LOG" 2>&1
       rc=$?
-      # the round is only DONE when all 4 bench rows are real; a tunnel
-      # death mid-round re-arms the watcher (completed rows resume from
-      # the partial file, so a retry only re-pays the failed metrics)
+      # the round is only DONE when all 5 bench rows are real (the
+      # round-5 ladder adds bert_onebit); a tunnel death mid-round
+      # re-arms the watcher (completed rows resume from the partial
+      # file, so a retry only re-pays the failed metrics)
       # NB grep -c prints the 0 itself on no-match (and exits 1) — an
       # `|| echo 0` here would yield the two-line "0\n0" and break -eq
       rows=$(grep -c '"metric"' /tmp/tpu_round/bench.jsonl 2>/dev/null)
       errs=$(grep -c '"unit": "error"' /tmp/tpu_round/bench.jsonl 2>/dev/null)
       rows=${rows:-0}; errs=${errs:-0}
-      if [ "$rc" -eq 0 ] && [ "$rows" -ge 4 ] && [ "$errs" -eq 0 ]; then
+      if [ "$rc" -eq 0 ] && [ "$rows" -ge 5 ] && [ "$errs" -eq 0 ]; then
         echo "$(date -u +%FT%TZ) hardware round COMPLETE ($rows rows)" >> "$LOG"
         exit 0
       fi
