@@ -500,12 +500,18 @@ class TestTransformerLayerGrid:
                                    np.asarray(o32), atol=5e-2, rtol=5e-2)
 
 
-def test_shipped_block_table_resolves():
+def test_shipped_block_table_resolves(monkeypatch):
     """Every entry in the checked-in block_table.json must resolve
     through the REAL loader path (entries list + device_kind matching),
     not just the _BLOCK_TABLE test hook — guards loader rewrites against
     silently orphaning the hardware-measured winners (r4 loader added
-    device_kind/gqa/kind fields)."""
+    device_kind/gqa/kind fields).
+
+    The lookup is pinned per entry by monkeypatching flash._device_kind
+    to the entry's own recorded device: stamped entries only match on
+    the chip that measured them, so resolving them against THIS host's
+    device kind would fail deterministically on CPU dev boxes the
+    moment a hardware sweep stamps the table (ADVICE r4)."""
     import json
     import os
     from deepspeed_tpu.ops.attention import flash as F
@@ -515,6 +521,8 @@ def test_shipped_block_table_resolves():
     for e in entries:
         if e.get("kind", "flash") != "flash":
             continue
+        monkeypatch.setattr(F, "_device_kind",
+                            lambda dk=e.get("device_kind"): dk)
         got = F._pick_blocks(e["seq_q"], e["seq_k"], e["d"],
                              gqa=e.get("gqa", 1))
         assert got == (e["bq"], e["bk"]), (e, got)

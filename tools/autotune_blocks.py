@@ -167,6 +167,19 @@ def time_banded_combo(S, fb, win, bq, bk, rtt, iters=None):
     try:
         if bs.planned_kernel(layout, fb) != "banded":
             raise RuntimeError("banded path did not engage")
+        # pick_blocks silently falls back to table/heuristic tiles when
+        # the forced pair fails _blocks_valid — make sure the kernel we
+        # are about to time actually walks (bq, bk), or the measurement
+        # would be recorded under the wrong label (ADVICE r4)
+        import numpy as _np
+        fn = bs._sparse_attention_fn(_np.asarray(layout), fb,
+                                     float(1.0 / _np.sqrt(64)),
+                                     has_am=False, interpret=False)
+        got = getattr(fn, "banded_blocks", None)
+        if got != (bq, bk):
+            raise RuntimeError(
+                f"forced banded blocks did not engage: built {got}, "
+                f"forced {(bq, bk)}")
         sec, _n2 = scan_grad_seconds(jax.grad(loss, argnums=(0, 1, 2)),
                                      (q, k, v), rtt, start_len=n,
                                      max_len=n * 4096)
