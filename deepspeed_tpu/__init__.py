@@ -28,6 +28,10 @@ from deepspeed_tpu.ops.transformer import (
     DeepSpeedTransformerLayer, DeepSpeedTransformerConfig)
 # reference exports `deepspeed.checkpointing` (__init__.py:16)
 from deepspeed_tpu.runtime.activation_checkpointing import checkpointing
+# explicit multi-host bootstrap for user scripts (engine.py calls it
+# automatically at initialize(); exported for the standalone-use parity
+# of deepspeed.init_distributed)
+from deepspeed_tpu.distributed import init_distributed
 
 __version__ = "0.1.0"
 

@@ -61,9 +61,14 @@ rc=$?
 export BENCH_METRIC_TIMEOUT=${BENCH_METRIC_TIMEOUT:-2400}
 export BENCH_STALL_TIMEOUT=${BENCH_STALL_TIMEOUT:-2280}
 
-echo "== sparse kernel A/B matrix (+ one traced dispatch)"
-AB_TRACE=1 timeout 3600 python tools/ab_coarse_sparse.py 2>&1 | tee "$OUT/sparse_ab.log"
+echo "== sparse kernel A/B matrix (+ BigBird hybrid + one traced dispatch)"
+# 5400s: the round-5 BigBird pair adds two grad-timed variants, each
+# paying fresh Pallas compiles through the tunnel
+AB_TRACE=1 timeout 5400 python tools/ab_coarse_sparse.py 2>&1 | tee "$OUT/sparse_ab.log"
 ab_rc=$?
+
+echo "== interleave V=2 vs V=4 tick-granularity timing"
+timeout 1800 python tools/ab_interleave.py 2>&1 | tee "$OUT/interleave_ab.log" || true
 
 echo "== headline variant A/Bs (log-only; the ladder rows above are canonical)"
 BENCH_MASTER_FREE=1 timeout 2400 python bench.py --metric gpt2_train_mfu \
