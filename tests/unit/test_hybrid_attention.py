@@ -234,17 +234,78 @@ def test_hybrid_bf16():
 # --------------------------------------------------------------------- #
 def test_hybrid_stats_bigbird_bench_geometry():
     """At the bench geometry (S=8192, 128 blocks, BigBird defaults) the
-    hybrid computes <= 2x the exact-sparse cell-dot bound — the
-    overhead is banded band-edge waste plus nothing per-residual-cell
-    (the v2 walk computes exactly its active cells)."""
+    hybrid computes <= 2x the exact-sparse cell-dot bound at the
+    PLANNED walk tiles (VERDICT r4 #3 bar), and the fine 128-tile walk
+    sits essentially AT the bound (<= 1.1x) — the per-step-overhead vs
+    FLOP-waste trade between them is the hardware sweep's call
+    (tools/ab_coarse_sparse.py)."""
     L = _bigbird(H=16, block=128).make_layout(8192)
     plan = hy.plan_hybrid(L, 128, interpret=False)
     assert plan is not None, "hybrid must engage at the bench geometry"
     stats = hy.hybrid_stats(L, 128, plan)
     assert stats["exact_cell_dots"] > 0
     assert stats["waste"] <= 2.0, stats
+    fine = hy.hybrid_stats(L, 128,
+                           plan._replace(blocks=(128, 128)))
+    assert fine["waste"] <= 1.1, fine
     # and the hybrid is the planned kernel there
     assert bs.planned_kernel(L, 128, interpret=False) == "hybrid"
+
+
+def test_detect_subpattern_fuzz_invariants():
+    """Property fuzz over planted banded structure + random residue:
+    detection must always return a predicate that is a SUBSET of every
+    head's layout, disjoint from the residual, reconstructing the
+    layout exactly, and covering at least the planted banded cells
+    (it may legally absorb coincidentally-full diagonals/rows)."""
+    rng = np.random.default_rng(42)
+    detected = 0
+    for trial in range(40):
+        n = int(rng.integers(4, 24))
+        H = int(rng.integers(1, 4))
+        g_r = int(rng.integers(0, max(n // 3, 1)))
+        g_c = int(rng.integers(0, max(n // 3, 1)))
+        w = int(rng.integers(0, max(n // 3, 1)))
+        causal = bool(rng.integers(0, 2))
+        idx = np.arange(n)
+        rb, cb = idx[:, None], idx[None, :]
+        clip = (cb <= rb) if causal else np.ones((n, n), bool)
+        pred = (((rb < g_r) | (cb < g_c) | (np.abs(rb - cb) <= w))
+                & clip)
+        L = np.broadcast_to(pred, (H, n, n)).copy()
+        # plant a few random residue blocks per head (inside the clip)
+        for h in range(H):
+            for _ in range(int(rng.integers(0, 4))):
+                r = int(rng.integers(0, n))
+                c = int(rng.integers(0, r + 1)) if causal \
+                    else int(rng.integers(0, n))
+                L[h, r, c] = True
+        L = L.astype(np.int32)
+        det = hy.detect_banded_subpattern(L)
+        if det is None:
+            # legal only when no full diagonal survives the fit
+            continue
+        detected += 1
+        p, residual, coverage = det
+        dp_clip = (cb <= rb) if p.causal else np.ones((n, n), bool)
+        dpred = (((rb < p.g_r) | (cb < p.g_c) |
+                  (np.abs(rb - cb) <= p.w)) & dp_clip)
+        for h in range(H):
+            lh = L[h].astype(bool)
+            assert (dpred <= lh).all(), (trial, p)           # subset
+            assert not (dpred & residual[h].astype(bool)).any(), trial
+            assert ((dpred | residual[h].astype(bool)) == lh).all(), \
+                (trial, p)
+        # the fit must COVER the planted banded structure (it may
+        # absorb more via coincidentally-full diagonals, never less) —
+        # guards a regression to trivial w=0/g=0 fits
+        if p.causal == causal:
+            assert (pred <= dpred).all(), (trial, p,
+                                           (g_r, g_c, w, causal))
+        assert 0.0 < coverage <= 1.0
+    # detection must actually fire on planted-banded layouts — a
+    # regression to always-None would otherwise pass vacuously
+    assert detected >= 30, detected
 
 
 def test_hybrid_stats_account_all_parts():

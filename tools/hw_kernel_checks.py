@@ -118,21 +118,23 @@ def _flash_streamed():
     _close(kern(q, k, v), orac(q, k, v), msg="fwd")
 
 
-@check("banded Longformer w=3 fwd+grad vs dense-masked oracle (S=2048)")
-def _splash_banded():
+def _sparse_vs_oracle(layout, seed, expect_kernel=None):
+    """Shared body of the sparse-kernel parity checks: dispatcher vs
+    the dense-masked oracle, fwd + all three grads, with an optional
+    planned-kernel pin so a dispatch regression cannot silently pass
+    as a different (correct) kernel family."""
     import jax.numpy as jnp
-    from deepspeed_tpu.ops.sparse_attention import (
-        BSLongformerSparsityConfig, block_sparse_attention)
+    from deepspeed_tpu.ops.sparse_attention import block_sparse_attention
     from deepspeed_tpu.ops.sparse_attention.blocksparse import (
         layout_additive_mask, planned_kernel)
     from deepspeed_tpu.ops.attention.flash import attention_reference
-    H, S = 4, 2048
-    cfg = BSLongformerSparsityConfig(num_heads=H, block=128,
-                                     num_sliding_window_blocks=3)
-    layout = cfg.make_layout(S)
-    assert planned_kernel(layout, 128) == "banded", \
-        "Longformer layout no longer dispatches to the banded fast path"
-    q, k, v = _qkv(1, H, S, 64, seed=3)
+    H = layout.shape[0]
+    S = layout.shape[1] * 128
+    if expect_kernel is not None:
+        got = planned_kernel(layout, 128)
+        assert got == expect_kernel, \
+            f"layout no longer dispatches to {expect_kernel} (got {got})"
+    q, k, v = _qkv(1, H, S, 64, seed=seed)
     am = jnp.asarray(layout_additive_mask(layout, 128))[None]
 
     def kern(q, k, v):
@@ -147,35 +149,38 @@ def _splash_banded():
         _close(a, b, msg=f"d{n}")
 
 
+@check("banded Longformer w=3 fwd+grad vs dense-masked oracle (S=2048)")
+def _splash_banded():
+    from deepspeed_tpu.ops.sparse_attention import (
+        BSLongformerSparsityConfig)
+    cfg = BSLongformerSparsityConfig(num_heads=4, block=128,
+                                     num_sliding_window_blocks=3)
+    _sparse_vs_oracle(cfg.make_layout(2048), seed=3,
+                      expect_kernel="banded")
+
+
+@check("hybrid BigBird fwd+grad vs dense-masked oracle (S=2048)")
+def _hybrid_bigbird():
+    from deepspeed_tpu.ops.sparse_attention import BigBirdSparsityConfig
+    cfg = BigBirdSparsityConfig(num_heads=4, block=128,
+                                num_random_blocks=1,
+                                num_sliding_window_blocks=3,
+                                num_global_blocks=1)
+    _sparse_vs_oracle(cfg.make_layout(2048), seed=7,
+                      expect_kernel="hybrid")
+
+
 @check("splash v2 (banded forced off) Longformer vs oracle (S=2048)")
 def _splash_v2():
-    import jax.numpy as jnp
     from deepspeed_tpu.ops.sparse_attention import (
-        BSLongformerSparsityConfig, block_sparse_attention)
+        BSLongformerSparsityConfig)
     from deepspeed_tpu.ops.sparse_attention import blocksparse as bs
-    from deepspeed_tpu.ops.sparse_attention.blocksparse import (
-        layout_additive_mask)
-    from deepspeed_tpu.ops.attention.flash import attention_reference
-    H, S = 4, 2048
-    cfg = BSLongformerSparsityConfig(num_heads=H, block=128,
+    cfg = BSLongformerSparsityConfig(num_heads=4, block=128,
                                      num_sliding_window_blocks=3)
-    layout = cfg.make_layout(S)
-    q, k, v = _qkv(1, H, S, 64, seed=3)
-    am = jnp.asarray(layout_additive_mask(layout, 128))[None]
-
     old = bs.USE_BANDED
     bs.USE_BANDED = False
     try:
-        def kern(q, k, v):
-            return block_sparse_attention(q, k, v, layout)
-
-        def orac(q, k, v):
-            return attention_reference(q, k, v, mask=am)
-
-        _close(kern(q, k, v), orac(q, k, v), msg="fwd")
-        ga, gb = _grad_pair(kern, orac, (q, k, v))
-        for a, b, n in zip(ga, gb, "qkv"):
-            _close(a, b, msg=f"d{n}")
+        _sparse_vs_oracle(cfg.make_layout(2048), seed=3)
     finally:
         bs.USE_BANDED = old
 

@@ -20,6 +20,15 @@ OUT=/tmp/tpu_round
 mkdir -p "$OUT"
 cd "$(dirname "$0")/.."
 
+# Single-core host: local CPU load inflates scan-amortized timings
+# (a stale watch loop once doubled measured times). The hardware
+# window outranks any local test run — clear it first. Anchored
+# patterns: a bare "pytest" would match any argv mentioning the word
+# (the watcher's own tail, an editor on a log).
+pkill -f "python[^ ]* -m pytest" 2>/dev/null || true
+pkill -f "hw_kernel_checks.py --allow-cpu" 2>/dev/null || true
+sleep 5   # let the killed processes actually release the core
+
 echo "== probe"
 if ! timeout 300 python -c "
 import jax, numpy as np, jax.numpy as jnp
