@@ -18,16 +18,88 @@ the *new* sharding. Elastic resharding across dp/mesh changes (the
 reference's merge-then-repartition, stage2.py:1713-1779) is therefore the
 default load path, at O(local shard) host memory. ``save_tree``/
 ``load_tree`` remain for small replicated host state and legacy files.
+
+Durability layer (fault model: preemption mid-save is *expected* on TPU
+pods): every file is written via temp + ``os.replace`` + fsync and retried
+through ``fault.retry_io``; a save is only visible once its directory
+carries a ``COMMITTED`` marker recording process_count and per-file
+sizes + CRC32 checksums, and the directory itself is renamed from
+``<tag>.tmp`` to ``<tag>`` only after the marker is durable. Loading
+verifies the marker (``verify_checkpoint_dir``) and the engine falls back
+to the newest committed tag when ``latest`` is torn or a shard is corrupt.
+Pre-durability checkpoints (no marker) remain loadable via a
+best-effort legacy check.
 """
 
+import io
 import json
 import os
-from typing import Any, Dict, Optional, Tuple
+import re
+import shutil
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 
+from deepspeed_tpu.runtime import fault
+
 LATEST = "latest"
+COMMIT_MARKER = "COMMITTED"
+TMP_SUFFIX = ".tmp"
+OLD_SUFFIX = ".old"
+CHECKPOINT_FORMAT_VERSION = 1
+
+# process-global retry policy for transient filesystem errors (GCS/NFS
+# flakes); the engine overrides it from the `checkpoint` config section
+_RETRY = {"retries": 3, "backoff": 0.05}
+
+
+def set_retry_policy(retries: Optional[int] = None,
+                     backoff: Optional[float] = None) -> None:
+    if retries is not None:
+        _RETRY["retries"] = int(retries)
+    if backoff is not None:
+        _RETRY["backoff"] = float(backoff)
+
+
+def _retry(fn):
+    return fault.retry_io(fn, retries=_RETRY["retries"],
+                          backoff=_RETRY["backoff"])
+
+
+def _fsync_dir(dirpath: str) -> None:
+    """Flush directory metadata (the rename itself) to stable storage."""
+    try:
+        fd = os.open(dirpath, os.O_RDONLY)
+    except OSError:
+        return  # some filesystems (or platforms) can't open dirs; best effort
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _atomic_write_bytes(path: str, data: bytes) -> None:
+    """Write-temp + fsync + ``os.replace``: readers never observe a torn
+    file at ``path``. Retried on transient ``OSError``."""
+    def _write():
+        fault.fire("io_write", path=path)
+        tmp = path + ".part"
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        _fsync_dir(os.path.dirname(path) or ".")
+    _retry(_write)
+
+
+def _npz_bytes(arrays: Dict[str, np.ndarray]) -> bytes:
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
 
 
 def _flatten_named(tree: Any) -> Dict[str, Any]:
@@ -64,14 +136,17 @@ def save_tree(path: str, tree: Any) -> None:
         if arr.dtype.kind == "V":
             arr = arr.astype(np.float32)
         arrays[k] = arr
-    np.savez(path, **arrays)
+    _atomic_write_bytes(path, _npz_bytes(arrays))
 
 
 def load_tree(path: str, template: Any, shardings: Optional[Any] = None) -> Any:
     """Load arrays and restore into the template's structure, placing each
     leaf with the template's (or given) sharding — this is the elastic
     repartition step."""
-    data = np.load(path)
+    # dict() forces the reads eagerly so the retry covers the actual I/O,
+    # not just the lazy zip-header open (legacy files are full arrays —
+    # everything gets read anyway)
+    data = _retry(lambda: dict(np.load(path)))
     leaves_paths, treedef = jax.tree_util.tree_flatten_with_path(template)
     shard_leaves = (jax.tree_util.tree_leaves(shardings)
                     if shardings is not None else [None] * len(leaves_paths))
@@ -164,14 +239,32 @@ def save_tree_sharded(ckpt_dir: str, name: str, tree: Any) -> None:
             entry["chunks"].append({"entry": ek, "start": starts,
                                     "stop": stops})
         manifest[key] = entry
-    np.savez(os.path.join(ckpt_dir, f"{name}.shard_{pidx}.npz"), **arrays)
-    with open(os.path.join(ckpt_dir, f"{name}.shard_{pidx}.json"),
-              "w") as f:
-        json.dump(manifest, f)
+    _atomic_write_bytes(os.path.join(ckpt_dir, f"{name}.shard_{pidx}.npz"),
+                        _npz_bytes(arrays))
+    _atomic_write_bytes(os.path.join(ckpt_dir, f"{name}.shard_{pidx}.json"),
+                        json.dumps(manifest).encode())
 
 
 def sharded_exists(ckpt_dir: str, name: str) -> bool:
-    return os.path.isfile(os.path.join(ckpt_dir, f"{name}.shard_0.json"))
+    """True when a complete sharded save of ``name`` is present.
+
+    A COMMITTED marker is authoritative: the files it lists for ``name``
+    must all exist. Pre-durability checkpoints (no marker) fall back to
+    all-fragments-present — every ``shard_*.json`` manifest must have its
+    paired ``.npz``, so a partial multi-process save no longer passes on
+    the strength of ``shard_0.json`` alone.
+    """
+    marker = read_commit_marker(ckpt_dir)
+    if marker is not None:
+        listed = [f for f in marker["files"]
+                  if f.startswith(f"{name}.shard_")]
+        return bool(listed) and all(
+            os.path.isfile(os.path.join(ckpt_dir, f)) for f in listed)
+    import glob
+    frags = glob.glob(os.path.join(ckpt_dir, f"{name}.shard_*.json"))
+    if not frags:
+        return False
+    return all(os.path.isfile(f[:-len(".json")] + ".npz") for f in frags)
 
 
 def _merged_manifest(ckpt_dir: str, name: str):
@@ -186,8 +279,10 @@ def _merged_manifest(ckpt_dir: str, name: str):
             f"no {name}.shard_*.json manifests in {ckpt_dir}")
     for fpath in frags:
         npz = fpath[:-len(".json")] + ".npz"
-        with open(fpath) as f:
-            frag = json.load(f)
+        def _read(p=fpath):
+            with open(p) as f:
+                return json.load(f)
+        frag = _retry(_read)
         for key, entry in frag.items():
             tgt = merged.setdefault(
                 key, (tuple(entry["global_shape"]), entry["dtype"], []))
@@ -210,9 +305,18 @@ def load_tree_sharded(ckpt_dir: str, name: str, template: Any,
     npz_cache: Dict[str, Any] = {}
 
     def chunk(npz_path, entry):
-        if npz_path not in npz_cache:
-            npz_cache[npz_path] = np.load(npz_path)
-        return npz_cache[npz_path][entry]
+        # lazy per-entry reads preserve O(local shard) host memory; the
+        # retry must wrap the read itself, and a failed read drops the
+        # cached NpzFile so the next attempt reopens a fresh handle
+        def _read():
+            if npz_path not in npz_cache:
+                npz_cache[npz_path] = np.load(npz_path)
+            try:
+                return npz_cache[npz_path][entry]
+            except OSError:
+                npz_cache.pop(npz_path, None)
+                raise
+        return _retry(_read)
 
     leaves_paths, treedef = jax.tree_util.tree_flatten_with_path(template)
     shard_leaves = (jax.tree_util.tree_leaves(shardings)
@@ -267,18 +371,34 @@ def load_tree_sharded(ckpt_dir: str, name: str, template: Any,
 
 
 def write_meta(ckpt_dir: str, meta: Dict) -> None:
-    with open(os.path.join(ckpt_dir, "meta.json"), "w") as f:
-        json.dump(meta, f, indent=2, default=str)
+    _atomic_write_bytes(
+        os.path.join(ckpt_dir, "meta.json"),
+        json.dumps(meta, indent=2, default=str).encode())
 
 
 def read_meta(ckpt_dir: str) -> Dict:
-    with open(os.path.join(ckpt_dir, "meta.json")) as f:
-        return json.load(f)
+    def _read():
+        with open(os.path.join(ckpt_dir, "meta.json")) as f:
+            return json.load(f)
+    return _retry(_read)
 
 
 def write_latest(save_dir: str, tag: str) -> None:
-    with open(os.path.join(save_dir, LATEST), "w") as f:
-        f.write(tag)
+    """Atomically repoint ``latest``: write-temp + fsync + ``os.replace``
+    so a crash mid-update can never leave a torn pointer."""
+    path = os.path.join(save_dir, LATEST)
+
+    def _write():
+        fault.fire("io_write", path=path)
+        tmp = path + TMP_SUFFIX
+        with open(tmp, "w") as f:
+            f.write(tag)
+            f.flush()
+            os.fsync(f.fileno())
+        fault.fire("ckpt.latest_tmp_written", path=path, tag=tag)
+        os.replace(tmp, path)
+        _fsync_dir(save_dir)
+    _retry(_write)
 
 
 def read_latest(save_dir: str) -> Optional[str]:
@@ -286,4 +406,169 @@ def read_latest(save_dir: str) -> Optional[str]:
     if not os.path.isfile(p):
         return None
     with open(p) as f:
-        return f.read().strip()
+        tag = f.read().strip()
+    # an empty/whitespace pointer (torn write from a pre-durability run)
+    # must not join into a nonsense path
+    return tag or None
+
+
+# --------------------------------------------------------------------- #
+# commit protocol: COMMITTED marker, verification, tag scan, retention
+# --------------------------------------------------------------------- #
+
+def write_commit_marker(ckpt_dir: str, process_count: int = 1) -> Dict:
+    """Seal a checkpoint directory: record process_count and every file's
+    size + CRC32 in the ``COMMITTED`` marker (written atomically, last).
+
+    Reading each file back for its checksum doubles as write-read
+    verification before the checkpoint becomes visible.
+    """
+    files: Dict[str, Dict[str, int]] = {}
+    for fn in sorted(os.listdir(ckpt_dir)):
+        p = os.path.join(ckpt_dir, fn)
+        if fn == COMMIT_MARKER or fn.endswith(".part") or not os.path.isfile(p):
+            continue
+        files[fn] = {"size": os.path.getsize(p),
+                     "crc32": _retry(lambda p=p: fault.crc32_file(p))}
+    marker = {"format_version": CHECKPOINT_FORMAT_VERSION,
+              "process_count": int(process_count), "files": files}
+    _atomic_write_bytes(os.path.join(ckpt_dir, COMMIT_MARKER),
+                        json.dumps(marker, indent=2).encode())
+    return marker
+
+
+def read_commit_marker(ckpt_dir: str) -> Optional[Dict]:
+    p = os.path.join(ckpt_dir, COMMIT_MARKER)
+    if not os.path.isfile(p):
+        return None
+    try:
+        with open(p) as f:
+            marker = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None  # unreadable marker == uncommitted
+    if not isinstance(marker.get("files"), dict):
+        return None
+    return marker
+
+
+def is_committed(ckpt_dir: str) -> bool:
+    return read_commit_marker(ckpt_dir) is not None
+
+
+def verify_checkpoint_dir(ckpt_dir: str,
+                          check_crc: bool = True) -> Tuple[bool, List[str]]:
+    """Integrity-check one checkpoint directory.
+
+    Committed dirs: every file the marker lists must exist with the
+    recorded size (and CRC32 unless ``check_crc=False``). Legacy dirs
+    (no marker): best-effort — ``meta.json`` plus either a single-file
+    ``model_states.npz`` or a complete set of paired shard fragments.
+    Returns ``(ok, problems)``.
+    """
+    problems: List[str] = []
+    if not os.path.isdir(ckpt_dir):
+        return False, [f"{ckpt_dir}: not a directory"]
+    marker = read_commit_marker(ckpt_dir)
+    if marker is None:
+        if not os.path.isfile(os.path.join(ckpt_dir, "meta.json")):
+            problems.append("no COMMITTED marker and no meta.json "
+                            "(incomplete or torn save)")
+        if not (os.path.isfile(os.path.join(ckpt_dir, "model_states.npz"))
+                or sharded_exists(ckpt_dir, "model_states")):
+            problems.append("no complete model_states (single-file or "
+                            "all shard fragments)")
+        return not problems, problems
+    for fn, info in marker["files"].items():
+        p = os.path.join(ckpt_dir, fn)
+        if not os.path.isfile(p):
+            problems.append(f"{fn}: listed in COMMITTED but missing")
+            continue
+        size = os.path.getsize(p)
+        if size != info.get("size"):
+            problems.append(f"{fn}: size {size} != recorded {info.get('size')}")
+            continue
+        if check_crc and fault.crc32_file(p) != info.get("crc32"):
+            problems.append(f"{fn}: CRC32 mismatch (corrupt bytes)")
+    return not problems, problems
+
+
+_STEP_RE = re.compile(r"(\d+)$")
+
+
+def _tag_rank(fn: str) -> Tuple[int, int]:
+    """(step, freshness) sort key: a ``<tag>.old`` rename-aside leftover
+    ranks by its base tag's step but *below* the live copy of that tag."""
+    base = fn[:-len(OLD_SUFFIX)] if fn.endswith(OLD_SUFFIX) else fn
+    m = _STEP_RE.search(base)
+    step = int(m.group(1)) if m else -1
+    return step, (0 if fn.endswith(OLD_SUFFIX) else 1)
+
+
+def tag_step(fn: str) -> int:
+    return _tag_rank(fn)[0]
+
+
+def list_tags(save_dir: str) -> List[str]:
+    """Checkpoint tags newest-first (step number when the tag ends in
+    digits — ``.old`` leftovers count as their base step — else mtime).
+    ``.tmp`` staging dirs are never tags."""
+    if not os.path.isdir(save_dir):
+        return []
+    ranked = []
+    for fn in os.listdir(save_dir):
+        p = os.path.join(save_dir, fn)
+        if not os.path.isdir(p) or fn.endswith(TMP_SUFFIX):
+            continue
+        if not (os.path.isfile(os.path.join(p, COMMIT_MARKER))
+                or os.path.isfile(os.path.join(p, "meta.json"))):
+            continue
+        step, fresh = _tag_rank(fn)
+        ranked.append((step, fresh, os.path.getmtime(p), fn))
+    ranked.sort(reverse=True)
+    return [fn for _, _, _, fn in ranked]
+
+
+def candidate_tags(save_dir: str) -> List[str]:
+    """Resume candidates, best-first.
+
+    A healthy ``latest`` pointer leads — it is the last *completed* save
+    and may deliberately name a non-step tag (``best``). The one case
+    where it is demoted: both ``latest`` and some other tag parse as step
+    numbers and the other tag is numerically newer — that only happens
+    when a save committed but crashed before the pointer update, so the
+    newest committed step should win (the save "finished").
+    """
+    tags = list_tags(save_dir)
+    latest = read_latest(save_dir)
+    if not latest:
+        return tags
+    if latest not in tags:
+        if os.path.isdir(os.path.join(save_dir, latest)):
+            return [latest] + tags
+        return tags
+    lstep = tag_step(latest)
+    if lstep >= 0 and any(tag_step(t) > lstep for t in tags):
+        return tags  # stale pointer: newest-first scan
+    return [latest] + [t for t in tags if t != latest]
+
+
+def gc_old_tags(save_dir: str, keep_n: int) -> List[str]:
+    """Retention: delete committed *step-suffixed* tags beyond the newest
+    ``keep_n``.
+
+    Only automatic ``...<step>`` tags (and their ``.old`` leftovers) are
+    managed; custom-named tags (``best``) are user-owned and never GC'd,
+    nor is whatever tag ``latest`` currently points to. Uncommitted or
+    legacy dirs are never touched (they may be someone's in-flight save
+    or the only pre-durability copy); ``keep_n <= 0`` keeps everything.
+    """
+    if keep_n <= 0:
+        return []
+    latest = read_latest(save_dir)
+    managed = [t for t in list_tags(save_dir)
+               if tag_step(t) >= 0
+               and is_committed(os.path.join(save_dir, t))]
+    doomed = [t for t in managed[keep_n:] if t != latest]
+    for t in doomed:
+        shutil.rmtree(os.path.join(save_dir, t), ignore_errors=True)
+    return doomed

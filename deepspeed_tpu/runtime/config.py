@@ -346,6 +346,21 @@ def get_compile_cache_config(param_dict):
     }
 
 
+def get_checkpoint_config(param_dict):
+    """Fault-tolerant checkpointing knobs (atomic commit + verification +
+    retention; see runtime/checkpoint.py)."""
+    sub = param_dict.get(C.CHECKPOINT, {})
+    return {
+        "verify_checksums": sub.get(C.CHECKPOINT_VERIFY_CHECKSUMS,
+                                    C.CHECKPOINT_VERIFY_CHECKSUMS_DEFAULT),
+        "keep_n": sub.get(C.CHECKPOINT_KEEP_N, C.CHECKPOINT_KEEP_N_DEFAULT),
+        "io_retries": sub.get(C.CHECKPOINT_IO_RETRIES,
+                              C.CHECKPOINT_IO_RETRIES_DEFAULT),
+        "io_retry_backoff": sub.get(C.CHECKPOINT_IO_RETRY_BACKOFF,
+                                    C.CHECKPOINT_IO_RETRY_BACKOFF_DEFAULT),
+    }
+
+
 def get_tensorboard_enabled(param_dict):
     if C.TENSORBOARD in param_dict:
         return get_scalar_param(param_dict[C.TENSORBOARD], C.TENSORBOARD_ENABLED,
@@ -444,6 +459,7 @@ class DeepSpeedConfig:
         self.compressed_allreduce_config = \
             get_compressed_allreduce_config(param_dict)
         self.memory_breakdown = get_memory_breakdown(param_dict)
+        self.checkpoint_config = get_checkpoint_config(param_dict)
         self.tensorboard_enabled = get_tensorboard_enabled(param_dict)
         self.tensorboard_output_path = get_tensorboard_output_path(param_dict)
         self.tensorboard_job_name = get_tensorboard_job_name(param_dict)

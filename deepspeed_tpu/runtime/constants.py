@@ -206,6 +206,29 @@ COMPILE_CACHE_DIR_DEFAULT = os.path.join(
 COMPILE_CACHE_MIN_COMPILE_SECS = "min_compile_secs"
 COMPILE_CACHE_MIN_COMPILE_SECS_DEFAULT = 1.0
 
+#############################################
+# Fault-tolerant checkpointing (TPU-native: preemption mid-save is the
+# expected failure mode on TPU pods — every save is atomically
+# committed, every load verified, recovery automatic; see
+# runtime/checkpoint.py and docs/checkpointing.md)
+#
+# "checkpoint": {
+#   "verify_checksums": true,   # CRC32-verify files against COMMITTED
+#   "keep_n": 0,                # retention: 0 keeps all committed tags
+#   "io_retries": 3,            # transient-OSError retries per file op
+#   "io_retry_backoff": 0.05    # base seconds, doubles per attempt
+# }
+#############################################
+CHECKPOINT = "checkpoint"
+CHECKPOINT_VERIFY_CHECKSUMS = "verify_checksums"
+CHECKPOINT_VERIFY_CHECKSUMS_DEFAULT = True
+CHECKPOINT_KEEP_N = "keep_n"
+CHECKPOINT_KEEP_N_DEFAULT = 0
+CHECKPOINT_IO_RETRIES = "io_retries"
+CHECKPOINT_IO_RETRIES_DEFAULT = 3
+CHECKPOINT_IO_RETRY_BACKOFF = "io_retry_backoff"
+CHECKPOINT_IO_RETRY_BACKOFF_DEFAULT = 0.05
+
 TENSORBOARD = "tensorboard"
 TENSORBOARD_ENABLED = "enabled"
 TENSORBOARD_ENABLED_DEFAULT = False

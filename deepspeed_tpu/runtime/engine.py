@@ -39,6 +39,7 @@ from deepspeed_tpu.ops.optimizers import Optimizer, build_optimizer
 from deepspeed_tpu.parallel.mesh import axis_size, build_mesh
 from deepspeed_tpu.parallel.topology import ParallelGrid
 from deepspeed_tpu.runtime import checkpoint as ckpt
+from deepspeed_tpu.runtime import fault
 from deepspeed_tpu.runtime.config import DeepSpeedConfig
 from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader, RepeatingLoader
 from deepspeed_tpu.runtime.fp16.loss_scaler import (
@@ -437,6 +438,11 @@ class DeepSpeedEngine:
         # on TPU the XLA trace is the actionable artifact, SURVEY.md §5)
         self._profiler_cfg = self._config.profiler_config
         self._profiler_active = False
+        # fault-tolerant checkpointing knobs ('checkpoint' config section):
+        # CRC verification on load, retention, transient-I/O retry policy
+        self._ckpt_cfg = self._config.checkpoint_config
+        ckpt.set_retry_policy(self._ckpt_cfg["io_retries"],
+                              self._ckpt_cfg["io_retry_backoff"])
         cc = self._config.compile_cache_config
         if cc["enabled"]:
             from ..utils.platform import enable_compile_cache
@@ -1561,21 +1567,44 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------ #
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
                         client_state: Optional[Dict] = None):
+        """Atomic-commit save: shards land in ``<tag>.tmp/``, process 0
+        seals a ``COMMITTED`` marker (process_count + per-file sizes and
+        CRC32s) after a multihost barrier, renames the directory to its
+        final tag, then repoints ``latest`` atomically. A crash at any
+        point leaves either the previous checkpoint fully intact or the
+        new one fully committed — never a half-save that resume trusts."""
+        import shutil
         self._offload_drain()
+        # the retry policy is process-global; re-assert this engine's so
+        # its own saves run under its own config even with several
+        # engines alive in one process
+        ckpt.set_retry_policy(self._ckpt_cfg["io_retries"],
+                              self._ckpt_cfg["io_retry_backoff"])
+        t0 = time.time()
         if tag is None:
             tag = f"global_step{int(self.state.global_step)}"
-        ckpt_dir = os.path.join(save_dir, tag)
-        os.makedirs(ckpt_dir, exist_ok=True)
+        final_dir = os.path.join(save_dir, tag)
+        tmp_dir = final_dir + ckpt.TMP_SUFFIX
+        if jax.process_index() == 0:
+            if os.path.isdir(tmp_dir):  # stale staging from a crashed save
+                shutil.rmtree(tmp_dir)
+            os.makedirs(tmp_dir, exist_ok=True)
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("ckpt_tmp_ready")
         # sharded format: every process writes only its local device shards
         # (reference per-dp-rank zero_pp_rank_* files, engine.py:1153-1164)
         # — no host-0 gather, flat host RAM regardless of model size
-        ckpt.save_tree_sharded(ckpt_dir, "model_states", self.state.params)
+        ckpt.save_tree_sharded(tmp_dir, "model_states", self.state.params)
+        fault.fire("ckpt.after_shard", name="model_states", dir=tmp_dir)
         ckpt.save_tree_sharded(
-            ckpt_dir, "optim_states",
+            tmp_dir, "optim_states",
             {"opt_state": self.state.opt_state,
              "loss_scale": self.state.loss_scale})
+        fault.fire("ckpt.after_shard", name="optim_states", dir=tmp_dir)
         if jax.process_count() > 1:
-            # all shard files must exist before the 'latest' pointer flips
+            # every process's shard files must be durable before process 0
+            # seals the marker — the marker asserts completeness
             from jax.experimental import multihost_utils
             multihost_utils.sync_global_devices("ckpt_shards_written")
         if jax.process_index() == 0:
@@ -1583,14 +1612,16 @@ class DeepSpeedEngine:
                 # host-resident fp32 master + moments (reference saves the
                 # fp32 partitions in zero_pp_rank files, engine.py:1409)
                 sd = self.optimizer.state_dict()
-                np.savez(os.path.join(ckpt_dir, "cpu_optim_states.npz"),
-                         step=sd["step"],
-                         **{f"mp_{i}": a for i, a in
-                            enumerate(sd["master_params"])},
-                         **{f"m_{i}": a for i, a in
-                            enumerate(sd["exp_avg"])},
-                         **{f"v_{i}": a for i, a in
-                            enumerate(sd["exp_avg_sq"])})
+                arrays = {"step": sd["step"]}
+                arrays.update({f"mp_{i}": a for i, a in
+                               enumerate(sd["master_params"])})
+                arrays.update({f"m_{i}": a for i, a in
+                               enumerate(sd["exp_avg"])})
+                arrays.update({f"v_{i}": a for i, a in
+                               enumerate(sd["exp_avg_sq"])})
+                ckpt._atomic_write_bytes(
+                    os.path.join(tmp_dir, "cpu_optim_states.npz"),
+                    ckpt._npz_bytes(arrays))
             meta = {
                 "global_step": int(self.state.global_step),
                 "micro_step": int(self.state.micro_step),
@@ -1604,21 +1635,141 @@ class DeepSpeedEngine:
                 "zero_stage": self.zero_stage,
                 "client_state": client_state or {},
             }
-            ckpt.write_meta(ckpt_dir, meta)
+            self._save_checkpoint_extras(tmp_dir)
+            ckpt.write_meta(tmp_dir, meta)
+            fault.fire("ckpt.before_marker", dir=tmp_dir)
+            ckpt.write_commit_marker(tmp_dir,
+                                     process_count=jax.process_count())
+            fault.fire("ckpt.before_rename", dir=tmp_dir)
+            # re-saving an existing tag: rename the old committed copy
+            # aside instead of deleting it — a crash between the two
+            # renames leaves '<tag>.old', which list_tags still offers as
+            # a fallback candidate, so no window ever has zero copies
+            old_dir = final_dir + ckpt.OLD_SUFFIX
+            if os.path.isdir(final_dir):
+                if os.path.isdir(old_dir):
+                    shutil.rmtree(old_dir)
+                os.rename(final_dir, old_dir)
+            os.replace(tmp_dir, final_dir)
+            ckpt._fsync_dir(save_dir)
+            if os.path.isdir(old_dir):
+                shutil.rmtree(old_dir)
             ckpt.write_latest(save_dir, tag)
-        log_dist(f"saved checkpoint {ckpt_dir}", ranks=[0])
-        return ckpt_dir
+            keep_n = int(self._ckpt_cfg["keep_n"] or 0)
+            if keep_n > 0:
+                dropped = ckpt.gc_old_tags(save_dir, keep_n)
+                if dropped:
+                    log_dist(f"checkpoint retention (keep_n={keep_n}): "
+                             f"removed {dropped}", ranks=[0])
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("ckpt_committed")
+        dur_ms = (time.time() - t0) * 1000.0
+        self.monitor.write_checkpoint_event(
+            action="save", ok=True, duration_ms=dur_ms,
+            samples=self._host_global_step * self.train_batch_size())
+        log_dist(f"saved checkpoint {final_dir} "
+                 f"(committed in {dur_ms:.0f}ms)", ranks=[0])
+        return final_dir
+
+    def _save_checkpoint_extras(self, ckpt_dir: str) -> None:
+        """Subclass hook: extra files written here (process 0, staging
+        dir) are sealed by the COMMITTED marker with the shards — they
+        can never be missing from a visible checkpoint."""
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
                         load_optimizer_states: bool = True,
-                        load_lr_scheduler_states: bool = True):
+                        load_lr_scheduler_states: bool = True,
+                        verify_integrity: Optional[bool] = None):
+        """Verified load with automatic fallback.
+
+        With an explicit ``tag`` the checkpoint must verify (marker +
+        sizes + CRC32 unless ``verify_integrity=False``) or this raises.
+        With ``tag=None`` the directory is scanned newest-first and the
+        newest *committed and verified* checkpoint is restored — a torn
+        ``latest`` pointer or a corrupt newest tag costs at most one
+        checkpoint of progress, never the run.
+        """
         self._offload_drain()
-        if tag is None:
-            tag = ckpt.read_latest(load_dir)
-            if tag is None:
-                logger.warning(f"no 'latest' file in {load_dir}; nothing loaded")
-                return None, {}
-        ckpt_dir = os.path.join(load_dir, tag)
+        ckpt.set_retry_policy(self._ckpt_cfg["io_retries"],
+                              self._ckpt_cfg["io_retry_backoff"])
+        t0 = time.time()
+        if verify_integrity is None:
+            verify_integrity = bool(self._ckpt_cfg["verify_checksums"])
+        samples = self._host_global_step * self.train_batch_size()
+
+        if tag is not None:
+            ckpt_dir = os.path.join(load_dir, tag)
+            ok, problems = ckpt.verify_checkpoint_dir(
+                ckpt_dir, check_crc=verify_integrity)
+            if not ok:
+                raise RuntimeError(
+                    f"checkpoint {ckpt_dir} failed integrity verification: "
+                    f"{'; '.join(problems)}")
+            result = self._load_checkpoint_dir(
+                ckpt_dir, load_optimizer_states, load_lr_scheduler_states)
+            self.monitor.write_checkpoint_event(
+                action="load", ok=True,
+                duration_ms=(time.time() - t0) * 1000.0, samples=samples)
+            return result
+
+        latest = ckpt.read_latest(load_dir)
+        candidates = ckpt.candidate_tags(load_dir)
+        if not candidates:
+            logger.warning(f"no loadable checkpoint tags in {load_dir}; "
+                           "nothing loaded")
+            return None, {}
+        for cand in candidates:
+            cand_dir = os.path.join(load_dir, cand)
+            ok, problems = ckpt.verify_checkpoint_dir(
+                cand_dir, check_crc=verify_integrity)
+            if not ok:
+                logger.warning(
+                    f"skipping checkpoint {cand_dir}: "
+                    f"{'; '.join(problems)} — falling back to an older tag")
+                self.monitor.write_checkpoint_event(
+                    action="fallback", ok=False, samples=samples)
+                continue
+            try:
+                result = self._load_checkpoint_dir(
+                    cand_dir, load_optimizer_states,
+                    load_lr_scheduler_states)
+            except fault.InjectedCrash:
+                raise
+            except Exception as e:
+                logger.warning(
+                    f"failed to load checkpoint {cand_dir} ({e!r}); "
+                    "falling back to an older tag")
+                self.monitor.write_checkpoint_event(
+                    action="fallback", ok=False, samples=samples)
+                continue
+            if latest is not None and cand != latest:
+                logger.warning(
+                    f"'latest' pointer named {latest!r} but the newest "
+                    f"committed+verified checkpoint is {cand!r}; resumed "
+                    "from it (torn pointer or interrupted save)")
+            self.monitor.write_checkpoint_event(
+                action="load", ok=True,
+                duration_ms=(time.time() - t0) * 1000.0, samples=samples)
+            return result
+        logger.warning(f"no committed+verified checkpoint in {load_dir}; "
+                       "nothing loaded")
+        return None, {}
+
+    def _load_checkpoint_dir(self, ckpt_dir: str,
+                             load_optimizer_states: bool = True,
+                             load_lr_scheduler_states: bool = True):
+        """Restore engine state from one verified checkpoint directory."""
+        # read + validate meta BEFORE any engine mutation: if it is
+        # semantically incomplete, this raises while the engine is still
+        # pristine and the fallback loop can cleanly try an older tag
+        # (no half-loaded optimizer/lr state left behind)
+        meta = ckpt.read_meta(ckpt_dir)
+        missing = [k for k in ("global_step", "micro_step",
+                               "skipped_steps", "rng") if k not in meta]
+        if missing:
+            raise KeyError(f"meta.json in {ckpt_dir} missing {missing}")
+        meta_rng = np.asarray(meta["rng"], dtype=np.uint32)
         sharded = ckpt.sharded_exists(ckpt_dir, "model_states")
         if sharded:
             params = ckpt.load_tree_sharded(
@@ -1671,7 +1822,20 @@ class DeepSpeedEngine:
                                 jax.tree_util.tree_leaves(params)):
                 np.copyto(dst, np.asarray(_to_host_global(src),
                                           np.float32).ravel())
-        meta = ckpt.read_meta(ckpt_dir)
+        # topology sanity (warn, don't crash: elastic resume across dp
+        # worlds / ZeRO stages is the supported path — but the operator
+        # should know it happened)
+        saved_dp = meta.get("dp_world_size")
+        if saved_dp is not None and saved_dp != self.dp_world_size:
+            logger.warning(
+                f"checkpoint {ckpt_dir} was saved at dp_world_size="
+                f"{saved_dp}, resuming at {self.dp_world_size} "
+                "(elastic repartition)")
+        saved_stage = meta.get("zero_stage")
+        if saved_stage is not None and saved_stage != self.zero_stage:
+            logger.warning(
+                f"checkpoint {ckpt_dir} was saved at zero_stage="
+                f"{saved_stage}, resuming at {self.zero_stage}")
         repl = self._state_shardings.global_step
         new_state = new_state._replace(
             global_step=jax.device_put(
@@ -1681,7 +1845,7 @@ class DeepSpeedEngine:
             skipped_steps=jax.device_put(
                 jnp.asarray(meta["skipped_steps"], jnp.int32), repl),
             rng=jax.device_put(
-                jnp.asarray(np.asarray(meta["rng"], dtype=np.uint32)), repl),
+                jnp.asarray(meta_rng), repl),
         )
         if load_lr_scheduler_states and self.lr_scheduler is not None and \
                 meta.get("lr_scheduler") is not None:
@@ -1693,6 +1857,9 @@ class DeepSpeedEngine:
                                  self.gradient_accumulation_steps +
                                  int(meta["micro_step"]))
         log_dist(f"loaded checkpoint {ckpt_dir} "
-                 f"(saved at dp={meta.get('dp_world_size')}, now "
+                 f"(step={int(meta['global_step'])} "
+                 f"skipped_steps={int(meta['skipped_steps'])} "
+                 f"loss_scale={self.loss_scale():.0f} "
+                 f"saved at dp={meta.get('dp_world_size')}, now "
                  f"dp={self.dp_world_size})", ranks=[0])
         return ckpt_dir, meta.get("client_state", {})

@@ -224,20 +224,18 @@ class PipelineEngine(DeepSpeedEngine):
         S = axis_size(self.mesh, "pipe")
         return interleave_stage_order(S, self.num_virtual)
 
-    def save_checkpoint(self, save_dir: str, tag=None, client_state=None):
-        if tag is None:
-            tag = f"global_step{int(self.state.global_step)}"
-        # the layout file must exist BEFORE super() flips the 'latest'
-        # pointer: a crash in between must never leave a loadable V>1
-        # checkpoint that load_checkpoint misreads as V=1 and mis-permutes
-        if jax.process_index() == 0:
-            import json as _json
-            ckpt_dir = os.path.join(save_dir, tag)
-            os.makedirs(ckpt_dir, exist_ok=True)
-            with open(os.path.join(ckpt_dir, "pipe_layout.json"), "w") as f:
-                _json.dump({"pipe_axis": axis_size(self.mesh, "pipe"),
-                            "virtual_stages": self.num_virtual}, f)
-        return super().save_checkpoint(save_dir, tag, client_state)
+    def _save_checkpoint_extras(self, ckpt_dir: str) -> None:
+        # written into the staging dir and sealed by the COMMITTED marker
+        # alongside the shards: a V>1 checkpoint can never become visible
+        # without its layout file and be misread as V=1 (mis-permuted);
+        # atomic+fsync'd like every other committed file so the marker's
+        # recorded size/CRC can't outlive the bytes on power loss
+        import json as _json
+        from deepspeed_tpu.runtime import checkpoint as _ckpt
+        _ckpt._atomic_write_bytes(
+            os.path.join(ckpt_dir, "pipe_layout.json"),
+            _json.dumps({"pipe_axis": axis_size(self.mesh, "pipe"),
+                         "virtual_stages": self.num_virtual}).encode())
 
     def load_checkpoint(self, load_dir: str, tag=None, **kw):
         ret = super().load_checkpoint(load_dir, tag, **kw)

@@ -103,6 +103,20 @@ class TensorBoardMonitor:
                               samples)
         self.flush()
 
+    def write_checkpoint_event(self, *, action: str, ok: bool = True,
+                               duration_ms=None, samples: int = 0):
+        """Checkpoint durability telemetry: ``save``/``load`` durations and
+        ``fallback`` events (a tag skipped as uncommitted or corrupt), so
+        preemption recovery is visible on the same samples x-axis as loss."""
+        if self.writer is None:
+            return
+        if duration_ms is not None:
+            self.write_scalar(f"Train/Samples/checkpoint_{action}_ms",
+                              duration_ms, samples)
+        self.write_scalar(f"Train/Samples/checkpoint_{action}_ok",
+                          1.0 if ok else 0.0, samples)
+        self.flush()
+
     def write_timer_values(self, timer_values: dict, samples: int = 0):
         """Per-timer milliseconds (engine.py:950-974 pattern)."""
         for name, ms in timer_values.items():

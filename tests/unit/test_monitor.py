@@ -35,6 +35,26 @@ def test_monitor_nonzero_rank_noops(tmp_path):
     assert m.writer is None
 
 
+def test_monitor_checkpoint_events(tmp_path, monkeypatch):
+    """Checkpoint durability telemetry: save/load durations and fallback
+    events land as scalars (JSONL fallback path for determinism)."""
+    import deepspeed_tpu.utils.monitor as mon
+    monkeypatch.setattr(mon, "_make_writer",
+                        lambda log_dir: _JsonlWriter(log_dir))
+    m = TensorBoardMonitor(enabled=True, output_path=str(tmp_path),
+                           job_name="job")
+    m.write_checkpoint_event(action="save", ok=True, duration_ms=12.5,
+                             samples=64)
+    m.write_checkpoint_event(action="fallback", ok=False, samples=64)
+    m.close()
+    lines = [json.loads(l) for l in
+             open(os.path.join(tmp_path, "job", "events.jsonl"))]
+    tags = {l["tag"]: l["value"] for l in lines}
+    assert tags["Train/Samples/checkpoint_save_ms"] == 12.5
+    assert tags["Train/Samples/checkpoint_save_ok"] == 1.0
+    assert tags["Train/Samples/checkpoint_fallback_ok"] == 0.0
+
+
 @pytest.mark.slow
 def test_monitor_writes_scalars(tmp_path):
     m = TensorBoardMonitor(enabled=True, output_path=str(tmp_path),
