@@ -8,6 +8,12 @@ Canonical axis names (any subset may be present, size-1 axes are legal):
 
 - ``pipe``   : pipeline stages
 - ``data``   : data parallel (ZeRO shards along this axis too)
+- ``data_inter`` / ``data_intra`` : hierarchical split of the data axis
+               (ZeRO++-style 2D collectives, runtime/quantized_collectives):
+               ``data_intra`` is the minor of the two so intra-slice peers
+               sit on ICI nearest neighbors while ``data_inter`` spans the
+               slow (DCN / inter-slice) dimension. Mutually exclusive with
+               a plain ``data`` axis.
 - ``expert`` : expert parallel (MoE expert banks, ops/moe.py) — TPU-native
                extension; absent from the reference snapshot
 - ``seq``    : sequence/context parallel (ring attention) — TPU-native
@@ -25,7 +31,66 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from deepspeed_tpu.parallel.topology import ProcessTopology
 
-CANONICAL_AXIS_ORDER = ("pipe", "data", "expert", "seq", "model")
+CANONICAL_AXIS_ORDER = ("pipe", "data", "data_inter", "data_intra",
+                        "expert", "seq", "model")
+
+# the hierarchical split of the data axis, major (slow wire) first
+DATA_SUB_AXES = ("data_inter", "data_intra")
+
+
+def data_axis_names(mesh: Mesh):
+    """The mesh's data-parallel axis names, major->minor: ``("data",)``,
+    ``("data_inter", "data_intra")`` for a hierarchical mesh, or ``()``
+    when no data axis exists."""
+    if "data" in mesh.axis_names:
+        return ("data",)
+    present = tuple(a for a in DATA_SUB_AXES if a in mesh.axis_names)
+    if present and len(present) != 2:
+        raise ValueError(
+            f"hierarchical data mesh needs both of {DATA_SUB_AXES}, "
+            f"got axes {mesh.axis_names}")
+    return present
+
+
+def data_axis_size(mesh: Mesh) -> int:
+    """Total data-parallel degree (product over the data axes), 1 if none."""
+    size = 1
+    for a in data_axis_names(mesh):
+        size *= mesh.shape[a]
+    return size
+
+
+def split_data_axis(axes: Dict[str, int], intra: int) -> Dict[str, int]:
+    """Rewrite a ``{'data': W, ...}`` axes dict into the hierarchical form
+    ``{'data_inter': W // intra, 'data_intra': intra, ...}``.
+
+    ``data_intra`` is placed minor so the intra-slice peers are ICI
+    nearest neighbors — the whole point of the 2D collectives.
+    """
+    axes = dict(axes)
+    if intra < 2:
+        raise ValueError(f"hierarchical intra size must be >= 2, got {intra}")
+    if "data" not in axes:
+        if all(a in axes for a in DATA_SUB_AXES):
+            # already split explicitly in mesh.axes — but it must AGREE
+            # with the requested intra size, or the bandwidth-heavy hop
+            # would silently land on a different-width axis
+            if axes["data_intra"] != intra:
+                raise ValueError(
+                    f"mesh.axes gives data_intra={axes['data_intra']} but "
+                    f"quantized_comm.hierarchical={intra}; make them "
+                    "match (or drop one)")
+            return axes
+        raise ValueError(
+            f"cannot split: no 'data' axis in {axes}")
+    W = axes.pop("data")
+    if W == -1 or W % intra != 0:
+        raise ValueError(
+            f"data axis size {W} is not divisible by hierarchical intra "
+            f"size {intra} (set mesh.axes.data explicitly)")
+    axes["data_inter"] = W // intra
+    axes["data_intra"] = intra
+    return axes
 
 
 def _order_axes(axes: Dict[str, int]) -> Dict[str, int]:
@@ -97,7 +162,12 @@ def mesh_from_topology(topo: ProcessTopology,
 
 def data_sharding(mesh: Mesh, batch_axis: str = "data") -> NamedSharding:
     """Sharding for a batch: leading dim split over the data axis (and seq
-    axis for the sequence dim if present is handled by callers)."""
+    axis for the sequence dim if present is handled by callers). On a
+    hierarchical mesh the leading dim splits over BOTH data sub-axes."""
+    if batch_axis == "data" and batch_axis not in mesh.axis_names:
+        sub = data_axis_names(mesh)
+        if sub:
+            return NamedSharding(mesh, PartitionSpec(sub))
     if batch_axis not in mesh.axis_names:
         return NamedSharding(mesh, PartitionSpec())
     return NamedSharding(mesh, PartitionSpec(batch_axis))

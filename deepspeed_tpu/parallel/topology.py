@@ -123,6 +123,40 @@ class ProcessTopology:
             return all(getattr(coord, ax) == v for ax, v in filter_kwargs.items())
         return [r for r in range(self._world_size) if matches(r)]
 
+    def split_axis(self, axis: str, outer_name: str, inner_name: str,
+                   inner_size: int) -> "ProcessTopology":
+        """New topology with ``axis`` (size W) split into
+        ``outer_name`` (W // inner_size, major) x ``inner_name``
+        (inner_size, minor), preserving every rank's position.
+
+        Because the layout is row-major, splitting an axis in place keeps
+        rank <-> coordinate assignments consistent: a rank's old ``axis``
+        coordinate c becomes (outer=c // inner_size, inner=c %
+        inner_size). This is the host-side mirror of
+        ``parallel.mesh.split_data_axis`` (hierarchical ZeRO++-style
+        collectives put the bandwidth-heavy hop on the minor/inner axis,
+        whose peers are rank-adjacent and therefore ICI neighbors).
+        """
+        if axis not in self.axes:
+            raise ValueError(f"no axis {axis!r} in {self.axes}")
+        W = self.dims[self.axes.index(axis)]
+        if inner_size < 1 or W % inner_size != 0:
+            raise ValueError(
+                f"axis {axis!r} size {W} not divisible by {inner_size}")
+        if outer_name in self.axes or inner_name in self.axes:
+            raise ValueError(
+                f"split names {outer_name!r}/{inner_name!r} collide with "
+                f"existing axes {self.axes}")
+        axes, dims = [], []
+        for a, d in zip(self.axes, self.dims):
+            if a == axis:
+                axes += [outer_name, inner_name]
+                dims += [W // inner_size, inner_size]
+            else:
+                axes.append(a)
+                dims.append(d)
+        return ProcessTopology(axes, dims)
+
     def __str__(self):
         return f"ProcessTopology(axes={self.axes}, dims={self.dims})"
 

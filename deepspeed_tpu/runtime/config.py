@@ -308,14 +308,43 @@ def get_memory_breakdown(param_dict):
                             C.MEMORY_BREAKDOWN_DEFAULT)
 
 
-def get_compressed_allreduce_config(param_dict):
-    """int8 block-quantized DP gradient exchange (TPU-native extension)."""
-    sub = param_dict.get(C.COMPRESSED_ALLREDUCE, {})
+def get_quantized_comm_config(param_dict):
+    """Hierarchical quantized collectives (TPU-native extension; ZeRO++
+    qgZ/qwZ/hpZ shapes — runtime/quantized_collectives.py).
+
+    The older ``compressed_allreduce: {enabled, block}`` block is still
+    accepted as a legacy alias: its keys seed the defaults, and any
+    explicit ``quantized_comm`` key wins.
+    """
+    legacy = param_dict.get(C.COMPRESSED_ALLREDUCE, {})
+    sub = param_dict.get(C.QUANTIZED_COMM, {})
+    hierarchical = sub.get(C.QUANTIZED_COMM_HIERARCHICAL,
+                           C.QUANTIZED_COMM_HIERARCHICAL_DEFAULT)
+    # bools are accepted for ergonomics; True means "let the engine pick"
+    # which it cannot (the intra size is a topology fact) — refuse early
+    if hierarchical is True:
+        raise DeepSpeedConfigError(
+            "quantized_comm.hierarchical must be the intra-slice size "
+            "(an int >= 2), not true — the split is a topology fact the "
+            "engine cannot guess")
     return {
-        "enabled": sub.get(C.COMPRESSED_ALLREDUCE_ENABLED,
-                           C.COMPRESSED_ALLREDUCE_ENABLED_DEFAULT),
-        "block": sub.get(C.COMPRESSED_ALLREDUCE_BLOCK,
-                         C.COMPRESSED_ALLREDUCE_BLOCK_DEFAULT),
+        "enabled": sub.get(
+            C.QUANTIZED_COMM_ENABLED,
+            legacy.get(C.COMPRESSED_ALLREDUCE_ENABLED,
+                       C.QUANTIZED_COMM_ENABLED_DEFAULT)),
+        "algo": sub.get(C.QUANTIZED_COMM_ALGO,
+                        C.QUANTIZED_COMM_ALGO_DEFAULT),
+        "block": sub.get(
+            C.QUANTIZED_COMM_BLOCK,
+            legacy.get(C.COMPRESSED_ALLREDUCE_BLOCK,
+                       C.QUANTIZED_COMM_BLOCK_DEFAULT)),
+        "hierarchical": int(hierarchical or 0),
+        "quantize_weights": sub.get(
+            C.QUANTIZED_COMM_QUANTIZE_WEIGHTS,
+            C.QUANTIZED_COMM_QUANTIZE_WEIGHTS_DEFAULT),
+        "secondary_partition": sub.get(
+            C.QUANTIZED_COMM_SECONDARY_PARTITION,
+            C.QUANTIZED_COMM_SECONDARY_PARTITION_DEFAULT),
     }
 
 
@@ -456,8 +485,9 @@ class DeepSpeedConfig:
         self.wall_clock_breakdown = get_wall_clock_breakdown(param_dict)
         self.profiler_config = get_profiler_config(param_dict)
         self.compile_cache_config = get_compile_cache_config(param_dict)
-        self.compressed_allreduce_config = \
-            get_compressed_allreduce_config(param_dict)
+        self.quantized_comm_config = get_quantized_comm_config(param_dict)
+        # legacy attribute name, kept for scripts written against it
+        self.compressed_allreduce_config = self.quantized_comm_config
         self.memory_breakdown = get_memory_breakdown(param_dict)
         self.checkpoint_config = get_checkpoint_config(param_dict)
         self.tensorboard_enabled = get_tensorboard_enabled(param_dict)
@@ -554,6 +584,47 @@ class DeepSpeedConfig:
                 "bf16.master_weights=false contradicts ZeRO-Offload: the "
                 "offloaded host fp32 copy IS a master copy (drop one of "
                 "the two)")
+        qc = self.quantized_comm_config
+        from deepspeed_tpu.runtime.quantized_collectives import \
+            QUANTIZED_ALGOS
+        if qc["algo"] not in QUANTIZED_ALGOS:
+            raise DeepSpeedConfigError(
+                f"quantized_comm.algo must be one of {QUANTIZED_ALGOS}, "
+                f"got {qc['algo']!r}")
+        if qc["block"] < 8:
+            raise DeepSpeedConfigError(
+                f"quantized_comm.block must be >= 8, got {qc['block']}")
+        if qc["hierarchical"] == 1 or qc["hierarchical"] < 0:
+            raise DeepSpeedConfigError(
+                "quantized_comm.hierarchical must be 0 (off) or the "
+                f"intra-slice size >= 2, got {qc['hierarchical']}")
+        if qc["secondary_partition"] and not qc["hierarchical"]:
+            raise DeepSpeedConfigError(
+                "quantized_comm.secondary_partition (hpZ) needs "
+                "quantized_comm.hierarchical >= 2: the secondary shard IS "
+                "the intra-slice copy")
+        if qc["enabled"] and qc["hierarchical"]:
+            if qc["algo"] != "twohop":
+                raise DeepSpeedConfigError(
+                    "quantized_comm.hierarchical requires algo='twohop' "
+                    f"(got {qc['algo']!r}: the legacy allgather exchange "
+                    "has no 2D form)")
+            if self.sparse_gradients_enabled:
+                raise DeepSpeedConfigError(
+                    "quantized_comm.hierarchical does not compose with "
+                    "sparse_gradients (the CSR exchange is written "
+                    "against the flat 'data' axis)")
+            if self.optimizer_name and \
+                    "onebit" in self.optimizer_name.lower().replace("_", ""):
+                raise DeepSpeedConfigError(
+                    "quantized_comm.hierarchical does not compose with "
+                    "OnebitAdam (its compressed exchange is written "
+                    "against the flat 'data' axis)")
+        if qc["quantize_weights"] and not self.zero_enabled:
+            logger.warning(
+                "quantized_comm.quantize_weights has no effect at ZeRO "
+                "stage 0: params are replicated, there is no gather to "
+                "compress")
 
     def _do_warning_check(self):
         if self.bf16_stochastic_rounding and self.bf16_master_weights:

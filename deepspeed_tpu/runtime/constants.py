@@ -161,6 +161,36 @@ COMPRESSED_ALLREDUCE_BLOCK = "block"
 COMPRESSED_ALLREDUCE_BLOCK_DEFAULT = 256
 
 #############################################
+# Hierarchical quantized collectives — TPU-native extension
+# (ZeRO++ qgZ/qwZ/hpZ shapes; see runtime/quantized_collectives.py).
+# Supersedes "compressed_allreduce" (still accepted as a legacy alias
+# for {enabled, block}).
+#
+# "quantized_comm": {
+#   "enabled": false,
+#   "algo": "twohop",           # qgZ two-hop | "allgather" (legacy, dp=2)
+#   "block": 256,               # quantization block size
+#   "hierarchical": 0,          # intra-slice size (>=2 splits the data
+#                               # axis into data_inter x data_intra)
+#   "quantize_weights": false,  # qwZ: int8 ZeRO param all-gather
+#   "secondary_partition": false# hpZ: intra-sharded compute-dtype copy
+# }
+#############################################
+QUANTIZED_COMM = "quantized_comm"
+QUANTIZED_COMM_ENABLED = "enabled"
+QUANTIZED_COMM_ENABLED_DEFAULT = False
+QUANTIZED_COMM_ALGO = "algo"
+QUANTIZED_COMM_ALGO_DEFAULT = "twohop"
+QUANTIZED_COMM_BLOCK = "block"
+QUANTIZED_COMM_BLOCK_DEFAULT = 256
+QUANTIZED_COMM_HIERARCHICAL = "hierarchical"
+QUANTIZED_COMM_HIERARCHICAL_DEFAULT = 0
+QUANTIZED_COMM_QUANTIZE_WEIGHTS = "quantize_weights"
+QUANTIZED_COMM_QUANTIZE_WEIGHTS_DEFAULT = False
+QUANTIZED_COMM_SECONDARY_PARTITION = "secondary_partition"
+QUANTIZED_COMM_SECONDARY_PARTITION_DEFAULT = False
+
+#############################################
 # Profiler (TPU-native: jax.profiler trace capture; SURVEY.md §5 —
 # the reference's wall_clock_breakdown/timers ladder, plus XLA traces)
 #

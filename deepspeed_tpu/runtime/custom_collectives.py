@@ -27,10 +27,27 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["pack_signs", "unpack_signs", "compressed_allreduce",
-           "CompressedAllreduceResult", "padded_numel", "server_chunk_size"]
+           "CompressedAllreduceResult", "padded_numel", "server_chunk_size",
+           "pad_to_multiple", "pad_flat_to_multiple"]
 
 _BITS = 8
 _POWERS = 2 ** np.arange(_BITS - 1, -1, -1, dtype=np.uint8)  # MSB-first
+
+
+def pad_to_multiple(n: int, m: int) -> int:
+    """Smallest multiple of ``m`` that is >= ``n`` (host-side size math,
+    shared by the 1-bit chunking and the int8 block collectives)."""
+    return n + (-n) % m
+
+
+def pad_flat_to_multiple(x: jax.Array, m: int) -> Tuple[jax.Array, int]:
+    """Zero-pad a flat array so its length is a multiple of ``m``.
+    Returns ``(padded, pad_count)``; shared by every compressed
+    collective that chunks a flattened tensor."""
+    pad = (-x.shape[0]) % m
+    if pad:
+        x = jnp.concatenate([x, jnp.zeros((pad,), x.dtype)])
+    return x, pad
 
 
 def pack_signs(x: jax.Array) -> jax.Array:
@@ -100,8 +117,7 @@ def compressed_allreduce(
     assert server_error.shape[0] == chunk
 
     if padded != orig_size:
-        flat = jnp.concatenate(
-            [flat, jnp.zeros((padded - orig_size,), jnp.float32)])
+        flat, _ = pad_flat_to_multiple(flat, padded)
 
     # ---- worker-side compression with error feedback (ref :122-128) ----
     compensated = flat + worker_error

@@ -69,7 +69,16 @@ class DeepSpeedDataLoader:
         return self.len
 
     def _sharding(self):
-        if self.mesh is None or self.batch_axis not in self.mesh.axis_names:
+        if self.mesh is None:
+            return None
+        if self.batch_axis not in self.mesh.axis_names:
+            if self.batch_axis == "data":
+                # hierarchical data mesh: the batch splits over BOTH
+                # data sub-axes (parallel.mesh.data_sharding)
+                from deepspeed_tpu.parallel.mesh import (data_axis_names,
+                                                         data_sharding)
+                if data_axis_names(self.mesh):
+                    return data_sharding(self.mesh)
             return None
         from jax.sharding import NamedSharding, PartitionSpec
         return NamedSharding(self.mesh, PartitionSpec(self.batch_axis))

@@ -36,7 +36,9 @@ from jax.sharding import NamedSharding, PartitionSpec
 
 from deepspeed_tpu import distributed as dist
 from deepspeed_tpu.ops.optimizers import Optimizer, build_optimizer
-from deepspeed_tpu.parallel.mesh import axis_size, build_mesh
+from deepspeed_tpu.parallel.mesh import (axis_size, build_mesh,
+                                         data_axis_names, data_axis_size,
+                                         split_data_axis)
 from deepspeed_tpu.parallel.topology import ParallelGrid
 from deepspeed_tpu.runtime import checkpoint as ckpt
 from deepspeed_tpu.runtime import fault
@@ -141,8 +143,31 @@ class DeepSpeedEngine:
                 raw = _json.load(f)
 
         mesh_axes = raw.get("mesh", {}).get("axes") if isinstance(raw, dict) else None
+        # hierarchical quantized comm (ZeRO++ 2D shapes) splits the data
+        # axis into data_inter x data_intra BEFORE the mesh is built, so
+        # every downstream sharding sees the 2D form
+        _qc_hier = 0
+        if isinstance(raw, dict):
+            from deepspeed_tpu.runtime.config import get_quantized_comm_config
+            _qc_raw = get_quantized_comm_config(raw)
+            # the split is gated on enabled: a disabled quantized_comm
+            # block must leave the mesh (and every 'data'-keyed path)
+            # exactly as before
+            if _qc_raw["enabled"]:
+                _qc_hier = int(_qc_raw["hierarchical"])
+        if _qc_hier >= 2:
+            if mesh_axes is None:
+                mesh_axes = {"data": len(jax.devices())}
+            mesh_axes = split_data_axis(mesh_axes, _qc_hier)
         self.mesh = build_mesh(mesh_axes)
-        self.dp_world_size = axis_size(self.mesh, "data")
+        # dp axes: ("data",), or ("data_inter", "data_intra") on a
+        # hierarchical mesh; dp_world_size is their product
+        self.dp_axes = data_axis_names(self.mesh) or ("data",)
+        self._dp_hierarchical = len(self.dp_axes) > 1
+        # the PartitionSpec dim entry that shards over the full dp degree
+        self._dp_axis_entry = (self.dp_axes if self._dp_hierarchical
+                               else self.dp_axes[0])
+        self.dp_world_size = data_axis_size(self.mesh)
         self.mp_world_size = axis_size(self.mesh, "model")
         # make the mesh known to the activation-checkpointing subsystem so
         # partition_activations can shard the stash (the reference threads
@@ -276,7 +301,7 @@ class DeepSpeedEngine:
         if self.zero_stage >= 1:
             self._param_shardings = zero_shardings(
                 master_params, self.mesh, stage=self.zero_stage,
-                model_specs=param_specs)
+                axis_name=self._dp_axis_entry, model_specs=param_specs)
         else:
             self._param_shardings = replicated_shardings(
                 master_params, self.mesh, model_specs=param_specs)
@@ -325,7 +350,7 @@ class DeepSpeedEngine:
             if self.zero_stage >= 1:
                 self._opt_shardings = zero_shardings(
                     opt_state, self.mesh, stage=self.zero_stage,
-                    model_specs=None)
+                    axis_name=self._dp_axis_entry, model_specs=None)
             else:
                 self._opt_shardings = replicated_shardings(opt_state,
                                                            self.mesh)
@@ -370,9 +395,10 @@ class DeepSpeedEngine:
                 accum = jax.tree_util.tree_map(
                     lambda p: jnp.zeros(p.shape, jnp.float32), params)
                 if self.zero_stage >= 2:
-                    accum_shardings = zero_shardings(accum, self.mesh,
-                                                     stage=self.zero_stage,
-                                                     model_specs=param_specs)
+                    accum_shardings = zero_shardings(
+                        accum, self.mesh, stage=self.zero_stage,
+                        axis_name=self._dp_axis_entry,
+                        model_specs=param_specs)
                 else:
                     accum_shardings = replicated_shardings(accum, self.mesh)
         else:
@@ -499,18 +525,50 @@ class DeepSpeedEngine:
         self._csr_overflow = None     # device flag from the last micro step
         self._csr_overflow_logged = False
 
-        # int8 block-quantized DP grad exchange (TPU-native extension;
-        # ZeRO++-style — runtime/quantized_collectives.py). Exclusive
-        # with the 1-bit and CSR manual paths.
-        cac = self._config.compressed_allreduce_config
+        # Hierarchical quantized collectives (TPU-native extension; ZeRO++
+        # qgZ/qwZ/hpZ shapes — runtime/quantized_collectives.py). The
+        # gradient path is exclusive with the 1-bit and CSR manual paths.
+        qc = self._config.quantized_comm_config
+        self._quant_cfg = qc
         self._quant_allreduce = bool(
-            cac["enabled"] and self.dp_world_size > 1
+            qc["enabled"] and self.dp_world_size > 1
             and not self._onebit and not self._sparse_grad_paths)
-        self._quant_block = int(cac["block"])
-        if cac["enabled"] and not self._quant_allreduce:
+        self._quant_block = int(qc["block"])
+        self._quant_algo = qc["algo"]
+        if qc["enabled"] and not self._quant_allreduce:
             logger.warning(
-                "compressed_allreduce ignored (needs dp > 1 and no "
-                "1-bit/sparse gradient path)")
+                "quantized_comm gradient exchange ignored (needs dp > 1 "
+                "and no 1-bit/sparse gradient path)")
+        if self._dp_hierarchical:
+            assert not self._onebit and not self._sparse_grad_paths, \
+                "quantized_comm.hierarchical does not compose with " \
+                "OnebitAdam or sparse_gradients (their manual shard_map " \
+                "paths are written against the flat 'data' axis)"
+            assert self._quant_algo == "twohop", \
+                "quantized_comm.hierarchical requires algo='twohop' " \
+                "(the legacy allgather exchange has no 2D form)"
+        # qwZ: int8 block-quantized ZeRO param all-gather. Only on the
+        # GSPMD (non-shard_map) path where the gather exists, with a
+        # compute-dtype cast to ride (stage 3 skips the up-front cast —
+        # its per-use-site gathers are already the lean shape).
+        self._qwz = bool(qc["enabled"] and qc["quantize_weights"]
+                         and 1 <= self.zero_stage <= 2
+                         and self.compute_dtype is not None
+                         and self.dp_world_size > 1)
+        if qc["quantize_weights"] and qc["enabled"] and not self._qwz:
+            logger.warning(
+                "quantized_comm.quantize_weights ignored (needs ZeRO "
+                "stage 1-2, a compute dtype, and dp > 1)")
+        # hpZ: keep the compute-dtype params sharded over the intra axis
+        # only, so backward re-gathers never cross the slow inter axis
+        self._hpz = bool(qc["enabled"] and qc["secondary_partition"]
+                         and self._dp_hierarchical
+                         and 1 <= self.zero_stage <= 2
+                         and self.compute_dtype is not None)
+        if qc["secondary_partition"] and qc["enabled"] and not self._hpz:
+            logger.warning(
+                "quantized_comm.secondary_partition ignored (needs "
+                "hierarchical mode, ZeRO stage 1-2, and a compute dtype)")
 
         self._compiled_micro_step = None
         self._compiled_grad = None
@@ -526,6 +584,20 @@ class DeepSpeedEngine:
         # authoritative).
         self._host_micro_step = 0
         self._host_global_step = 0
+
+        # per-step DP comm-bytes model (host math on leaf shapes; the
+        # wire shape itself is pinned by the HLO audits) — written to the
+        # monitor each step and logged once here
+        self._comm_stats = self._estimate_step_comm_bytes()
+        if self._comm_stats is not None:
+            log_dist(
+                "dp grad exchange: ~{:.2f} MB/step/rank ({}), dense fp32 "
+                "ring would be ~{:.2f} MB (ratio {:.2f}x)".format(
+                    self._comm_stats["bytes_per_step"] / 2**20,
+                    self._comm_stats["mode"],
+                    self._comm_stats["dense_bytes_per_step"] / 2**20,
+                    self._comm_stats["compression_ratio"] or 1.0),
+                ranks=[0])
 
         log_dist(
             f"DeepSpeedEngine initialized: mesh={dict(self.mesh.shape)} "
@@ -829,6 +901,11 @@ class DeepSpeedEngine:
             return params
         if self.zero_stage >= 3:
             return params
+        if constrain and self._qwz:
+            # qwZ: the ZeRO param all-gather moves int8 + per-slice fp32
+            # scales instead of bf16 (ZeRO++ arXiv:2306.10209 §quantized
+            # weights) — see _quantized_weight_cast
+            return self._quantized_weight_cast(params)
         cast = _tree_cast(params, self.compute_dtype)
         if constrain and self.compute_dtype is not None \
                 and self.zero_stage >= 1:
@@ -838,9 +915,106 @@ class DeepSpeedEngine:
             # gather the f32 masters and cast downstream — 2x wire traffic
             # on the per-micro gather (the docs/performance.md caveat,
             # now asserted in test_hlo_collectives.py).
-            cast = jax.lax.with_sharding_constraint(cast,
-                                                    self._param_shardings)
+            # hpZ (secondary_partition): constrain to the intra-sharded
+            # secondary layout instead — the inter hop happens here once,
+            # and every use-site (re-)gather stays on the fast intra axis.
+            target = (self._secondary_shardings() if self._hpz
+                      else self._param_shardings)
+            cast = jax.lax.with_sharding_constraint(cast, target)
         return cast
+
+    # -- qwZ / hpZ: quantized + secondary-sharded ZeRO weight gather ------
+    def _leaf_dp_dim(self, spec) -> Optional[int]:
+        """Index of the PartitionSpec dim sharded over the dp axes, or
+        None (replicated / model-only leaf)."""
+        dp = set(self.dp_axes)
+        for i, entry in enumerate(spec):
+            names = entry if isinstance(entry, tuple) else (entry,)
+            if any(a in dp for a in names if a is not None):
+                return i
+        return None
+
+    def _secondary_shardings(self):
+        """hpZ target layout: each leaf's dp-sharded dim re-sharded over
+        the intra sub-axis ONLY (replicated across data_inter) — the
+        ZeRO++ secondary partition, as a sharding assignment."""
+        def one(shd):
+            spec = shd.spec
+            k = self._leaf_dp_dim(spec)
+            if k is None:
+                return shd
+            entries = list(spec)
+            entries[k] = "data_intra"
+            return NamedSharding(self.mesh, PartitionSpec(*entries))
+        return jax.tree_util.tree_map(one, self._param_shardings)
+
+    def _quantized_weight_cast(self, params):
+        """qwZ (+ optional hpZ): per-leaf int8 block-quantized ZeRO param
+        gather.
+
+        For each dp-sharded leaf: symmetric int8 quantization per slice
+        along the sharded dim (absmax over the other dims — shard-local
+        math), both q and scales pinned to the master's sharded layout,
+        then resharded to the gather target (replicated, or the
+        intra-sharded secondary layout under hpZ) BEFORE dequantization —
+        so the partitioner's all-gather moves int8 elements + fp32
+        scales, ~2x less wire than the bf16 gather and ~4x less than a
+        naive f32 one. Dequant + compute-dtype cast run on the gathered
+        values (elementwise, negligible). Leaves with no dp sharding or
+        tiny per-slice extents ship as plain compute-dtype casts.
+
+        MUST be applied OUTSIDE autodiff (every caller pre-casts before
+        value_and_grad / before entering shard_map): round() has a zero
+        derivative and the int8 wire carries no cotangents, so
+        differentiating through this cast would zero the master
+        gradients.
+        """
+        mesh = self.mesh
+        hpz = self._hpz
+        dtype = self.compute_dtype
+
+        def one(leaf, shd):
+            spec = shd.spec
+            k = self._leaf_dp_dim(spec)
+            plain_ok = (k is None or leaf.ndim == 0
+                        or not jnp.issubdtype(leaf.dtype, jnp.floating)
+                        or leaf.size // leaf.shape[k] < 16)
+            if plain_ok:
+                cast = (leaf.astype(dtype)
+                        if jnp.issubdtype(leaf.dtype, jnp.floating)
+                        else leaf)
+                if k is not None:
+                    cast = jax.lax.with_sharding_constraint(cast, shd)
+                return cast
+            # per-slice symmetric int8: one fp32 scale per index along
+            # the sharded dim (reduction is over unsharded dims only)
+            other = tuple(i for i in range(leaf.ndim) if i != k)
+            absmax = jnp.max(jnp.abs(leaf.astype(jnp.float32)),
+                             axis=other, keepdims=True)
+            s = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+            q = jnp.clip(jnp.round(leaf.astype(jnp.float32) / s),
+                         -127, 127).astype(jnp.int8)
+            # scales: same rank, size-1 dims except k -> only dim k's
+            # entry of the leaf spec survives
+            s_spec = PartitionSpec(*[spec[i] if i == k else None
+                                     for i in range(leaf.ndim)])
+            q = jax.lax.with_sharding_constraint(q, shd)
+            s = jax.lax.with_sharding_constraint(
+                s, NamedSharding(mesh, s_spec))
+            # the gather: reshard the int8 payload (this is what crosses
+            # the wire). hpZ keeps the intra shard; otherwise replicate.
+            tgt_entry = "data_intra" if hpz else None
+            t_spec = list(spec)
+            t_spec[k] = tgt_entry
+            q = jax.lax.with_sharding_constraint(
+                q, NamedSharding(mesh, PartitionSpec(*t_spec)))
+            ts_spec = [None] * leaf.ndim
+            ts_spec[k] = tgt_entry
+            s = jax.lax.with_sharding_constraint(
+                s, NamedSharding(mesh, PartitionSpec(*ts_spec)))
+            return (q.astype(jnp.float32) * s).astype(dtype)
+
+        return jax.tree_util.tree_map(one, params, self._param_shardings)
 
     def _compute_loss_and_grads(self, params, batch, rng, scale,
                                 constrain_cast=True):
@@ -955,21 +1129,47 @@ class DeepSpeedEngine:
 
     # -- int8 quantized allreduce path ------------------------------------
     def _compute_quantized_grads(self, params, batch, rng, scale):
-        """Backward under shard_map over 'data' with the int8 block-
-        quantized gradient exchange (runtime/quantized_collectives.py) —
-        ~3.7x less DP wire traffic than fp32 grads. Leaves smaller than
-        one quantization block ship dense (pmean)."""
+        """Backward under shard_map over the data axes with the int8
+        block-quantized gradient exchange
+        (runtime/quantized_collectives.py).
+
+        algo='twohop' (default) is the qgZ shape: per-rank wire ~2n int8
+        bytes independent of dp degree. algo='allgather' is the legacy
+        O(W*n) exchange (only sane at dp=2). With
+        quantized_comm.hierarchical the bandwidth-heavy hops run over
+        'data_intra' and only the reduced 1/W_intra chunk crosses
+        'data_inter'. Leaves smaller than one quantization block ship
+        dense (pmean)."""
         from deepspeed_tpu.runtime.quantized_collectives import (
-            quantized_allreduce_mean)
+            hierarchical_quantized_allreduce_mean, quantized_allreduce_mean)
         P = PartitionSpec
         repl = lambda tree: jax.tree_util.tree_map(lambda _: P(), tree)
+        # Gather + cast ONCE in GSPMD land before entering shard_map:
+        # in_specs=repl would otherwise coerce the ZeRO-sharded fp32
+        # masters to replicated — an f32 all-gather on the wire where a
+        # compute-dtype (or, under qwZ, int8) gather would do. The cast
+        # rides qwZ/hpZ when enabled; inside the shard_map the re-cast
+        # is a no-op.
+        params = self._cast_for_loss(params, constrain=True)
         block = self._quant_block
+        algo = self._quant_algo
+        dp_axes = self.dp_axes
+        batch_entry = self._dp_axis_entry
+        hierarchical = self._dp_hierarchical
+        if hierarchical:
+            inter_size = axis_size(self.mesh, "data_inter")
+            intra_size = axis_size(self.mesh, "data_intra")
+        world = self.dp_world_size
 
         def inner(p, b, r, s):
-            r = jax.random.fold_in(r, jax.lax.axis_index("data"))
+            idx = jax.lax.axis_index(dp_axes[0])
+            for ax in dp_axes[1:]:
+                idx = idx * axis_size(self.mesh, ax) + \
+                    jax.lax.axis_index(ax)
+            r = jax.random.fold_in(r, idx)
             loss, _aux, g = self._compute_loss_and_grads(
                 p, b, r, s, constrain_cast=False)
-            loss = jax.lax.pmean(loss, "data")
+            loss = jax.lax.pmean(loss, dp_axes)
 
             # fp16 overflow sentinel: quantization destroys inf/nan (the
             # absmax scale goes inf -> q garbage), so detect nonfinite
@@ -981,12 +1181,19 @@ class DeepSpeedEngine:
                     ovf = jnp.logical_or(
                         ovf, jnp.any(~jnp.isfinite(leaf)))
                 ovf = jax.lax.pmax(ovf.astype(jnp.int32),
-                                   "data").astype(bool)
+                                   dp_axes).astype(bool)
 
             def exchange(grad):
                 if grad.size < block:
-                    return jax.lax.pmean(grad, "data")
-                out = quantized_allreduce_mean(grad, "data", block)
+                    return jax.lax.pmean(grad, dp_axes)
+                if hierarchical:
+                    out = hierarchical_quantized_allreduce_mean(
+                        grad, "data_intra", "data_inter",
+                        intra_size, inter_size, block)
+                else:
+                    out = quantized_allreduce_mean(
+                        grad, dp_axes[0], block, algo=algo,
+                        world_size=world)
                 if self.fp16_enabled:
                     out = jnp.where(ovf, jnp.nan, out)
                 return out
@@ -997,7 +1204,8 @@ class DeepSpeedEngine:
         loss, grads = jax.shard_map(
             inner, mesh=self.mesh,
             in_specs=(repl(params),
-                      jax.tree_util.tree_map(lambda _: P("data"), batch),
+                      jax.tree_util.tree_map(lambda _: P(batch_entry),
+                                             batch),
                       P(), P()),
             out_specs=(P(), repl(params)),
             check_vma=False)(params, batch, rng, scale)
@@ -1223,6 +1431,13 @@ class DeepSpeedEngine:
                     loss, ovf, grads = self._compute_sparse_grads(
                         state.params, batch, sub, state.loss_scale.scale)
                     return loss, grads, rng, ovf
+                elif self._quant_allreduce:
+                    # same exchange as the fused train_batch path; also
+                    # keeps the qwZ weight quantization OUTSIDE autodiff
+                    # (differentiating through round() would zero the
+                    # master gradients)
+                    loss, aux, grads = self._compute_quantized_grads(
+                        state.params, batch, sub, state.loss_scale.scale)
                 else:
                     loss, aux, grads = self._compute_loss_and_grads(
                         state.params, batch, sub, state.loss_scale.scale)
@@ -1538,6 +1753,48 @@ class DeepSpeedEngine:
             self._profiler_active = False
             log_dist(f"profiler: trace stopped at step {step}", ranks=[0])
 
+    def _estimate_step_comm_bytes(self):
+        """Host-side model of the per-rank DP gradient-exchange bytes per
+        optimizer step (the wire SHAPE is pinned by the HLO audits in
+        tests/unit/test_hlo_quantized_comm.py; this is the byte-level
+        telemetry of the same model, written per step to the monitor).
+        None at dp=1 (no exchange)."""
+        from deepspeed_tpu.runtime.quantized_collectives import wire_bytes
+        from deepspeed_tpu.utils.hlo_audit import dense_allreduce_ring_bytes
+        W = self.dp_world_size
+        if W <= 1:
+            return None
+        gas = self.gradient_accumulation_steps
+        hier = None
+        if self._dp_hierarchical:
+            hier = (axis_size(self.mesh, "data_inter"),
+                    axis_size(self.mesh, "data_intra"))
+        total_q = total_d = 0
+        for leaf in jax.tree_util.tree_leaves(self.state.params):
+            if not (hasattr(leaf, "dtype")
+                    and jnp.issubdtype(leaf.dtype, jnp.floating)):
+                continue
+            n = leaf.size
+            dense = dense_allreduce_ring_bytes(n, W, dtype_bytes=4)  # fp32
+            total_d += dense
+            if self._quant_allreduce and n >= self._quant_block:
+                qb, _ = wire_bytes(n, W, self._quant_block,
+                                   algo=self._quant_algo,
+                                   hierarchical=hier)
+                total_q += qb
+            else:
+                total_q += dense
+        active = total_q if self._quant_allreduce else total_d
+        if self._quant_allreduce:
+            mode = ("hierarchical-" + self._quant_algo if hier
+                    else self._quant_algo)
+        else:
+            mode = "dense"
+        return {"bytes_per_step": active * gas,
+                "dense_bytes_per_step": total_d * gas,
+                "compression_ratio": (total_d / active) if active else None,
+                "mode": mode}
+
     def _write_monitor(self, loss=None):
         """reference engine.py:780-790/:922-936: loss/lr/scale scalars,
         x-axis = cumulative samples (forces a loss sync; opt-in)."""
@@ -1552,6 +1809,11 @@ class DeepSpeedEngine:
         if self._last_step_time_ms is not None:
             self.monitor.write_timer_values(
                 {"step_time_ms": self._last_step_time_ms}, samples)
+        if self._comm_stats is not None:
+            self.monitor.write_comm_metrics(
+                bytes_per_step=self._comm_stats["bytes_per_step"],
+                compression_ratio=self._comm_stats["compression_ratio"],
+                samples=samples)
 
     def _report_progress(self):
         # gate on the host mirror: no device sync unless actually printing

@@ -1,20 +1,47 @@
-"""Block-quantized gradient allreduce — ZeRO++-style comm compression.
+"""Hierarchical block-quantized collectives — ZeRO++-style comm compression.
 
 TPU-native extension past the reference snapshot (whose only compressed
-collective is 1-bit Adam's sign exchange): data-parallel gradients are
-exchanged as int8 with per-block fp32 scales (~3.7x less ICI/DCN traffic
-than fp32, ~1.9x vs bf16), the pattern of ZeRO++'s quantized gradient
-collectives (arXiv:2306.10209) and EQuARX (arXiv:2506.17615) re-expressed
-as in-jit XLA collectives:
+collective is 1-bit Adam's sign exchange): data-parallel gradients and
+(opt-in) ZeRO weight gathers cross the wire as int8 with per-block fp32
+scales, following ZeRO++'s qgZ/qwZ/hpZ (arXiv:2306.10209) and EQuARX
+(arXiv:2506.17615), re-expressed as in-jit XLA collectives so the whole
+exchange is auditable in partitioned HLO.
 
-    quantize(local grad) -> all_gather(int8 + scales) over 'data'
-    -> dequantize + mean locally on every rank
+Three gradient-exchange algorithms, all shard_map-composable:
 
-Summation happens in fp32 AFTER dequantization (int8 sums would
-overflow), which is exactly EQuARX's "quantize the wire, not the math".
-Quantization is symmetric per block of 256 values (absmax scaling,
-round-to-nearest): unbiased up to rounding, error bounded by
-absmax/127 per element.
+``allgather`` (legacy; only sane at dp=2)::
+
+    quantize -> all_gather(int8 + scales) over 'data' -> dequant + mean
+
+  Per-rank wire is O(W*n): every rank receives every other rank's FULL
+  quantized gradient. At W >= 4 this moves MORE bytes than a plain bf16
+  ring allreduce (2n * 2B) — compression defeated by the exchange shape.
+
+``twohop`` (qgZ; the default)::
+
+    quantize -> all_to_all chunk j -> rank j        (~n int8 out/in)
+    -> fp32 partial-sum of the owned 1/W chunk
+    -> requantize -> all_gather(reduced chunk)      (~n int8 in)
+
+  Per-rank wire is ~2n int8 bytes + scales, INDEPENDENT of W — always
+  below the 4n-byte dense bf16 ring.
+
+``twohop`` + hierarchical (qgZ over a 2D data axis)::
+
+    intra hop : quantize -> all_to_all over 'data_intra' -> partial sum
+    inter hop : two-hop allreduce of the owned 1/Wi chunk over
+                'data_inter' (~2n/Wi int8 on the slow axis)
+    gather    : requantize -> all_gather over 'data_intra'
+
+  The bandwidth-heavy hops (~2n int8) stay on the fast intra-slice ICI;
+  only the reduced 1/Wi chunk ever crosses the slow inter axis.
+
+Summation always happens in fp32 AFTER dequantization (int8 sums would
+overflow) — EQuARX's "quantize the wire, not the math". Quantization is
+symmetric per block of 256 values (absmax scaling, round-to-nearest):
+unbiased up to rounding, error bounded by absmax/127 per element; the
+two-hop paths requantize the reduced chunk, compounding one extra
+rounding (the ZeRO++ trade).
 """
 
 from typing import Optional, Tuple
@@ -22,17 +49,18 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from deepspeed_tpu.runtime.custom_collectives import (pad_flat_to_multiple,
+                                                      pad_to_multiple)
+
 __all__ = ["quantize_blockwise", "dequantize_blockwise",
-           "quantized_allreduce_mean", "wire_bytes"]
+           "quantized_allreduce_mean", "hierarchical_quantized_allreduce_mean",
+           "wire_bytes", "wire_bytes_by_axis",
+           "ALGO_ALLGATHER", "ALGO_TWOHOP", "QUANTIZED_ALGOS"]
 
 DEFAULT_BLOCK = 256
-
-
-def _pad_to(x, m):
-    pad = (-x.shape[0]) % m
-    if pad:
-        x = jnp.concatenate([x, jnp.zeros((pad,), x.dtype)])
-    return x, pad
+ALGO_ALLGATHER = "allgather"
+ALGO_TWOHOP = "twohop"
+QUANTIZED_ALGOS = (ALGO_TWOHOP, ALGO_ALLGATHER)
 
 
 def quantize_blockwise(x: jax.Array, block: int = DEFAULT_BLOCK
@@ -40,7 +68,7 @@ def quantize_blockwise(x: jax.Array, block: int = DEFAULT_BLOCK
     """Flatten + symmetric int8 quantization per block of ``block``
     values. Returns (q (nb, block) int8, scales (nb,) fp32, orig_size)."""
     n = x.size
-    flat, _ = _pad_to(x.reshape(-1).astype(jnp.float32), block)
+    flat, _ = pad_flat_to_multiple(x.reshape(-1).astype(jnp.float32), block)
     blocks = flat.reshape(-1, block)
     absmax = jnp.max(jnp.abs(blocks), axis=1)
     scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
@@ -55,22 +83,173 @@ def dequantize_blockwise(q: jax.Array, scale: jax.Array, n: int,
     return out.reshape(shape) if shape is not None else out
 
 
+def _quantize_chunked(flat: jax.Array, world: int, block: int
+                      ) -> Tuple[jax.Array, jax.Array]:
+    """Quantize a (pre-padded, multiple of world*block) flat fp32 array
+    into per-rank chunks: q (world, cb, block) int8, s (world, cb) f32."""
+    cb = flat.shape[0] // (world * block)
+    q, s, _ = quantize_blockwise(flat, block)
+    return q.reshape(world, cb, block), s.reshape(world, cb)
+
+
+def _dequant_mean(q: jax.Array, s: jax.Array, world: int) -> jax.Array:
+    """fp32 mean over the leading (source-rank) axis of quantized rows."""
+    return jnp.sum(q.astype(jnp.float32) * s[..., None], axis=0) / world
+
+
+def _allgather_dequant(part: jax.Array, axis_name: str, block: int
+                       ) -> jax.Array:
+    """Requantize a locally-owned reduced chunk and all_gather it: the
+    second hop of qgZ. Returns the full flat fp32 tensor (padded)."""
+    q, s, _ = quantize_blockwise(part, block)
+    q_all = jax.lax.all_gather(q, axis_name)      # (W, cb, block) int8
+    s_all = jax.lax.all_gather(s, axis_name)      # (W, cb) f32
+    return (q_all.astype(jnp.float32) * s_all[..., None]).reshape(-1)
+
+
+def _twohop_mean_flat(flat: jax.Array, axis_name: str, world: int,
+                      block: int) -> jax.Array:
+    """qgZ two-hop mean of a flat fp32 array over one mesh axis.
+    Returns the (padded) flat fp32 mean, identical on every rank."""
+    padded, _ = pad_flat_to_multiple(flat, world * block)
+    q, s = _quantize_chunked(padded, world, block)
+    # hop 1: rank i ships its quantized chunk j to rank j (row j of the
+    # result came from rank j) — ~n int8 per rank on the wire
+    q_x = jax.lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0,
+                             tiled=False)
+    s_x = jax.lax.all_to_all(s, axis_name, split_axis=0, concat_axis=0,
+                             tiled=False)
+    # fp32 partial-sum of the owned 1/W chunk (never sum in int8)
+    part = _dequant_mean(q_x, s_x, world)          # (cb, block) f32
+    # hop 2: requantize + all_gather the reduced chunk — ~n int8 per rank
+    return _allgather_dequant(part, axis_name, block)
+
+
 def quantized_allreduce_mean(grad: jax.Array, axis_name: str,
-                             block: int = DEFAULT_BLOCK) -> jax.Array:
+                             block: int = DEFAULT_BLOCK,
+                             algo: str = ALGO_TWOHOP,
+                             world_size: Optional[int] = None) -> jax.Array:
     """Mean-allreduce ``grad`` across ``axis_name`` shipping int8 + block
     scales on the wire. Call inside shard_map; every rank returns the
-    identical fp32 mean."""
-    q, scale, n = quantize_blockwise(grad, block)
-    q_all = jax.lax.all_gather(q, axis_name)            # (W, nb, block)
-    s_all = jax.lax.all_gather(scale, axis_name)        # (W, nb)
-    W = q_all.shape[0]
-    deq = q_all.astype(jnp.float32) * s_all[:, :, None]
-    mean = jnp.sum(deq, axis=0) / W
-    return mean.reshape(-1)[:n].reshape(grad.shape).astype(grad.dtype)
+    identical fp32 mean (cast back to ``grad.dtype``).
+
+    ``algo='twohop'`` (default) is the qgZ shape — per-rank wire ~2n int8
+    bytes independent of the axis size (requires ``world_size``, the
+    static mesh-axis extent). ``algo='allgather'`` is the legacy O(W*n)
+    exchange, kept for dp=2 where its single hop wins on latency.
+    """
+    if algo == ALGO_ALLGATHER:
+        q, scale, n = quantize_blockwise(grad, block)
+        q_all = jax.lax.all_gather(q, axis_name)        # (W, nb, block)
+        s_all = jax.lax.all_gather(scale, axis_name)    # (W, nb)
+        W = q_all.shape[0]
+        mean = _dequant_mean(q_all, s_all, W)
+        return mean.reshape(-1)[:n].reshape(grad.shape).astype(grad.dtype)
+    if algo != ALGO_TWOHOP:
+        raise ValueError(f"unknown quantized allreduce algo {algo!r}; "
+                         f"expected one of {QUANTIZED_ALGOS}")
+    assert world_size is not None and world_size >= 1, \
+        "algo='twohop' needs the static world_size of the mesh axis"
+    n = grad.size
+    full = _twohop_mean_flat(grad.reshape(-1).astype(jnp.float32),
+                             axis_name, world_size, block)
+    return full[:n].reshape(grad.shape).astype(grad.dtype)
 
 
-def wire_bytes(n: int, block: int = DEFAULT_BLOCK,
-               dense_dtype_bytes: int = 4) -> Tuple[int, int]:
-    """(quantized, dense) per-leg payload bytes for n elements."""
-    nb = -(-n // block)
-    return nb * block * 1 + nb * 4, n * dense_dtype_bytes
+def hierarchical_quantized_allreduce_mean(
+        grad: jax.Array, intra_axis: str, inter_axis: str,
+        intra_size: int, inter_size: int,
+        block: int = DEFAULT_BLOCK) -> jax.Array:
+    """2D qgZ: two-hop quantized mean over ``intra_axis`` x ``inter_axis``
+    keeping the bandwidth-heavy hops on the (fast) intra axis.
+
+    Shape: quantize -> all_to_all over intra (~n int8, fast wire) ->
+    fp32 partial-sum of the owned 1/Wi chunk -> full two-hop mean of
+    that chunk over inter (~2n/Wi int8, slow wire) -> requantize ->
+    all_gather over intra (~n int8, fast wire). The slow axis only ever
+    carries the reduced chunk.
+    """
+    n = grad.size
+    flat = grad.reshape(-1).astype(jnp.float32)
+    padded, _ = pad_flat_to_multiple(flat, intra_size * block)
+    q, s = _quantize_chunked(padded, intra_size, block)
+    # intra hop (fast axis): chunk j -> intra-rank j, fp32 partial sum
+    q_x = jax.lax.all_to_all(q, intra_axis, split_axis=0, concat_axis=0,
+                             tiled=False)
+    s_x = jax.lax.all_to_all(s, intra_axis, split_axis=0, concat_axis=0,
+                             tiled=False)
+    part = _dequant_mean(q_x, s_x, intra_size)       # (cb, block) f32
+    # inter hop (slow axis): only the reduced 1/Wi chunk crosses it.
+    # Skipped entirely when the inter axis is degenerate (hierarchical
+    # == full dp width): every collective would be a no-op but the
+    # quantize/requantize round-trip would still cost compute + error.
+    if inter_size > 1:
+        cb = part.shape[0]
+        part = _twohop_mean_flat(part.reshape(-1), inter_axis, inter_size,
+                                 block)[:cb * block].reshape(cb, block)
+    # gather (fast axis): requantized reduced chunk back to every rank
+    full = _allgather_dequant(part, intra_axis, block)
+    return full[:n].reshape(grad.shape).astype(grad.dtype)
+
+
+# --------------------------------------------------------------- wire model
+
+
+def _scaled_payload(elems: int, block: int) -> int:
+    """int8 payload + fp32 block scales, in bytes, for ``elems`` values."""
+    return elems + 4 * (elems // block)
+
+
+def wire_bytes(n: int, world_size: int, block: int = DEFAULT_BLOCK,
+               algo: str = ALGO_TWOHOP,
+               hierarchical: Optional[Tuple[int, int]] = None,
+               dense_dtype_bytes: int = 2) -> Tuple[int, int]:
+    """(quantized, dense) TOTAL per-rank wire bytes for one mean-allreduce
+    of ``n`` elements across ``world_size`` ranks.
+
+    Models the full algorithm, not a single leg: bytes a rank RECEIVES
+    across every hop (send volume is symmetric). ``dense`` is the ring
+    bf16 allreduce baseline, ``2*(W-1)/W * n * dense_dtype_bytes``
+    (reduce-scatter + all-gather legs).
+
+    - ``allgather`` (legacy): ``(W-1) * (n + scales)`` — O(W*n); exceeds
+      the dense bf16 ring whenever W >= 4 (at default block).
+    - ``twohop`` (qgZ): ``2*(W-1)/W * (n + scales)`` — O(n), independent
+      of W.
+    - ``hierarchical=(inter, intra)``: sum of the intra hops on n and
+      the inter hops on the n/intra chunk (see
+      :func:`wire_bytes_by_axis` for the per-axis split).
+    """
+    from deepspeed_tpu.utils.hlo_audit import dense_allreduce_ring_bytes
+    W = max(world_size, 1)
+    dense = dense_allreduce_ring_bytes(n, W, dense_dtype_bytes)
+    if W == 1:
+        return 0, 0
+    if hierarchical is not None:
+        per_axis = wire_bytes_by_axis(n, hierarchical[0], hierarchical[1],
+                                      block)
+        return per_axis["intra"] + per_axis["inter"], dense
+    padded = pad_to_multiple(n, W * block)
+    payload = _scaled_payload(padded, block)
+    if algo == ALGO_ALLGATHER:
+        return (W - 1) * payload, dense
+    if algo != ALGO_TWOHOP:
+        raise ValueError(f"unknown quantized allreduce algo {algo!r}")
+    # hop 1 all_to_all: (W-1)/W of the payload; hop 2 chunk all_gather:
+    # (W-1) chunks of payload/W — 2 * (W-1)/W * payload total
+    return 2 * (W - 1) * payload // W, dense
+
+
+def wire_bytes_by_axis(n: int, inter_size: int, intra_size: int,
+                       block: int = DEFAULT_BLOCK) -> dict:
+    """Per-axis per-rank wire bytes of the hierarchical two-hop mean:
+    ``{'intra': fast-axis bytes (~2n), 'inter': slow-axis bytes
+    (~2n/intra)}``."""
+    Wi, Wo = max(intra_size, 1), max(inter_size, 1)
+    padded = pad_to_multiple(n, Wi * block)
+    intra = (2 * (Wi - 1) * _scaled_payload(padded, block) // Wi
+             if Wi > 1 else 0)
+    chunk = pad_to_multiple(padded // Wi, Wo * block)
+    inter = (2 * (Wo - 1) * _scaled_payload(chunk, block) // Wo
+             if Wo > 1 else 0)
+    return {"intra": intra, "inter": inter}

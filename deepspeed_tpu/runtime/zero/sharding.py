@@ -27,7 +27,7 @@ TPU-native extension here):
   in backward — the param-sharded-forward lifecycle as a GSPMD schedule.
 """
 
-from typing import Any, Optional
+from typing import Any, Optional, Tuple, Union
 
 import jax
 import numpy as np
@@ -35,11 +35,27 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from deepspeed_tpu.parallel.mesh import axis_size
 
+# a ZeRO shard axis is either one mesh axis name or — on a hierarchical
+# data mesh — the ('data_inter', 'data_intra') tuple, which PartitionSpec
+# accepts as a single composite dim entry
+AxisName = Union[str, Tuple[str, ...]]
 
-def leaf_partition_spec(shape, axis_name: str, axis_n: int,
+
+def _axes_size(mesh: Mesh, axis_name: AxisName) -> int:
+    if isinstance(axis_name, str):
+        return axis_size(mesh, axis_name)
+    n = 1
+    for a in axis_name:
+        n *= axis_size(mesh, a)
+    return n
+
+
+def leaf_partition_spec(shape, axis_name: AxisName, axis_n: int,
                         model_spec: Optional[PartitionSpec] = None
                         ) -> PartitionSpec:
-    """Choose a PartitionSpec that shards one array over ``axis_name``.
+    """Choose a PartitionSpec that shards one array over ``axis_name``
+    (one mesh axis, or a tuple of axes sharding a single dim over their
+    product — the hierarchical data mesh).
 
     Picks the first dimension divisible by the axis size that is not already
     taken by ``model_spec`` (tensor-parallel sharding); falls back to
@@ -55,14 +71,14 @@ def leaf_partition_spec(shape, axis_name: str, axis_n: int,
 
 
 def zero_shardings(tree: Any, mesh: Mesh, stage: int,
-                   axis_name: str = "data",
+                   axis_name: AxisName = "data",
                    model_specs: Optional[Any] = None) -> Any:
     """NamedSharding pytree for optimizer state / master params.
 
     ``model_specs`` optionally carries per-leaf tensor-parallel
     PartitionSpecs to compose with (ZeRO over 'data' × TP over 'model').
     """
-    n = axis_size(mesh, axis_name)
+    n = _axes_size(mesh, axis_name)
 
     def one(leaf, mspec=None):
         if not hasattr(leaf, "shape") or leaf.ndim == 0 or stage < 1 or n == 1:

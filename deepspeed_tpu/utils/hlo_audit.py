@@ -1,0 +1,198 @@
+"""Compiled-HLO collective accounting (shared by tests and bench).
+
+The only multi-chip perf evidence a single-host rig can produce:
+compile the partitioned program on a virtual CPU mesh, walk the HLO,
+and pin communication volume to theory. Used by
+``tests/unit/test_hlo_collectives.py`` / ``test_hlo_quantized_comm.py``
+and by ``bench.py``'s hardware-free ``comm_wire_bytes_per_step`` row.
+
+Counting rules:
+
+- **Elements** are backend-invariant for float math comparisons (the
+  CPU backend upcasts bf16 dots to f32, so float byte counts are not).
+- **Bytes** ARE meaningful for quantized payloads: int8 collectives
+  stay s8 in HLO on every backend (FloatNormalization touches only
+  floats), which is exactly what the quantized-comm audits measure.
+- all-reduce counts 2x its size (ring cost = reduce-scatter +
+  all-gather); all-to-all / all-gather / reduce-scatter /
+  collective-permute count 1x their output.
+- async pairs count ONCE: the ``-start`` form is skipped (its tuple
+  result carries operand + result, double-counting the transfer) and
+  the ``-done`` form's plain result is counted.
+"""
+
+import re
+from typing import List, NamedTuple, Optional
+
+__all__ = ["HLO_DTYPE_BYTES", "shape_elems", "shape_bytes",
+           "Collective", "collect_collectives", "collect_collectives_full",
+           "wire_elements", "wire_bytes_of", "conditional_branch_comps",
+           "hlo_computation_body", "dense_allreduce_ring_bytes"]
+
+# dtype name -> byte width; accounting by ELEMENTS uses only the names
+HLO_DTYPE_BYTES = {"f64": 8, "s64": 8, "u64": 8,
+                   "f32": 4, "s32": 4, "u32": 4,
+                   "bf16": 2, "f16": 2, "s16": 2, "u16": 2,
+                   "s8": 1, "u8": 1, "pred": 1}
+
+_COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter",
+                "collective-permute", "all-to-all")
+
+
+def _shapes(shape_str):
+    """[(dtype, elems)] for every array in an HLO result type (handles
+    tuples)."""
+    out = []
+    for dt, dims in re.findall(r"(\w+)\[([\d,]*)\]", shape_str):
+        if dt not in HLO_DTYPE_BYTES:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        out.append((dt, n))
+    return out
+
+
+def shape_elems(shape_str) -> int:
+    """Total elements across every array in an HLO result type."""
+    return sum(n for _, n in _shapes(shape_str))
+
+
+def shape_bytes(shape_str) -> int:
+    """Total payload bytes across every array in an HLO result type."""
+    return sum(n * HLO_DTYPE_BYTES[dt] for dt, n in _shapes(shape_str))
+
+
+def _group_size(line) -> Optional[int]:
+    """Devices per replica group of a collective instruction, parsed from
+    either the explicit ``replica_groups={{0,1},{2,3}}`` form or the
+    iota ``replica_groups=[G,S]<=[...]`` form (S = group size). None if
+    the attribute is absent (single-group collective)."""
+    m = re.search(r"replica_groups=\[(\d+),(\d+)\]<=", line)
+    if m:
+        return int(m.group(2))
+    m = re.search(r"replica_groups=\{\{([\d,]*)\}", line)
+    if m:
+        ids = [x for x in m.group(1).split(",") if x]
+        return len(ids)
+    return None
+
+
+class Collective(NamedTuple):
+    op: str            # e.g. "all-gather"
+    elems: int         # result elements (transfer size, counting rules)
+    bytes: int         # result payload bytes (int8-aware)
+    group_size: Optional[int]  # devices per replica group
+    line: str
+    comp: Optional[str]        # enclosing HLO computation name
+
+
+def _iter_collectives(hlo_text):
+    comp = None
+    comp_pat = re.compile(r"^\s*(?:ENTRY\s+)?%?([\w.\-]+)\s*\([^=]*\)\s*->")
+    # the result type may be a variadic tuple whose long form carries
+    # /*index=N*/ comments (which contain '='), so match lazily up to
+    # the op name rather than forbidding '=' inside parens
+    pat = re.compile(
+        r"^\s*(?:ROOT\s+)?%?[\w.\-]+ = (\(.*?\)|\S+) "
+        r"(" + "|".join(_COLLECTIVES) + r")(-start|-done)?\(",
+    )
+    for line in hlo_text.splitlines():
+        cm = comp_pat.match(line)
+        if cm and "{" in line:
+            comp = cm.group(1)
+        m = pat.match(line)
+        if m:
+            if m.group(3) == "-start":
+                continue            # counted at the matching -done
+            yield m, line, comp
+
+
+def collect_collectives(hlo_text):
+    """[(op, result_elems, line, computation)] for every collective
+    instruction in a compiled (SPMD-partitioned) HLO module — the
+    4-tuple shape the element-count audits consume."""
+    return [(m.group(2), shape_elems(m.group(1)), line.strip(), comp)
+            for m, line, comp in _iter_collectives(hlo_text)]
+
+
+def collect_collectives_full(hlo_text) -> List[Collective]:
+    """:class:`Collective` records with byte accounting and replica-group
+    sizes — what the quantized-comm audits need (int8 payloads, and
+    which mesh axis a collective ran over, identified by group size)."""
+    out = []
+    for m, line, comp in _iter_collectives(hlo_text):
+        shape = m.group(1)
+        # async -done: replica_groups live on the matching -start line
+        gsz = _group_size(line)
+        out.append(Collective(op=m.group(2), elems=shape_elems(shape),
+                              bytes=shape_bytes(shape), group_size=gsz,
+                              line=line.strip(), comp=comp))
+    if any(c.group_size is None and c.line.find("-done(") >= 0
+           for c in out):
+        # map -done ops to their -start's replica_groups via operand name
+        starts = {}
+        for raw in hlo_text.splitlines():
+            sm = re.match(r"^\s*(?:ROOT\s+)?%?([\w.\-]+) = (?:\(.*?\)|\S+) "
+                          r"(?:" + "|".join(_COLLECTIVES) + r")-start\(",
+                          raw)
+            if sm:
+                starts[sm.group(1)] = _group_size(raw)
+        fixed = []
+        for c in out:
+            if c.group_size is None:
+                dm = re.search(r"-done\(%?([\w.\-]+)\)", c.line)
+                if dm and dm.group(1) in starts:
+                    c = c._replace(group_size=starts[dm.group(1)])
+            fixed.append(c)
+        out = fixed
+    return out
+
+
+def wire_elements(colls) -> int:
+    """Ring-model wire cost in elements: all-reduce = 2x its size.
+    Accepts 4-tuples or :class:`Collective` records."""
+    return sum(c[1] * (2 if c[0] == "all-reduce" else 1) for c in colls)
+
+
+def wire_bytes_of(colls) -> int:
+    """Ring-model wire cost in result-payload bytes (int8-aware);
+    requires :class:`Collective` records."""
+    return sum(c.bytes * (2 if c.op == "all-reduce" else 1) for c in colls)
+
+
+def dense_allreduce_ring_bytes(n: int, world: int,
+                               dtype_bytes: int = 2) -> int:
+    """Theory baseline: per-rank bytes of a dense ring allreduce of
+    ``n`` elements (reduce-scatter + all-gather legs)."""
+    return 2 * (world - 1) * n * dtype_bytes // world
+
+
+def conditional_branch_comps(hlo_text):
+    """Names of computations used as lax.cond branches (direct bodies)."""
+    names = set()
+    for m in re.finditer(r"(?:true_computation|false_computation)="
+                         r"%?([\w.\-]+)", hlo_text):
+        names.add(m.group(1))
+    for m in re.finditer(r"branch_computations=\{([^}]*)\}", hlo_text):
+        for n in m.group(1).split(","):
+            names.add(n.strip().lstrip("%"))
+    return names
+
+
+def hlo_computation_body(hlo_text, comp_name):
+    """Lines of one named HLO computation's body."""
+    lines = hlo_text.splitlines()
+    out, inside = [], False
+    pat = re.compile(r"^\s*(?:ENTRY\s+)?%?" + re.escape(comp_name) +
+                     r"\s*\(")
+    for line in lines:
+        if not inside and pat.match(line) and "{" in line:
+            inside = True
+            continue
+        if inside:
+            if line.strip() == "}" or line.strip().startswith("}"):
+                break
+            out.append(line)
+    return out

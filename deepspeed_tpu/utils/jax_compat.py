@@ -1,0 +1,67 @@
+"""JAX version compatibility shims.
+
+The framework targets the current ``jax.shard_map`` API (top-level
+export, ``check_vma`` kwarg). Older jax releases (< 0.5) ship the same
+machinery as ``jax.experimental.shard_map.shard_map`` with the kwarg
+spelled ``check_rep``. Rather than sprinkling try/except at every call
+site, :func:`install` publishes one adapter as ``jax.shard_map`` when
+the top-level name is missing, so the rest of the codebase (and user
+scripts written against it) can use the modern spelling everywhere.
+
+Idempotent and a no-op on jax versions that already export
+``jax.shard_map``.
+"""
+
+import jax
+
+__all__ = ["install"]
+
+
+def _make_adapter(legacy_shard_map):
+    def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                  check_vma=None, check_rep=None, axis_names=None, **kw):
+        check = True
+        if check_vma is not None:
+            check = check_vma
+        if check_rep is not None:
+            check = check_rep
+        if axis_names is not None:
+            # modern API: axis_names = the MANUAL axes; legacy spells the
+            # complement as auto= (axes left to the partitioner)
+            auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+            if auto:
+                kw.setdefault("auto", auto)
+        return legacy_shard_map(f, mesh=mesh, in_specs=in_specs,
+                                out_specs=out_specs, check_rep=check, **kw)
+    shard_map.__doc__ = legacy_shard_map.__doc__
+    return shard_map
+
+
+def _axis_size(axis_name):
+    """``jax.lax.axis_size`` backport: static size of a bound mesh axis
+    (or product over a tuple of axes) inside shard_map/pmap."""
+    if isinstance(axis_name, (tuple, list)):
+        n = 1
+        for a in axis_name:
+            n *= _axis_size(a)
+        return n
+    frame = jax.core.axis_frame(axis_name)
+    return int(getattr(frame, "size", frame))
+
+
+def install():
+    """Publish ``jax.shard_map`` / ``jax.lax.axis_size`` on jax versions
+    that predate them."""
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as legacy
+        jax.shard_map = _make_adapter(legacy)
+    if not hasattr(jax.lax, "axis_size"):
+        jax.lax.axis_size = _axis_size
+    try:
+        import jax.experimental.pallas.tpu as pltpu
+        if not hasattr(pltpu, "HBM"):
+            # newer pallas spells HBM-resident refs pltpu.HBM; older
+            # releases only have the ANY memory space (same placement)
+            pltpu.HBM = pltpu.ANY
+    except ImportError:       # pallas not present on this backend
+        pass

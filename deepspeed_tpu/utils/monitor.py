@@ -117,6 +117,22 @@ class TensorBoardMonitor:
                           1.0 if ok else 0.0, samples)
         self.flush()
 
+    def write_comm_metrics(self, *, bytes_per_step=None,
+                           compression_ratio=None, samples: int = 0):
+        """Per-step data-parallel communication telemetry (TPU-native
+        extension): modeled wire bytes per rank per optimizer step and
+        the compression ratio vs a dense fp32 ring allreduce — so a
+        quantized_comm config change shows up on the same samples x-axis
+        as loss/throughput."""
+        if self.writer is None:
+            return
+        if bytes_per_step is not None:
+            self.write_scalar("Train/Samples/comm_bytes_per_step",
+                              bytes_per_step, samples)
+        if compression_ratio is not None:
+            self.write_scalar("Train/Samples/comm_compression_ratio",
+                              compression_ratio, samples)
+
     def write_timer_values(self, timer_values: dict, samples: int = 0):
         """Per-timer milliseconds (engine.py:950-974 pattern)."""
         for name, ms in timer_values.items():

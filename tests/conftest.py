@@ -21,6 +21,12 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)
 
+# jax < 0.5 has no top-level jax.shard_map; tests (and the package) use
+# the modern spelling — install the adapter before any test imports it
+from deepspeed_tpu.utils.jax_compat import install as _install  # noqa: E402
+
+_install()
+
 assert jax.device_count() == 8, (
     f"tests expect an 8-device CPU mesh, got {jax.device_count()} "
     f"{jax.default_backend()} devices")

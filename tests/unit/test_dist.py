@@ -213,6 +213,13 @@ print(f"proc {pid} ok", flush=True)
         for p in procs:
             if p.poll() is None:
                 p.kill()
+    if any("Multiprocess computations aren't implemented on the CPU "
+           "backend" in out for out in outs):
+        # older jaxlib CPU runtimes have no cross-process collectives
+        # (gloo backend landed later) — the bootstrap handshake itself
+        # succeeded (coordinator logs printed), only the collective is
+        # unimplemented on this backend
+        pytest.skip("jaxlib CPU backend lacks multiprocess collectives")
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} rc={p.returncode}:\n{out[-2000:]}"
         assert f"proc {i} ok" in out

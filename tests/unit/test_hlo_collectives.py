@@ -43,75 +43,14 @@ import numpy as np
 import pytest
 
 import deepspeed_tpu as ds
+# shared HLO collective accounting (also feeds bench.py's hardware-free
+# comm_wire_bytes_per_step row and test_hlo_quantized_comm.py)
+from deepspeed_tpu.utils.hlo_audit import (
+    collect_collectives, wire_elements,
+    conditional_branch_comps as _conditional_branch_comps,
+    hlo_computation_body as _hlo_computation_body)
 
 pytestmark = pytest.mark.slow      # multi-minute 8-dev compiles
-
-# dtype NAMES only — accounting is in elements, never bytes (module
-# docstring: byte counts are not backend-invariant)
-_HLO_DTYPES = frozenset({"f64", "s64", "u64", "f32", "s32", "u32",
-                         "bf16", "f16", "s16", "u16", "s8", "u8",
-                         "pred"})
-
-_COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter",
-                "collective-permute", "all-to-all")
-
-
-def _shape_elems(shape_str):
-    """Total elements across every array in an HLO result type (handles
-    tuples)."""
-    total = 0
-    for dt, dims in re.findall(r"(\w+)\[([\d,]*)\]", shape_str):
-        if dt not in _HLO_DTYPES:
-            continue
-        n = 1
-        for d in dims.split(","):
-            if d:
-                n *= int(d)
-        total += n
-    return total
-
-
-def collect_collectives(hlo_text):
-    """[(op, result_elems, line, computation)] for every collective
-    instruction in a compiled (SPMD-partitioned) HLO module. Async
-    pairs count ONCE: the -start form is skipped (its tuple result
-    carries operand + result, double-counting the transfer) and the
-    -done form's plain result is counted; sync forms count directly."""
-    out = []
-    comp = None
-    comp_pat = re.compile(r"^\s*(?:ENTRY\s+)?%?([\w.\-]+)\s*\([^=]*\)\s*->")
-    pat = re.compile(
-        r"^\s*(?:ROOT\s+)?%?[\w.\-]+ = (\([^=]*?\)|\S+) "
-        r"(" + "|".join(_COLLECTIVES) + r")(-start|-done)?\(",
-    )
-    for line in hlo_text.splitlines():
-        cm = comp_pat.match(line)
-        if cm and "{" in line:
-            comp = cm.group(1)
-        m = pat.match(line)
-        if m:
-            if m.group(3) == "-start":
-                continue            # counted at the matching -done
-            out.append((m.group(2), _shape_elems(m.group(1)),
-                        line.strip(), comp))
-    return out
-
-
-def _conditional_branch_comps(hlo_text):
-    """Names of computations used as lax.cond branches (direct bodies)."""
-    names = set()
-    for m in re.finditer(r"(?:true_computation|false_computation)="
-                         r"%?([\w.\-]+)", hlo_text):
-        names.add(m.group(1))
-    for m in re.finditer(r"branch_computations=\{([^}]*)\}", hlo_text):
-        for n in m.group(1).split(","):
-            names.add(n.strip().lstrip("%"))
-    return names
-
-
-def wire_elements(colls):
-    """Ring-model wire cost in elements: all-reduce = 2x its size."""
-    return sum(c[1] * (2 if c[0] == "all-reduce" else 1) for c in colls)
 
 
 def _mlp_engine(gas=1):
@@ -192,23 +131,6 @@ def test_zero2_grad_accumulation_boundary_split():
                                  [c[:2] for c in on_boundary])
 
 
-def _hlo_computation_body(hlo_text, comp_name):
-    """Lines of one named HLO computation's body."""
-    lines = hlo_text.splitlines()
-    out, inside = [], False
-    pat = re.compile(r"^\s*(?:ENTRY\s+)?%?" + re.escape(comp_name) +
-                     r"\s*\(")
-    for line in lines:
-        if not inside and pat.match(line) and "{" in line:
-            inside = True
-            continue
-        if inside:
-            if line.strip() == "}" or line.strip().startswith("}"):
-                break
-            out.append(line)
-    return out
-
-
 def test_zero2_param_gather_rides_compute_dtype_cast():
     """The compute-dtype cast sits AHEAD of the per-micro param
     all-gather — the bf16 value is what crosses the wire.
@@ -238,9 +160,14 @@ def test_zero2_param_gather_rides_compute_dtype_cast():
                .lower(engine.state, batch))
     stable = lowered.as_text()
     for shape in ("256x512", "512x128"):
-        pat = (r"sdy\.sharding_constraint[^\n]*<@mesh, \[\{\"data\"\}"
+        # shardy partitioner (newer jax): sdy.sharding_constraint; GSPMD
+        # (jax < 0.5): a @Sharding custom call with a non-replicated
+        # mhlo.sharding — both prove the bf16 value is what gets resharded
+        sdy = (r"sdy\.sharding_constraint[^\n]*<@mesh, \[\{\"data\"\}"
                r"[^\n]*tensor<" + shape + r"xbf16>")
-        assert re.search(pat, stable), \
+        gspmd = (r"custom_call @Sharding[^\n]*devices=\[[^\n]*"
+                 r"tensor<" + shape + r"xbf16>")
+        assert re.search(sdy, stable) or re.search(gspmd, stable), \
             f"no sharded bf16 constraint for param {shape} in StableHLO"
 
     txt = lowered.compile().as_text()
@@ -255,11 +182,14 @@ def test_zero2_param_gather_rides_compute_dtype_cast():
     for op, e, line, _ in param_gathers:
         # collect_collectives returns sync `all-gather(` or async
         # `all-gather-done(` lines; for async, hop -done -> -start ->
-        # the real data operand
-        m = re.search(r"all-gather(?:-done)?\(%?([\w.\-]+)", line)
+        # the real data operand. The operand may carry a printed type
+        # prefix (`all-gather(f32[...] %x)`) depending on jax version.
+        m = re.search(r"all-gather(?:-done)?\((?:\S+(?:\{[\d,]*\})? )?"
+                      r"%?([\w.\-]+)", line)
         assert m, line[:160]
         opd_line = defn.get(m.group(1), "")
-        sm = re.search(r"all-gather-start\(%?([\w.\-]+)", opd_line)
+        sm = re.search(r"all-gather-start\((?:\S+(?:\{[\d,]*\})? )?"
+                       r"%?([\w.\-]+)", opd_line)
         if sm:
             opd_line = defn.get(sm.group(1), "")
         # a raw master crossing the wire would be parameter/gte directly

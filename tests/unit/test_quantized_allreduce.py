@@ -10,8 +10,9 @@ from jax.sharding import PartitionSpec as P
 import deepspeed_tpu as ds
 from deepspeed_tpu.parallel.mesh import build_mesh
 from deepspeed_tpu.runtime.quantized_collectives import (
-    dequantize_blockwise, quantize_blockwise, quantized_allreduce_mean,
-    wire_bytes)
+    ALGO_ALLGATHER, ALGO_TWOHOP, dequantize_blockwise,
+    hierarchical_quantized_allreduce_mean, quantize_blockwise,
+    quantized_allreduce_mean, wire_bytes, wire_bytes_by_axis)
 
 
 def test_quantize_roundtrip_error_bound():
@@ -25,13 +26,15 @@ def test_quantize_roundtrip_error_bound():
     assert err.max() <= bound
 
 
-def test_allreduce_mean_matches_dense_within_quant_error():
+@pytest.mark.parametrize("algo", [ALGO_ALLGATHER, ALGO_TWOHOP])
+def test_allreduce_mean_matches_dense_within_quant_error(algo):
     mesh = build_mesh({"data": 8})
     rng = np.random.RandomState(1)
     g = jnp.asarray(rng.randn(8, 512).astype(np.float32))
 
     def inner(x):
-        return quantized_allreduce_mean(x[0], "data")
+        return quantized_allreduce_mean(x[0], "data", algo=algo,
+                                        world_size=8)
 
     out = jax.jit(jax.shard_map(
         inner, mesh=mesh, in_specs=(P("data"),), out_specs=P(),
@@ -40,9 +43,95 @@ def test_allreduce_mean_matches_dense_within_quant_error():
     np.testing.assert_allclose(np.asarray(out), dense, atol=0.05)
 
 
-def test_wire_volume():
-    qb, db = wire_bytes(1_000_000)
-    assert db / qb > 3.5  # ~3.7x less traffic than fp32
+@pytest.mark.parametrize("n", [1, 255, 256, 257, 999, 2048 + 17])
+def test_twohop_odd_sizes_and_padding(n):
+    """Sizes around the block/world-chunk boundaries survive the pad ->
+    chunk -> all_to_all -> gather -> unpad round trip exactly."""
+    mesh = build_mesh({"data": 8})
+    rng = np.random.RandomState(n)
+    g = jnp.asarray(rng.randn(8, n).astype(np.float32))
+
+    def inner(x):
+        return quantized_allreduce_mean(x[0], "data", algo=ALGO_TWOHOP,
+                                        world_size=8)
+
+    out = jax.jit(jax.shard_map(
+        inner, mesh=mesh, in_specs=(P("data"),), out_specs=P(),
+        check_vma=False))(g)
+    assert out.shape == (n,)
+    dense = np.asarray(g).mean(axis=0)
+    # two quantization passes (worker + reduced chunk): 2x the one-pass
+    # bound of absmax/127 per pass
+    bound = 2 * np.abs(np.asarray(g)).max() / 127 + 1e-6
+    assert np.abs(np.asarray(out) - dense).max() <= bound
+
+
+def test_twohop_preserves_2d_shape_and_dtype():
+    mesh = build_mesh({"data": 8})
+    rng = np.random.RandomState(3)
+    g = jnp.asarray(rng.randn(8, 33, 17).astype(np.float32))
+
+    def inner(x):
+        return quantized_allreduce_mean(x[0], "data", algo=ALGO_TWOHOP,
+                                        world_size=8)
+
+    out = jax.jit(jax.shard_map(
+        inner, mesh=mesh, in_specs=(P("data"),), out_specs=P(),
+        check_vma=False))(g)
+    assert out.shape == (33, 17) and out.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(g).mean(axis=0), atol=0.08)
+
+
+def test_hierarchical_matches_dense_within_quant_error():
+    """2x4 hierarchical two-hop == flat dense mean within the (three
+    quantization passes) error bound."""
+    from jax.sharding import Mesh
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 4),
+                axis_names=("data_inter", "data_intra"))
+    rng = np.random.RandomState(5)
+    g = jnp.asarray(rng.randn(8, 777).astype(np.float32))
+
+    def inner(x):
+        return hierarchical_quantized_allreduce_mean(
+            x[0], "data_intra", "data_inter", 4, 2)
+
+    out = jax.jit(jax.shard_map(
+        inner, mesh=mesh, in_specs=(P(("data_inter", "data_intra")),),
+        out_specs=P(), check_vma=False))(g)
+    dense = np.asarray(g).mean(axis=0)
+    bound = 3 * np.abs(np.asarray(g)).max() / 127 + 1e-6
+    assert np.abs(np.asarray(out) - dense).max() <= bound
+
+
+def test_wire_volume_models_full_algorithm():
+    """Satellite-1 regression: wire_bytes models the TOTAL per-rank
+    payload of the actual algorithm. The legacy all_gather path exceeds
+    a dense bf16 ring allreduce at every W >= 4; the two-hop path never
+    does, and is W-independent."""
+    n = 1_000_000
+    for W in (4, 8, 32):
+        legacy, dense = wire_bytes(n, W, algo=ALGO_ALLGATHER)
+        assert legacy > dense, (W, legacy, dense)      # compression defeated
+        two, dense2 = wire_bytes(n, W, algo=ALGO_TWOHOP)
+        assert dense2 == dense
+        assert two < dense, (W, two, dense)
+    # dp=2 is the one world where the legacy single-hop still beats bf16
+    legacy2, dense_w2 = wire_bytes(n, 2, algo=ALGO_ALLGATHER)
+    assert legacy2 < dense_w2
+    # O(n): the two-hop payload is independent of W (same padding)
+    two4, _ = wire_bytes(n, 4, block=250)
+    two8, _ = wire_bytes(n, 8, block=250)
+    assert abs(two4 - two8) / two8 < 0.2, (two4, two8)
+    # vs fp32 grads the two-hop still compresses ~3.7x
+    two, _ = wire_bytes(n, 8)
+    _, dense_fp32 = wire_bytes(n, 8, dense_dtype_bytes=4)
+    assert dense_fp32 / two > 3.4
+    # hierarchical split: slow-axis bytes ~ 1/intra of fast-axis bytes
+    split = wire_bytes_by_axis(n, 2, 4)
+    assert split["inter"] < 0.4 * split["intra"], split
+    hier_total, _ = wire_bytes(n, 8, hierarchical=(2, 4))
+    assert hier_total == split["intra"] + split["inter"]
 
 
 def test_engine_trains_and_converges():
@@ -113,6 +202,206 @@ def test_quantized_composes_with_zero2_and_accumulation():
         losses.append(float(e.train_batch(iter(bs))))
     assert all(np.isfinite(l) for l in losses)
     assert losses[-1] < losses[0]
+
+
+def test_twohop_composes_with_zero2_and_accumulation():
+    """The qgZ two-hop exchange (explicit quantized_comm config) under
+    ZeRO-2 + gradient accumulation converges and tracks finite losses —
+    leaves >= one block actually ride the quantized exchange (hidden_dim
+    chosen so w leaves are 1024 elems > block 256)."""
+    from tests.unit.simple_model import (init_simple_params, simple_loss_fn,
+                                         random_batches)
+    params = init_simple_params(jax.random.PRNGKey(0), hidden_dim=32)
+    e, *_ = ds.initialize(
+        model=simple_loss_fn, model_parameters=params,
+        config={"train_micro_batch_size_per_gpu": 4,
+                "gradient_accumulation_steps": 2,
+                "quantized_comm": {"enabled": True, "algo": "twohop"},
+                "zero_optimization": {"stage": 2},
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}}})
+    assert e._quant_allreduce and e._quant_algo == "twohop"
+    assert any(l.size >= e._quant_block for l in
+               jax.tree_util.tree_leaves(e.state.params))
+    losses = []
+    for i in range(4):
+        bs = random_batches(2, 32, 32, seed=i)
+        losses.append(float(e.train_batch(iter(bs))))
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]
+
+
+def test_hierarchical_composes_with_zero2_and_accumulation():
+    """quantized_comm.hierarchical splits the mesh into
+    data_inter x data_intra; ZeRO-2 + grad accumulation still trains,
+    and the run tracks a flat-mesh two-hop run closely."""
+    from tests.unit.simple_model import (init_simple_params, simple_loss_fn,
+                                         random_batches)
+    params = init_simple_params(jax.random.PRNGKey(0), hidden_dim=32)
+
+    def engine(hier):
+        qc = {"enabled": True}
+        if hier:
+            qc["hierarchical"] = 4
+        e, *_ = ds.initialize(
+            model=simple_loss_fn, model_parameters=params,
+            config={"train_micro_batch_size_per_gpu": 4,
+                    "gradient_accumulation_steps": 2,
+                    "quantized_comm": qc,
+                    "zero_optimization": {"stage": 2},
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-2}}})
+        return e
+
+    eh, ef = engine(True), engine(False)
+    assert eh._dp_hierarchical and eh.dp_world_size == 8
+    assert dict(eh.mesh.shape) == {"data_inter": 2, "data_intra": 4}
+    lh, lf = [], []
+    for i in range(4):
+        bs = random_batches(2, 32, 32, seed=i)
+        lh.append(float(eh.train_batch(iter(bs))))
+        lf.append(float(ef.train_batch(iter(bs))))
+    assert all(np.isfinite(l) for l in lh)
+    assert lh[-1] < lh[0]
+    np.testing.assert_allclose(lh, lf, rtol=0.1)
+
+
+def test_qwz_weight_quantization_trains():
+    """qwZ (int8 weight gather) + hpZ (secondary partition) on the
+    hierarchical mesh: trains, converges, and tracks the plain bf16
+    ZeRO-2 run within the weight-quantization tolerance."""
+    from tests.unit.simple_model import (init_simple_params, simple_loss_fn,
+                                         random_batches)
+    params = init_simple_params(jax.random.PRNGKey(0), hidden_dim=32)
+
+    def engine(qc):
+        e, *_ = ds.initialize(
+            model=simple_loss_fn, model_parameters=params,
+            config={"train_micro_batch_size_per_gpu": 4,
+                    "bf16": {"enabled": True},
+                    "quantized_comm": qc,
+                    "zero_optimization": {"stage": 2},
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-2}}})
+        return e
+
+    eq = engine({"enabled": True, "quantize_weights": True,
+                 "hierarchical": 4, "secondary_partition": True})
+    e0 = engine({"enabled": True})
+    assert eq._qwz and eq._hpz
+    lq, l0 = [], []
+    for i in range(8):
+        b = random_batches(1, 32, 32, seed=i)[0]
+        lq.append(float(eq.train_batch(iter([b]))))
+        l0.append(float(e0.train_batch(iter([b]))))
+    assert all(np.isfinite(l) for l in lq)
+    assert lq[-1] < lq[0]
+    np.testing.assert_allclose(lq, l0, rtol=0.25)
+
+
+def test_disabled_hierarchical_leaves_mesh_flat():
+    """quantized_comm disabled must be a true no-op: a leftover
+    hierarchical knob must not split the mesh (user code keyed on the
+    flat 'data' axis keeps working)."""
+    from tests.unit.simple_model import init_simple_params, simple_loss_fn
+    params = init_simple_params(jax.random.PRNGKey(0), hidden_dim=8)
+    e, *_ = ds.initialize(
+        model=simple_loss_fn, model_parameters=params,
+        config={"train_micro_batch_size_per_gpu": 4,
+                "quantized_comm": {"enabled": False, "hierarchical": 4},
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}}})
+    assert dict(e.mesh.shape) == {"data": 8}
+    assert not e._dp_hierarchical and not e._quant_allreduce
+
+
+def test_twohop_forward_backward_step_facade():
+    """The reference-style forward()/backward()/step() facade rides the
+    same quantized exchange as train_batch (and keeps qwZ outside
+    autodiff)."""
+    from tests.unit.simple_model import (init_simple_params, simple_loss_fn,
+                                         random_batches)
+    params = init_simple_params(jax.random.PRNGKey(0), hidden_dim=32)
+
+    def engine(qc):
+        e, *_ = ds.initialize(
+            model=simple_loss_fn, model_parameters=params,
+            config={"train_micro_batch_size_per_gpu": 4,
+                    "bf16": {"enabled": True},
+                    "quantized_comm": qc,
+                    "zero_optimization": {"stage": 2},
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-2}}})
+        return e
+
+    e = engine({"enabled": True, "quantize_weights": True})
+    assert e._quant_allreduce and e._qwz
+    losses = []
+    for i in range(6):
+        b = random_batches(1, 32, 32, seed=i)[0]
+        loss = e.forward(b)
+        e.backward(loss)
+        e.step()
+        losses.append(float(loss))
+    assert all(np.isfinite(l) for l in losses)
+    # qwZ differentiated through round() would zero the grads and stall:
+    # convergence here proves the cast stayed outside autodiff
+    assert losses[-1] < losses[0]
+
+
+def test_secondary_partition_requires_hierarchical():
+    from deepspeed_tpu.runtime.config import DeepSpeedConfigError
+    from tests.unit.simple_model import init_simple_params, simple_loss_fn
+    params = init_simple_params(jax.random.PRNGKey(0), hidden_dim=8)
+    with pytest.raises(DeepSpeedConfigError):
+        ds.initialize(
+            model=simple_loss_fn, model_parameters=params,
+            config={"train_micro_batch_size_per_gpu": 4,
+                    "quantized_comm": {"enabled": True,
+                                       "secondary_partition": True},
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-2}}})
+
+
+def test_invalid_hierarchical_combinations_rejected_at_config_time():
+    """Bad combinations die as DeepSpeedConfigError during config
+    parsing (not as late engine asserts): legacy algo with hierarchical,
+    sparse_gradients, OnebitAdam, and a mesh.axes data_intra that
+    contradicts the hierarchical knob."""
+    from deepspeed_tpu.runtime.config import (DeepSpeedConfig,
+                                              DeepSpeedConfigError)
+    base = {"train_micro_batch_size_per_gpu": 4,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}}}
+    bad = [
+        {**base, "quantized_comm": {"enabled": True, "hierarchical": 4,
+                                    "algo": "allgather"}},
+        {**base, "sparse_gradients": True,
+         "quantized_comm": {"enabled": True, "hierarchical": 4}},
+        {**base, "optimizer": {"type": "OneBitAdam",
+                               "params": {"lr": 1e-2}},
+         "quantized_comm": {"enabled": True, "hierarchical": 4}},
+    ]
+    for cfg in bad:
+        with pytest.raises(DeepSpeedConfigError):
+            DeepSpeedConfig(cfg, world_size=8)
+    # explicit mesh.axes split disagreeing with the hierarchical knob
+    from tests.unit.simple_model import init_simple_params, simple_loss_fn
+    params = init_simple_params(jax.random.PRNGKey(0), hidden_dim=8)
+    with pytest.raises(ValueError):
+        ds.initialize(
+            model=simple_loss_fn, model_parameters=params,
+            config={**base,
+                    "mesh": {"axes": {"data_inter": 4, "data_intra": 2}},
+                    "quantized_comm": {"enabled": True,
+                                       "hierarchical": 4}})
+
+
+def test_legacy_compressed_allreduce_config_still_works():
+    """The pre-rewrite 'compressed_allreduce' block keeps working as an
+    alias of quantized_comm {enabled, block}."""
+    from tests.unit.simple_model import init_simple_params, simple_loss_fn
+    params = init_simple_params(jax.random.PRNGKey(0), hidden_dim=8)
+    e, *_ = ds.initialize(
+        model=simple_loss_fn, model_parameters=params,
+        config={"train_micro_batch_size_per_gpu": 4,
+                "compressed_allreduce": {"enabled": True, "block": 128},
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}}})
+    assert e._quant_allreduce and e._quant_block == 128
+    assert e._quant_algo == "twohop"      # new default rides the alias
 
 
 def test_quantized_composes_with_zero2_and_bf16():

@@ -67,6 +67,34 @@ class TestProcessTopology:
         with pytest.raises(ValueError):
             ProcessTopology(axes=["x", "x"], dims=[2, 2])
 
+    def test_split_axis_preserves_rank_positions(self):
+        """Splitting 'data' (8) into inter(2) x intra(4) keeps every
+        rank's position: old coord c -> (c // 4, c % 4), and intra
+        peers stay rank-adjacent (ICI neighbors)."""
+        topo = PipeDataParallelTopology(num_pp=2, num_dp=8)
+        split = topo.split_axis("data", "data_inter", "data_intra", 4)
+        assert split.axes == ["pipe", "data_inter", "data_intra"]
+        assert split.dims == [2, 2, 4]
+        assert split.world_size() == topo.world_size()
+        for rank in range(topo.world_size()):
+            old = topo.get_coord(rank)
+            new = split.get_coord(rank)
+            assert new.pipe == old.pipe
+            assert new.data_inter == old.data // 4
+            assert new.data_intra == old.data % 4
+        # intra groups are contiguous rank runs (the fast-wire property)
+        for group in split.get_axis_comm_lists("data_intra"):
+            assert group == list(range(group[0], group[0] + 4))
+
+    def test_split_axis_errors(self):
+        topo = PipeDataParallelTopology(num_pp=1, num_dp=8)
+        with pytest.raises(ValueError):
+            topo.split_axis("nope", "a", "b", 2)
+        with pytest.raises(ValueError):
+            topo.split_axis("data", "a", "b", 3)    # 8 % 3 != 0
+        with pytest.raises(ValueError):
+            topo.split_axis("data", "pipe", "b", 2)  # name collision
+
 
 class TestParallelGrid:
 
@@ -112,6 +140,27 @@ class TestMesh:
     def test_canonical_ordering(self):
         mesh = build_mesh({"model": 2, "pipe": 2, "data": 2})
         assert mesh.axis_names == ("pipe", "data", "model")
+
+    def test_hierarchical_data_axes(self):
+        from deepspeed_tpu.parallel.mesh import (data_axis_names,
+                                                 data_axis_size,
+                                                 split_data_axis)
+        axes = split_data_axis({"data": 8}, 4)
+        assert axes == {"data_inter": 2, "data_intra": 4}
+        mesh = build_mesh(axes)
+        # canonical order: inter (major/slow) before intra (minor/fast)
+        assert mesh.axis_names == ("data_inter", "data_intra")
+        assert data_axis_names(mesh) == ("data_inter", "data_intra")
+        assert data_axis_size(mesh) == 8
+        flat = build_mesh({"data": 8})
+        assert data_axis_names(flat) == ("data",)
+        assert data_axis_size(flat) == 8
+        with pytest.raises(ValueError):
+            split_data_axis({"data": 8}, 3)       # not divisible
+        with pytest.raises(ValueError):
+            split_data_axis({"model": 8}, 2)      # no data axis
+        with pytest.raises(ValueError):
+            split_data_axis({"data": 8}, 1)       # degenerate split
 
     def test_infer_axis(self):
         mesh = build_mesh({"data": -1, "model": 2})
