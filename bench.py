@@ -11,6 +11,12 @@ Metrics (BASELINE.md rows):
   gradient, counted from the partitioned HLO on a forced 8-device CPU
   mesh (same accounting as tests/unit/test_hlo_quantized_comm.py);
   vs_baseline = quantized / dense-bf16-ring ratio (acceptance: <= 0.6)
+- mfu_cost_model : HARDWARE-FREE — XLA cost-analysis FLOPs/token of the
+  compiled GPT-2 micro-step (the same record the observability layer's
+  flops profiler writes per run), on the forced 8-device CPU mesh;
+  vs_baseline = cost-model / analytic (6N + 12LSH) FLOPs ratio — a
+  drift guard on the MFU accounting both bench rows and per-run MFU
+  telemetry rely on
 - bert_large_samples_per_s : BERT-large fused-layer training @ seq 128
   (reference: 272 samples/s on 1x V100, fastest-bert post :38-40)
 - bert_onebit_samples_per_s : BERT + 1-bit Adam in the compression
@@ -62,6 +68,7 @@ _EMIT_LOCK = threading.Lock()
 # virtual CPU mesh) and runs first: it lands even when the tunnel is dead.
 METRICS = [
     "comm_wire_bytes_per_step",
+    "mfu_cost_model",
     "bert_large_samples_per_s",
     "bert_onebit_samples_per_s",
     "sparse_attention_speedup_s8k",
@@ -71,7 +78,7 @@ METRICS = [
 HEADLINE = "gpt2_train_mfu"
 # metrics that never touch the device tunnel: forced onto a virtual
 # 8-device CPU mesh in their child, runnable with the tunnel down
-HW_FREE = {"comm_wire_bytes_per_step"}
+HW_FREE = {"comm_wire_bytes_per_step", "mfu_cost_model"}
 
 PARTIAL_PATH = os.environ.get(
     "BENCH_PARTIAL", "/tmp/dstpu_bench_partial.jsonl")
@@ -504,6 +511,13 @@ def bench_sparse_attention(on_tpu, rtt):
                   "hbm_peak_mb_child": _hbm_peak_mb()})
 
 
+def gpt2_analytic_flops_per_token(n_params, num_layers, seq, hidden):
+    """PaLM-appendix model FLOPs/token: 6N + 12*L*S*H (fwd+bwd; shared
+    by the hardware MFU rows and the mfu_cost_model drift guard — keep
+    ONE instance so a correction can't silently diverge them)."""
+    return 6 * n_params + 12 * num_layers * seq * hidden
+
+
 def bench_gpt2(on_tpu, rtt, dropout: float, metric: str):
     import jax
     import jax.numpy as jnp
@@ -574,8 +588,8 @@ def bench_gpt2(on_tpu, rtt, dropout: float, metric: str):
     dt = max(time.perf_counter() - t0 - rtt, 1e-9)
 
     tokens_per_s = batch * seq * steps / dt
-    flops_per_token = (6 * n_params +
-                       12 * cfg.num_layers * seq * cfg.hidden_size)
+    flops_per_token = gpt2_analytic_flops_per_token(
+        n_params, cfg.num_layers, seq, cfg.hidden_size)
     tflops = tokens_per_s * flops_per_token / 1e12
     peak = 197.0 if on_tpu else 1e9
     mfu = tflops / peak / max(n_dev, 1)
@@ -642,6 +656,80 @@ def bench_comm_wire_bytes(on_tpu, rtt):
                   "source": "partitioned-HLO audit (hardware-free)"})
 
 
+def bench_mfu_cost_model(on_tpu, rtt):
+    """Hardware-free row: cost-analysis FLOPs per token of the compiled
+    GPT-2 micro-step (fwd + bwd + Adam update, ZeRO-2 over the virtual
+    8-device mesh) — the exact record the observability layer's flops
+    profiler writes per run (deepspeed_tpu/profiling/flops.py), pinned
+    here against the analytic PaLM-appendix count so a silent change in
+    what the compiled program computes (lost fusion, duplicated
+    backward, an optimizer graph regression) moves a checked number.
+
+    value = cost-model FLOPs/token; vs_baseline = cost / analytic
+    (6N + 12LSH) ratio — expected O(1); detail carries a projected v5e
+    step time at the reference 45% MFU bar for quick mental math.
+    """
+    del on_tpu, rtt           # compiled-program accounting; no device timing
+    import jax
+    import jax.numpy as jnp
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import (
+        GPT2Config, count_params, gpt2_loss_fn, init_gpt2_params)
+    from deepspeed_tpu.profiling.flops import profile_jit_fn
+
+    cfg = GPT2Config(vocab_size=512, max_position_embeddings=128,
+                     hidden_size=64, num_layers=2, num_heads=2)
+    batch, seq = 8, 64
+    n_dev = jax.device_count()
+    params = init_gpt2_params(cfg, jax.random.PRNGKey(0))
+    n_params = count_params(params)
+    loss_fn = gpt2_loss_fn(cfg, dtype=jnp.bfloat16, deterministic=True)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=loss_fn, model_parameters=params,
+        config={
+            "train_micro_batch_size_per_gpu": max(batch // n_dev, 1),
+            "gradient_accumulation_steps": 1,
+            "bf16": {"enabled": True},
+            "steps_per_print": 10**9,
+            "zero_optimization": {"stage": 2 if n_dev > 1 else 0},
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+        })
+    ids = np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (batch, seq + 1)).astype(np.int32)
+    from jax.sharding import NamedSharding, PartitionSpec
+    b = {"input_ids": jax.device_put(
+        ids, NamedSharding(engine.mesh,
+                           PartitionSpec("data" if n_dev > 1 else None)))}
+    _beat()
+    prof = profile_jit_fn(engine._get_compiled_micro_step(),
+                          (engine.state, b), name="gpt2_micro_step")
+    # cost_analysis flops are PER-DEVICE for the partitioned program
+    # (FlopsProfile docstring), so divide by the per-device token share
+    tokens = batch * seq
+    tokens_per_dev = tokens / max(n_dev, 1)
+    flops_per_token = prof.flops / tokens_per_dev
+    analytic = gpt2_analytic_flops_per_token(
+        n_params, cfg.num_layers, seq, cfg.hidden_size)
+    # projected v5e step time at the reference's 45% MFU bar
+    # (per-device program against the per-device peak)
+    v5e_peak = 197e12
+    proj_step_ms = prof.flops / (0.45 * v5e_peak) * 1e3
+    return _emit("mfu_cost_model", round(flops_per_token, 1),
+                 "flops_per_token_cost_model",
+                 round(flops_per_token / analytic, 4),
+                 {"model": f"gpt2-{n_params/1e6:.1f}M", "tokens": tokens,
+                  "flops_per_step_per_device": prof.flops,
+                  "bytes_accessed_per_device": prof.bytes_accessed,
+                  "arithmetic_intensity": round(
+                      prof.arithmetic_intensity, 3),
+                  "analytic_flops_per_token": analytic,
+                  "projected_v5e_step_ms_at_45pct_mfu": round(
+                      proj_step_ms, 4),
+                  "world": n_dev, "backend": jax.default_backend(),
+                  "source": "compiled-program cost analysis "
+                            "(hardware-free)"})
+
+
 # ------------------------------------------------------------- child mode
 
 
@@ -688,6 +776,8 @@ def run_child(metric):
 
     if metric == "comm_wire_bytes_per_step":
         bench_comm_wire_bytes(on_tpu, rtt)
+    elif metric == "mfu_cost_model":
+        bench_mfu_cost_model(on_tpu, rtt)
     elif metric == "bert_large_samples_per_s":
         bench_bert_large(on_tpu, rtt)
     elif metric == "bert_onebit_samples_per_s":

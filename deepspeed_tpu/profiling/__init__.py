@@ -1,0 +1,264 @@
+"""Unified profiling & telemetry layer.
+
+One opt-in config section (``observability: {}``) wires four probes
+through the engine:
+
+- **FLOPs/MFU profiler** (:mod:`.flops`): cost-analysis of the compiled
+  micro-step → model FLOPs, bytes accessed, per-step MFU against a
+  peak-FLOPs device registry.
+- **Recompile tracking** (:mod:`.recompile`): every compiled entry
+  point is wrapped; compile counts/wall-times are recorded and
+  steady-state recompiles (the silent TPU perf killer) warn loudly.
+- **HBM watermarks** (:mod:`.memory`): structured
+  ``device.memory_stats()`` samples at step boundaries, with per-phase
+  deltas and a run peak (host-RSS fallback on backends without
+  allocator stats).
+- **Trace spans** (:mod:`.spans`): ``trace_span("forward")`` shows up in
+  captured XLA traces *and* in a standalone Chrome-trace JSON.
+
+Everything lands as ``(tag, value, step)`` scalars on the monitor AND
+in a crash-safe JSONL event log (``events.jsonl``) that
+``tools/obs_report.py`` renders into a run summary. The x-axis is
+cumulative samples, matching the reference's tensorboard convention.
+
+:class:`Observer` is the engine-facing facade; the probe modules are
+importable standalone.
+"""
+
+import os
+from typing import Any, Dict, Optional, Tuple
+
+from deepspeed_tpu.profiling.flops import (
+    FlopsProfile, compute_mfu, format_profile, peak_flops_per_device,
+    profile_jit_fn)
+from deepspeed_tpu.profiling.memory import MemoryWatermark, memory_snapshot
+from deepspeed_tpu.profiling.recompile import (CompileEvent, CompileTracker,
+                                               TrackedFunction)
+from deepspeed_tpu.profiling.spans import (ChromeTraceRecorder,
+                                           get_default_recorder,
+                                           set_default_recorder, trace_span)
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+__all__ = [
+    "Observer", "FlopsProfile", "CompileTracker", "CompileEvent",
+    "TrackedFunction", "MemoryWatermark", "memory_snapshot",
+    "ChromeTraceRecorder", "trace_span", "set_default_recorder",
+    "get_default_recorder", "compute_mfu", "peak_flops_per_device",
+    "profile_jit_fn",
+]
+
+# scalar tags (pinned by tests/unit/test_observability.py and consumed
+# by tools/obs_report.py — change both together)
+TAG_FLOPS = "Observability/flops_per_step"
+TAG_BYTES = "Observability/bytes_accessed"
+TAG_MFU = "Observability/mfu"
+TAG_RECOMPILES = "Observability/recompiles"
+TAG_COMPILE_MS = "Observability/compile_ms_total"
+TAG_MEM_IN_USE = "Memory/bytes_in_use"
+TAG_MEM_PEAK = "Memory/peak_bytes_in_use"
+TAG_MEM_DELTA = "Memory/step_delta_bytes"
+
+
+class Observer:
+    """Engine-facing facade over the probes.
+
+    Construction is cheap and always succeeds; when ``enabled`` is
+    False (config off, or non-zero rank — telemetry is rank-0 like the
+    monitor) every method is a no-op/passthrough, so the engine wires
+    it unconditionally. Instrumentation failures degrade to warnings:
+    observability must never take down a training step.
+    """
+
+    def __init__(self, cfg: Dict[str, Any], monitor=None, rank: int = 0,
+                 device=None, num_devices: Optional[int] = None):
+        self.cfg = cfg
+        self.monitor = monitor
+        self.enabled = bool(cfg.get("enabled")) and rank == 0
+        self._device = device
+        self._num_devices = num_devices
+        self._log = None
+        self.compile_tracker: Optional[CompileTracker] = None
+        self.memory: Optional[MemoryWatermark] = None
+        self.recorder: Optional[ChromeTraceRecorder] = None
+        self.flops_profiles: Dict[str, FlopsProfile] = {}
+        self._step_provider = lambda: 0
+        self._closed = False
+        if not self.enabled:
+            return
+
+        events_dir = cfg.get("events_dir") or "/tmp/deepspeed_tpu_obs"
+        try:
+            from deepspeed_tpu.utils.monitor import _JsonlWriter
+            self._log = _JsonlWriter(events_dir)
+        except Exception as e:
+            logger.warning(f"observability: event log unavailable "
+                           f"({e}); scalars go to the monitor only")
+        # route every monitor scalar (loss, lr, step time, comm bytes,
+        # checkpoint events) into the event log too, so obs_report sees
+        # one complete record even when tensorboard is off
+        if self.monitor is not None and self._log is not None:
+            self.monitor.mirror = self._log
+
+        self.compile_tracker = CompileTracker(
+            step_provider=lambda: self._step_provider(),
+            warn_after=int(cfg.get("recompile_warn_after", 1)),
+            on_event=self._on_compile_event)
+        if cfg.get("memory_watermarks", True):
+            self.memory = MemoryWatermark(device)
+        self.recorder = ChromeTraceRecorder()
+        self._chrome_path = cfg.get("chrome_trace_path") or None
+        self._chrome_last_dump = 0.0  # monotonic secs; 0 = never dumped
+        # the engine has no shutdown hook; close() (idempotent) seals
+        # the compile summary + final chrome trace at interpreter exit
+        import atexit
+        atexit.register(self.close)
+        log_dist(f"observability: enabled (events -> "
+                 f"{os.path.join(events_dir, 'events.jsonl')})", ranks=[0])
+
+    # ------------------------------------------------------------ sinks
+    def set_step_provider(self, fn) -> None:
+        """Host-step source for compile-event attribution (the engine's
+        ``_host_global_step`` mirror — no device sync)."""
+        self._step_provider = fn
+
+    def scalar(self, tag: str, value, step: int) -> None:
+        """One (tag, value, step) record to monitor + event log."""
+        if not self.enabled:
+            return
+        if self.monitor is not None:
+            self.monitor.write_scalar(tag, value, step)
+        elif self._log is not None:
+            self._log.add_scalar(tag, value, step)
+
+    def event(self, kind: str, **fields) -> None:
+        """One structured (non-scalar) event row in the JSONL log."""
+        if self._log is not None:
+            self._log.add_event(kind, **fields)
+
+    def _on_compile_event(self, ev: CompileEvent) -> None:
+        self.event("compile", fn=ev.fn_name, count=ev.count,
+                   wall_ms=round(ev.wall_ms, 3), step=ev.step)
+
+    # ------------------------------------------------------------ probes
+    def wrap_jit(self, fn, name: str):
+        """Wrap a jit-compiled callable for compile tracking; identity
+        when disabled (existing code sees the raw jit function)."""
+        if not self.enabled or self.compile_tracker is None:
+            return fn
+        return self.compile_tracker.wrap(fn, name)
+
+    def span(self, name: str, **extra):
+        """Phase span: XLA TraceAnnotation always (near-free, shows in
+        captured traces even with observability off), Chrome-trace event
+        when enabled. trace_span itself never raises from
+        instrumentation (annotation enter/exit are guarded in-body)."""
+        return trace_span(name, recorder=self.recorder, **extra)
+
+    def wants_flops_profile(self, name: str) -> bool:
+        return (self.enabled and bool(self.cfg.get("flops_profiler", True))
+                and name not in self.flops_profiles)
+
+    def maybe_profile_flops(self, name: str, fn, args: Tuple,
+                            samples: int = 0) -> Optional[FlopsProfile]:
+        """One-time cost-analysis of a compiled entry point (an AOT
+        re-compile — opt-in cost, absorbed by the persistent compile
+        cache on re-runs). Writes the FLOPs/bytes scalars and logs the
+        reference-style profile block."""
+        if not self.wants_flops_profile(name):
+            return self.flops_profiles.get(name)
+        try:
+            prof = profile_jit_fn(fn, args, name=name, device=self._device,
+                                  num_devices=self._num_devices)
+        except Exception as e:
+            logger.warning(f"observability: cost analysis of {name!r} "
+                           f"failed ({e!r}); MFU will not be reported")
+            # sentinel so we don't retry (and re-fail) every step
+            prof = FlopsProfile(name=name, flops=0.0, bytes_accessed=0.0,
+                                peak_flops_per_device=0.0, device_kind="?",
+                                num_devices=0)
+            self.flops_profiles[name] = prof
+            return prof
+        self.flops_profiles[name] = prof
+        self.scalar(TAG_FLOPS, prof.flops, samples)
+        self.scalar(TAG_BYTES, prof.bytes_accessed, samples)
+        self.event("flops_profile", fn=name, flops=prof.flops,
+                   bytes_accessed=prof.bytes_accessed,
+                   peak_flops_per_device=prof.peak_flops_per_device,
+                   device_kind=prof.device_kind,
+                   num_devices=prof.num_devices,
+                   compile_ms=round(prof.compile_ms or 0.0, 3))
+        log_dist(format_profile(prof), ranks=[0])
+        return prof
+
+    # --------------------------------------------------------- per step
+    def on_step(self, samples: int, step_time_ms: Optional[float],
+                micro_steps_per_step: int = 1) -> None:
+        """Step-boundary emission: MFU, recompile counters, memory
+        watermarks; Chrome trace refreshed on disk.
+        ``micro_steps_per_step`` scales the profiled program's FLOPs up
+        to the full optimizer step (gradient accumulation runs the
+        compiled micro-step N times per reported step time)."""
+        if not self.enabled:
+            return
+        prof = self.flops_profiles.get("micro_step")
+        if prof is not None and prof.flops > 0 and step_time_ms:
+            # cost_analysis flops are PER-DEVICE (FlopsProfile docstring)
+            # so the denominator is the per-device peak — the ratio
+            # equals global-flops / all-device-peak
+            mfu = compute_mfu(prof.flops * max(micro_steps_per_step, 1),
+                              step_time_ms / 1e3,
+                              prof.peak_flops_per_device)
+            self.scalar(TAG_MFU, mfu, samples)
+        if self.compile_tracker is not None:
+            self.scalar(TAG_RECOMPILES, self.compile_tracker.total_compiles,
+                        samples)
+            self.scalar(TAG_COMPILE_MS, self.compile_tracker.total_compile_ms,
+                        samples)
+        if self.memory is not None:
+            snap = self.memory.sample("step")
+            if snap is not None:
+                self.scalar(TAG_MEM_IN_USE, snap["bytes_in_use"], samples)
+                self.scalar(TAG_MEM_PEAK, self.memory.peak_bytes, samples)
+                self.scalar(TAG_MEM_DELTA, snap["delta_bytes"], samples)
+        if self._chrome_path and self.recorder is not None:
+            # throttled: rewriting the whole trace JSON is O(buffered
+            # events) — once early (so the file exists mid-run), then at
+            # most every few seconds; close() writes the final state
+            import time as _time
+            now = _time.monotonic()
+            if self._chrome_last_dump == 0.0 or \
+                    now - self._chrome_last_dump > 5.0:
+                try:
+                    self.recorder.dump(self._chrome_path)
+                    self._chrome_last_dump = now
+                except Exception:
+                    pass
+        if self._log is not None:
+            self._log.flush()
+
+    def close(self) -> None:
+        if self._closed or not self.enabled:
+            return
+        self._closed = True
+        # drop the atexit pin: without this, the registry (via the
+        # step_provider closure) would keep the engine — and its
+        # on-device state — alive for the whole process lifetime
+        import atexit
+        try:
+            atexit.unregister(self.close)
+        except Exception:
+            pass
+        self._step_provider = lambda: 0
+        if self._chrome_path and self.recorder is not None:
+            try:
+                self.recorder.dump(self._chrome_path)
+            except Exception:
+                pass
+        if self.compile_tracker is not None:
+            self.event("compile_summary", **self.compile_tracker.summary())
+        if self.monitor is not None and \
+                getattr(self.monitor, "mirror", None) is self._log:
+            self.monitor.mirror = None
+        if self._log is not None:
+            self._log.close()
+            self._log = None

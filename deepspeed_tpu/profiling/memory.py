@@ -1,0 +1,97 @@
+"""HBM watermark sampling.
+
+Replaces the one-line ``SynchronizedWallClockTimer.memory_usage()``
+string with structured samples: ``device.memory_stats()`` where the
+backend provides it (TPU), a host-RSS fallback where it does not (the
+CPU backend returns None — tests and forced-CPU smoke runs still get
+well-defined watermark scalars, labeled ``source: "host"``).
+
+Sampling is a cheap host call (no device sync), so the engine can take
+a watermark at every step boundary; :class:`MemoryWatermark` keeps the
+run peak and per-phase deltas on top of the raw samples.
+"""
+
+import os
+from typing import Dict, Optional
+
+__all__ = ["memory_snapshot", "MemoryWatermark"]
+
+_PAGE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
+
+
+def _host_rss_bytes() -> Optional[int]:
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * _PAGE
+    except Exception:
+        return None
+
+
+def _host_peak_rss_bytes() -> Optional[int]:
+    try:
+        import resource
+        ru = resource.getrusage(resource.RUSAGE_SELF)
+        return int(ru.ru_maxrss) * 1024  # linux reports KiB
+    except Exception:
+        return None
+
+
+def memory_snapshot(device=None) -> Optional[Dict]:
+    """``{"bytes_in_use", "peak_bytes_in_use", "source"}`` for one
+    device, host-RSS fallback when the backend has no allocator stats.
+    None only when neither source is readable."""
+    stats = None
+    if device is None:
+        try:
+            import jax
+            device = jax.local_devices()[0]
+        except Exception:
+            device = None
+    if device is not None:
+        try:
+            stats = device.memory_stats()
+        except Exception:
+            stats = None
+    if stats:
+        return {"bytes_in_use": int(stats.get("bytes_in_use", 0)),
+                "peak_bytes_in_use": int(
+                    stats.get("peak_bytes_in_use",
+                              stats.get("bytes_in_use", 0))),
+                "source": "device"}
+    rss = _host_rss_bytes()
+    peak = _host_peak_rss_bytes()
+    if rss is None and peak is None:
+        return None
+    return {"bytes_in_use": int(rss or peak or 0),
+            "peak_bytes_in_use": int(peak or rss or 0),
+            "source": "host"}
+
+
+class MemoryWatermark:
+    """Stateful watermark tracking over :func:`memory_snapshot`.
+
+    ``sample(phase)`` returns the snapshot extended with
+    ``delta_bytes`` (bytes_in_use change since the previous sample, any
+    phase) and maintains ``peak_bytes`` across the run — the number an
+    OOM post-mortem wants even if the fatal step never reported."""
+
+    def __init__(self, device=None):
+        self._device = device
+        self.last: Optional[Dict] = None
+        self.peak_bytes: int = 0
+        self.samples_by_phase: Dict[str, Dict] = {}
+
+    def sample(self, phase: str = "step") -> Optional[Dict]:
+        snap = memory_snapshot(self._device)
+        if snap is None:
+            return None
+        prev = self.last
+        snap = dict(snap)
+        snap["phase"] = phase
+        snap["delta_bytes"] = (snap["bytes_in_use"] - prev["bytes_in_use"]
+                               if prev else 0)
+        self.peak_bytes = max(self.peak_bytes, snap["peak_bytes_in_use"],
+                              snap["bytes_in_use"])
+        self.last = snap
+        self.samples_by_phase[phase] = snap
+        return snap

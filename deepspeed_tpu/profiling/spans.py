@@ -1,0 +1,111 @@
+"""Structured trace spans: one context manager, two sinks.
+
+``trace_span("forward")`` emits
+- a ``jax.profiler.TraceAnnotation`` — the span shows up inside captured
+  XLA traces (the ``profiler``/``observability.trace`` window), nested
+  under the device timeline exactly where it ran; and
+- a Chrome-trace JSON "complete" event into a
+  :class:`ChromeTraceRecorder` — loadable in ``chrome://tracing`` /
+  Perfetto without capturing a full XLA trace.
+
+The recorder is deliberately tiny (host wall-clock only, no device
+sync): spans measure *dispatch-side* phase structure. Device-honest
+timing stays with SynchronizedWallClockTimer / the XLA trace.
+"""
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import List, Optional
+
+__all__ = ["ChromeTraceRecorder", "trace_span", "set_default_recorder",
+           "get_default_recorder"]
+
+
+class ChromeTraceRecorder:
+    """Accumulates Chrome-trace 'X' (complete) events; ``dump(path)``
+    writes the standard ``{"traceEvents": [...]}`` container.
+
+    The buffer is bounded (``max_events``, oldest dropped first, with a
+    count of what was shed) so a multi-day run cannot grow host memory
+    without limit; the viewers care about the recent window anyway."""
+
+    def __init__(self, max_events: int = 100_000):
+        self.events: List[dict] = []
+        self.max_events = int(max_events)
+        self.dropped = 0
+        self._lock = threading.Lock()
+        self._origin = time.perf_counter()
+
+    def add(self, name: str, t0: float, t1: float, **extra) -> None:
+        ev = {"name": name, "ph": "X", "cat": "deepspeed_tpu",
+              "ts": (t0 - self._origin) * 1e6,       # chrome wants µs
+              "dur": max(t1 - t0, 0.0) * 1e6,
+              "pid": os.getpid(), "tid": threading.get_ident()}
+        if extra:
+            ev["args"] = extra
+        with self._lock:
+            self.events.append(ev)
+            if len(self.events) > self.max_events:
+                shed = len(self.events) - self.max_events
+                del self.events[:shed]
+                self.dropped += shed
+
+    def dump(self, path: str) -> str:
+        with self._lock:
+            payload = {"traceEvents": list(self.events),
+                       "displayTimeUnit": "ms"}
+            if self.dropped:
+                payload["otherData"] = {
+                    "dropped_events": self.dropped,
+                    "note": "oldest events shed by the bounded buffer"}
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)  # readable mid-run, never half-written
+        return path
+
+
+_default_recorder: Optional[ChromeTraceRecorder] = None
+
+
+def set_default_recorder(rec: Optional[ChromeTraceRecorder]) -> None:
+    global _default_recorder
+    _default_recorder = rec
+
+
+def get_default_recorder() -> Optional[ChromeTraceRecorder]:
+    return _default_recorder
+
+
+@contextmanager
+def trace_span(name: str, recorder: Optional[ChromeTraceRecorder] = None,
+               **extra):
+    """Context manager wrapping a phase in both sinks. Never raises from
+    instrumentation: a missing/odd jax profiler degrades to timing-only."""
+    rec = recorder if recorder is not None else _default_recorder
+    try:
+        import jax.profiler as _jp
+        annotation = _jp.TraceAnnotation(name)
+    except Exception:
+        annotation = None
+    t0 = time.perf_counter()
+    if annotation is not None:
+        try:
+            annotation.__enter__()
+        except Exception:
+            annotation = None  # profiler refused to start: timing-only
+    try:
+        yield
+    finally:
+        if annotation is not None:
+            try:
+                annotation.__exit__(None, None, None)
+            except Exception:
+                pass
+        if rec is not None:
+            rec.add(name, t0, time.perf_counter(), **extra)

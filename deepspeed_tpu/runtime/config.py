@@ -348,18 +348,56 @@ def get_quantized_comm_config(param_dict):
     }
 
 
-def get_profiler_config(param_dict):
-    """TPU-native profiling: jax.profiler trace window (SURVEY.md §5)."""
-    sub = param_dict.get(C.PROFILER, {})
-    return {
-        "enabled": sub.get(C.PROFILER_ENABLED, C.PROFILER_ENABLED_DEFAULT),
-        "output_path": sub.get(C.PROFILER_OUTPUT_PATH,
-                               C.PROFILER_OUTPUT_PATH_DEFAULT),
-        "start_step": sub.get(C.PROFILER_START_STEP,
-                              C.PROFILER_START_STEP_DEFAULT),
-        "num_steps": sub.get(C.PROFILER_NUM_STEPS,
-                             C.PROFILER_NUM_STEPS_DEFAULT),
+def get_observability_config(param_dict):
+    """Unified profiling & telemetry (deepspeed_tpu/profiling/): FLOPs/MFU
+    cost profiler, recompile tracking, memory watermarks, trace spans,
+    and the JSONL event log tools/obs_report.py renders.
+
+    The legacy top-level ``profiler: {}`` section (jax.profiler trace
+    window) is aliased into ``observability.trace``: its keys seed the
+    defaults and any explicit ``observability.trace`` key wins — same
+    pattern as the compressed_allreduce -> quantized_comm alias.
+    """
+    legacy_trace = param_dict.get(C.PROFILER, {})
+    sub = param_dict.get(C.OBSERVABILITY, {})
+    tr = sub.get(C.OBS_TRACE, {})
+    trace = {
+        "enabled": tr.get(
+            C.PROFILER_ENABLED,
+            legacy_trace.get(C.PROFILER_ENABLED,
+                             C.PROFILER_ENABLED_DEFAULT)),
+        "output_path": tr.get(
+            C.PROFILER_OUTPUT_PATH,
+            legacy_trace.get(C.PROFILER_OUTPUT_PATH,
+                             C.PROFILER_OUTPUT_PATH_DEFAULT)),
+        "start_step": tr.get(
+            C.PROFILER_START_STEP,
+            legacy_trace.get(C.PROFILER_START_STEP,
+                             C.PROFILER_START_STEP_DEFAULT)),
+        "num_steps": tr.get(
+            C.PROFILER_NUM_STEPS,
+            legacy_trace.get(C.PROFILER_NUM_STEPS,
+                             C.PROFILER_NUM_STEPS_DEFAULT)),
     }
+    return {
+        "enabled": sub.get(C.OBS_ENABLED, C.OBS_ENABLED_DEFAULT),
+        "events_dir": sub.get(C.OBS_EVENTS_DIR, C.OBS_EVENTS_DIR_DEFAULT),
+        "flops_profiler": sub.get(C.OBS_FLOPS_PROFILER,
+                                  C.OBS_FLOPS_PROFILER_DEFAULT),
+        "memory_watermarks": sub.get(C.OBS_MEMORY_WATERMARKS,
+                                     C.OBS_MEMORY_WATERMARKS_DEFAULT),
+        "recompile_warn_after": sub.get(C.OBS_RECOMPILE_WARN_AFTER,
+                                        C.OBS_RECOMPILE_WARN_AFTER_DEFAULT),
+        "chrome_trace_path": sub.get(C.OBS_CHROME_TRACE_PATH,
+                                     C.OBS_CHROME_TRACE_PATH_DEFAULT),
+        "trace": trace,
+    }
+
+
+def get_profiler_config(param_dict):
+    """Legacy accessor: the jax.profiler trace window, now owned by
+    observability.trace (this returns the same aliased dict)."""
+    return get_observability_config(param_dict)["trace"]
 
 
 def get_compile_cache_config(param_dict):
@@ -483,7 +521,10 @@ class DeepSpeedConfig:
         self.scheduler_params = get_scheduler_params(param_dict)
 
         self.wall_clock_breakdown = get_wall_clock_breakdown(param_dict)
-        self.profiler_config = get_profiler_config(param_dict)
+        self.observability_config = get_observability_config(param_dict)
+        # legacy attribute: the jax.profiler trace window, aliased into
+        # observability.trace (scripts written against it keep working)
+        self.profiler_config = self.observability_config["trace"]
         self.compile_cache_config = get_compile_cache_config(param_dict)
         self.quantized_comm_config = get_quantized_comm_config(param_dict)
         # legacy attribute name, kept for scripts written against it
@@ -620,6 +661,19 @@ class DeepSpeedConfig:
                     "quantized_comm.hierarchical does not compose with "
                     "OnebitAdam (its compressed exchange is written "
                     "against the flat 'data' axis)")
+        obs = self.observability_config
+        if int(obs["recompile_warn_after"]) < 0:
+            raise DeepSpeedConfigError(
+                "observability.recompile_warn_after must be >= 0, got "
+                f"{obs['recompile_warn_after']}")
+        if obs["enabled"] and not isinstance(obs["events_dir"], str):
+            raise DeepSpeedConfigError(
+                "observability.events_dir must be a path string, got "
+                f"{type(obs['events_dir']).__name__}")
+        if obs["trace"]["enabled"] and int(obs["trace"]["num_steps"]) < 1:
+            raise DeepSpeedConfigError(
+                "observability.trace.num_steps must be >= 1 when the "
+                "trace window is enabled")
         if qc["quantize_weights"] and not self.zero_enabled:
             logger.warning(
                 "quantized_comm.quantize_weights has no effect at ZeRO "

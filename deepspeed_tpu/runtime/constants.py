@@ -212,6 +212,43 @@ PROFILER_NUM_STEPS = "num_steps"
 PROFILER_NUM_STEPS_DEFAULT = 3
 
 #############################################
+# Unified observability (deepspeed_tpu/profiling/): FLOPs/MFU cost
+# profiler, recompile tracking, HBM watermarks, trace spans, and the
+# crash-safe JSONL event log that tools/obs_report.py renders. The
+# legacy top-level "profiler" section above is aliased as
+# observability.trace (its keys seed the defaults; explicit
+# observability.trace keys win), mirroring the
+# compressed_allreduce -> quantized_comm aliasing.
+#
+# "observability": {
+#   "enabled": false,
+#   "events_dir": "/tmp/deepspeed_tpu_obs",  # events.jsonl location
+#   "flops_profiler": true,      # cost-analysis FLOPs/MFU record
+#   "memory_watermarks": true,   # structured memory_stats() scalars
+#   "recompile_warn_after": 1,   # warn on recompiles past this step
+#   "chrome_trace_path": "",     # span timeline JSON ("" disables)
+#   "trace": {                   # jax.profiler window (legacy "profiler")
+#     "enabled": false, "output_path": "/tmp/deepspeed_tpu_trace",
+#     "start_step": 2, "num_steps": 3
+#   }
+# }
+#############################################
+OBSERVABILITY = "observability"
+OBS_ENABLED = "enabled"
+OBS_ENABLED_DEFAULT = False
+OBS_EVENTS_DIR = "events_dir"
+OBS_EVENTS_DIR_DEFAULT = "/tmp/deepspeed_tpu_obs"
+OBS_FLOPS_PROFILER = "flops_profiler"
+OBS_FLOPS_PROFILER_DEFAULT = True
+OBS_MEMORY_WATERMARKS = "memory_watermarks"
+OBS_MEMORY_WATERMARKS_DEFAULT = True
+OBS_RECOMPILE_WARN_AFTER = "recompile_warn_after"
+OBS_RECOMPILE_WARN_AFTER_DEFAULT = 1
+OBS_CHROME_TRACE_PATH = "chrome_trace_path"
+OBS_CHROME_TRACE_PATH_DEFAULT = ""
+OBS_TRACE = "trace"
+
+#############################################
 # Persistent XLA compilation cache (TPU-native: first jit of a large
 # model costs tens of seconds — and minutes through a remote-compile
 # tunnel; caching the compiled executable on disk makes re-runs,

@@ -459,11 +459,22 @@ class DeepSpeedEngine:
             num_workers=self.dp_world_size,
             steps_per_output=self._config.steps_per_print)
         self.wall_clock_breakdown_enabled = self._config.wall_clock_breakdown
-        # jax.profiler trace window (config 'profiler' section; the
-        # reference's analog is the wall_clock_breakdown timer ladder —
-        # on TPU the XLA trace is the actionable artifact, SURVEY.md §5)
+        # jax.profiler trace window ('observability.trace', legacy
+        # 'profiler' section aliased; the reference's analog is the
+        # wall_clock_breakdown timer ladder — on TPU the XLA trace is
+        # the actionable artifact, SURVEY.md §5)
         self._profiler_cfg = self._config.profiler_config
         self._profiler_active = False
+        # unified profiling & telemetry ('observability' config section):
+        # FLOPs/MFU cost profiler, recompile tracking, memory watermarks,
+        # trace spans, JSONL event log (deepspeed_tpu/profiling/)
+        from deepspeed_tpu.profiling import Observer
+        self.observability = Observer(
+            self._config.observability_config, monitor=self.monitor,
+            rank=jax.process_index(), device=jax.local_devices()[0],
+            num_devices=len(jax.devices()))
+        self.observability.set_step_provider(
+            lambda: self._host_global_step)
         # fault-tolerant checkpointing knobs ('checkpoint' config section):
         # CRC verification on load, retention, transient-I/O retry policy
         self._ckpt_cfg = self._config.checkpoint_config
@@ -1404,8 +1415,11 @@ class DeepSpeedEngine:
 
     def _get_compiled_micro_step(self):
         if self._compiled_micro_step is None:
-            self._compiled_micro_step = jax.jit(self._micro_step,
-                                                donate_argnums=(0,))
+            # wrap_jit is identity with observability off; on, it counts
+            # compiles + wall time and flags steady-state recompiles
+            self._compiled_micro_step = self.observability.wrap_jit(
+                jax.jit(self._micro_step, donate_argnums=(0,)),
+                "micro_step")
         return self._compiled_micro_step
 
     # ------------------------------------------------------------------ #
@@ -1442,8 +1456,10 @@ class DeepSpeedEngine:
                     loss, aux, grads = self._compute_loss_and_grads(
                         state.params, batch, sub, state.loss_scale.scale)
                 return loss, grads, rng
-            self._compiled_grad = jax.jit(fwd)
-        out = self._compiled_grad(self.state, batch)
+            self._compiled_grad = self.observability.wrap_jit(
+                jax.jit(fwd), "grad")
+        with self.observability.span("forward"):
+            out = self._compiled_grad(self.state, batch)
         if self._sparse_grad_paths and not self._onebit_dist:
             loss, grads, rng, self._csr_overflow = out
         else:
@@ -1465,6 +1481,13 @@ class DeepSpeedEngine:
             "backward() must follow forward() on the same micro batch"
         if self.wall_clock_breakdown_enabled:
             self.timers("backward").start()
+        with self.observability.span("backward"):
+            self._backward_inner()
+        if self.wall_clock_breakdown_enabled:
+            self.timers("backward").stop()
+        return loss
+
+    def _backward_inner(self):
         grads = self._cached_grads
         self._cached_grads = None
         if self.zero_cpu_offload and self.gradient_accumulation_steps == 1:
@@ -1484,9 +1507,6 @@ class DeepSpeedEngine:
             self._pending_grads = grads
             self.state = self.state._replace(
                 micro_step=self.state.micro_step + 1)
-        if self.wall_clock_breakdown_enabled:
-            self.timers("backward").stop()
-        return loss
 
     # -- ZeRO-Offload boundary, split so the host Adam can overlap the
     # -- next window's device compute (reference overlaps D2H/H2D on side
@@ -1646,15 +1666,17 @@ class DeepSpeedEngine:
         if self._compiled_apply is None:
             if ga > 1:
                 # grads live inside the (donated) state as accum_grads
-                self._compiled_apply = jax.jit(
+                apply = jax.jit(
                     lambda s: self._apply_update(s, s.accum_grads),
                     donate_argnums=(0,))
             else:
-                self._compiled_apply = jax.jit(self._apply_update,
-                                               donate_argnums=(0,))
+                apply = jax.jit(self._apply_update, donate_argnums=(0,))
+            self._compiled_apply = self.observability.wrap_jit(apply,
+                                                               "apply")
         if ga > 1:
             if self.is_gradient_accumulation_boundary():
-                self.state = self._compiled_apply(self.state)
+                with self.observability.span("step"):
+                    self.state = self._compiled_apply(self.state)
                 self._host_global_step += 1
                 self._check_csr_overflow()
                 self._report_progress()
@@ -1663,7 +1685,8 @@ class DeepSpeedEngine:
             grads = getattr(self, "_pending_grads", None)
             assert grads is not None, "step() must follow backward()"
             self._pending_grads = None
-            self.state = self._compiled_apply(self.state, grads)
+            with self.observability.span("step"):
+                self.state = self._compiled_apply(self.state, grads)
             self._host_global_step += 1
             self._check_csr_overflow()
             self._report_progress()
@@ -1697,26 +1720,34 @@ class DeepSpeedEngine:
         total = None
         offload_direct = (self.zero_cpu_offload and
                           self.gradient_accumulation_steps == 1)
-        for _ in range(self.gradient_accumulation_steps):
-            batch = next(data_iter)
-            self.state, out = step_fn(self.state, batch)
-            if offload_direct:
-                out, self._offload_grads_device = out
-            if self._sparse_grad_paths and not self._onebit_dist:
-                loss, self._csr_overflow = out
-            else:
-                loss = out
-            total = loss if total is None else total + loss
-        if self.zero_cpu_offload:
-            if self._offload_overlap:
-                self._host_apply_update_overlapped()
-            else:
-                self._host_apply_update()
+        with self.observability.span("train_batch"):
+            for _ in range(self.gradient_accumulation_steps):
+                batch = next(data_iter)
+                self.state, out = step_fn(self.state, batch)
+                if offload_direct:
+                    out, self._offload_grads_device = out
+                if self._sparse_grad_paths and not self._onebit_dist:
+                    loss, self._csr_overflow = out
+                else:
+                    loss = out
+                total = loss if total is None else total + loss
+            if self.zero_cpu_offload:
+                if self._offload_overlap:
+                    self._host_apply_update_overlapped()
+                else:
+                    self._host_apply_update()
         self.tput_timer.stop()
         self._last_step_time_ms = (time.perf_counter() - _t_step0) * 1e3
         mean_loss = total / self.gradient_accumulation_steps
         self._host_micro_step += self.gradient_accumulation_steps
         self._host_global_step += 1
+        # one-time FLOPs/MFU cost profile of the compiled micro-step —
+        # OUTSIDE the timed window (it is an AOT re-compile); only the
+        # last micro-batch's shapes are read, never its (donated) buffers
+        if self.observability.wants_flops_profile("micro_step"):
+            self.observability.maybe_profile_flops(
+                "micro_step", step_fn, (self.state, batch),
+                samples=self._host_global_step * self.train_batch_size())
         self._check_csr_overflow()
         self._report_progress()
         self._write_monitor(mean_loss)
@@ -1731,8 +1762,11 @@ class DeepSpeedEngine:
                 out = (self._loss_fn(cp, batch, rng) if self._loss_takes_rng
                        else self._loss_fn(cp, batch))
                 return out[0] if isinstance(out, tuple) else out
-            self._compiled_eval = jax.jit(ev)
-        return self._compiled_eval(self.state.params, batch, self.state.rng)
+            self._compiled_eval = self.observability.wrap_jit(
+                jax.jit(ev), "eval")
+        with self.observability.span("eval"):
+            return self._compiled_eval(self.state.params, batch,
+                                       self.state.rng)
 
     def _maybe_profile_step(self):
         """Start/stop a jax.profiler trace window around the configured
@@ -1798,7 +1832,7 @@ class DeepSpeedEngine:
     def _write_monitor(self, loss=None):
         """reference engine.py:780-790/:922-936: loss/lr/scale scalars,
         x-axis = cumulative samples (forces a loss sync; opt-in)."""
-        if not self.monitor.enabled:
+        if not (self.monitor.enabled or self.observability.enabled):
             return
         samples = self._host_global_step * self.train_batch_size()
         self.monitor.write_train_metrics(
@@ -1809,11 +1843,22 @@ class DeepSpeedEngine:
         if self._last_step_time_ms is not None:
             self.monitor.write_timer_values(
                 {"step_time_ms": self._last_step_time_ms}, samples)
+            # throughput next to the step time it derives from (the
+            # tput_timer's average only prints; this lands in the record)
+            if self._last_step_time_ms > 0:
+                self.monitor.write_scalar(
+                    "Train/Samples/samples_per_sec",
+                    self.train_batch_size() /
+                    (self._last_step_time_ms / 1e3), samples)
         if self._comm_stats is not None:
             self.monitor.write_comm_metrics(
                 bytes_per_step=self._comm_stats["bytes_per_step"],
                 compression_ratio=self._comm_stats["compression_ratio"],
                 samples=samples)
+        # MFU / recompile counters / memory watermarks / trace refresh
+        self.observability.on_step(
+            samples=samples, step_time_ms=self._last_step_time_ms,
+            micro_steps_per_step=self.gradient_accumulation_steps)
 
     def _report_progress(self):
         # gate on the host mirror: no device sync unless actually printing

@@ -188,16 +188,24 @@ class PipelineEngine(DeepSpeedEngine):
             data_iter = self._train_iter
 
         self._maybe_profile_step()
-        batch = self._stack_micro_batches(data_iter)
+        with self.observability.span("pipe/stack_batch"):
+            batch = self._stack_micro_batches(data_iter)
         step_fn = self._get_compiled_micro_step()
         self.tput_timer.start()
         import time as _time
         _t0 = _time.perf_counter()
-        self.state, loss = step_fn(self.state, batch)
+        with self.observability.span("pipe/train_batch"):
+            self.state, loss = step_fn(self.state, batch)
         self.tput_timer.stop()
         self._last_step_time_ms = (_time.perf_counter() - _t0) * 1e3
         self._host_micro_step += self.micro_batches
         self._host_global_step += 1
+        # the pipelined program consumes the WHOLE accumulation window in
+        # one dispatch, so its cost profile is already per optimizer step
+        if self.observability.wants_flops_profile("micro_step"):
+            self.observability.maybe_profile_flops(
+                "micro_step", step_fn, (self.state, batch),
+                samples=self._host_global_step * self.train_batch_size())
         self._report_progress()
         self._write_monitor(loss)  # tensorboard (reference pipe :283-292)
         return loss
@@ -208,10 +216,12 @@ class PipelineEngine(DeepSpeedEngine):
         if not hasattr(self, "_compiled_pipe_eval"):
             def ev(params, batch, rng):
                 return self._loss_fn(self._cast_for_loss(params), batch, rng)
-            self._compiled_pipe_eval = jax.jit(ev)
+            self._compiled_pipe_eval = self.observability.wrap_jit(
+                jax.jit(ev), "pipe_eval")
         batch = self._stack_micro_batches(data_iter)
-        return self._compiled_pipe_eval(self.state.params, batch,
-                                        self.state.rng)
+        with self.observability.span("pipe/eval_batch"):
+            return self._compiled_pipe_eval(self.state.params, batch,
+                                            self.state.rng)
 
     # ---------------- checkpoint layout portability ----------------- #
     # stage weights are stored in the V-dependent interleaved layout

@@ -25,21 +25,60 @@ __all__ = ["TensorBoardMonitor", "get_summary_writer"]
 
 
 class _JsonlWriter:
-    """Fallback SummaryWriter look-alike: one JSON object per scalar."""
+    """Fallback SummaryWriter look-alike: one JSON object per scalar.
+
+    Crash-safe by construction: the file is opened line-buffered, so
+    every record hits the OS the moment it is written — a preempted run
+    loses at most the line being formatted, never a buffered backlog.
+    Also usable as a context manager, and the fd is reclaimed on GC
+    (``__del__``) so abandoned writers don't leak descriptors.
+
+    Schema (pinned by tests/unit/test_monitor.py; tools/obs_report.py
+    relies on it): scalar rows are ``{"tag": str, "value": float,
+    "step": int}``; structured rows carry ``{"event": str, ...}``.
+    """
 
     def __init__(self, log_dir: str):
         os.makedirs(log_dir, exist_ok=True)
-        self._f = open(os.path.join(log_dir, "events.jsonl"), "a")
+        self.path = os.path.join(log_dir, "events.jsonl")
+        self._f = open(self.path, "a", buffering=1)
 
     def add_scalar(self, tag, value, step):
+        if self._f is None:
+            return
         self._f.write(json.dumps(
-            {"tag": tag, "value": float(value), "step": int(step)}) + "\n")
+            {"tag": str(tag), "value": float(value), "step": int(step)})
+            + "\n")
+
+    def add_event(self, kind, **fields):
+        """One structured (non-scalar) record, e.g. a compile event."""
+        if self._f is None:
+            return
+        row = {"event": str(kind)}
+        row.update(fields)
+        self._f.write(json.dumps(row, default=str) + "\n")
 
     def flush(self):
-        self._f.flush()
+        if self._f is not None:
+            self._f.flush()
 
     def close(self):
-        self._f.close()
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 def _make_writer(log_dir: str):
@@ -70,12 +109,19 @@ def get_summary_writer(name: str = "DeepSpeedTPUJobName",
 
 
 class TensorBoardMonitor:
-    """Engine-facing wrapper: no-ops unless enabled and on rank 0."""
+    """Engine-facing wrapper: no-ops unless enabled and on rank 0.
+
+    ``mirror`` (optional, set by the observability layer) receives a
+    copy of every scalar — typically a :class:`_JsonlWriter` — so one
+    crash-safe ``events.jsonl`` records the full run even when the
+    tensorboard writer is the binary torch one (or disabled entirely).
+    """
 
     def __init__(self, enabled: bool, output_path: Optional[str] = None,
                  job_name: Optional[str] = None, rank: int = 0):
         self.enabled = bool(enabled) and rank == 0
         self.writer = None
+        self.mirror = None
         if self.enabled:
             if output_path:
                 self.writer = _make_writer(os.path.join(
@@ -84,15 +130,20 @@ class TensorBoardMonitor:
                 self.writer = get_summary_writer(
                     name=job_name or "DeepSpeedTPUJobName")
 
+    def _writes(self) -> bool:
+        return self.writer is not None or self.mirror is not None
+
     def write_scalar(self, tag: str, value, step: int):
         if self.writer is not None:
             self.writer.add_scalar(tag, float(value), int(step))
+        if self.mirror is not None:
+            self.mirror.add_scalar(tag, float(value), int(step))
 
     def write_train_metrics(self, *, loss=None, lr=None, loss_scale=None,
                             samples: int = 0):
         """The reference's per-step scalars (engine.py:780-790, 922-936):
         x-axis is cumulative sample count."""
-        if self.writer is None:
+        if not self._writes():
             return
         if loss is not None:
             self.write_scalar("Train/Samples/train_loss", loss, samples)
@@ -108,7 +159,7 @@ class TensorBoardMonitor:
         """Checkpoint durability telemetry: ``save``/``load`` durations and
         ``fallback`` events (a tag skipped as uncommitted or corrupt), so
         preemption recovery is visible on the same samples x-axis as loss."""
-        if self.writer is None:
+        if not self._writes():
             return
         if duration_ms is not None:
             self.write_scalar(f"Train/Samples/checkpoint_{action}_ms",
@@ -124,7 +175,7 @@ class TensorBoardMonitor:
         the compression ratio vs a dense fp32 ring allreduce — so a
         quantized_comm config change shows up on the same samples x-axis
         as loss/throughput."""
-        if self.writer is None:
+        if not self._writes():
             return
         if bytes_per_step is not None:
             self.write_scalar("Train/Samples/comm_bytes_per_step",
@@ -132,6 +183,9 @@ class TensorBoardMonitor:
         if compression_ratio is not None:
             self.write_scalar("Train/Samples/comm_compression_ratio",
                               compression_ratio, samples)
+        # like every other write_* method: without the flush, comm
+        # telemetry buffered in the writer is lost on crash/preemption
+        self.flush()
 
     def write_timer_values(self, timer_values: dict, samples: int = 0):
         """Per-timer milliseconds (engine.py:950-974 pattern)."""
@@ -141,8 +195,11 @@ class TensorBoardMonitor:
     def flush(self):
         if self.writer is not None:
             self.writer.flush()
+        if self.mirror is not None:
+            self.mirror.flush()
 
     def close(self):
         if self.writer is not None:
             self.writer.close()
             self.writer = None
+        self.mirror = None  # owned by the observability layer, not closed

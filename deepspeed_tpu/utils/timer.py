@@ -78,15 +78,27 @@ class SynchronizedWallClockTimer:
         return self.timers[name]
 
     @staticmethod
-    def memory_usage() -> str:
+    def memory_stats() -> Optional[dict]:
+        """Structured device-memory sample: ``{"bytes_in_use",
+        "peak_bytes_in_use", "source"}`` (``source: "host"`` = RSS
+        fallback on backends without allocator stats), or None when
+        nothing is readable. The observability layer writes these as
+        per-step scalars (profiling/memory.py owns the sampling)."""
         try:
-            import jax
-            stats = jax.local_devices()[0].memory_stats() or {}
-            in_use = stats.get("bytes_in_use", 0) / (1024**3)
-            peak = stats.get("peak_bytes_in_use", 0) / (1024**3)
-            return f"mem in_use={in_use:.2f} GB peak={peak:.2f} GB"
+            from deepspeed_tpu.profiling.memory import memory_snapshot
+            return memory_snapshot()
         except Exception:
+            return None
+
+    @staticmethod
+    def memory_usage() -> str:
+        stats = SynchronizedWallClockTimer.memory_stats()
+        if stats is None:
             return "mem stats unavailable"
+        in_use = stats["bytes_in_use"] / (1024**3)
+        peak = stats["peak_bytes_in_use"] / (1024**3)
+        src = "" if stats["source"] == "device" else f" ({stats['source']})"
+        return f"mem in_use={in_use:.2f} GB peak={peak:.2f} GB{src}"
 
     def log(self, names: List[str], normalizer: float = 1.0, reset: bool = True,
             ranks: Optional[List[int]] = None, memory_breakdown: bool = False):

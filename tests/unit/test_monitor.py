@@ -23,6 +23,106 @@ def test_jsonl_writer(tmp_path):
                         "step": 10}
 
 
+def test_jsonl_schema_pinned(tmp_path):
+    """tools/obs_report.py parses this log offline: the scalar row is
+    exactly {"tag": str, "value": float, "step": int} (values coerced),
+    and structured rows carry {"event": str, ...}."""
+    w = _JsonlWriter(str(tmp_path))
+    w.add_scalar("t", np.float32(1.5), np.int64(7))   # numpy in, json out
+    w.add_event("compile", fn="micro_step", wall_ms=12.5)
+    w.close()
+    lines = [json.loads(l) for l in
+             open(os.path.join(tmp_path, "events.jsonl"))]
+    scalar, event = lines
+    assert set(scalar) == {"tag", "value", "step"}
+    assert type(scalar["tag"]) is str
+    assert type(scalar["value"]) is float and scalar["value"] == 1.5
+    assert type(scalar["step"]) is int and scalar["step"] == 7
+    assert event["event"] == "compile" and event["wall_ms"] == 12.5
+
+
+def test_jsonl_writer_crash_safe_line_buffering(tmp_path):
+    """Rows must be on disk WITHOUT flush()/close(): a preempted run
+    keeps its telemetry (the writer opens line-buffered)."""
+    w = _JsonlWriter(str(tmp_path))
+    w.add_scalar("a", 1.0, 1)
+    # no flush, no close — read through a separate fd
+    lines = open(os.path.join(tmp_path, "events.jsonl")).readlines()
+    assert len(lines) == 1 and json.loads(lines[0])["tag"] == "a"
+    w.close()
+
+
+def test_jsonl_writer_context_manager_and_double_close(tmp_path):
+    with _JsonlWriter(str(tmp_path)) as w:
+        w.add_scalar("a", 1.0, 1)
+    assert w._f is None
+    w.close()                      # idempotent
+    w.add_scalar("b", 2.0, 2)      # post-close writes are dropped, not a crash
+    w.flush()
+    lines = open(os.path.join(tmp_path, "events.jsonl")).readlines()
+    assert len(lines) == 1
+
+
+def test_jsonl_writer_del_closes_fd(tmp_path):
+    w = _JsonlWriter(str(tmp_path))
+    f = w._f
+    del w
+    import gc
+    gc.collect()
+    assert f.closed
+
+
+def test_comm_metrics_flushed(tmp_path, monkeypatch):
+    """write_comm_metrics was the only write_* method that never
+    flushed — comm telemetry died with the process. Now it flushes like
+    the rest."""
+    import deepspeed_tpu.utils.monitor as mon
+
+    class CountingWriter(_JsonlWriter):
+        flushes = 0
+
+        def flush(self):
+            CountingWriter.flushes += 1
+            super().flush()
+
+    monkeypatch.setattr(mon, "_make_writer",
+                        lambda log_dir: CountingWriter(log_dir))
+    m = TensorBoardMonitor(enabled=True, output_path=str(tmp_path),
+                           job_name="job")
+    m.write_comm_metrics(bytes_per_step=1024.0, compression_ratio=2.0,
+                         samples=8)
+    assert CountingWriter.flushes >= 1
+    m.close()
+    lines = [json.loads(l) for l in
+             open(os.path.join(tmp_path, "job", "events.jsonl"))]
+    tags = {l["tag"]: l["value"] for l in lines}
+    assert tags["Train/Samples/comm_bytes_per_step"] == 1024.0
+    assert tags["Train/Samples/comm_compression_ratio"] == 2.0
+
+
+def test_monitor_mirror_receives_all_scalars(tmp_path):
+    """The observability layer attaches a JSONL mirror: every monitor
+    scalar (train metrics, checkpoint events, comm bytes) lands there
+    even when tensorboard itself is disabled."""
+    m = TensorBoardMonitor(enabled=False)
+    assert m.writer is None
+    mirror = _JsonlWriter(str(tmp_path))
+    m.mirror = mirror
+    m.write_train_metrics(loss=1.25, lr=1e-3, loss_scale=1.0, samples=4)
+    m.write_checkpoint_event(action="save", ok=True, duration_ms=9.0,
+                             samples=4)
+    m.write_comm_metrics(bytes_per_step=77.0, samples=4)
+    m.close()                      # must NOT close the (borrowed) mirror
+    assert m.mirror is None and mirror._f is not None
+    mirror.close()
+    tags = {json.loads(l)["tag"] for l in
+            open(os.path.join(tmp_path, "events.jsonl"))}
+    assert {"Train/Samples/train_loss", "Train/Samples/lr",
+            "Train/Samples/checkpoint_save_ms",
+            "Train/Samples/checkpoint_save_ok",
+            "Train/Samples/comm_bytes_per_step"} <= tags
+
+
 def test_monitor_disabled_noops():
     m = TensorBoardMonitor(enabled=False)
     assert m.writer is None

@@ -1,0 +1,123 @@
+"""utils/timer.py semantics (ISSUE-3 satellite): Timer_ elapsed/reset
+behavior the engine's wall_clock_breakdown ladder depends on, the
+structured memory_stats + memory_usage fallback, and ThroughputTimer
+averaging."""
+
+import time
+
+import pytest
+
+from deepspeed_tpu.utils.timer import (SynchronizedWallClockTimer,
+                                       ThroughputTimer, Timer_)
+
+
+def _spin(ms):
+    t0 = time.perf_counter()
+    while (time.perf_counter() - t0) * 1e3 < ms:
+        pass
+
+
+class TestTimer:
+    def test_start_stop_accumulates(self):
+        t = Timer_("t", synchronize=False)
+        t.start(); _spin(2); t.stop()
+        first = t.elapsed(reset=False)
+        assert first >= 0.002
+        t.start(); _spin(2); t.stop()
+        assert t.elapsed(reset=False) >= first + 0.002
+
+    def test_stop_reset_replaces_instead_of_accumulating(self):
+        t = Timer_("t", synchronize=False)
+        t.start(); _spin(5); t.stop()
+        t.start(); _spin(1); t.stop(reset=True)
+        assert t.elapsed(reset=False) < 0.005
+
+    def test_elapsed_reset_true_zeroes(self):
+        t = Timer_("t", synchronize=False)
+        t.start(); _spin(2); t.stop()
+        assert t.elapsed(reset=True) >= 0.002
+        assert t.elapsed(reset=False) == 0.0
+
+    def test_elapsed_reset_false_preserves(self):
+        t = Timer_("t", synchronize=False)
+        t.start(); _spin(2); t.stop()
+        v = t.elapsed(reset=False)
+        assert t.elapsed(reset=False) == v
+
+    def test_elapsed_while_running_restarts_the_timer(self):
+        """elapsed() on a RUNNING timer stops, reads, and restarts — the
+        reference's mid-window read semantics (timer.py:56-65)."""
+        t = Timer_("t", synchronize=False)
+        t.start()
+        _spin(2)
+        v = t.elapsed(reset=True)
+        assert v >= 0.002
+        assert t.started_            # restarted after the read
+        t.stop()
+
+    def test_double_start_asserts(self):
+        t = Timer_("t", synchronize=False)
+        t.start()
+        with pytest.raises(AssertionError):
+            t.start()
+        t.stop()
+        with pytest.raises(AssertionError):
+            t.stop()
+
+    def test_group_creates_and_caches(self):
+        timers = SynchronizedWallClockTimer(synchronize=False)
+        a = timers("fwd")
+        assert timers("fwd") is a
+        a.start(); a.stop()
+        timers.log(["fwd", "missing-is-skipped"], ranks=[0])
+
+
+class TestMemoryUsage:
+    def test_memory_stats_structured(self):
+        stats = SynchronizedWallClockTimer.memory_stats()
+        assert stats is not None
+        assert stats["source"] in ("device", "host")
+        assert stats["bytes_in_use"] > 0
+        assert stats["peak_bytes_in_use"] >= stats["bytes_in_use"] or \
+            stats["peak_bytes_in_use"] > 0
+
+    def test_memory_usage_string(self):
+        s = SynchronizedWallClockTimer.memory_usage()
+        assert "mem in_use=" in s and "peak=" in s
+
+    def test_memory_usage_fallback_when_everything_fails(self, monkeypatch):
+        import deepspeed_tpu.utils.timer as timer_mod
+        monkeypatch.setattr(
+            timer_mod.SynchronizedWallClockTimer, "memory_stats",
+            staticmethod(lambda: None))
+        assert SynchronizedWallClockTimer.memory_usage() == \
+            "mem stats unavailable"
+
+    def test_memory_usage_labels_host_fallback(self, monkeypatch):
+        import deepspeed_tpu.utils.timer as timer_mod
+        monkeypatch.setattr(
+            timer_mod.SynchronizedWallClockTimer, "memory_stats",
+            staticmethod(lambda: {"bytes_in_use": 2 << 30,
+                                  "peak_bytes_in_use": 3 << 30,
+                                  "source": "host"}))
+        s = SynchronizedWallClockTimer.memory_usage()
+        assert s == "mem in_use=2.00 GB peak=3.00 GB (host)"
+
+
+class TestThroughputTimer:
+    def test_avg_samples_per_sec(self):
+        t = ThroughputTimer(batch_size=8, num_workers=2, start_step=1,
+                            steps_per_output=10**9,
+                            logging_fn=lambda *a, **k: None)
+        assert t.avg_samples_per_sec() == float("-1")   # before warmup
+        for _ in range(4):
+            t.start(); _spin(1); t.stop()
+        sps = t.avg_samples_per_sec()
+        assert sps > 0
+        # 16 samples per >=1ms step: bounded above by 16/1ms
+        assert sps <= 16 / 0.001
+
+    def test_stop_without_start_is_noop(self):
+        t = ThroughputTimer(batch_size=4, logging_fn=lambda *a, **k: None)
+        t.stop()
+        assert t.total_step_count == 0

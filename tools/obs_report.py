@@ -1,0 +1,264 @@
+#!/usr/bin/env python
+"""Render a run's observability event log into a summary.
+
+Reads the JSONL event log written by the monitor / observability layer
+(``events.jsonl``: scalar rows ``{"tag", "value", "step"}`` plus
+structured rows ``{"event", ...}`` — schema pinned by
+tests/unit/test_monitor.py) and prints the run report:
+
+- step-time p50/p95, samples/s
+- model FLOPs per step, MFU
+- comm bytes per step & compression ratio
+- recompile count (+ per-function compile wall time)
+- memory watermarks (peak / last in-use)
+- checkpoint events (saves / loads / fallbacks)
+- loss trajectory (first -> last)
+
+Usage::
+
+    python tools/obs_report.py <events.jsonl | dir containing it> [--json]
+
+Pure-stdlib and device-free: runnable on a laptop against a log rsync'd
+off a pod. ``summarize()`` is importable for programmatic use (the
+tier-1 smoke test drives both the function and the CLI).
+"""
+
+import argparse
+import json
+import math
+import os
+import sys
+from collections import defaultdict
+
+# scalar tags (must match deepspeed_tpu/profiling/__init__.py and
+# utils/monitor.py)
+T_STEP_MS = "Train/Samples/step_time_ms"
+T_SPS = "Train/Samples/samples_per_sec"
+T_LOSS = "Train/Samples/train_loss"
+T_COMM_BYTES = "Train/Samples/comm_bytes_per_step"
+T_COMM_RATIO = "Train/Samples/comm_compression_ratio"
+T_FLOPS = "Observability/flops_per_step"
+T_BYTES = "Observability/bytes_accessed"
+T_MFU = "Observability/mfu"
+T_RECOMPILES = "Observability/recompiles"
+T_COMPILE_MS = "Observability/compile_ms_total"
+T_MEM_PEAK = "Memory/peak_bytes_in_use"
+T_MEM_USE = "Memory/bytes_in_use"
+
+
+def find_events_file(path):
+    """Accept the file itself or any directory above it (first match in
+    a sorted walk, so runs with one log resolve deterministically)."""
+    if os.path.isfile(path):
+        return path
+    if os.path.isdir(path):
+        direct = os.path.join(path, "events.jsonl")
+        if os.path.isfile(direct):
+            return direct
+        for dirpath, _dirnames, filenames in sorted(os.walk(path)):
+            if "events.jsonl" in filenames:
+                return os.path.join(dirpath, "events.jsonl")
+    raise FileNotFoundError(f"no events.jsonl under {path!r}")
+
+
+def load_events(path):
+    """(scalars_by_tag, event_rows): scalars as [(step, value)] per tag,
+    malformed lines skipped (a crash can tear the final line)."""
+    scalars = defaultdict(list)
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue
+            if "tag" in row and "value" in row:
+                try:
+                    scalars[str(row["tag"])].append(
+                        (int(row.get("step", 0)), float(row["value"])))
+                except (TypeError, ValueError):
+                    continue
+            elif "event" in row:
+                events.append(row)
+    return dict(scalars), events
+
+
+def percentile(values, q):
+    """Linear-interpolation percentile (numpy-free)."""
+    if not values:
+        return None
+    vs = sorted(values)
+    if len(vs) == 1:
+        return vs[0]
+    pos = (len(vs) - 1) * q
+    lo = math.floor(pos)
+    hi = math.ceil(pos)
+    return vs[lo] + (vs[hi] - vs[lo]) * (pos - lo)
+
+
+def _vals(scalars, tag):
+    return [v for _, v in scalars.get(tag, [])]
+
+
+def _last(scalars, tag):
+    vs = scalars.get(tag)
+    return vs[-1][1] if vs else None
+
+
+def summarize(path):
+    """The report as a plain dict (``render`` turns it into text)."""
+    events_file = find_events_file(path)
+    scalars, events = load_events(events_file)
+
+    step_ms = _vals(scalars, T_STEP_MS)
+    sps = _vals(scalars, T_SPS)
+    loss = _vals(scalars, T_LOSS)
+    mfu = _vals(scalars, T_MFU)
+
+    compile_events = [e for e in events if e.get("event") == "compile"]
+    per_fn = defaultdict(lambda: {"count": 0, "wall_ms": 0.0})
+    for e in compile_events:
+        fn = str(e.get("fn", "?"))
+        per_fn[fn]["count"] += 1
+        try:
+            per_fn[fn]["wall_ms"] += float(e.get("wall_ms", 0.0))
+        except (TypeError, ValueError):
+            pass
+    recompiles = _last(scalars, T_RECOMPILES)
+    if recompiles is None and compile_events:
+        recompiles = float(len(compile_events))
+
+    mem_peak = _vals(scalars, T_MEM_PEAK)
+
+    ckpt = {"saves": 0, "loads": 0, "fallbacks": 0, "save_ms": []}
+    for tag, rows in scalars.items():
+        if tag.endswith("checkpoint_save_ok"):
+            ckpt["saves"] += len(rows)
+        elif tag.endswith("checkpoint_load_ok"):
+            ckpt["loads"] += len(rows)
+        elif tag.endswith("checkpoint_fallback_ok"):
+            ckpt["fallbacks"] += len(rows)
+        elif tag.endswith("checkpoint_save_ms"):
+            ckpt["save_ms"].extend(v for _, v in rows)
+
+    return {
+        "events_file": events_file,
+        "steps": len(step_ms),
+        "step_time_ms": {
+            "p50": percentile(step_ms, 0.50),
+            "p95": percentile(step_ms, 0.95),
+            "mean": sum(step_ms) / len(step_ms) if step_ms else None,
+            "min": min(step_ms) if step_ms else None,
+        },
+        "samples_per_sec": {
+            "last": sps[-1] if sps else None,
+            "best": max(sps) if sps else None,
+        },
+        "mfu": {
+            "last": mfu[-1] if mfu else None,
+            "best": max(mfu) if mfu else None,
+        },
+        "flops_per_step": _last(scalars, T_FLOPS),
+        "bytes_accessed": _last(scalars, T_BYTES),
+        "comm": {
+            "bytes_per_step": _last(scalars, T_COMM_BYTES),
+            "compression_ratio": _last(scalars, T_COMM_RATIO),
+        },
+        "recompiles": {
+            "count": int(recompiles) if recompiles is not None else 0,
+            "total_compile_ms": _last(scalars, T_COMPILE_MS),
+            "per_fn": {k: dict(v) for k, v in sorted(per_fn.items())},
+        },
+        "memory": {
+            "peak_bytes_in_use": max(mem_peak) if mem_peak else None,
+            "last_bytes_in_use": _last(scalars, T_MEM_USE),
+        },
+        "checkpoints": {
+            "saves": ckpt["saves"], "loads": ckpt["loads"],
+            "fallbacks": ckpt["fallbacks"],
+            "save_ms_mean": (sum(ckpt["save_ms"]) / len(ckpt["save_ms"])
+                             if ckpt["save_ms"] else None),
+        },
+        "loss": {
+            "first": loss[0] if loss else None,
+            "last": loss[-1] if loss else None,
+        },
+    }
+
+
+def _fmt(v, spec="{:.2f}", none="-"):
+    return none if v is None else spec.format(v)
+
+
+def _fmt_bytes(v):
+    if v is None:
+        return "-"
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(v) < 1024 or unit == "TiB":
+            return f"{v:.1f} {unit}"
+        v /= 1024
+    return f"{v:.1f} TiB"
+
+
+def render(s):
+    st = s["step_time_ms"]
+    lines = [
+        f"run report: {s['events_file']}",
+        f"  steps             : {s['steps']}",
+        f"  step_time_ms      : p50={_fmt(st['p50'])} "
+        f"p95={_fmt(st['p95'])} mean={_fmt(st['mean'])}",
+        f"  samples_per_sec   : last={_fmt(s['samples_per_sec']['last'])} "
+        f"best={_fmt(s['samples_per_sec']['best'])}",
+        f"  mfu               : last={_fmt(s['mfu']['last'], '{:.4f}')} "
+        f"best={_fmt(s['mfu']['best'], '{:.4f}')}",
+        f"  flops_per_step    : "
+        f"{_fmt(s['flops_per_step'], '{:.3e}')}",
+        f"  comm_bytes_per_step: "
+        f"{_fmt_bytes(s['comm']['bytes_per_step'])} "
+        f"(compression {_fmt(s['comm']['compression_ratio'])}x)",
+        f"  recompiles        : {s['recompiles']['count']}"
+        + (f" (total {_fmt(s['recompiles']['total_compile_ms'], '{:.0f}')}"
+           " ms)" if s['recompiles']['total_compile_ms'] else ""),
+    ]
+    for fn, d in s["recompiles"]["per_fn"].items():
+        lines.append(f"    - {fn}: {d['count']} compile(s), "
+                     f"{d['wall_ms']:.0f} ms")
+    lines += [
+        f"  memory            : "
+        f"peak={_fmt_bytes(s['memory']['peak_bytes_in_use'])} "
+        f"last={_fmt_bytes(s['memory']['last_bytes_in_use'])}",
+        f"  checkpoints       : saves={s['checkpoints']['saves']} "
+        f"loads={s['checkpoints']['loads']} "
+        f"fallbacks={s['checkpoints']['fallbacks']}"
+        + (f" save_ms_mean={_fmt(s['checkpoints']['save_ms_mean'])}"
+           if s['checkpoints']['save_ms_mean'] is not None else ""),
+        f"  loss              : first={_fmt(s['loss']['first'], '{:.4f}')} "
+        f"last={_fmt(s['loss']['last'], '{:.4f}')}",
+    ]
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="events.jsonl file, or a directory "
+                                 "containing one (searched recursively)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the summary as JSON instead of text")
+    args = ap.parse_args(argv)
+    try:
+        summary = summarize(args.path)
+    except FileNotFoundError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(summary, indent=2))
+    else:
+        print(render(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
