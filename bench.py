@@ -17,6 +17,11 @@ Metrics (BASELINE.md rows):
   vs_baseline = cost-model / analytic (6N + 12LSH) FLOPs ratio — a
   drift guard on the MFU accounting both bench rows and per-run MFU
   telemetry rely on
+- host_dispatch_overhead : HARDWARE-FREE — compiled-program dispatches
+  and forced host syncs per train_batch at gas=4, counted by the
+  observability CompileTracker on the forced 8-device CPU mesh — pins
+  the async-pipeline contract (1 fused dispatch/step, 0 steady-state
+  syncs); vs_baseline = fused dispatches / the per-micro loop's gas
 - bert_large_samples_per_s : BERT-large fused-layer training @ seq 128
   (reference: 272 samples/s on 1x V100, fastest-bert post :38-40)
 - bert_onebit_samples_per_s : BERT + 1-bit Adam in the compression
@@ -69,6 +74,7 @@ _EMIT_LOCK = threading.Lock()
 METRICS = [
     "comm_wire_bytes_per_step",
     "mfu_cost_model",
+    "host_dispatch_overhead",
     "bert_large_samples_per_s",
     "bert_onebit_samples_per_s",
     "sparse_attention_speedup_s8k",
@@ -78,7 +84,8 @@ METRICS = [
 HEADLINE = "gpt2_train_mfu"
 # metrics that never touch the device tunnel: forced onto a virtual
 # 8-device CPU mesh in their child, runnable with the tunnel down
-HW_FREE = {"comm_wire_bytes_per_step", "mfu_cost_model"}
+HW_FREE = {"comm_wire_bytes_per_step", "mfu_cost_model",
+           "host_dispatch_overhead"}
 
 PARTIAL_PATH = os.environ.get(
     "BENCH_PARTIAL", "/tmp/dstpu_bench_partial.jsonl")
@@ -91,6 +98,30 @@ if os.environ.get("JAX_PLATFORMS", "").startswith("cpu") and \
 # First metric in a cold child pays remote compile time; give headroom.
 METRIC_TIMEOUT = int(os.environ.get("BENCH_METRIC_TIMEOUT", "1500"))
 METRIC_RETRIES = int(os.environ.get("BENCH_METRIC_RETRIES", "1"))
+# Hardware-free rows compile tiny programs on the CPU backend — a much
+# tighter per-row budget than the tunnel rows, so the rows that CAN
+# always land do so early (the BENCH_r05 rc=124 empty-tail fix: two
+# hw-free children at the full 1500s each could eat the driver's whole
+# window before a single row printed).
+HW_FREE_TIMEOUT = int(os.environ.get("BENCH_HW_FREE_TIMEOUT", "300"))
+# Overall ladder wall-clock budget: when it runs out, remaining metrics
+# become explicit error rows IMMEDIATELY and the ladder finishes with
+# the headline line — completed rows are never lost to an outer
+# timeout's SIGKILL. 0 disables the budget.
+TIME_BUDGET = int(os.environ.get("BENCH_TIME_BUDGET", "840"))
+_T_START = time.monotonic()
+
+
+def _remaining_budget():
+    """Seconds left in the ladder budget, or None when unbudgeted."""
+    if TIME_BUDGET <= 0:
+        return None
+    return TIME_BUDGET - (time.monotonic() - _T_START)
+
+
+def _budget_exhausted(floor=45):
+    rem = _remaining_budget()
+    return rem is not None and rem < floor
 # Child stall watchdog: a fresh remote model compile through the tunnel
 # can exceed 15 min with no heartbeat (the first train_batch call IS the
 # compile), so the stall budget tracks the per-metric budget rather than
@@ -730,6 +761,84 @@ def bench_mfu_cost_model(on_tpu, rtt):
                             "(hardware-free)"})
 
 
+def bench_host_dispatch_overhead(on_tpu, rtt):
+    """Hardware-free row: host-dispatch accounting of the async step
+    pipeline on the virtual 8-device CPU mesh — compiled-program
+    executions and forced host syncs per ``train_batch`` at gas=4,
+    counted exactly by the observability CompileTracker (the same
+    counters ``tools/obs_report.py`` surfaces).
+
+    value = dispatches per train_batch on the default (fused) path —
+    the async-pipeline contract is exactly 1.0; vs_baseline = fused
+    dispatches / the per-micro loop's gas dispatches (0.25 at gas=4).
+    detail carries the steady-state forced-sync count (contract: 0)
+    and the measured host-gap time.
+    """
+    del on_tpu, rtt       # CompileTracker accounting; no device timing
+    import tempfile
+    import jax
+    import jax.numpy as jnp
+    import deepspeed_tpu
+
+    gas, steps, hidden = 4, 5, 64
+    n_dev = jax.device_count()
+
+    def init_params(key):
+        k1, k2 = jax.random.split(key)
+        scale = 1.0 / np.sqrt(hidden)
+        return {"w1": jax.random.normal(k1, (hidden, hidden),
+                                        jnp.float32) * scale,
+                "w2": jax.random.normal(k2, (hidden, hidden),
+                                        jnp.float32) * scale}
+
+    def loss_fn(p, batch):
+        h = jnp.maximum(batch["x"] @ p["w1"], 0.0)
+        return jnp.mean((h @ p["w2"] - batch["y"]) ** 2)
+
+    obs_dir = tempfile.mkdtemp(prefix="dstpu_bench_obs_")
+    engine, *_ = deepspeed_tpu.initialize(
+        model=loss_fn, model_parameters=init_params(jax.random.PRNGKey(0)),
+        config={
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": gas,
+            "steps_per_print": 10**9,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "observability": {"enabled": True, "events_dir": obs_dir,
+                              "flops_profiler": False,
+                              "memory_watermarks": False},
+        })
+    bs = 2 * n_dev
+    rng = np.random.RandomState(0)
+
+    def window():
+        return iter([{"x": rng.randn(bs, hidden).astype(np.float32),
+                      "y": rng.randn(bs, hidden).astype(np.float32)}
+                     for _ in range(gas)])
+
+    engine.train_batch(window())          # compile + settle
+    _beat()
+    tracker = engine.observability.compile_tracker
+    d0, s0 = tracker.total_dispatches, engine._host_sync_count
+    gaps = []
+    for _ in range(steps):
+        engine.train_batch(window())
+        gaps.append(engine._host_gap_ms or 0.0)
+    d_per_step = (tracker.total_dispatches - d0) / steps
+    syncs_per_step = (engine._host_sync_count - s0) / steps
+    fused = bool(engine._use_fused_batch)
+    return _emit("host_dispatch_overhead", round(d_per_step, 3),
+                 "dispatches_per_train_batch", round(d_per_step / gas, 4),
+                 {"gas": gas, "path": "fused" if fused else "per-micro",
+                  "steady_state_syncs_per_step": syncs_per_step,
+                  "host_gap_ms_mean": round(sum(gaps) / len(gaps), 3),
+                  "last_step_ms": round(engine._last_step_time_ms or 0.0,
+                                        3),
+                  "compiles": dict(tracker.counts),
+                  "world": n_dev, "backend": jax.default_backend(),
+                  "source": "CompileTracker dispatch accounting "
+                            "(hardware-free)"})
+
+
 # ------------------------------------------------------------- child mode
 
 
@@ -778,6 +887,8 @@ def run_child(metric):
         bench_comm_wire_bytes(on_tpu, rtt)
     elif metric == "mfu_cost_model":
         bench_mfu_cost_model(on_tpu, rtt)
+    elif metric == "host_dispatch_overhead":
+        bench_host_dispatch_overhead(on_tpu, rtt)
     elif metric == "bert_large_samples_per_s":
         bench_bert_large(on_tpu, rtt)
     elif metric == "bert_onebit_samples_per_s":
@@ -837,7 +948,8 @@ def _git_head():
         # control knobs (timeouts/paths/retries/resume) must not
         control = {"BENCH_PARTIAL", "BENCH_METRIC_TIMEOUT",
                    "BENCH_METRIC_RETRIES", "BENCH_NO_RESUME",
-                   "BENCH_STALL_TIMEOUT"}
+                   "BENCH_STALL_TIMEOUT", "BENCH_HW_FREE_TIMEOUT",
+                   "BENCH_TIME_BUDGET"}
         for k in sorted(os.environ):
             if k.startswith("BENCH_") and k not in control:
                 h.update(f"{k}={os.environ[k]}".encode())
@@ -947,9 +1059,18 @@ def _probe_tunnel(timeout=300):
 
 
 def _run_metric_subprocess(metric):
-    """(row, err): parse the child's last JSON row; err string on failure."""
+    """(row, err): parse the child's last JSON row; err string on failure.
+
+    Per-row time budget: hardware-free rows get the tight
+    HW_FREE_TIMEOUT, device rows the full METRIC_TIMEOUT, and BOTH are
+    clamped to what is left of the overall ladder budget — a slow row
+    can delay later rows but never erase already-streamed ones."""
     cmd = [sys.executable, os.path.abspath(__file__), "--metric", metric]
     env = None
+    timeout = HW_FREE_TIMEOUT if metric in HW_FREE else METRIC_TIMEOUT
+    rem = _remaining_budget()
+    if rem is not None:
+        timeout = max(min(timeout, int(rem) - 10), 30)
     if metric in HW_FREE:
         # hardware-free audits run on a virtual 8-device CPU mesh in
         # their own child — deterministic, tunnel-independent
@@ -957,11 +1078,14 @@ def _run_metric_subprocess(metric):
         env["JAX_PLATFORMS"] = "cpu"
         env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
                             " --xla_force_host_platform_device_count=8")
+        # the child's in-process stall watchdog must not outlive the
+        # row budget (it defaults to tracking the device-row budget)
+        env["BENCH_STALL_TIMEOUT"] = str(max(timeout - 30, 30))
     try:
         r = subprocess.run(cmd, capture_output=True, text=True,
-                           timeout=METRIC_TIMEOUT, env=env)
+                           timeout=timeout, env=env)
     except subprocess.TimeoutExpired:
-        return None, f"metric subprocess exceeded {METRIC_TIMEOUT}s (killed)"
+        return None, f"metric subprocess exceeded {timeout}s (killed)"
     row = None
     for line in r.stdout.splitlines():
         line = line.strip()
@@ -1007,6 +1131,10 @@ def main():
     # hardware-free metrics first (forced-CPU children): they cannot
     # hang on the tunnel and land even when the device is unreachable
     for metric in [m for m in METRICS if m in HW_FREE and m not in done]:
+        if _budget_exhausted():
+            failed[metric] = (f"skipped: ladder time budget "
+                              f"({TIME_BUDGET}s) exhausted")
+            continue
         row, err = _run_metric_subprocess(metric)
         if row is not None:
             done[metric] = row
@@ -1023,8 +1151,15 @@ def main():
         # burn METRIC_TIMEOUT before failing (~25 min per metric);
         # probing twice up front converts that into explicit error rows
         # in minutes. The probe asserts default_backend() == "tpu" — a
-        # CPU-fallback matmul must never pass for hardware rows.
-        if not _probe_tunnel() and (time.sleep(60) or not _probe_tunnel()):
+        # CPU-fallback matmul must never pass for hardware rows. Probe
+        # time is clamped to the ladder budget so the gate itself can
+        # never eat the window the completed rows need to be reported.
+        probe_t = 300
+        rem = _remaining_budget()
+        if rem is not None:
+            probe_t = max(min(300, int(rem / 3)), 30)
+        if not _probe_tunnel(probe_t) and \
+                (time.sleep(min(60, probe_t)) or not _probe_tunnel(probe_t)):
             tunnel_dead = True
             err = ("device unreachable at bench start (2 probes failed "
                    "to complete a matmul on the tpu backend)")
@@ -1038,14 +1173,27 @@ def main():
 
     if not tunnel_dead:
         for metric in need_hw:
+            if _budget_exhausted():
+                failed[metric] = (f"skipped: ladder time budget "
+                                  f"({TIME_BUDGET}s) exhausted; "
+                                  "completed rows already streamed")
+                continue
             err = None
             for attempt in range(1 + METRIC_RETRIES):
                 if attempt > 0:
+                    if _budget_exhausted(floor=120):
+                        err = f"{err}; budget exhausted, retry skipped"
+                        break
                     # only retry against a live tunnel; a second hang
-                    # costs another METRIC_TIMEOUT for nothing
-                    if not _probe_tunnel():
-                        time.sleep(60)
-                        if not _probe_tunnel():
+                    # costs another METRIC_TIMEOUT for nothing. The
+                    # probe is clamped to the remaining budget like the
+                    # upfront gate — it must never be what overruns it.
+                    rem = _remaining_budget()
+                    probe_t = (300 if rem is None
+                               else max(min(300, int(rem / 3)), 30))
+                    if not _probe_tunnel(probe_t):
+                        time.sleep(min(60, probe_t))
+                        if not _probe_tunnel(probe_t):
                             err = f"{err}; tunnel probe dead, retry skipped"
                             break
                 row, err = _run_metric_subprocess(metric)

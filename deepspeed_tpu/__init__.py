@@ -21,7 +21,7 @@ from deepspeed_tpu.runtime.lr_schedules import (
     WarmupLR, OneCycle, LRRangeTest, add_tuning_arguments)
 from deepspeed_tpu.utils.logging import log_dist
 from deepspeed_tpu.runtime.dataloader import (
-    DeepSpeedDataLoader, RepeatingLoader)
+    DeepSpeedDataLoader, PrefetchLoader, RepeatingLoader)
 from deepspeed_tpu.parallel.topology import (
     ProcessTopology, PipeDataParallelTopology, PipeModelDataParallelTopology,
     ParallelGrid)

@@ -57,6 +57,11 @@ TAG_COMPILE_MS = "Observability/compile_ms_total"
 TAG_MEM_IN_USE = "Memory/bytes_in_use"
 TAG_MEM_PEAK = "Memory/peak_bytes_in_use"
 TAG_MEM_DELTA = "Memory/step_delta_bytes"
+# async-pipeline host-overhead counters (docs/performance.md "Async
+# step pipeline"; rendered by tools/obs_report.py)
+TAG_DISPATCHES = "Observability/dispatches"       # cumulative jit calls
+TAG_HOST_SYNCS = "Observability/host_syncs"       # cumulative forced syncs
+TAG_HOST_GAP = "Observability/host_gap_ms"        # per-step host gap time
 
 
 class Observer:
@@ -191,29 +196,68 @@ class Observer:
         return prof
 
     # --------------------------------------------------------- per step
+    def mfu(self, step_time_ms: Optional[float],
+            micro_steps_per_step: int = 1,
+            program: str = "micro_step") -> Optional[float]:
+        """Model FLOPs utilization for one step time, from the profiled
+        program, or None when either is missing. cost_analysis flops
+        are PER-DEVICE (FlopsProfile docstring) so the denominator is
+        the per-device peak — the ratio equals global-flops /
+        all-device-peak. The engine calls this at telemetry-flush
+        barriers with the window-averaged step time (per-dispatch wall
+        clock is not device time once the host runs ahead of an async
+        device)."""
+        if not self.enabled or not step_time_ms:
+            return None
+        prof = (self.flops_profiles.get(program)
+                or self.flops_profiles.get("micro_step"))
+        if prof is None or prof.flops <= 0:
+            return None
+        return compute_mfu(prof.flops * max(micro_steps_per_step, 1),
+                           step_time_ms / 1e3,
+                           prof.peak_flops_per_device)
+
+    def write_mfu(self, step_time_ms: Optional[float], samples: int,
+                  micro_steps_per_step: int = 1,
+                  program: str = "micro_step") -> Optional[float]:
+        """Compute AND emit the MFU scalar for one honest step time —
+        the single emission path (the engine calls it at telemetry
+        flush barriers with the window-averaged time)."""
+        mfu = self.mfu(step_time_ms, micro_steps_per_step, program)
+        if mfu is not None:
+            self.scalar(TAG_MFU, mfu, samples)
+        return mfu
+
     def on_step(self, samples: int, step_time_ms: Optional[float],
-                micro_steps_per_step: int = 1) -> None:
-        """Step-boundary emission: MFU, recompile counters, memory
-        watermarks; Chrome trace refreshed on disk.
+                micro_steps_per_step: int = 1,
+                program: str = "micro_step",
+                host_gap_ms: Optional[float] = None,
+                host_syncs: Optional[int] = None) -> None:
+        """Step-boundary emission: MFU, recompile + dispatch counters,
+        memory watermarks; Chrome trace refreshed on disk.
         ``micro_steps_per_step`` scales the profiled program's FLOPs up
         to the full optimizer step (gradient accumulation runs the
-        compiled micro-step N times per reported step time)."""
+        compiled micro-step N times per reported step time; the fused
+        ``batch_step`` program already covers the window, so its caller
+        passes 1). ``program`` names the profiled entry point.
+        ``host_gap_ms``/``host_syncs`` are the async-pipeline host
+        overhead counters (time the host spent outside the dispatch,
+        cumulative forced device syncs)."""
         if not self.enabled:
             return
-        prof = self.flops_profiles.get("micro_step")
-        if prof is not None and prof.flops > 0 and step_time_ms:
-            # cost_analysis flops are PER-DEVICE (FlopsProfile docstring)
-            # so the denominator is the per-device peak — the ratio
-            # equals global-flops / all-device-peak
-            mfu = compute_mfu(prof.flops * max(micro_steps_per_step, 1),
-                              step_time_ms / 1e3,
-                              prof.peak_flops_per_device)
-            self.scalar(TAG_MFU, mfu, samples)
+        self.write_mfu(step_time_ms, samples, micro_steps_per_step,
+                       program)
         if self.compile_tracker is not None:
             self.scalar(TAG_RECOMPILES, self.compile_tracker.total_compiles,
                         samples)
             self.scalar(TAG_COMPILE_MS, self.compile_tracker.total_compile_ms,
                         samples)
+            self.scalar(TAG_DISPATCHES,
+                        self.compile_tracker.total_dispatches, samples)
+        if host_gap_ms is not None:
+            self.scalar(TAG_HOST_GAP, host_gap_ms, samples)
+        if host_syncs is not None:
+            self.scalar(TAG_HOST_SYNCS, host_syncs, samples)
         if self.memory is not None:
             snap = self.memory.sample("step")
             if snap is not None:

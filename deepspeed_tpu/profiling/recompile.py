@@ -82,6 +82,7 @@ class TrackedFunction:
         else:
             compiled = compiled_guess
             self._seen_signatures.add(sig)
+        self._tracker._record_dispatch(self._name)
         if compiled:
             self._tracker._record(self._name, wall_ms)
         return out
@@ -112,6 +113,11 @@ class CompileTracker:
         self.on_event = on_event
         self.counts: Dict[str, int] = {}
         self.compile_ms: Dict[str, float] = {}
+        # every CALL of a wrapped function, compiled or cached — the
+        # host-dispatch accounting the async-pipeline bench row and
+        # dispatch-count tests pin (one batch_step dispatch per
+        # train_batch on the fused path)
+        self.dispatch_counts: Dict[str, int] = {}
         self.events: List[CompileEvent] = []
         self._warned_fns = set()
 
@@ -121,6 +127,13 @@ class CompileTracker:
     @property
     def total_compiles(self) -> int:
         return sum(self.counts.values())
+
+    @property
+    def total_dispatches(self) -> int:
+        return sum(self.dispatch_counts.values())
+
+    def _record_dispatch(self, name: str) -> None:
+        self.dispatch_counts[name] = self.dispatch_counts.get(name, 0) + 1
 
     @property
     def total_compile_ms(self) -> float:
@@ -153,7 +166,9 @@ class CompileTracker:
         return {
             "total_compiles": self.total_compiles,
             "total_compile_ms": round(self.total_compile_ms, 3),
+            "total_dispatches": self.total_dispatches,
             "per_fn": {n: {"count": c,
-                           "wall_ms": round(self.compile_ms.get(n, 0.0), 3)}
+                           "wall_ms": round(self.compile_ms.get(n, 0.0), 3),
+                           "dispatches": self.dispatch_counts.get(n, 0)}
                        for n, c in sorted(self.counts.items())},
         }

@@ -348,6 +348,23 @@ def get_quantized_comm_config(param_dict):
     }
 
 
+def get_async_pipeline_config(param_dict):
+    """Async step pipeline (scan-fused accumulation + prefetching
+    dataloader + deferred loss telemetry; docs/performance.md "Async
+    step pipeline"). All knobs have safe defaults — the section is
+    purely an override surface."""
+    sub = param_dict.get(C.ASYNC_PIPELINE, {})
+    return {
+        "fused_accumulation": sub.get(C.ASYNC_FUSED_ACCUMULATION,
+                                      C.ASYNC_FUSED_ACCUMULATION_DEFAULT),
+        "prefetch_depth": sub.get(C.ASYNC_PREFETCH_DEPTH,
+                                  C.ASYNC_PREFETCH_DEPTH_DEFAULT),
+        "sync_loss_every_step": sub.get(
+            C.ASYNC_SYNC_LOSS_EVERY_STEP,
+            C.ASYNC_SYNC_LOSS_EVERY_STEP_DEFAULT),
+    }
+
+
 def get_observability_config(param_dict):
     """Unified profiling & telemetry (deepspeed_tpu/profiling/): FLOPs/MFU
     cost profiler, recompile tracking, memory watermarks, trace spans,
@@ -521,6 +538,7 @@ class DeepSpeedConfig:
         self.scheduler_params = get_scheduler_params(param_dict)
 
         self.wall_clock_breakdown = get_wall_clock_breakdown(param_dict)
+        self.async_pipeline_config = get_async_pipeline_config(param_dict)
         self.observability_config = get_observability_config(param_dict)
         # legacy attribute: the jax.profiler trace window, aliased into
         # observability.trace (scripts written against it keep working)
@@ -661,6 +679,12 @@ class DeepSpeedConfig:
                     "quantized_comm.hierarchical does not compose with "
                     "OnebitAdam (its compressed exchange is written "
                     "against the flat 'data' axis)")
+        ap = self.async_pipeline_config
+        if not isinstance(ap["prefetch_depth"], int) or \
+                ap["prefetch_depth"] < 0:
+            raise DeepSpeedConfigError(
+                "async_pipeline.prefetch_depth must be an int >= 0 "
+                f"(0 disables prefetching), got {ap['prefetch_depth']!r}")
         obs = self.observability_config
         if int(obs["recompile_warn_after"]) < 0:
             raise DeepSpeedConfigError(

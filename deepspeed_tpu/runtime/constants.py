@@ -249,6 +249,33 @@ OBS_CHROME_TRACE_PATH_DEFAULT = ""
 OBS_TRACE = "trace"
 
 #############################################
+# Async step pipeline (TPU-native: the host must never sit between two
+# device steps. One scan-fused compiled program per global batch, a
+# background prefetch stage that overlaps H2D with compute, and
+# deferred loss telemetry so steady-state steps enqueue work and
+# return without a device round-trip; see docs/performance.md
+# "Async step pipeline".)
+#
+# "async_pipeline": {
+#   "fused_accumulation": true,   # lax.scan over the gas micro batches
+#                                 # inside ONE jit (auto-falls back to
+#                                 # the per-micro loop for offload/
+#                                 # 1-bit/sparse-grad configs)
+#   "prefetch_depth": 2,          # batches in flight in the background
+#                                 # prefetch thread; 0 disables it
+#   "sync_loss_every_step": false # true restores the old per-step
+#                                 # float(loss) device sync
+# }
+#############################################
+ASYNC_PIPELINE = "async_pipeline"
+ASYNC_FUSED_ACCUMULATION = "fused_accumulation"
+ASYNC_FUSED_ACCUMULATION_DEFAULT = True
+ASYNC_PREFETCH_DEPTH = "prefetch_depth"
+ASYNC_PREFETCH_DEPTH_DEFAULT = 2
+ASYNC_SYNC_LOSS_EVERY_STEP = "sync_loss_every_step"
+ASYNC_SYNC_LOSS_EVERY_STEP_DEFAULT = False
+
+#############################################
 # Persistent XLA compilation cache (TPU-native: first jit of a large
 # model costs tens of seconds — and minutes through a remote-compile
 # tunnel; caching the compiled executable on disk makes re-runs,

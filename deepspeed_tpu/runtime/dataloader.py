@@ -1,12 +1,21 @@
-"""Data loading: repeating + distributed-sharded loaders.
+"""Data loading: repeating + distributed-sharded + prefetching loaders.
 
 TPU-native analog of the reference's ``deepspeed/runtime/dataloader.py``
 (RepeatingLoader :10, DeepSpeedDataLoader :33 which auto-installed a
 DistributedSampler per dp rank). Under single-controller SPMD we instead
 device_put each host batch with a NamedSharding over the ``data`` axis — the
 global batch is laid out across chips in one call; no sampler zoo.
+
+:class:`PrefetchLoader` is the async-pipeline input stage
+(docs/performance.md "Async step pipeline"): a background thread pulls
+host batches, optionally stacks ``stack_micros`` of them to the
+``(gas, ...)`` layout the scan-fused batch step consumes, and issues the
+sharded ``device_put`` — so H2D transfer for batch N+1 overlaps device
+compute of batch N instead of serializing in front of the dispatch.
 """
 
+import queue
+import threading
 from typing import Any, Callable, Iterable, Iterator, Optional
 
 import jax
@@ -33,6 +42,55 @@ class RepeatingLoader:
         return batch
 
 
+def stack_micro_batches(micros):
+    """Stack a list of micro-batch pytrees on a new leading axis (the
+    ``(gas, ...)`` layout the scan-fused batch step scans over). Leaves
+    are pulled to host (``np.asarray``) — callers feeding device arrays
+    pay a D2H; the prefetch/train paths stack host batches."""
+    return jax.tree_util.tree_map(
+        lambda *xs: np.stack([np.asarray(x) for x in xs]), *micros)
+
+
+def normalize_eval_input(batch_or_iter, micro_batches: int = 1):
+    """One eval API shape for both engines (the base engine historically
+    took a batch pytree, the pipe engine an iterator): accept either and
+    return an iterator of micro batches.
+
+    A ``list`` whose elements are all containers (dict/tuple/list) is
+    read as a SEQUENCE of micro batches — the pipe engine previously
+    raised TypeError on lists, and stacking one as a single batch would
+    be silently wrong. A list of array leaves (e.g. ``[inputs,
+    targets]``) stays a single batch pytree, as the base engine always
+    accepted. A single batch pytree is repeated to fill a multi-micro
+    window — the mean loss over identical micros equals that batch's
+    loss."""
+    if hasattr(batch_or_iter, "__next__"):
+        return batch_or_iter
+    if hasattr(batch_or_iter, "__iter__") and \
+            not isinstance(batch_or_iter, (dict, tuple, list)) and \
+            not hasattr(batch_or_iter, "shape"):
+        # a loader-like iterable (has __iter__, is no container/array
+        # pytree): iterate it — replicating the object itself would
+        # reach jax as an opaque non-array leaf and crash far away
+        return iter(batch_or_iter)
+    if isinstance(batch_or_iter, list) and batch_or_iter and \
+            all(isinstance(m, (dict, tuple, list))
+                for m in batch_or_iter):
+        global _WARNED_LIST_EVAL
+        if not _WARNED_LIST_EVAL:
+            _WARNED_LIST_EVAL = True
+            from deepspeed_tpu.utils.logging import logger
+            logger.info(
+                "eval_batch: a list of containers is interpreted as a "
+                "sequence of micro batches; pass a tuple/dict pytree "
+                "for a single list-structured batch")
+        return iter(batch_or_iter)
+    return iter([batch_or_iter] * max(int(micro_batches), 1))
+
+
+_WARNED_LIST_EVAL = False
+
+
 class DeepSpeedDataLoader:
     """Yields device-sharded global batches.
 
@@ -56,6 +114,12 @@ class DeepSpeedDataLoader:
         self.collate_fn = collate_fn
         self.data_sampler = data_sampler
         self._epoch = 0
+        # the mesh is fixed at construction, so the NamedSharding is too —
+        # cache it instead of rebuilding per batch
+        self._cached_sharding = self._build_sharding()
+        # the engine's prefetch stage flips this off and owns the H2D
+        # itself (its worker thread device_puts with the same sharding)
+        self.device_put_enabled = True
         try:
             n = len(dataset)
             self.len = (n // batch_size if drop_last
@@ -68,7 +132,7 @@ class DeepSpeedDataLoader:
             raise TypeError("underlying dataset has no length")
         return self.len
 
-    def _sharding(self):
+    def _build_sharding(self):
         if self.mesh is None:
             return None
         if self.batch_axis not in self.mesh.axis_names:
@@ -83,12 +147,15 @@ class DeepSpeedDataLoader:
         from jax.sharding import NamedSharding, PartitionSpec
         return NamedSharding(self.mesh, PartitionSpec(self.batch_axis))
 
+    def _sharding(self):
+        return self._cached_sharding
+
     def _put(self, batch):
-        sharding = self._sharding()
-        if sharding is None:
+        sharding = self._cached_sharding
+        if sharding is None or not self.device_put_enabled:
             return batch
         return jax.tree_util.tree_map(
-            lambda x: jax.device_put(np.asarray(x), sharding), batch)
+            lambda x: _put_leaf(x, sharding), batch)
 
     def __iter__(self) -> Iterator[Any]:
         if hasattr(self.dataset, "__getitem__") and self.len is not None:
@@ -111,3 +178,164 @@ class DeepSpeedDataLoader:
         else:
             for batch in self.dataset:
                 yield self._put(batch)
+
+
+def _put_leaf(x, sharding):
+    """Sharded device_put that skips leaves already resident in the
+    target layout (a re-put of a committed same-sharding jax.Array is
+    pure overhead — a copy at best)."""
+    if isinstance(x, jax.Array):
+        try:
+            if x.sharding == sharding:
+                return x
+        except Exception:
+            pass
+        return jax.device_put(x, sharding)
+    return jax.device_put(np.asarray(x), sharding)
+
+
+class PrefetchLoader:
+    """Background-prefetching, device-putting wrapper around any batch
+    iterable.
+
+    A worker thread pulls host batches from ``loader``, stacks groups of
+    ``stack_micros`` micro-batches to a ``(stack_micros, ...)`` leading
+    layout (``stack_micros=1`` passes batches through unstacked), and —
+    when ``sharding`` is given — issues the sharded ``device_put``. The
+    consumer therefore always finds the next batch already on device:
+    H2D for batch N+1 overlaps compute of batch N. ``depth`` bounds the
+    number of prepared batches in flight (double buffering by default).
+
+    ``put_fn`` (a callable ``batch -> device batch``) overrides the
+    plain sharded put — the engines pass their guarded put so undersized
+    or scalar leaves degrade to replication exactly as they do on the
+    non-prefetched path, instead of crashing the worker thread.
+    ``stack_always=True`` stacks even a group of one (the pipe engine's
+    ``(M=1, batch, ...)`` window layout).
+
+    Lifecycle: the thread starts lazily on first ``__next__``, dies on
+    iterator exhaustion (a partial trailing group of fewer than
+    ``stack_micros`` micros is dropped, drop_last-style), and is joined
+    by :meth:`close` / ``__del__`` — no thread leak. Exceptions raised
+    in the worker propagate to the consumer's ``next()`` call.
+    Re-iterating after exhaustion restarts from ``iter(loader)``.
+    """
+
+    def __init__(self, loader: Iterable, sharding=None, depth: int = 2,
+                 stack_micros: int = 1, put_fn: Optional[Callable] = None,
+                 stack_always: bool = False):
+        self.loader = loader
+        self.sharding = sharding
+        self.put_fn = put_fn
+        self.depth = max(int(depth), 1)
+        self.stack_micros = max(int(stack_micros), 1)
+        self.stack_always = bool(stack_always)
+        self._q: Optional[queue.Queue] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._failed: Optional[BaseException] = None
+
+    @property
+    def stacks_micro_batches(self) -> bool:
+        """True when this loader yields pre-stacked ``(gas, ...)``
+        batches (the engines' fused/pipe paths consume them directly)."""
+        return self.stack_micros > 1 or self.stack_always
+
+    # ------------------------------------------------------------ worker
+    def _enqueue(self, item) -> bool:
+        """Blocking put that stays responsive to close(); False when the
+        loader is shutting down (drop the item, exit the worker)."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _worker(self, it):
+        try:
+            while not self._stop.is_set():
+                micros = []
+                for _ in range(self.stack_micros):
+                    try:
+                        micros.append(next(it))
+                    except StopIteration:
+                        break
+                if len(micros) < self.stack_micros:
+                    self._enqueue(("end", None))
+                    return
+                batch = (stack_micro_batches(micros)
+                         if self.stacks_micro_batches else micros[0])
+                if self.put_fn is not None:
+                    batch = self.put_fn(batch)
+                elif self.sharding is not None:
+                    batch = jax.tree_util.tree_map(
+                        lambda x: _put_leaf(x, self.sharding), batch)
+                if not self._enqueue(("item", batch)):
+                    return
+            # stop requested: fall through without an "end" marker —
+            # close() owns the shutdown
+        except BaseException as e:  # propagate to the consumer
+            self._enqueue(("error", e))
+
+    def _start(self):
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._q = queue.Queue(maxsize=self.depth)
+        self._thread = threading.Thread(
+            target=self._worker, args=(iter(self.loader),),
+            name="ds-prefetch", daemon=True)
+        self._thread.start()
+
+    # ---------------------------------------------------------- consumer
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._failed is not None:
+            # a worker error is sticky: restarting from iter(loader)
+            # would silently re-serve (and re-train on) early batches;
+            # an explicit close() resets the loader
+            raise self._failed
+        if self._thread is None or (not self._thread.is_alive()
+                                    and (self._q is None
+                                         or self._q.empty())):
+            self._start()
+        kind, val = self._q.get()
+        if kind == "item":
+            return val
+        if kind == "end":
+            self._join()
+            raise StopIteration
+        self._join()
+        self._failed = val
+        raise val
+
+    def _join(self):
+        t, self._thread = self._thread, None
+        if t is not None and t.is_alive():
+            t.join(timeout=5.0)
+
+    def close(self):
+        """Stop the worker and reclaim the thread (idempotent). Batches
+        already prefetched are discarded; a sticky worker error is
+        cleared (close is the explicit reset)."""
+        self._failed = None
+        self._stop.set()
+        q = self._q
+        if q is not None:
+            try:  # unblock a worker waiting on a full queue
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
+        self._join()
+        self._q = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

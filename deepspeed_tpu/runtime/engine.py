@@ -43,7 +43,9 @@ from deepspeed_tpu.parallel.topology import ParallelGrid
 from deepspeed_tpu.runtime import checkpoint as ckpt
 from deepspeed_tpu.runtime import fault
 from deepspeed_tpu.runtime.config import DeepSpeedConfig
-from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader, RepeatingLoader
+from deepspeed_tpu.runtime.dataloader import (
+    DeepSpeedDataLoader, PrefetchLoader, RepeatingLoader,
+    normalize_eval_input, stack_micro_batches)
 from deepspeed_tpu.runtime.fp16.loss_scaler import (
     DynamicLossScaler, LossScaleState, StaticLossScaler, has_overflow)
 from deepspeed_tpu.runtime.lr_schedules import build_lr_schedule
@@ -582,10 +584,53 @@ class DeepSpeedEngine:
                 "hierarchical mode, ZeRO stage 1-2, and a compute dtype)")
 
         self._compiled_micro_step = None
+        self._compiled_batch_step = None
         self._compiled_grad = None
         self._compiled_apply = None
         self._cached_grads = None
         self._cached_loss = None
+
+        # Async step pipeline ('async_pipeline' config section,
+        # docs/performance.md "Async step pipeline"): scan-fused
+        # accumulation (one dispatch per train_batch), background
+        # prefetch, and deferred loss telemetry so steady-state steps
+        # never force a device round-trip.
+        ap = self._config.async_pipeline_config
+        self._async_cfg = ap
+        self._sync_loss_every_step = bool(ap["sync_loss_every_step"])
+        self._prefetch_depth = int(ap["prefetch_depth"])
+        self._use_fused_batch = None     # decided once, at first train_batch
+        self._prefetcher = None
+        self._train_iter = None
+        self._stacked_shd = None
+        self._micro_shd = None
+        self._monitor_ring = []          # deferred loss/lr/scale records
+        self._last_loss_device = None    # device scalar; last_loss() syncs
+        self._host_sync_count = 0        # forced device syncs (telemetry)
+        self._host_gap_ms = None         # per-step host time outside dispatch
+        # only a dynamic fp16 scaler's per-step scale must be snapshot
+        # into the ring; static scales are exact at flush time
+        self._dynamic_scale_telemetry = bool(
+            self.fp16_enabled and isinstance(self.loss_scaler,
+                                             DynamicLossScaler))
+        self._window_anchor = None       # flush-to-flush wall-clock base
+        # scripts predating close() must not lose the tail of the ring
+        # at process exit; registered AFTER the Observer's own atexit
+        # hook so (LIFO) the flush still finds an open event log. The
+        # hook holds only a WEAKREF — the registry must not pin the
+        # engine (and its device state) for process life when the
+        # caller simply drops it; close() unregisters explicitly.
+        import atexit
+        import weakref
+        self_ref = weakref.ref(self)
+
+        def _exit_flush(ref=self_ref):
+            eng = ref()
+            if eng is not None:
+                eng._flush_monitor_atexit()
+
+        self._atexit_flush_hook = _exit_flush
+        atexit.register(_exit_flush)
         # Host mirrors of the device counters, used for boundary checks and
         # print gating WITHOUT a device->host sync per step (the device is
         # potentially across a network tunnel; a sync per step destroys
@@ -1366,24 +1411,32 @@ class DeepSpeedEngine:
             skipped_steps=state.skipped_steps + overflow.astype(jnp.int32),
         )
 
+    def _grads_for_micro(self, state: TrainState, batch, sub):
+        """One micro batch's fwd+bwd, dispatched to the configured
+        gradient-exchange path. Returns ``(loss, csr_overflow|None,
+        grads)`` — shared by the per-micro step, the facade
+        ``forward()``, and the fused batch step's scan body."""
+        scale = state.loss_scale.scale
+        if self._onebit_dist:
+            loss, _aux, grads = self._compute_local_grads(
+                state.params, batch, sub, scale)
+        elif self._sparse_grad_paths:
+            return self._compute_sparse_grads(state.params, batch, sub,
+                                              scale)
+        elif self._quant_allreduce:
+            loss, _aux, grads = self._compute_quantized_grads(
+                state.params, batch, sub, scale)
+        else:
+            loss, _aux, grads = self._compute_loss_and_grads(
+                state.params, batch, sub, scale)
+        return loss, None, grads
+
     def _micro_step(self, state: TrainState, batch) -> Tuple[TrainState, Any]:
         """One fused micro-batch step: fwd + bwd + accumulate + maybe-apply.
         Returns ``(state, loss)`` — or ``(state, (loss, csr_overflow))``
         when the CSR sparse-gradient path is active."""
         rng, sub = jax.random.split(state.rng)
-        csr_ovf = None
-        if self._onebit_dist:
-            loss, aux, grads = self._compute_local_grads(
-                state.params, batch, sub, state.loss_scale.scale)
-        elif self._sparse_grad_paths:
-            loss, csr_ovf, grads = self._compute_sparse_grads(
-                state.params, batch, sub, state.loss_scale.scale)
-        elif self._quant_allreduce:
-            loss, aux, grads = self._compute_quantized_grads(
-                state.params, batch, sub, state.loss_scale.scale)
-        else:
-            loss, aux, grads = self._compute_loss_and_grads(
-                state.params, batch, sub, state.loss_scale.scale)
+        loss, csr_ovf, grads = self._grads_for_micro(state, batch, sub)
 
         out = loss if csr_ovf is None else (loss, csr_ovf)
         if self.zero_cpu_offload and self.gradient_accumulation_steps == 1:
@@ -1423,6 +1476,211 @@ class DeepSpeedEngine:
         return self._compiled_micro_step
 
     # ------------------------------------------------------------------ #
+    # async step pipeline: scan-fused accumulation
+    # ------------------------------------------------------------------ #
+    def _batch_step(self, state: TrainState, stacked) -> Tuple[TrainState,
+                                                               Any]:
+        """The WHOLE accumulation window as ONE compiled program
+        (``async_pipeline.fused_accumulation``): a ``lax.scan`` of the
+        micro fwd+bwd+accumulate body over the stacked ``(gas, ...)``
+        batch, then the boundary apply — same rng stream, same
+        accumulation order, same loss-scale/overflow semantics as
+        ``gas`` separate micro dispatches, so losses and updates are
+        bit-identical to the per-micro loop
+        (tests/unit/test_async_pipeline.py pins this). One dispatch per
+        ``train_batch`` instead of ``gas``: the host never sits between
+        two micro steps. State/accumulator shardings are the micro
+        step's own (the ZeRO ``zero_shardings`` placements ride the
+        donated carry); the quantized/hierarchical DP exchange runs
+        unchanged inside the scan body."""
+        gas = self.gradient_accumulation_steps
+        # the scan body IS the micro step (same accumulate + boundary
+        # cond + apply graph per iteration) — parity with the per-micro
+        # loop is structural, not re-derived
+        state, losses = jax.lax.scan(self._micro_step, state, stacked)
+        # left-fold mean in the loss dtype, matching the per-micro
+        # loop's python-side accumulation
+        total = losses[0]
+        for i in range(1, gas):
+            total = total + losses[i]
+        return state, total / gas
+
+    def _select_batch_path(self):
+        """(fused?, why) for this engine's configuration. The fused path
+        covers the default configs (bf16/fp16/fp32 x ZeRO 0-2 x dense or
+        quantized/hierarchical collectives); paths that genuinely need
+        the host between micro steps keep the per-micro loop."""
+        if not self._async_cfg["fused_accumulation"]:
+            return False, "async_pipeline.fused_accumulation=false"
+        if self.gradient_accumulation_steps == 1:
+            return False, ("gas=1: the micro step already covers the "
+                           "window in one dispatch")
+        if self.zero_cpu_offload:
+            return False, "ZeRO-Offload runs the host Adam at the boundary"
+        if self._onebit or self._onebit_dist:
+            return False, "1-bit Adam phase switching is host-driven"
+        if self._sparse_grad_paths:
+            return False, ("sparse (CSR) grads surface a per-micro "
+                           "overflow flag")
+        return True, (f"scan over gas={self.gradient_accumulation_steps} "
+                      "micro batches, one dispatch per train_batch")
+
+    def _batch_path(self) -> bool:
+        """Decide once (at first train_batch) which path compiles, with
+        the one-line log the acceptance criteria require."""
+        if self._use_fused_batch is None:
+            fused, why = self._select_batch_path()
+            self._use_fused_batch = fused
+            log_dist("async_pipeline: train_batch path = "
+                     + ("fused batch_step" if fused else "per-micro loop")
+                     + f" ({why})", ranks=[0])
+        return self._use_fused_batch
+
+    def _get_compiled_batch_step(self):
+        if self._compiled_batch_step is None:
+            self._compiled_batch_step = self.observability.wrap_jit(
+                jax.jit(self._batch_step, donate_argnums=(0,)),
+                "batch_step")
+        return self._compiled_batch_step
+
+    def _stacked_batch_sharding(self):
+        """Sharding for the fused path's ``(gas, batch, ...)`` input:
+        micro axis replicated, batch dim split over the data axes
+        (cached — the mesh is fixed at construction)."""
+        if self._stacked_shd is None:
+            from deepspeed_tpu.parallel.mesh import data_axis_names
+            axes = data_axis_names(self.mesh)
+            if axes:
+                entry = axes if len(axes) > 1 else axes[0]
+                spec = PartitionSpec(None, entry)
+            else:
+                spec = PartitionSpec()
+            self._stacked_shd = NamedSharding(self.mesh, spec)
+        return self._stacked_shd
+
+    def _micro_batch_sharding(self):
+        """Cached per-micro batch sharding (leading dim over data)."""
+        if self._micro_shd is None:
+            from deepspeed_tpu.parallel.mesh import data_sharding
+            self._micro_shd = data_sharding(self.mesh)
+        return self._micro_shd
+
+    def _next_stacked_batch(self, data_iter):
+        """One ``(gas, ...)`` stacked device batch for the fused step:
+        consumed directly from a stacking :class:`PrefetchLoader`, else
+        ``gas`` micro batches are pulled and stacked host-side
+        (device-array micros pay a D2H — feed host batches, or let the
+        engine's own prefetcher assemble them off-thread)."""
+        if getattr(data_iter, "stacks_micro_batches", False):
+            return next(data_iter)
+        micros = [next(data_iter)
+                  for _ in range(self.gradient_accumulation_steps)]
+        # device-resident micros (a user loader that already device_put
+        # them) stack on-device — np.stack would pull every micro D2H
+        # and re-upload, a per-step round-trip the per-micro loop never
+        # paid
+        on_device = all(isinstance(x, jax.Array)
+                        for x in jax.tree_util.tree_leaves(micros[0]))
+        stacked = (jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
+                                          *micros)
+                   if on_device else stack_micro_batches(micros))
+        return self._put_stacked_batch(stacked)
+
+    def _put_guarded(self, batch, shd, batch_dim):
+        """Sharded put with a replication fallback: leaves whose batch
+        dim (``batch_dim``) doesn't divide the dp degree — or that lack
+        it entirely (scalars) — stay replicated. The per-micro loop fed
+        such host batches to jit unsharded and GSPMD partitions the
+        compute either way, so the prefetch/stacking puts can never
+        crash a config that runs without them."""
+        if shd.spec == PartitionSpec():
+            return jax.device_put(batch, shd)
+        repl = NamedSharding(self.mesh, PartitionSpec())
+        dp = self.dp_world_size
+
+        def put(x):
+            ok = (hasattr(x, "ndim") and x.ndim > batch_dim
+                  and x.shape[batch_dim] % dp == 0)
+            return jax.device_put(x, shd if ok else repl)
+
+        return jax.tree_util.tree_map(put, batch)
+
+    def _put_stacked_batch(self, stacked):
+        """Guarded put for a ``(gas, batch, ...)`` window (also the
+        stacking prefetch worker's put)."""
+        return self._put_guarded(stacked, self._stacked_batch_sharding(),
+                                 batch_dim=1)
+
+    def _put_micro_batch(self, batch):
+        """Guarded put for one un-stacked micro batch (the non-fused
+        prefetch path)."""
+        return self._put_guarded(batch, self._micro_batch_sharding(),
+                                 batch_dim=0)
+
+    def _ensure_train_iter(self):
+        """``train_batch(data_iter=None)`` plumbing, shared with the
+        pipe engine: lazily wrap ``training_data``'s loader in a
+        RepeatingLoader plus (base engine) the async prefetch stage."""
+        assert self.training_dataloader is not None, \
+            "train_batch() without data_iter requires training_data"
+        if getattr(self, "_train_iter", None) is None:
+            self._train_iter = iter(self._wrap_train_iter(
+                RepeatingLoader(self.training_dataloader)))
+        return self._train_iter
+
+    def _wrap_train_iter(self, it):
+        """Insert the background prefetch stage (``async_pipeline
+        .prefetch_depth`` > 0): a worker thread assembles and
+        device_puts batches — stacked to ``(gas, ...)`` on the fused
+        path — so H2D for batch N+1 overlaps compute of batch N."""
+        fused = self._batch_path()
+        if isinstance(self.training_dataloader, DeepSpeedDataLoader) and \
+                (fused or self._prefetch_depth > 0):
+            # the stacking put (or the prefetch worker) owns the H2D; a
+            # loader-side device_put would force a D2H round-trip at
+            # the host stacking stage
+            self.training_dataloader.device_put_enabled = False
+        if self._prefetch_depth <= 0:
+            return it
+        stack = self.gradient_accumulation_steps if fused else 1
+        put_fn = (self._put_stacked_batch if stack > 1
+                  else self._put_micro_batch)
+        self._prefetcher = PrefetchLoader(it, put_fn=put_fn,
+                                          depth=self._prefetch_depth,
+                                          stack_micros=stack)
+        return self._prefetcher
+
+    def close(self):
+        """Release engine-owned background resources: drain any
+        in-flight overlapped offload update, stop the prefetch thread,
+        flush deferred telemetry, seal the observability log."""
+        self._offload_drain()
+        if self._prefetcher is not None:
+            self._prefetcher.close()
+            self._prefetcher = None
+        # drop the train iterator too: it wraps the closed prefetcher,
+        # and a later train_batch() through it would silently restart a
+        # worker thread the engine no longer tracks
+        self._train_iter = None
+        if self._monitor_ring:
+            self._flush_monitor()
+        import atexit
+        try:
+            atexit.unregister(self._atexit_flush_hook)
+        except Exception:
+            pass
+        self.observability.close()
+
+    def _flush_monitor_atexit(self):
+        """Interpreter-exit safety net for the deferred-telemetry ring
+        (best-effort: the device may already be tearing down)."""
+        try:
+            if self._monitor_ring:
+                self._flush_monitor()
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------------ #
     # reference-style facade: forward / backward / step
     # ------------------------------------------------------------------ #
     def forward(self, batch):
@@ -1437,24 +1695,14 @@ class DeepSpeedEngine:
             self.timers("forward").start()
         if self._compiled_grad is None:
             def fwd(state, batch):
+                # same per-path dispatch as the micro/batch steps (incl.
+                # the quantized exchange, which keeps the qwZ weight
+                # quantization OUTSIDE autodiff — differentiating
+                # through round() would zero the master gradients)
                 rng, sub = jax.random.split(state.rng)
-                if self._onebit_dist:
-                    loss, aux, grads = self._compute_local_grads(
-                        state.params, batch, sub, state.loss_scale.scale)
-                elif self._sparse_grad_paths:
-                    loss, ovf, grads = self._compute_sparse_grads(
-                        state.params, batch, sub, state.loss_scale.scale)
+                loss, ovf, grads = self._grads_for_micro(state, batch, sub)
+                if ovf is not None:
                     return loss, grads, rng, ovf
-                elif self._quant_allreduce:
-                    # same exchange as the fused train_batch path; also
-                    # keeps the qwZ weight quantization OUTSIDE autodiff
-                    # (differentiating through round() would zero the
-                    # master gradients)
-                    loss, aux, grads = self._compute_quantized_grads(
-                        state.params, batch, sub, state.loss_scale.scale)
-                else:
-                    loss, aux, grads = self._compute_loss_and_grads(
-                        state.params, batch, sub, state.loss_scale.scale)
                 return loss, grads, rng
             self._compiled_grad = self.observability.wrap_jit(
                 jax.jit(fwd), "grad")
@@ -1638,6 +1886,7 @@ class DeepSpeedEngine:
         if phase != self._onebit_compression:
             self._onebit_compression = phase
             self._compiled_micro_step = None
+            self._compiled_batch_step = None
             self._compiled_apply = None
             self._compiled_grad = None
             log_dist(f"OnebitAdam: compression phase = {phase} "
@@ -1701,61 +1950,114 @@ class DeepSpeedEngine:
     # fused path
     # ------------------------------------------------------------------ #
     def train_batch(self, data_iter=None):
-        """Process one *full* batch = grad_acc micro batches, fused one
-        dispatch per micro batch. Mirrors PipelineEngine.train_batch
-        (pipe/engine.py:229) semantics for the non-pipe engine."""
+        """Process one *full* batch = grad_acc micro batches. On the
+        scan-fused path (``async_pipeline.fused_accumulation``, the
+        default for non-offload/1-bit/sparse configs) the whole window
+        is ONE asynchronously-dispatched compiled program and the step
+        returns without a device round-trip; otherwise the per-micro
+        dispatch loop runs, one dispatch per micro batch. Mirrors
+        PipelineEngine.train_batch (pipe/engine.py:229) semantics for
+        the non-pipe engine.
+
+        The returned loss is a device scalar (convert with ``float``,
+        or read :meth:`last_loss` — both are explicit sync points)."""
         if data_iter is None:
-            assert self.training_dataloader is not None, \
-                "train_batch() without data_iter requires training_data"
-            if not hasattr(self, "_train_iter"):
-                self._train_iter = iter(RepeatingLoader(
-                    self.training_dataloader))
-            data_iter = self._train_iter
+            data_iter = self._ensure_train_iter()
 
         self._maybe_switch_onebit_phase()
         self._maybe_profile_step()
-        step_fn = self._get_compiled_micro_step()
+        fused = self._batch_path()
         self.tput_timer.start()
         _t_step0 = time.perf_counter()
-        total = None
-        offload_direct = (self.zero_cpu_offload and
-                          self.gradient_accumulation_steps == 1)
-        with self.observability.span("train_batch"):
-            for _ in range(self.gradient_accumulation_steps):
-                batch = next(data_iter)
-                self.state, out = step_fn(self.state, batch)
-                if offload_direct:
-                    out, self._offload_grads_device = out
-                if self._sparse_grad_paths and not self._onebit_dist:
-                    loss, self._csr_overflow = out
-                else:
-                    loss = out
-                total = loss if total is None else total + loss
-            if self.zero_cpu_offload:
-                if self._offload_overlap:
-                    self._host_apply_update_overlapped()
-                else:
-                    self._host_apply_update()
+        if self._window_anchor is None:
+            # telemetry window opens at the first dispatch after a
+            # (re)anchor, so flush-time averages never include idle time
+            self._window_anchor = _t_step0
+        _t_dispatch = 0.0
+        if fused:
+            step_fn = self._get_compiled_batch_step()
+            with self.observability.span("train_batch"):
+                with self.observability.span("data"):
+                    batch = self._next_stacked_batch(data_iter)
+                _t0 = time.perf_counter()
+                self.state, mean_loss = step_fn(self.state, batch)
+                _t_dispatch = time.perf_counter() - _t0
+        else:
+            step_fn = self._get_compiled_micro_step()
+            total = None
+            offload_direct = (self.zero_cpu_offload and
+                              self.gradient_accumulation_steps == 1)
+            with self.observability.span("train_batch"):
+                for _ in range(self.gradient_accumulation_steps):
+                    with self.observability.span("data"):
+                        batch = next(data_iter)
+                    _t0 = time.perf_counter()
+                    self.state, out = step_fn(self.state, batch)
+                    _t_dispatch += time.perf_counter() - _t0
+                    if offload_direct:
+                        out, self._offload_grads_device = out
+                    if self._sparse_grad_paths and not self._onebit_dist:
+                        loss, self._csr_overflow = out
+                    else:
+                        loss = out
+                    total = loss if total is None else total + loss
+                if self.zero_cpu_offload:
+                    if self._offload_overlap:
+                        self._host_apply_update_overlapped()
+                    else:
+                        self._host_apply_update()
+            mean_loss = total / self.gradient_accumulation_steps
         self.tput_timer.stop()
         self._last_step_time_ms = (time.perf_counter() - _t_step0) * 1e3
-        mean_loss = total / self.gradient_accumulation_steps
+        # host time NOT spent inside a dispatch call: data wait + python
+        # bookkeeping — the overhead the async pipeline exists to hide
+        self._host_gap_ms = max(
+            self._last_step_time_ms - _t_dispatch * 1e3, 0.0)
         self._host_micro_step += self.gradient_accumulation_steps
         self._host_global_step += 1
-        # one-time FLOPs/MFU cost profile of the compiled micro-step —
+        # one-time FLOPs/MFU cost profile of the compiled step program —
         # OUTSIDE the timed window (it is an AOT re-compile); only the
-        # last micro-batch's shapes are read, never its (donated) buffers
-        if self.observability.wants_flops_profile("micro_step"):
+        # last batch's shapes are read, never its (donated) buffers
+        prog = "batch_step" if fused else "micro_step"
+        if self.observability.wants_flops_profile(prog):
             self.observability.maybe_profile_flops(
-                "micro_step", step_fn, (self.state, batch),
+                prog, step_fn, (self.state, batch),
                 samples=self._host_global_step * self.train_batch_size())
         self._check_csr_overflow()
         self._report_progress()
         self._write_monitor(mean_loss)
         return mean_loss
 
+    def last_loss(self):
+        """Python float of the most recent ``train_batch`` mean loss —
+        an explicit sync point that also flushes the deferred telemetry
+        ring. ``None`` before the first step."""
+        if self._last_loss_device is None:
+            return None
+        if self._monitor_ring:
+            self._flush_monitor()
+        else:
+            self._host_sync_count += 1
+        return float(self._last_loss_device)
+
     def eval_batch(self, batch):
-        """Loss without grads/update."""
+        """Loss without grads/update. Accepts a single batch pytree OR
+        an iterator of micro batches (the pipe engine's historical
+        shape) — one eval API for both engines. An iterator is drained
+        up to ``gradient_accumulation_steps`` micros (the engine's
+        window, mirroring the pipe engine's ``micro_batches``) and the
+        mean loss returned."""
         self._offload_drain()
+        if self._monitor_ring:
+            self._flush_monitor()   # eval is an explicit sync point
+        it = normalize_eval_input(batch)
+        micros = []
+        for _ in range(self.gradient_accumulation_steps):
+            try:
+                micros.append(next(it))
+            except StopIteration:
+                break
+        assert micros, "eval_batch: empty micro-batch iterator"
         if not hasattr(self, "_compiled_eval"):
             def ev(params, batch, rng):
                 cp = self._cast_for_loss(params)
@@ -1764,9 +2066,13 @@ class DeepSpeedEngine:
                 return out[0] if isinstance(out, tuple) else out
             self._compiled_eval = self.observability.wrap_jit(
                 jax.jit(ev), "eval")
+        total = None
         with self.observability.span("eval"):
-            return self._compiled_eval(self.state.params, batch,
-                                       self.state.rng)
+            for m in micros:
+                loss = self._compiled_eval(self.state.params, m,
+                                           self.state.rng)
+                total = loss if total is None else total + loss
+        return total / len(micros)
 
     def _maybe_profile_step(self):
         """Start/stop a jax.profiler trace window around the configured
@@ -1829,36 +2135,156 @@ class DeepSpeedEngine:
                 "compression_ratio": (total_d / active) if active else None,
                 "mode": mode}
 
+    # steady-state bound on the deferred-telemetry ring: past this many
+    # unflushed steps the ring syncs regardless of steps_per_print (the
+    # records are tiny, but unbounded deferral would hold a device
+    # scalar per step for the run's lifetime)
+    _MONITOR_RING_CAP = 512
+
     def _write_monitor(self, loss=None):
-        """reference engine.py:780-790/:922-936: loss/lr/scale scalars,
-        x-axis = cumulative samples (forces a loss sync; opt-in)."""
+        """reference engine.py:780-790/:922-936 scalars, x-axis =
+        cumulative samples — but sync-free in steady state: host-side
+        scalars (step time, throughput, comm bytes, MFU, memory,
+        dispatch counters) are written immediately, while device-valued
+        ones (loss, lr, loss_scale) are queued in a small ring and
+        materialized only at sync points — every ``steps_per_print``,
+        on :meth:`last_loss`/:meth:`eval_batch`/:meth:`close`, or at
+        the ring cap. ``async_pipeline.sync_loss_every_step=true``
+        restores the old per-step ``float(loss)`` sync. Deferred lr
+        records are computed from the host step mirror (identical to
+        the device counter except under fp16 overflow skips within a
+        flush window)."""
+        if loss is not None:
+            self._last_loss_device = loss
         if not (self.monitor.enabled or self.observability.enabled):
             return
         samples = self._host_global_step * self.train_batch_size()
-        self.monitor.write_train_metrics(
-            loss=float(loss) if loss is not None else None,
-            lr=float(self._lr_at(self.state.global_step)),
-            loss_scale=self.loss_scale(),
-            samples=samples)
-        if self._last_step_time_ms is not None:
-            self.monitor.write_timer_values(
-                {"step_time_ms": self._last_step_time_ms}, samples)
-            # throughput next to the step time it derives from (the
-            # tput_timer's average only prints; this lands in the record)
-            if self._last_step_time_ms > 0:
-                self.monitor.write_scalar(
-                    "Train/Samples/samples_per_sec",
-                    self.train_batch_size() /
-                    (self._last_step_time_ms / 1e3), samples)
         if self._comm_stats is not None:
             self.monitor.write_comm_metrics(
                 bytes_per_step=self._comm_stats["bytes_per_step"],
                 compression_ratio=self._comm_stats["compression_ratio"],
                 samples=samples)
-        # MFU / recompile counters / memory watermarks / trace refresh
+        # dynamic fp16 scaling: snapshot the per-step scale (jnp.copy —
+        # the state leaf itself is donated to the next dispatch) so the
+        # flushed scale trajectory attributes backoffs to the right
+        # step; static scalers are constant and read at flush time
+        scale = (jnp.copy(self.state.loss_scale.scale)
+                 if self._dynamic_scale_telemetry else None)
+        self._monitor_ring.append(
+            {"samples": samples, "host_step": self._host_global_step,
+             "loss": loss, "scale": scale,
+             "raw_step_ms": self._last_step_time_ms})
+        if (self._sync_loss_every_step
+                or self._host_global_step % self._config.steps_per_print
+                == 0
+                or len(self._monitor_ring) >= self._MONITOR_RING_CAP):
+            self._flush_monitor(at_step_boundary=True)
+        # recompile + dispatch counters / memory / trace refresh — all
+        # host-side probes, no device round-trip (the sync counter
+        # reflects any flush this step just performed). Step time, MFU
+        # and throughput are emitted at flush barriers instead: once
+        # the host runs ahead of an async device, per-dispatch wall
+        # clock measures host time, not device time.
         self.observability.on_step(
-            samples=samples, step_time_ms=self._last_step_time_ms,
-            micro_steps_per_step=self.gradient_accumulation_steps)
+            samples=samples, step_time_ms=None,
+            host_gap_ms=self._host_gap_ms,
+            host_syncs=self._host_sync_count)
+
+    def _flush_monitor(self, at_step_boundary: bool = False):
+        """Materialize the deferred loss/lr/scale records — the ONE
+        periodic device round-trip of the async pipeline — and emit the
+        window's honest step-time/throughput/MFU.
+
+        The ``block_until_ready`` on the newest loss is the explicit
+        periodic barrier: a flush at a step boundary reports
+        barrier-to-barrier wall time divided by the window's step
+        count, which IS the device step time regardless of how far the
+        host's async dispatches ran ahead (per-dispatch wall clock
+        would measure only host time). Out-of-band flushes (eval /
+        save / last_loss — arbitrary idle time may have passed) write
+        loss/lr/scale but NO step-time/throughput/MFU records: honest
+        by omission beats an idle-inflated or host-only number."""
+        ring, self._monitor_ring = self._monitor_ring, []
+        if not ring:
+            return
+        self._host_sync_count += 1
+        newest = next((r["loss"] for r in reversed(ring)
+                       if r["loss"] is not None), None)
+        if newest is not None:
+            jax.block_until_ready(newest)
+        avg_ms = None
+        comp_by_step = {}
+        if at_step_boundary:
+            now = time.perf_counter()
+            if self._window_anchor is not None:
+                window_ms = (now - self._window_anchor) * 1e3
+                # jit compiles block the dispatching step — attribute
+                # their wall time to THAT step's record instead of
+                # smearing it across the window (keeps compile spikes
+                # in the p95 tail, as the per-step scheme did). Compile
+                # events record the pre-increment host step, hence +1.
+                tracker = self.observability.compile_tracker
+                steps_in = {rec["host_step"] for rec in ring}
+                if tracker is not None:
+                    for ev in tracker.events:
+                        # only the train-step programs compile inside
+                        # the timed window; eval/grad/apply compiles
+                        # happen between train dispatches and must not
+                        # be deducted from it
+                        if ev.fn_name not in ("batch_step",
+                                              "micro_step"):
+                            continue
+                        s = ev.step + 1
+                        if s in steps_in:
+                            comp_by_step[s] = (comp_by_step.get(s, 0.0)
+                                               + ev.wall_ms)
+                elif 1 in steps_in and len(ring) > 1 and \
+                        ring[0]["raw_step_ms"]:
+                    # no tracker (observability off): at least keep the
+                    # first compile pinned to step 1 via its raw time
+                    comp_by_step[ring[0]["host_step"]] = \
+                        ring[0]["raw_step_ms"]
+                avg_ms = max(window_ms - sum(comp_by_step.values()),
+                             0.0) / len(ring)
+            self._window_anchor = now
+        else:
+            self._window_anchor = None   # re-anchor at the next step
+        scale = self.loss_scale()
+        # the host step mirror over-counts the device optimizer step by
+        # the cumulative fp16 overflow skips; re-anchor on the (now
+        # settled) device counter so logged lr indices drift at most
+        # within one flush window, never for the rest of the run
+        skip_offset = self._host_global_step - int(self.state.global_step)
+        for rec in ring:
+            lr_step = max(rec["host_step"] - skip_offset, 0)
+            self.monitor.write_train_metrics(
+                loss=(float(rec["loss"]) if rec["loss"] is not None
+                      else None),
+                lr=float(self._lr_at(lr_step)),
+                loss_scale=(float(rec["scale"])
+                            if rec.get("scale") is not None else scale),
+                samples=rec["samples"], flush=False)
+            # step time only from boundary flushes: an out-of-band
+            # flush (eval/save/last_loss — arbitrary idle or mere host
+            # time may have passed) writes no step time rather than a
+            # misleading one
+            if avg_ms is not None:
+                step_ms = avg_ms + comp_by_step.get(rec["host_step"],
+                                                    0.0)
+                self.monitor.write_timer_values(
+                    {"step_time_ms": step_ms}, rec["samples"])
+                if step_ms > 0:
+                    self.monitor.write_scalar(
+                        "Train/Samples/samples_per_sec",
+                        self.train_batch_size() / (step_ms / 1e3),
+                        rec["samples"])
+        self.observability.write_mfu(
+            avg_ms, ring[-1]["samples"],
+            micro_steps_per_step=(1 if self._use_fused_batch
+                                  else self.gradient_accumulation_steps),
+            program=("batch_step" if self._use_fused_batch
+                     else "micro_step"))
+        self.monitor.flush()
 
     def _report_progress(self):
         # gate on the host mirror: no device sync unless actually printing
@@ -1882,6 +2308,8 @@ class DeepSpeedEngine:
         new one fully committed — never a half-save that resume trusts."""
         import shutil
         self._offload_drain()
+        if self._monitor_ring:
+            self._flush_monitor()   # a save is a natural sync point
         # the retry policy is process-global; re-assert this engine's so
         # its own saves run under its own config even with several
         # engines alive in one process

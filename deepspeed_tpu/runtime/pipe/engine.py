@@ -33,6 +33,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from deepspeed_tpu.parallel.mesh import axis_size
+from deepspeed_tpu.runtime.dataloader import (DeepSpeedDataLoader,
+                                              PrefetchLoader,
+                                              normalize_eval_input,
+                                              stack_micro_batches)
 from deepspeed_tpu.runtime.engine import DeepSpeedEngine, TrainState
 from deepspeed_tpu.runtime.pipe.module import PipelineModule
 from deepspeed_tpu.runtime.pipe.spmd import (
@@ -165,12 +169,30 @@ class PipelineEngine(DeepSpeedEngine):
     def train_batch_size(self):
         return self._true_train_batch_size
 
+    def _wrap_train_iter(self, it):
+        """The pipelined step stacks its own micro window; the async
+        prefetch stage (when configured) assembles + device_puts the
+        stacked (M, ...) batch off-thread with the pipe sharding."""
+        if self._prefetch_depth <= 0:
+            return it
+        if isinstance(self.training_dataloader, DeepSpeedDataLoader):
+            self.training_dataloader.device_put_enabled = False
+        # stack_always: even an M=1 window needs the leading micro axis
+        # the pipelined program (and self._batch_sharding) expect
+        self._prefetcher = PrefetchLoader(
+            it, put_fn=self._put_stacked_batch,
+            depth=self._prefetch_depth, stack_micros=self.micro_batches,
+            stack_always=True)
+        return self._prefetcher
+
     def _stack_micro_batches(self, data_iter):
-        """Pull micro_batches items and stack on a new leading axis."""
+        """Pull micro_batches items and stack on a new leading axis (a
+        stacking PrefetchLoader already yields the (M, ...) batch)."""
+        if getattr(data_iter, "stacks_micro_batches", False):
+            return next(data_iter)
         micros = [next(data_iter) for _ in range(self.micro_batches)]
-        stacked = jax.tree_util.tree_map(
-            lambda *xs: np.stack([np.asarray(x) for x in xs]), *micros)
-        return jax.device_put(stacked, self._batch_sharding)
+        return jax.device_put(stack_micro_batches(micros),
+                              self._batch_sharding)
 
     def train_batch(self, data_iter=None) -> jnp.ndarray:
         """One full pipelined optimizer step (reference pipe/engine.py:229).
@@ -179,13 +201,7 @@ class PipelineEngine(DeepSpeedEngine):
         pre-stacked (M, ...) batches is NOT supported — always micro.
         """
         if data_iter is None:
-            assert self.training_dataloader is not None, \
-                "train_batch() without data_iter requires training_data"
-            if not hasattr(self, "_train_iter"):
-                from deepspeed_tpu.runtime.dataloader import RepeatingLoader
-                self._train_iter = iter(RepeatingLoader(
-                    self.training_dataloader))
-            data_iter = self._train_iter
+            data_iter = self._ensure_train_iter()
 
         self._maybe_profile_step()
         with self.observability.span("pipe/stack_batch"):
@@ -194,6 +210,8 @@ class PipelineEngine(DeepSpeedEngine):
         self.tput_timer.start()
         import time as _time
         _t0 = _time.perf_counter()
+        if self._window_anchor is None:
+            self._window_anchor = _t0   # see base train_batch
         with self.observability.span("pipe/train_batch"):
             self.state, loss = step_fn(self.state, batch)
         self.tput_timer.stop()
@@ -212,12 +230,18 @@ class PipelineEngine(DeepSpeedEngine):
 
     def eval_batch(self, data_iter) -> jnp.ndarray:
         """Pipelined forward-only loss (reference pipe/engine.py:306) —
-        realizes InferenceSchedule's wavefront (the same scan, no grad)."""
+        realizes InferenceSchedule's wavefront (the same scan, no grad).
+        Accepts an iterator of micro batches or — like the base engine —
+        a single batch pytree (repeated across the micro window; the
+        mean loss over identical micros equals that batch's loss)."""
+        if self._monitor_ring:
+            self._flush_monitor()   # eval is an explicit sync point
         if not hasattr(self, "_compiled_pipe_eval"):
             def ev(params, batch, rng):
                 return self._loss_fn(self._cast_for_loss(params), batch, rng)
             self._compiled_pipe_eval = self.observability.wrap_jit(
                 jax.jit(ev), "pipe_eval")
+        data_iter = normalize_eval_input(data_iter, self.micro_batches)
         batch = self._stack_micro_batches(data_iter)
         with self.observability.span("pipe/eval_batch"):
             return self._compiled_pipe_eval(self.state.params, batch,

@@ -140,9 +140,11 @@ class TensorBoardMonitor:
             self.mirror.add_scalar(tag, float(value), int(step))
 
     def write_train_metrics(self, *, loss=None, lr=None, loss_scale=None,
-                            samples: int = 0):
+                            samples: int = 0, flush: bool = True):
         """The reference's per-step scalars (engine.py:780-790, 922-936):
-        x-axis is cumulative sample count."""
+        x-axis is cumulative sample count. ``flush=False`` lets the
+        engine's deferred-telemetry ring write a whole window of
+        records and flush once at the end."""
         if not self._writes():
             return
         if loss is not None:
@@ -152,7 +154,8 @@ class TensorBoardMonitor:
         if loss_scale is not None:
             self.write_scalar("Train/Samples/loss_scale", loss_scale,
                               samples)
-        self.flush()
+        if flush:
+            self.flush()
 
     def write_checkpoint_event(self, *, action: str, ok: bool = True,
                                duration_ms=None, samples: int = 0):

@@ -185,6 +185,31 @@ def test_engine_micro_step_uses_twohop_shape():
         assert c.bytes <= 1.2 * P_total, (c.op, c.bytes, P_total)
 
 
+def test_fused_batch_step_keeps_twohop_shape():
+    """The scan-fused batch_step (async_pipeline, gas>=2) carries the
+    SAME two-hop gradient exchange inside its scan body: s8 all_to_all
+    present, no s8 collective moving more than ~one parameter set per
+    iteration — fusing the window must not re-route the wire."""
+    engine, batch, P_total = _mlp_engine(
+        {"quantized_comm": {"enabled": True},
+         "gradient_accumulation_steps": 2})
+    assert engine._quant_allreduce
+    fused, _why = engine._select_batch_path()
+    assert fused
+    stacked = jax.device_put(
+        jax.tree_util.tree_map(lambda x: np.stack([np.asarray(x)] * 2),
+                               batch),
+        engine._stacked_batch_sharding())
+    txt = (engine._get_compiled_batch_step()
+           .lower(engine.state, stacked).compile().as_text())
+    colls = collect_collectives_full(txt)
+    s8 = [c for c in colls if "s8[" in c.line]
+    assert any(c.op == "all-to-all" for c in s8), \
+        [c.line[:80] for c in colls]
+    for c in s8:
+        assert c.bytes <= 1.2 * P_total, (c.op, c.bytes, P_total)
+
+
 def test_qwz_weight_gather_moves_int8():
     """With quantize_weights, the ZeRO param all-gather moves s8
     elements (+ small fp32 scales) — the bf16 (f32-on-CPU) master

@@ -57,6 +57,10 @@ def test_three_step_run_produces_full_observability_record(tmp_path):
         model=simple_loss_fn, model_parameters=params,
         config={
             "train_micro_batch_size_per_gpu": 4,
+            # per-step flush: this test reads the per-step records
+            # mid-run; the async pipeline otherwise defers device-
+            # valued scalars to steps_per_print boundaries
+            "steps_per_print": 1,
             "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
             "observability": {
                 "enabled": True, "events_dir": str(tmp_path),

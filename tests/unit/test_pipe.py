@@ -146,6 +146,48 @@ def test_pipeline_eval_batch():
     np.testing.assert_allclose(ev, ref, rtol=2e-4)
 
 
+def test_pipeline_eval_batch_accepts_single_batch():
+    """Eval API unification: like the base engine, the pipe engine now
+    also accepts one batch pytree — repeated across the micro window,
+    so the mean loss equals that batch's loss."""
+    module = _make_module(num_stages=4)
+    params = module.init_params(jax.random.PRNGKey(0))
+    batch = _micro_batches(1, global_mb=4)[0]
+    eng, *_ = ds.initialize(model=module, model_parameters=params,
+                            config=_pipe_config())
+    ev = float(eng.eval_batch(batch))
+    ref = float(_mse(module.forward(params, batch["x"]), batch))
+    np.testing.assert_allclose(ev, ref, rtol=2e-4)
+
+
+def test_pipeline_train_batch_via_prefetcher():
+    """training_data + async prefetch: the stacked (M, ...) window is
+    assembled and device_put by the worker thread, and train_batch
+    consumes it pre-stacked."""
+    steps, gas = 3, 4
+    module = _make_module(num_stages=4)
+    params = module.init_params(jax.random.PRNGKey(0))
+    micros = _micro_batches(steps * gas, global_mb=4)
+
+    dataset = [{k: v[i] for k, v in m.items()}
+               for m in micros for i in range(4)]
+    eng, *_ = ds.initialize(model=_make_module(num_stages=4),
+                            model_parameters=params,
+                            config=_pipe_config(
+                                async_pipeline={"prefetch_depth": 2}),
+                            training_data=dataset)
+    # same data, loader-shuffled order differs from the baseline — only
+    # assert the plumbing: prefetcher active, stacked layout, training
+    losses = [float(eng.train_batch()) for _ in range(steps)]
+    assert eng._prefetcher is not None
+    assert eng._prefetcher.stacks_micro_batches
+    assert eng.training_dataloader.device_put_enabled is False
+    assert np.isfinite(losses).all()
+    assert eng.global_steps == steps
+    eng.close()
+    assert eng._prefetcher is None
+
+
 def test_pipeline_forbids_fwd_bwd_facade():
     module = _make_module(num_stages=4)
     eng, *_ = ds.initialize(

@@ -10,6 +10,9 @@ tests/unit/test_monitor.py) and prints the run report:
 - model FLOPs per step, MFU
 - comm bytes per step & compression ratio
 - recompile count (+ per-function compile wall time)
+- host overhead (async step pipeline): dispatches per step, forced
+  host syncs, host-gap time — flagged when the host gap exceeds a
+  threshold fraction of step time (--host-gap-threshold)
 - memory watermarks (peak / last in-use)
 - checkpoint events (saves / loads / fallbacks)
 - loss trajectory (first -> last)
@@ -42,8 +45,15 @@ T_BYTES = "Observability/bytes_accessed"
 T_MFU = "Observability/mfu"
 T_RECOMPILES = "Observability/recompiles"
 T_COMPILE_MS = "Observability/compile_ms_total"
+T_DISPATCHES = "Observability/dispatches"
+T_HOST_SYNCS = "Observability/host_syncs"
+T_HOST_GAP = "Observability/host_gap_ms"
 T_MEM_PEAK = "Memory/peak_bytes_in_use"
 T_MEM_USE = "Memory/bytes_in_use"
+
+# host gap above this fraction of step time flags the run: the device
+# is waiting on the host often enough to cost real throughput
+DEFAULT_HOST_GAP_THRESHOLD = 0.1
 
 
 def find_events_file(path):
@@ -108,7 +118,7 @@ def _last(scalars, tag):
     return vs[-1][1] if vs else None
 
 
-def summarize(path):
+def summarize(path, host_gap_threshold=DEFAULT_HOST_GAP_THRESHOLD):
     """The report as a plain dict (``render`` turns it into text)."""
     events_file = find_events_file(path)
     scalars, events = load_events(events_file)
@@ -117,6 +127,23 @@ def summarize(path):
     sps = _vals(scalars, T_SPS)
     loss = _vals(scalars, T_LOSS)
     mfu = _vals(scalars, T_MFU)
+
+    # host overhead (async step pipeline): dispatches is a cumulative
+    # counter — the per-step rate is its spread over the steps observed
+    dispatches = _vals(scalars, T_DISPATCHES)
+    disp_per_step = None
+    if len(dispatches) >= 2:
+        disp_per_step = ((dispatches[-1] - dispatches[0]) /
+                         (len(dispatches) - 1))
+    elif dispatches:
+        disp_per_step = dispatches[0]
+    host_gap = _vals(scalars, T_HOST_GAP)
+    gap_p50 = percentile(host_gap, 0.50)
+    step_p50 = percentile(step_ms, 0.50)
+    gap_fraction = (gap_p50 / step_p50
+                    if gap_p50 is not None and step_p50 else None)
+    host_flagged = bool(gap_fraction is not None
+                        and gap_fraction > host_gap_threshold)
 
     compile_events = [e for e in events if e.get("event") == "compile"]
     per_fn = defaultdict(lambda: {"count": 0, "wall_ms": 0.0})
@@ -172,6 +199,14 @@ def summarize(path):
             "total_compile_ms": _last(scalars, T_COMPILE_MS),
             "per_fn": {k: dict(v) for k, v in sorted(per_fn.items())},
         },
+        "host_overhead": {
+            "dispatches_per_step": disp_per_step,
+            "host_syncs": _last(scalars, T_HOST_SYNCS),
+            "gap_ms_p50": gap_p50,
+            "gap_fraction_of_step": gap_fraction,
+            "threshold": host_gap_threshold,
+            "flagged": host_flagged,
+        },
         "memory": {
             "peak_bytes_in_use": max(mem_peak) if mem_peak else None,
             "last_bytes_in_use": _last(scalars, T_MEM_USE),
@@ -226,6 +261,21 @@ def render(s):
     for fn, d in s["recompiles"]["per_fn"].items():
         lines.append(f"    - {fn}: {d['count']} compile(s), "
                      f"{d['wall_ms']:.0f} ms")
+    ho = s.get("host_overhead", {})
+    if any(v is not None for k, v in ho.items()
+           if k not in ("threshold", "flagged")):
+        line = (f"  host_overhead     : "
+                f"dispatches/step={_fmt(ho.get('dispatches_per_step'))} "
+                f"syncs={_fmt(ho.get('host_syncs'), '{:.0f}')} "
+                f"gap_p50={_fmt(ho.get('gap_ms_p50'))} ms "
+                f"({_fmt(ho.get('gap_fraction_of_step'), '{:.1%}')} "
+                f"of step)")
+        if ho.get("flagged"):
+            line += (f"  ** WARNING: host gap > "
+                     f"{ho['threshold']:.0%} of step time — the device "
+                     "is waiting on the host (check prefetch depth / "
+                     "per-step syncs) **")
+        lines.append(line)
     lines += [
         f"  memory            : "
         f"peak={_fmt_bytes(s['memory']['peak_bytes_in_use'])} "
@@ -247,9 +297,14 @@ def main(argv=None):
                                  "containing one (searched recursively)")
     ap.add_argument("--json", action="store_true",
                     help="emit the summary as JSON instead of text")
+    ap.add_argument("--host-gap-threshold", type=float,
+                    default=DEFAULT_HOST_GAP_THRESHOLD,
+                    help="flag the run when host-gap p50 exceeds this "
+                         "fraction of step-time p50 (default %(default)s)")
     args = ap.parse_args(argv)
     try:
-        summary = summarize(args.path)
+        summary = summarize(args.path,
+                            host_gap_threshold=args.host_gap_threshold)
     except FileNotFoundError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
