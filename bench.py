@@ -22,6 +22,12 @@ Metrics (BASELINE.md rows):
   observability CompileTracker on the forced 8-device CPU mesh — pins
   the async-pipeline contract (1 fused dispatch/step, 0 steady-state
   syncs); vs_baseline = fused dispatches / the per-micro loop's gas
+- decode_throughput : HARDWARE-FREE — serving tokens/s of the inference
+  engine's bucketed KV-cache decode on a tiny GPT-2 (CPU), after bucket
+  warmup; pins the serving contract of 0 steady-state recompiles (the
+  CompileTracker count is in detail and must be 0); vs_baseline =
+  cached decode tokens/s / a no-cache full-forward-per-token loop at
+  the same batch size (isolates the KV-cache payoff from batching)
 - bert_large_samples_per_s : BERT-large fused-layer training @ seq 128
   (reference: 272 samples/s on 1x V100, fastest-bert post :38-40)
 - bert_onebit_samples_per_s : BERT + 1-bit Adam in the compression
@@ -75,6 +81,7 @@ METRICS = [
     "comm_wire_bytes_per_step",
     "mfu_cost_model",
     "host_dispatch_overhead",
+    "decode_throughput",
     "bert_large_samples_per_s",
     "bert_onebit_samples_per_s",
     "sparse_attention_speedup_s8k",
@@ -85,7 +92,7 @@ HEADLINE = "gpt2_train_mfu"
 # metrics that never touch the device tunnel: forced onto a virtual
 # 8-device CPU mesh in their child, runnable with the tunnel down
 HW_FREE = {"comm_wire_bytes_per_step", "mfu_cost_model",
-           "host_dispatch_overhead"}
+           "host_dispatch_overhead", "decode_throughput"}
 
 PARTIAL_PATH = os.environ.get(
     "BENCH_PARTIAL", "/tmp/dstpu_bench_partial.jsonl")
@@ -839,6 +846,85 @@ def bench_host_dispatch_overhead(on_tpu, rtt):
                             "(hardware-free)"})
 
 
+def bench_decode_throughput(on_tpu, rtt):
+    """Hardware-free row: serving decode throughput of the inference
+    engine (bucketed prefill/decode + continuous batching + donated KV
+    cache) on a tiny GPT-2, CPU backend.
+
+    value = generated tokens/s across a mixed-length request burst
+    after bucket warmup; vs_baseline = that rate / a no-cache
+    full-forward-per-token greedy loop on the same model AT THE SAME
+    BATCH as the decode slots — the ratio isolates the KV-cache
+    payoff, not batching. detail pins the serving latency contract:
+    ``steady_state_recompiles`` MUST be 0 — every steady-state shape
+    was compiled during warmup.
+    """
+    del on_tpu, rtt        # CPU-only accounting + wall-clock on tiny model
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.models.gpt2 import (GPT2Config, gpt2_forward,
+                                           init_gpt2_params)
+    from deepspeed_tpu.inference import InferenceEngine
+
+    cfg = GPT2Config(vocab_size=256, max_position_embeddings=128,
+                     hidden_size=64, num_layers=2, num_heads=4,
+                     embd_dropout=0.0, attn_dropout=0.0,
+                     resid_dropout=0.0)
+    params = init_gpt2_params(cfg, jax.random.PRNGKey(0))
+    new_tokens = 24
+    engine = InferenceEngine(cfg, params, {
+        "max_batch_size": 4, "prompt_buckets": [8, 16],
+        "batch_buckets": [1, 4], "max_seq_len": 128,
+        "max_new_tokens": new_tokens}, dtype=jnp.float32)
+    warm_programs = engine.warmup()
+    _beat()
+
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, 256, (l,)).tolist()
+               for l in (5, 8, 13, 3, 16, 7, 11, 4)]
+    t0 = time.perf_counter()
+    outs = engine.generate(prompts, max_new_tokens=new_tokens,
+                           temperature=0.0)
+    wall = time.perf_counter() - t0
+    gen_tokens = sum(len(o) - len(p) for o, p in zip(outs, prompts))
+    tps = gen_tokens / wall
+    recompiles = engine.steady_state_recompiles
+    _beat()
+
+    # baseline: no-cache greedy loop — a full forward over a
+    # fixed-length padded buffer for EVERY generated token (what
+    # serving without a KV cache costs; fixed shape so the baseline
+    # pays compile once, not per token). Runs at the SAME batch as the
+    # engine's decode slots so the ratio isolates the KV-cache payoff,
+    # not a batching difference.
+    fwd = jax.jit(lambda p, ids: gpt2_forward(p, cfg, ids,
+                                              dtype=jnp.float32))
+    Lfix, nb = 64, 4                   # nb == engine max_batch_size
+    buf = np.zeros((nb, Lfix), np.int32)
+    for r, prompt in enumerate(prompts[:nb]):
+        buf[r, :8] = (prompt + [1] * 8)[:8]    # uniform 8-token prompts
+    buf = jnp.asarray(buf)
+    cur = 7
+    jax.block_until_ready(fwd(params, buf))      # compile outside timing
+    t0 = time.perf_counter()
+    n_base = 8
+    for i in range(n_base):
+        logits = fwd(params, buf)
+        nxt = jnp.argmax(logits[:, cur + i], axis=-1).astype(jnp.int32)
+        buf = buf.at[:, cur + i + 1].set(nxt)
+    jax.block_until_ready(buf)
+    base_tps = n_base * nb / (time.perf_counter() - t0)
+    return _emit("decode_throughput", round(tps, 2), "tokens_per_s",
+                 round(tps / base_tps, 3) if base_tps > 0 else 0.0,
+                 {"requests": len(prompts), "new_tokens": new_tokens,
+                  "warmup_programs": warm_programs,
+                  "steady_state_recompiles": recompiles,
+                  "baseline_tokens_per_s": round(base_tps, 2),
+                  "slots": 4, "backend": jax.default_backend(),
+                  "source": "inference engine wall clock + "
+                            "CompileTracker (hardware-free)"})
+
+
 # ------------------------------------------------------------- child mode
 
 
@@ -889,6 +975,8 @@ def run_child(metric):
         bench_mfu_cost_model(on_tpu, rtt)
     elif metric == "host_dispatch_overhead":
         bench_host_dispatch_overhead(on_tpu, rtt)
+    elif metric == "decode_throughput":
+        bench_decode_throughput(on_tpu, rtt)
     elif metric == "bert_large_samples_per_s":
         bench_bert_large(on_tpu, rtt)
     elif metric == "bert_onebit_samples_per_s":

@@ -37,6 +37,9 @@ from deepspeed_tpu.runtime.activation_checkpointing import checkpointing
 # automatically at initialize(); exported for the standalone-use parity
 # of deepspeed.init_distributed)
 from deepspeed_tpu.distributed import init_distributed
+# serving (TPU-native extension: the reference snapshot is
+# training-only; docs/inference.md)
+from deepspeed_tpu.inference import InferenceEngine
 
 __version__ = "0.1.0"
 

@@ -1,0 +1,27 @@
+"""Inference serving engine (TPU-native extension — the reference
+DeepSpeed v0.3.0 snapshot is training-only).
+
+Bucketed jit-compiled prefill/decode over a preallocated, donated KV
+cache; continuous-batching scheduler; checkpoint -> serving bridge
+(params-only load, optional qwZ int8 weight distribution); serving
+telemetry through the observability event log. See
+``deepspeed_tpu/inference/engine.py`` and ``docs/inference.md``.
+"""
+
+from deepspeed_tpu.inference.buckets import (pad_prompts, pick_bucket,
+                                             validate_buckets, warmup_plan)
+from deepspeed_tpu.inference.engine import (InferenceEngine,
+                                            qwz_distribute_params)
+from deepspeed_tpu.inference.kv_cache import (KVCacheSpec, cache_spec_for,
+                                              init_kv_cache,
+                                              kv_cache_bytes)
+from deepspeed_tpu.inference.scheduler import (FinishedRequest,
+                                               PrefillBatch, Request,
+                                               Scheduler)
+
+__all__ = [
+    "InferenceEngine", "Request", "FinishedRequest", "PrefillBatch",
+    "Scheduler", "KVCacheSpec", "cache_spec_for", "init_kv_cache",
+    "kv_cache_bytes", "pick_bucket", "pad_prompts", "validate_buckets",
+    "warmup_plan", "qwz_distribute_params",
+]

@@ -1,0 +1,459 @@
+"""The inference serving engine.
+
+The reference snapshot (DeepSpeed v0.3.0) is training-only; this is the
+serving half the ROADMAP's "heavy traffic" north star needs, built
+TPU-first:
+
+- **Two compiled programs, fixed shapes.** A jit-compiled *prefill*
+  runs the padded prompt batch through the model's cached forward
+  (``models/*`` ``kv_cache=`` mode — the SAME blocks as training) and
+  scatters the prompt K/V into the persistent slot cache; a
+  jit-compiled single-token *decode* advances every slot one position.
+  Both carry the preallocated KV cache ``(layers, rows, kv_heads,
+  max_len, head_dim)`` as a **donated** argument — steady state
+  allocates nothing.
+- **Bucketed shapes.** Prompts pad to configured ``prompt_buckets`` and
+  prefill batches to ``batch_buckets`` (inference/buckets.py), so
+  steady-state serving dispatches exactly
+  ``len(batch_buckets) x len(prompt_buckets)`` prefill programs + 1
+  decode program — all compiled by :meth:`InferenceEngine.warmup` and
+  pinned by the engine's CompileTracker (``steady_state_recompiles``
+  must stay 0; tier-1 asserted).
+- **Continuous batching.** The host-side :class:`~.scheduler.Scheduler`
+  admits queued requests into freed decode slots every step and evicts
+  finished sequences (EOS / max_tokens) — iteration-level scheduling,
+  per-request sampling state (greedy / temperature+top-k with
+  per-request PRNG keys).
+- **Checkpoint -> serving bridge.** :meth:`from_checkpoint` loads a
+  committed PR-1 checkpoint's ``model_states`` group only
+  (``runtime/checkpoint.load_params_only``), optionally shipping the
+  weights through the qwZ int8 block wire format
+  (``runtime/quantized_collectives``) — the ZeRO++ weight-gather
+  numerics applied to serving-replica distribution.
+- **Serving telemetry.** TTFT, per-token latency, tokens/s, queue
+  depth and slot occupancy stream through the PR-3 monitor into
+  ``events.jsonl`` (``Serve/*`` tags), rendered by
+  ``tools/obs_report.py``'s serving section.
+"""
+
+import os
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.inference.buckets import pad_prompts, warmup_plan
+from deepspeed_tpu.inference.kv_cache import (cache_spec_for, init_kv_cache,
+                                              kv_cache_bytes)
+from deepspeed_tpu.inference.scheduler import (FinishedRequest, Request,
+                                               Scheduler)
+from deepspeed_tpu.models.gpt2 import (GPT2Config, gpt2_forward,
+                                       init_gpt2_params)
+from deepspeed_tpu.models.llama import (LlamaConfig, init_llama_params,
+                                        llama_forward)
+from deepspeed_tpu.ops.attention.flash import NEG_INF
+from deepspeed_tpu.profiling.recompile import CompileTracker
+from deepspeed_tpu.profiling.spans import trace_span
+from deepspeed_tpu.utils.logging import logger
+from deepspeed_tpu.utils.monitor import TensorBoardMonitor, _JsonlWriter
+
+__all__ = ["InferenceEngine"]
+
+_FAMILIES = {
+    GPT2Config: ("gpt2", gpt2_forward, init_gpt2_params),
+    LlamaConfig: ("llama", llama_forward, init_llama_params),
+}
+
+
+def _family_of(model_config):
+    for cls, entry in _FAMILIES.items():
+        if isinstance(model_config, cls):
+            return entry
+    raise TypeError(
+        f"unsupported model config {type(model_config).__name__}; "
+        f"serving supports {[c.__name__ for c in _FAMILIES]}")
+
+
+def _normalize_inference_config(inference_config) -> Dict[str, Any]:
+    from deepspeed_tpu.runtime.config import get_inference_config
+    return get_inference_config(
+        {"inference": dict(inference_config or {})})
+
+
+def qwz_distribute_params(params, block: int = 256):
+    """Ship params through the qwZ int8 block wire format (ZeRO++
+    quantized weight gather, ``runtime/quantized_collectives``): every
+    float leaf crosses as int8 blocks + fp32 scales and dequantizes on
+    the serving replica — ~4x less weight traffic when fanning one
+    committed checkpoint out to many replicas. Returns the dequantized
+    params; the block-quantization rounding is the accuracy cost."""
+    from deepspeed_tpu.runtime.quantized_collectives import (
+        dequantize_blockwise, quantize_blockwise)
+
+    def one(x):
+        x = jnp.asarray(x)
+        if not jnp.issubdtype(x.dtype, jnp.floating):
+            return x
+        q, s, n = quantize_blockwise(x, block)
+        return dequantize_blockwise(q, s, n, x.shape).astype(x.dtype)
+    return jax.tree_util.tree_map(one, params)
+
+
+class InferenceEngine:
+    """Bucketed prefill/decode serving over a continuous-batching
+    scheduler. See the module docstring for the architecture;
+    ``docs/inference.md`` for usage."""
+
+    def __init__(self, model_config, params, inference_config=None,
+                 dtype=jnp.bfloat16, monitor: Optional[Any] = None):
+        self.model_config = model_config
+        self.family, self._forward, _ = _family_of(model_config)
+        self.dtype = dtype
+        cfg = _normalize_inference_config(inference_config)
+        self.config = cfg
+        self.params = jax.tree_util.tree_map(jnp.asarray, params)
+
+        self.num_slots = cfg["max_batch_size"]
+        self._rows = self.num_slots + 1          # +1 scratch row
+        self._scratch = self.num_slots
+        max_len = min(cfg["max_seq_len"],
+                      model_config.max_position_embeddings)
+        if max_len < cfg["max_seq_len"]:
+            logger.info(f"inference: max_seq_len clamped to the model's "
+                        f"max_position_embeddings ({max_len})")
+        if max(cfg["prompt_buckets"]) > max_len:
+            raise ValueError(
+                f"inference.prompt_buckets max "
+                f"({max(cfg['prompt_buckets'])}) exceeds the effective "
+                f"max_seq_len ({max_len})")
+        self.max_len = max_len
+        self._vocab = model_config.vocab_size
+        self._top_k = min(cfg["top_k"], self._vocab)
+
+        self.cache_spec = cache_spec_for(model_config, self._rows,
+                                         max_len, dtype=dtype)
+        self._cache = init_kv_cache(self.cache_spec)
+        self.scheduler = Scheduler(self.num_slots, cfg["prompt_buckets"],
+                                   cfg["batch_buckets"], max_len)
+
+        # telemetry: monitor (PR-3 pattern) + crash-safe events.jsonl
+        self.monitor = monitor if monitor is not None else \
+            TensorBoardMonitor(enabled=False)
+        self._log = None
+        if cfg["events_dir"]:
+            self._log = _JsonlWriter(cfg["events_dir"])
+            if getattr(self.monitor, "mirror", None) is None:
+                self.monitor.mirror = self._log
+        self.compile_tracker = CompileTracker(
+            step_provider=lambda: self._steps, warn_after=0,
+            on_event=self._on_compile_event)
+        self._steps = 0
+        self._warm_compiles: Optional[int] = None
+        self._serve_secs = 0.0
+        self._key_cache: Dict[int, np.ndarray] = {}
+
+        self._prefill = self.compile_tracker.wrap(
+            jax.jit(self._prefill_impl, donate_argnums=(1,)), "prefill")
+        self._decode = self.compile_tracker.wrap(
+            jax.jit(self._decode_impl, donate_argnums=(1,)), "decode")
+        logger.info(
+            f"inference engine: {self.family}, {self.num_slots} slots, "
+            f"max_len {max_len}, prompt buckets {cfg['prompt_buckets']}, "
+            f"batch buckets {cfg['batch_buckets']}, KV cache "
+            f"{kv_cache_bytes(self.cache_spec) / 2**20:.1f} MiB")
+
+    # -------------------------------------------------- compiled programs
+    def _sample_tokens(self, logits, keys, temps):
+        """Per-request sampling: greedy rows (temp <= 0) take argmax;
+        the rest sample ``categorical(logits / temp)`` under the
+        engine-global top-k filter with each row's own PRNG key."""
+        logits = logits.astype(jnp.float32)
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+        if self._top_k > 0:
+            kth = jax.lax.top_k(scaled, self._top_k)[0][:, -1][:, None]
+            scaled = jnp.where(scaled < kth, NEG_INF, scaled)
+        sampled = jax.vmap(jax.random.categorical)(keys, scaled)
+        return jnp.where(temps > 0, sampled.astype(jnp.int32), greedy)
+
+    def _prefill_impl(self, params, cache, ids, lengths, slots, keys,
+                      temps):
+        """One bucketed prefill: run the padded prompt batch through the
+        model's cached forward against a fresh (bucket-batch-sized)
+        cache, scatter its rows into the persistent slot cache at
+        ``slots`` (pad rows target the scratch row), and sample each
+        row's FIRST token from its last true prompt position."""
+        kc, vc = cache
+        Bb = ids.shape[0]
+        spec = self.cache_spec
+        tmp = (jnp.zeros((spec.num_layers, Bb, spec.kv_heads,
+                          spec.max_len, spec.head_dim), spec.dtype),
+               jnp.zeros((spec.num_layers, Bb, spec.kv_heads,
+                          spec.max_len, spec.head_dim), spec.dtype))
+        logits, (nkc, nvc) = self._forward(
+            params, self.model_config, ids, dtype=self.dtype,
+            kv_cache=tmp,
+            cache_position=jnp.zeros((Bb,), jnp.int32))
+        kc = kc.at[:, slots].set(nkc)
+        vc = vc.at[:, slots].set(nvc)
+        last = logits[jnp.arange(Bb), lengths - 1]          # (Bb, V)
+        first_keys = jax.vmap(jax.random.fold_in)(keys, lengths)
+        first = self._sample_tokens(last, first_keys, temps)
+        return first, (kc, vc)
+
+    def _decode_impl(self, params, cache, toks, positions, keys, temps):
+        """One decode step over the FULL slot table: write each slot's
+        pending token at its own position, sample the next. Inactive
+        rows compute garbage that the host discards — uniform shapes
+        are what keep this a single compiled program."""
+        logits, cache = self._forward(
+            params, self.model_config, toks[:, None], dtype=self.dtype,
+            kv_cache=cache, cache_position=positions)
+        step_keys = jax.vmap(jax.random.fold_in)(keys, positions + 1)
+        nxt = self._sample_tokens(logits[:, 0], step_keys, temps)
+        return nxt, cache
+
+    # ----------------------------------------------------------- serving
+    # seeds are caller-supplied, so the memo must be bounded: a serving
+    # daemon taking per-request random seeds would otherwise grow it one
+    # entry per distinct seed, forever
+    _KEY_CACHE_CAP = 4096
+
+    def _key_for(self, seed: int) -> np.ndarray:
+        key = self._key_cache.get(seed)
+        if key is None:
+            if len(self._key_cache) >= self._KEY_CACHE_CAP:
+                self._key_cache.clear()
+            key = np.asarray(jax.random.PRNGKey(seed))
+            self._key_cache[seed] = key
+        return key
+
+    def submit(self, request: Request) -> int:
+        """Queue one request; returns its uid (serving order is FIFO)."""
+        return self.scheduler.submit(request)
+
+    def _run_prefill(self, batch) -> np.ndarray:
+        ids, lengths = pad_prompts([r.prompt for r in batch.requests],
+                                   batch.prompt_bucket, batch.batch_bucket)
+        slots = np.full((batch.batch_bucket,), self._scratch, np.int32)
+        slots[:len(batch.slot_ids)] = batch.slot_ids
+        keys = np.zeros((batch.batch_bucket, 2), np.uint32)
+        temps = np.zeros((batch.batch_bucket,), np.float32)
+        for i, req in enumerate(batch.requests):
+            keys[i] = self._key_for(req.seed)
+            temps[i] = req.temperature
+        with trace_span("serve/prefill", batch=batch.batch_bucket,
+                        prompt=batch.prompt_bucket):
+            first, self._cache = self._prefill(
+                self.params, self._cache, jnp.asarray(ids),
+                jnp.asarray(lengths), jnp.asarray(slots),
+                jnp.asarray(keys), jnp.asarray(temps))
+            return np.asarray(first)
+
+    def step(self) -> List[FinishedRequest]:
+        """One serving iteration: admit waiting requests into free slots
+        (bucketed prefill, first token sampled), then advance every
+        in-flight sequence one decode step. Returns requests that
+        finished this iteration."""
+        sched = self.scheduler
+        finished: List[FinishedRequest] = []
+        t_start = time.perf_counter()
+
+        for batch in sched.admit():
+            first = self._run_prefill(batch)
+            finished.extend(sched.record_tokens(
+                {sid: int(first[i])
+                 for i, sid in enumerate(batch.slot_ids)}))
+            for ttft in sched.drain_ttfts():
+                self.monitor.write_serving_metrics(
+                    ttft_ms=ttft, tokens=sched.total_tokens, flush=False)
+
+        sids, toks, poss, temps, seeds = sched.decode_state()
+        if sids:
+            occupancy = len(sids) / self.num_slots
+            toks_a = np.zeros((self._rows,), np.int32)
+            poss_a = np.zeros((self._rows,), np.int32)
+            temps_a = np.zeros((self._rows,), np.float32)
+            keys_a = np.zeros((self._rows, 2), np.uint32)
+            for sid, tok, pos, temp, seed in zip(sids, toks, poss, temps,
+                                                 seeds):
+                toks_a[sid] = tok
+                poss_a[sid] = pos
+                temps_a[sid] = temp
+                keys_a[sid] = self._key_for(seed)
+            t0 = time.perf_counter()
+            with trace_span("serve/decode", active=len(sids)):
+                nxt, self._cache = self._decode(
+                    self.params, self._cache, jnp.asarray(toks_a),
+                    jnp.asarray(poss_a), jnp.asarray(keys_a),
+                    jnp.asarray(temps_a))
+                # host sync: the scheduler needs the token values
+                nxt = np.asarray(nxt)
+            tok_ms = (time.perf_counter() - t0) * 1e3
+            finished.extend(sched.record_tokens(
+                {sid: int(nxt[sid]) for sid in sids}))
+            self._serve_secs += time.perf_counter() - t_start
+            tps = (sched.total_tokens / self._serve_secs
+                   if self._serve_secs > 0 else 0.0)
+            self.monitor.write_serving_metrics(
+                token_latency_ms=tok_ms, tokens_per_sec=tps,
+                queue_depth=sched.queue_depth, batch_occupancy=occupancy,
+                tokens=sched.total_tokens, flush=False)
+        else:
+            self._serve_secs += time.perf_counter() - t_start
+
+        for f in finished:
+            if self._log is not None:
+                self._log.add_event(
+                    "serve_finish", uid=f.uid, reason=f.finish_reason,
+                    new_tokens=len(f.tokens),
+                    ttft_ms=round(f.ttft_ms or 0.0, 3),
+                    latency_ms=round(f.latency_ms, 3))
+        self.monitor.flush()
+        self._steps += 1
+        return finished
+
+    def run(self) -> List[FinishedRequest]:
+        """Serve until queue and slots drain; returns everything that
+        finished."""
+        out: List[FinishedRequest] = []
+        while not self.scheduler.idle():
+            out.extend(self.step())
+        return out
+
+    def generate(self, prompts: Sequence[Sequence[int]],
+                 max_new_tokens: Optional[int] = None,
+                 temperature: Optional[float] = None,
+                 seeds: Optional[Sequence[int]] = None,
+                 eos_id: Optional[int] = "__cfg__") -> List[List[int]]:
+        """Batch convenience API over submit/run: serve ``prompts`` and
+        return the full sequences (prompt + generated tokens) in
+        submission order. Per-request knobs default to the
+        ``inference:{}`` config."""
+        cfg = self.config
+        if eos_id == "__cfg__":
+            eos_id = cfg["eos_token_id"]
+        reqs = [Request(
+            prompt=p,
+            max_new_tokens=(max_new_tokens if max_new_tokens is not None
+                            else cfg["max_new_tokens"]),
+            temperature=(temperature if temperature is not None
+                         else cfg["temperature"]),
+            seed=(seeds[i] if seeds is not None else i),
+            eos_id=eos_id) for i, p in enumerate(prompts)]
+        uids = [self.submit(r) for r in reqs]
+        finished = {f.uid: f for f in self.run()}
+        return [finished[u].prompt + finished[u].tokens for u in uids]
+
+    # ----------------------------------------------------------- warmup
+    def warmup(self):
+        """Compile the steady-state program set: one prefill per
+        (batch bucket, prompt bucket) pair + the decode program, all
+        against the scratch row (the live cache stays untouched where
+        it matters — must run while no requests are in flight). After
+        this, :attr:`steady_state_recompiles` staying 0 is the serving
+        latency contract."""
+        assert self.scheduler.idle(), "warmup with requests in flight"
+        for bb, sb in warmup_plan(self.config["batch_buckets"],
+                                  self.config["prompt_buckets"]):
+            ids = np.zeros((bb, sb), np.int32)
+            lengths = np.ones((bb,), np.int32)
+            slots = np.full((bb,), self._scratch, np.int32)
+            keys = np.zeros((bb, 2), np.uint32)
+            temps = np.zeros((bb,), np.float32)
+            first, self._cache = self._prefill(
+                self.params, self._cache, jnp.asarray(ids),
+                jnp.asarray(lengths), jnp.asarray(slots),
+                jnp.asarray(keys), jnp.asarray(temps))
+        nxt, self._cache = self._decode(
+            self.params, self._cache,
+            jnp.zeros((self._rows,), jnp.int32),
+            jnp.zeros((self._rows,), jnp.int32),
+            jnp.zeros((self._rows, 2), jnp.uint32),
+            jnp.zeros((self._rows,), jnp.float32))
+        jax.block_until_ready(nxt)
+        self._warm_compiles = self.compile_tracker.total_compiles
+        if self._log is not None:
+            self._log.add_event("serve_warmup",
+                                programs=self._warm_compiles,
+                                batch_buckets=self.config["batch_buckets"],
+                                prompt_buckets=self.config["prompt_buckets"])
+        return self._warm_compiles
+
+    @property
+    def steady_state_recompiles(self) -> int:
+        """Compiles since :meth:`warmup` — the zero-recompile serving
+        contract (0 until a shape outside the bucket table sneaks in).
+        -1 before warmup ran."""
+        if self._warm_compiles is None:
+            return -1
+        return self.compile_tracker.total_compiles - self._warm_compiles
+
+    # ----------------------------------------- checkpoint -> serving
+    @classmethod
+    def from_checkpoint(cls, load_dir: str, model_config,
+                        tag: Optional[str] = None, inference_config=None,
+                        dtype=jnp.bfloat16, monitor: Optional[Any] = None,
+                        quantize_weights: Optional[bool] = None,
+                        verify_integrity: bool = True):
+        """Build a serving engine from a committed training checkpoint.
+
+        Loads the ``model_states`` group ONLY (params-only mode —
+        optimizer moments and loss scale never touch the serving
+        replica). With ``tag=None`` the newest committed-and-verified
+        tag wins, skipping corrupt/uncommitted ones (the PR-1 fallback
+        discipline). ``quantize_weights`` (default: the
+        ``inference.quantize_weights`` config) ships the weights
+        through the qwZ int8 block wire format
+        (:func:`qwz_distribute_params`)."""
+        from deepspeed_tpu.runtime import checkpoint as ckptlib
+        cfg = _normalize_inference_config(inference_config)
+        candidates = [tag] if tag is not None else \
+            ckptlib.candidate_tags(load_dir)
+        chosen = None
+        for t in candidates:
+            d = os.path.join(load_dir, t)
+            ok, problems = ckptlib.verify_checkpoint_dir(
+                d, check_crc=verify_integrity)
+            if ok and ckptlib.state_groups(d)["model_states"]:
+                chosen = d
+                break
+            logger.warning(f"from_checkpoint: skipping {d}: "
+                           f"{problems or 'no model_states group'}")
+        if chosen is None:
+            raise FileNotFoundError(
+                f"no loadable committed checkpoint with model_states "
+                f"under {load_dir} (tag={tag!r})")
+        _, _, init_fn = _family_of(model_config)
+        template = jax.eval_shape(
+            lambda k: init_fn(model_config, k), jax.random.PRNGKey(0))
+        params = ckptlib.load_params_only(chosen, template)
+        if quantize_weights is None:
+            quantize_weights = cfg["quantize_weights"]
+        if quantize_weights:
+            params = qwz_distribute_params(params, cfg["quantize_block"])
+            logger.info(f"from_checkpoint: params distributed via qwZ "
+                        f"int8 (block {cfg['quantize_block']})")
+        engine = cls(model_config, params, cfg, dtype=dtype,
+                     monitor=monitor)
+        if engine._log is not None:
+            engine._log.add_event(
+                "serve_load", checkpoint=chosen,
+                quantize_weights=bool(quantize_weights))
+        logger.info(f"inference engine loaded params from {chosen}")
+        return engine
+
+    # ------------------------------------------------------------- misc
+    def _on_compile_event(self, ev):
+        if self._log is not None:
+            self._log.add_event("compile", fn=ev.fn_name, count=ev.count,
+                                wall_ms=round(ev.wall_ms, 3), step=ev.step)
+
+    def close(self):
+        if getattr(self.monitor, "mirror", None) is self._log:
+            self.monitor.mirror = None
+        if self._log is not None:
+            self._log.close()
+            self._log = None

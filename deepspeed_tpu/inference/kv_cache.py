@@ -1,0 +1,68 @@
+"""KV-cache allocation and accounting for the serving engine.
+
+The cache is ONE preallocated pair of arrays ``(kc, vc)``, each shaped
+``(layers, batch_rows, kv_heads, max_len, head_dim)`` — the static
+buffer the jit-compiled prefill/decode programs carry (and donate) so
+steady-state serving never allocates, never reshapes, and therefore
+never recompiles. ``batch_rows`` is ``max_batch_size + 1``: the extra
+row is the *scratch slot* — padding rows of a partially-filled prefill
+bucket scatter their (garbage) K/V there instead of corrupting a live
+request's slot.
+
+Writes happen inside the model forwards via
+:func:`deepspeed_tpu.models.gpt2.write_kv_cache` (per-row
+``lax.dynamic_update_slice``); this module only owns allocation, the
+family-specific geometry (GQA caches are kv_heads-sized), and byte
+accounting for telemetry.
+"""
+
+from typing import Any, NamedTuple, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["KVCacheSpec", "cache_spec_for", "init_kv_cache",
+           "kv_cache_bytes"]
+
+
+class KVCacheSpec(NamedTuple):
+    """Static geometry of the serving KV cache."""
+    num_layers: int
+    batch_rows: int      # serving slots + 1 scratch row
+    kv_heads: int        # GQA: the cache stays kv_heads-sized
+    max_len: int
+    head_dim: int
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def shape(self) -> Tuple[int, int, int, int, int]:
+        return (self.num_layers, self.batch_rows, self.kv_heads,
+                self.max_len, self.head_dim)
+
+
+def cache_spec_for(model_config, batch_rows: int, max_len: int,
+                   dtype=jnp.bfloat16) -> KVCacheSpec:
+    """Cache geometry from a model config (GPT2Config / LlamaConfig):
+    kv_heads-sized for GQA families, head-count-sized otherwise."""
+    kv_heads = getattr(model_config, "kv_heads", None) or \
+        model_config.num_heads
+    head_dim = getattr(model_config, "head_dim", None) or (
+        model_config.hidden_size // model_config.num_heads)
+    if max_len > model_config.max_position_embeddings:
+        raise ValueError(
+            f"kv cache max_len {max_len} exceeds the model's "
+            f"max_position_embeddings {model_config.max_position_embeddings}")
+    return KVCacheSpec(num_layers=model_config.num_layers,
+                       batch_rows=batch_rows, kv_heads=kv_heads,
+                       max_len=max_len, head_dim=head_dim, dtype=dtype)
+
+
+def init_kv_cache(spec: KVCacheSpec):
+    """Allocate the zeroed ``(kc, vc)`` pair."""
+    return (jnp.zeros(spec.shape, spec.dtype),
+            jnp.zeros(spec.shape, spec.dtype))
+
+
+def kv_cache_bytes(spec: KVCacheSpec) -> int:
+    """Total bytes of the (kc, vc) pair — the serving memory headline."""
+    return 2 * int(np.prod(spec.shape)) * jnp.dtype(spec.dtype).itemsize

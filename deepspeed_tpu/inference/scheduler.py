@@ -1,0 +1,251 @@
+"""Continuous-batching scheduler for the serving engine.
+
+Static-batch serving wastes slots: a batch of 8 runs at the speed of
+its longest request while 7 finished rows decode garbage. Continuous
+batching (Orca-style iteration-level scheduling) instead treats the
+decode batch as SLOTS: every engine step, finished sequences (EOS /
+max_tokens) are evicted and waiting requests are admitted into the
+freed slots via a bucketed prefill — occupancy stays high under
+heterogeneous request lengths.
+
+This module is the pure host-side half: FIFO queue, slot table, bucket
+grouping for admission, per-request sampling state (temperature + PRNG
+seed — deterministic per request, independent of what else shares the
+batch), and completion bookkeeping (TTFT, per-request token counts).
+The jit-facing half (padded arrays, cache scatter) lives in
+``inference/engine.py``; nothing here imports jax, so scheduler policy
+is unit-testable in microseconds.
+"""
+
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from deepspeed_tpu.inference.buckets import pick_bucket
+
+__all__ = ["Request", "FinishedRequest", "PrefillBatch", "Scheduler"]
+
+_uid_counter = itertools.count()
+
+
+@dataclass
+class Request:
+    """One generation request. ``seed`` drives the per-request PRNG key
+    (sampling is deterministic per request regardless of batch
+    composition); ``temperature <= 0`` decodes greedily."""
+    prompt: Sequence[int]
+    max_new_tokens: int = 16
+    temperature: float = 0.0
+    seed: int = 0
+    eos_id: Optional[int] = None
+    uid: int = field(default_factory=lambda: next(_uid_counter))
+
+    def __post_init__(self):
+        self.prompt = [int(t) for t in np.asarray(self.prompt).reshape(-1)]
+        if not self.prompt:
+            raise ValueError("empty prompt")
+        if self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+
+
+@dataclass
+class FinishedRequest:
+    """A completed request plus its serving telemetry."""
+    uid: int
+    prompt: List[int]
+    tokens: List[int]            # generated tokens (EOS included if hit)
+    finish_reason: str           # "eos" | "length"
+    ttft_ms: Optional[float]
+    latency_ms: float            # submit -> finish wall time
+
+
+@dataclass
+class PrefillBatch:
+    """One bucketed prefill the engine must run: ``requests[i]`` lands
+    in serving slot ``slot_ids[i]``; the engine pads to
+    (batch_bucket, prompt_bucket) and scatters pad rows to scratch."""
+    slot_ids: List[int]
+    requests: List[Request]
+    batch_bucket: int
+    prompt_bucket: int
+
+
+@dataclass
+class _Slot:
+    request: Request
+    position: int                # tokens currently in this row's cache
+    pending_tok: Optional[int]   # sampled, not yet written to cache
+    tokens: List[int]
+    t_submit: float
+    ttft_ms: Optional[float] = None
+
+
+class Scheduler:
+    """FIFO continuous-batching scheduler over ``num_slots`` decode
+    slots. The engine drives it: ``submit`` -> ``admit`` (bucketed
+    prefill batches for free slots) -> ``record_tokens`` (one sampled
+    token per active slot; evicts finished sequences and frees their
+    slots). ``clock`` is injectable for deterministic tests."""
+
+    def __init__(self, num_slots: int, prompt_buckets: Sequence[int],
+                 batch_buckets: Sequence[int], max_len: int,
+                 clock=time.monotonic):
+        if num_slots < 1:
+            raise ValueError("num_slots must be >= 1")
+        self.num_slots = int(num_slots)
+        self.prompt_buckets = tuple(int(b) for b in prompt_buckets)
+        self.batch_buckets = tuple(int(b) for b in batch_buckets)
+        self.max_len = int(max_len)
+        self._clock = clock
+        self.queue: List[Request] = []
+        self.slots: List[Optional[_Slot]] = [None] * self.num_slots
+        self._submit_time: Dict[int, float] = {}
+        self.finished: List[FinishedRequest] = []
+        self._new_ttfts: List[float] = []
+        # cumulative counters (serving telemetry)
+        self.total_admitted = 0
+        self.total_tokens = 0
+
+    # ------------------------------------------------------------ state
+    def free_slots(self) -> List[int]:
+        return [i for i, s in enumerate(self.slots) if s is None]
+
+    def active_slots(self) -> List[int]:
+        return [i for i, s in enumerate(self.slots) if s is not None]
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self.queue)
+
+    @property
+    def occupancy(self) -> float:
+        return 1.0 - len(self.free_slots()) / self.num_slots
+
+    def idle(self) -> bool:
+        return not self.queue and not self.active_slots()
+
+    # ----------------------------------------------------------- submit
+    def submit(self, request: Request) -> int:
+        """Queue a request; returns its uid. Rejects up front what no
+        bucket/cache geometry could ever serve — a queued request never
+        dies later of a shape it arrived with."""
+        plen = len(request.prompt)
+        if plen > max(self.prompt_buckets):
+            raise ValueError(
+                f"prompt length {plen} exceeds the largest prompt bucket "
+                f"{max(self.prompt_buckets)}")
+        if plen + request.max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({plen}) + max_new_tokens "
+                f"({request.max_new_tokens}) exceeds max_len {self.max_len}")
+        self._submit_time[request.uid] = self._clock()
+        self.queue.append(request)
+        return request.uid
+
+    # ------------------------------------------------------------ admit
+    def admit(self) -> List[PrefillBatch]:
+        """Assign waiting requests to free slots, grouped into bucketed
+        prefill batches.
+
+        FIFO with same-bucket batching: the head of the queue fixes the
+        prompt bucket; later queued requests sharing that bucket may
+        ride along (up to the largest batch bucket / free slots), which
+        keeps arrival order *across admissions* while letting one
+        prefill program serve several requests. Repeats until slots or
+        queue run out.
+        """
+        batches: List[PrefillBatch] = []
+        free = self.free_slots()
+        while free and self.queue:
+            head_bucket = pick_bucket(len(self.queue[0].prompt),
+                                      self.prompt_buckets)
+            cap = min(len(free), max(self.batch_buckets))
+            take: List[Request] = []
+            for req in self.queue:
+                if len(take) >= cap:
+                    break
+                if pick_bucket(len(req.prompt),
+                               self.prompt_buckets) == head_bucket:
+                    take.append(req)
+            for req in take:
+                self.queue.remove(req)
+            batch_bucket = pick_bucket(len(take), self.batch_buckets)
+            slot_ids = [free.pop(0) for _ in take]
+            now = self._clock()
+            for sid, req in zip(slot_ids, take):
+                self.slots[sid] = _Slot(
+                    request=req, position=len(req.prompt),
+                    pending_tok=None, tokens=[],
+                    t_submit=self._submit_time.pop(req.uid, now))
+            self.total_admitted += len(take)
+            batches.append(PrefillBatch(
+                slot_ids=slot_ids, requests=take,
+                batch_bucket=batch_bucket, prompt_bucket=head_bucket))
+        return batches
+
+    # ----------------------------------------------------- token stream
+    def record_tokens(self, tokens: Dict[int, int]
+                      ) -> List[FinishedRequest]:
+        """Record one sampled token per slot (``{slot_id: token}``) —
+        from a prefill's first token or a decode step — advancing each
+        slot's pending/position bookkeeping. Finished sequences (EOS or
+        max_new_tokens) are evicted; their slots free immediately for
+        the next ``admit``. Returns the newly finished requests."""
+        now = self._clock()
+        done: List[FinishedRequest] = []
+        for sid, tok in tokens.items():
+            slot = self.slots[sid]
+            if slot is None:
+                raise KeyError(f"slot {sid} is not active")
+            tok = int(tok)
+            if slot.pending_tok is not None:
+                # the previous sample was written to the cache by the
+                # decode step that produced this one
+                slot.position += 1
+            if slot.ttft_ms is None:
+                slot.ttft_ms = (now - slot.t_submit) * 1e3
+                self._new_ttfts.append(slot.ttft_ms)
+            slot.tokens.append(tok)
+            slot.pending_tok = tok
+            self.total_tokens += 1
+            req = slot.request
+            hit_eos = req.eos_id is not None and tok == req.eos_id
+            if hit_eos or len(slot.tokens) >= req.max_new_tokens:
+                done.append(FinishedRequest(
+                    uid=req.uid, prompt=list(req.prompt),
+                    tokens=list(slot.tokens),
+                    finish_reason="eos" if hit_eos else "length",
+                    ttft_ms=slot.ttft_ms,
+                    latency_ms=(now - slot.t_submit) * 1e3))
+                self.slots[sid] = None
+        self.finished.extend(done)
+        return done
+
+    def drain_ttfts(self) -> List[float]:
+        """TTFTs recorded since the last drain (telemetry pull — the
+        engine writes one ``Serve/ttft_ms`` scalar per admitted
+        request)."""
+        out = self._new_ttfts
+        self._new_ttfts = []
+        return out
+
+    # -------------------------------------------- decode-batch assembly
+    def decode_state(self):
+        """Host arrays for one decode dispatch over the full slot table:
+        (slot_ids, toks, positions, temps, seeds) — inactive rows carry
+        zeros and are ignored on the way back. Empty when nothing is
+        mid-decode."""
+        sids, toks, poss, temps, seeds = [], [], [], [], []
+        for sid in self.active_slots():
+            slot = self.slots[sid]
+            if slot.pending_tok is None:
+                continue        # admitted this step; first token pending
+            sids.append(sid)
+            toks.append(slot.pending_tok)
+            poss.append(slot.position)
+            temps.append(slot.request.temperature)
+            seeds.append(slot.request.seed)
+        return sids, toks, poss, temps, seeds

@@ -327,10 +327,52 @@ def _gpt2_trunk(params, config: GPT2Config, input_ids, rng=None,
     return x
 
 
+def _gpt2_trunk_cached(params, config: GPT2Config, input_ids, kv_cache,
+                       cache_position, dtype):
+    """Cache-carrying trunk: run ``input_ids`` (B, S) through the SAME
+    gpt2_block as training with attention over the provided KV cache
+    (``kv_cache = (kc, vc)``, each (layers, B, heads, max_len, hd)),
+    writing this call's K/V at each row's ``cache_position`` offset.
+    Returns (final hidden states after ln_f, updated kv_cache). Serves
+    prefill (S = padded prompt, cache_position = 0) and decode (S = 1,
+    per-slot positions) with one code path — no second copy of the
+    block math to drift."""
+    kc, vc = kv_cache
+    B, S = input_ids.shape
+    pos = cache_position[:, None] + jnp.arange(S)[None, :]
+    x = (params["wte"][input_ids] + params["wpe"][pos]).astype(dtype)
+    new_kc, new_vc = [], []
+    for i in range(config.num_layers):
+        box = []
+        x = gpt2_block(layer_params(params, config, i), config, x, None,
+                       True, dtype,
+                       attention_fn=_offset_cache_attention(
+                           kc[i], vc[i], cache_position, box))
+        ki, vi = box[0]
+        new_kc.append(ki)
+        new_vc.append(vi)
+    x = _layer_norm(x, params["ln_f"], config.layer_norm_eps)
+    return x, (jnp.stack(new_kc), jnp.stack(new_vc))
+
+
 def gpt2_forward(params, config: GPT2Config, input_ids, rng=None,
                  deterministic: bool = True, dtype=jnp.bfloat16,
-                 remat: bool = False):
-    """Logits (B, S, vocab). Embedding output layer is tied to wte."""
+                 remat: bool = False, kv_cache=None, cache_position=None):
+    """Logits (B, S, vocab). Embedding output layer is tied to wte.
+
+    KV-cache mode (serving): with ``kv_cache=(kc, vc)`` (each
+    ``(layers, B, heads, max_len, hd)``) and ``cache_position`` ((B,)
+    int32 — tokens already in each row's cache), the forward writes this
+    call's K/V into the cache at each row's offset, attends with
+    :func:`causal_cache_mask`, and returns ``(logits, updated_cache)``
+    instead of bare logits. The training call signature is unchanged
+    (both arguments default to None)."""
+    if kv_cache is not None:
+        if cache_position is None:
+            cache_position = jnp.zeros((input_ids.shape[0],), jnp.int32)
+        x, cache = _gpt2_trunk_cached(params, config, input_ids, kv_cache,
+                                      cache_position, dtype)
+        return _tied_logits(x, params["wte"], dtype), cache
     x = _gpt2_trunk(params, config, input_ids, rng=rng,
                     deterministic=deterministic, dtype=dtype, remat=remat)
     return _tied_logits(x, params["wte"], dtype)
@@ -401,27 +443,65 @@ def run_decode_scan(step_logits, sample, first_tok, caches,
     return jnp.concatenate([toks.T, last[:, None]], axis=1)
 
 
-def _cached_attention(kcache, vcache, pos, out_box):
-    """attention_fn for one decode step: write this position's K/V into
-    the cache, attend the single query to all cached positions <= pos.
-    Updated caches are returned through ``out_box`` (gpt2_block's hook
-    only returns the context)."""
+def causal_cache_mask(cache_position, q_len: int, kv_len: int):
+    """Causal mask over a KV cache that respects per-row cache offsets.
+
+    ``cache_position``: (B,) int32 — absolute position of each row's
+    FIRST query token in its stream (the number of tokens already in
+    that row's cache). Query j of row b therefore sits at position
+    ``cache_position[b] + j`` and may attend exactly the cache slots
+    ``<= `` that position: everything written before it plus the slots
+    this same call writes at/before its own position. Returns a bool
+    (B, 1, q_len, kv_len) mask (broadcasts over heads). The shared
+    offset-mask home for the cached prefill/decode paths of every model
+    family — the serving engine's bucketed programs pin their numerics
+    on it.
+    """
+    q_pos = cache_position[:, None] + jnp.arange(q_len)[None, :]
+    k_idx = jnp.arange(kv_len)
+    return k_idx[None, None, None, :] <= q_pos[:, None, :, None]
+
+
+def write_kv_cache(cache, new, cache_position):
+    """Write ``new`` (B, heads, S, hd) into ``cache`` (B, heads, max_len,
+    hd) starting at per-row position ``cache_position`` (B,) — a
+    ``lax.dynamic_update_slice`` vmapped over the batch so every serving
+    slot advances at its own offset (continuous batching: slots are at
+    different sequence lengths)."""
+    return jax.vmap(
+        lambda c, u, s: jax.lax.dynamic_update_slice(c, u, (0, s, 0))
+    )(cache, new.astype(cache.dtype), cache_position)
+
+
+def _offset_cache_attention(kcache, vcache, cache_position, out_box):
+    """attention_fn for the cached forward (prefill-into-cache and
+    decode alike): write this call's K/V into the cache at each row's
+    own offset, attend every query to all cache slots <= its absolute
+    position (``causal_cache_mask``). Updated caches return through
+    ``out_box`` (gpt2_block's hook only returns the context)."""
     def attn(q, k, v, rate, rng):
-        del rate, rng                      # decode is deterministic
-        kc = jax.lax.dynamic_update_slice(kcache, k.astype(kcache.dtype),
-                                          (0, 0, pos, 0))
-        vc = jax.lax.dynamic_update_slice(vcache, v.astype(vcache.dtype),
-                                          (0, 0, pos, 0))
+        del rate, rng                      # cached forward is deterministic
+        kc = write_kv_cache(kcache, k, cache_position)
+        vc = write_kv_cache(vcache, v, cache_position)
         out_box.append((kc, vc))
         hd = q.shape[-1]
         scores = jnp.einsum("bhqd,bhld->bhql", q.astype(jnp.float32),
                             kc.astype(jnp.float32)) / np.sqrt(hd)
-        valid = (jnp.arange(kc.shape[2]) <= pos)[None, None, None, :]
-        scores = jnp.where(valid, scores, NEG_INF)
+        mask = causal_cache_mask(cache_position, q.shape[2], kc.shape[2])
+        scores = jnp.where(mask, scores, NEG_INF)
         probs = jax.nn.softmax(scores, axis=-1)
         return jnp.einsum("bhql,bhld->bhqd", probs,
                           vc.astype(jnp.float32)).astype(q.dtype)
     return attn
+
+
+def _cached_attention(kcache, vcache, pos, out_box):
+    """Single-position decode hook (gpt2_generate's scan): every row
+    writes/attends at the same scalar ``pos`` — the offset-cache
+    attention with a broadcast position vector."""
+    B = kcache.shape[0]
+    return _offset_cache_attention(
+        kcache, vcache, jnp.full((B,), pos, jnp.int32), out_box)
 
 
 def gpt2_generate(params, config: GPT2Config, prompt_ids, max_new_tokens,

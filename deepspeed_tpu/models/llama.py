@@ -139,15 +139,23 @@ def rope_cos_sin(seq_len: int, head_dim: int, theta: float,
 
 
 def apply_rope(x, cos, sin):
-    """Rotate (B, H, S, hd) by per-position angles ((S, hd/2) tables).
+    """Rotate (B, H, S, hd) by per-position angles.
 
-    Pair layout is (x[..., :hd/2], x[..., hd/2:]) — the "rotate_half"
-    convention; consistent across q and k so relative phases match.
+    ``cos``/``sin`` are either the shared (S, hd/2) tables (training —
+    every row sees positions 0..S-1) or per-row (B, S, hd/2) gathers
+    (KV-cache serving — continuous-batching slots sit at different
+    absolute positions). Pair layout is (x[..., :hd/2], x[..., hd/2:])
+    — the "rotate_half" convention; consistent across q and k so
+    relative phases match.
     """
     half = x.shape[-1] // 2
     x1, x2 = x[..., :half], x[..., half:]
-    c = cos[None, None].astype(x.dtype)
-    s = sin[None, None].astype(x.dtype)
+    if cos.ndim == 3:            # (B, S, hd/2): broadcast over heads only
+        c = cos[:, None].astype(x.dtype)
+        s = sin[:, None].astype(x.dtype)
+    else:                        # (S, hd/2): broadcast over batch + heads
+        c = cos[None, None].astype(x.dtype)
+        s = sin[None, None].astype(x.dtype)
     return jnp.concatenate([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1)
 
 
@@ -204,37 +212,92 @@ def _llama_trunk(params, config: LlamaConfig, input_ids,
     return rms_norm(x, params["ln_f"]["w"], config.rms_norm_eps)
 
 
+def _gqa_offset_cache_attention(kcache, vcache, cache_position, out_box):
+    """attention_fn for the cached llama forward (prefill-into-cache and
+    decode alike): write this call's post-RoPE K/V into the hkv-head
+    cache at each row's own offset, attend group-wise over all cache
+    slots <= each query's absolute position (the shared
+    ``causal_cache_mask``). The cache stays kv_heads-sized — GQA's
+    serving payoff. Updated caches return through ``out_box``."""
+    from deepspeed_tpu.models.gpt2 import causal_cache_mask, write_kv_cache
+
+    def attn(q, k, v):
+        kc = write_kv_cache(kcache, k, cache_position)
+        vc = write_kv_cache(vcache, v, cache_position)
+        out_box.append((kc, vc))
+        B, H, S, hd = q.shape
+        hkv = kc.shape[1]
+        qg = q.reshape(B, hkv, H // hkv, S, hd)
+        scores = jnp.einsum("bkgsd,bkld->bkgsl", qg.astype(jnp.float32),
+                            kc.astype(jnp.float32)) / np.sqrt(hd)
+        mask = causal_cache_mask(cache_position, S, kc.shape[2])
+        scores = jnp.where(mask[:, :, None], scores, NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1)
+        ctx = jnp.einsum("bkgsl,bkld->bkgsd", probs,
+                         vc.astype(jnp.float32))
+        return ctx.reshape(B, H, S, hd).astype(q.dtype)
+    return attn
+
+
+def _llama_trunk_cached(params, config: LlamaConfig, input_ids, kv_cache,
+                        cache_position, dtype):
+    """Cache-carrying trunk (see gpt2._gpt2_trunk_cached): one code path
+    for prefill-into-cache and decode, through the SAME llama_block as
+    training. RoPE angles are gathered per row at each token's absolute
+    position. Returns (hidden states after ln_f, updated kv_cache)."""
+    from deepspeed_tpu.models.gpt2 import layer_params
+    kc, vc = kv_cache
+    B, S = input_ids.shape
+    max_len = kc.shape[3]
+    pos = cache_position[:, None] + jnp.arange(S)[None, :]
+    cos_full, sin_full = rope_cos_sin(max_len, config.head_dim,
+                                      config.rope_theta)
+    cos_b, sin_b = cos_full[pos], sin_full[pos]        # (B, S, hd/2)
+    x = params["tok_emb"][input_ids].astype(dtype)
+    new_kc, new_vc = [], []
+    for i in range(config.num_layers):
+        box = []
+        x = llama_block(layer_params(params, config, i), config, x,
+                        cos_b, sin_b, dtype,
+                        attention_fn=_gqa_offset_cache_attention(
+                            kc[i], vc[i], cache_position, box))
+        ki, vi = box[0]
+        new_kc.append(ki)
+        new_vc.append(vi)
+    x = rms_norm(x, params["ln_f"]["w"], config.rms_norm_eps)
+    return x, (jnp.stack(new_kc), jnp.stack(new_vc))
+
+
 def llama_forward(params, config: LlamaConfig, input_ids,
-                  dtype=jnp.bfloat16, remat: bool = False):
-    """Logits (B, S, vocab)."""
+                  dtype=jnp.bfloat16, remat: bool = False,
+                  kv_cache=None, cache_position=None):
+    """Logits (B, S, vocab).
+
+    KV-cache mode (serving): with ``kv_cache=(kc, vc)`` (each
+    ``(layers, B, kv_heads, max_len, hd)``) and ``cache_position``
+    ((B,) int32), writes this call's K/V at each row's offset and
+    returns ``(logits, updated_cache)`` — same contract as
+    :func:`deepspeed_tpu.models.gpt2.gpt2_forward`. Training call
+    signature unchanged."""
     from deepspeed_tpu.models.gpt2 import _tied_logits
+    if kv_cache is not None:
+        if cache_position is None:
+            cache_position = jnp.zeros((input_ids.shape[0],), jnp.int32)
+        x, cache = _llama_trunk_cached(params, config, input_ids,
+                                       kv_cache, cache_position, dtype)
+        return _tied_logits(x, params["lm_head"], dtype), cache
     x = _llama_trunk(params, config, input_ids, dtype=dtype, remat=remat)
     return _tied_logits(x, params["lm_head"], dtype)
 
 
 def _gqa_cached_attention(kcache, vcache, pos, out_box):
-    """Decode-step attention hook: write this position's (post-RoPE) K/V
-    into the hkv-head cache, attend the single query group-wise to all
-    cached positions <= pos. The cache stays kv_heads-sized — the point
-    of GQA at inference. Updated caches return through ``out_box``."""
-    def attn(q, k, v):
-        kc = jax.lax.dynamic_update_slice(kcache, k.astype(kcache.dtype),
-                                          (0, 0, pos, 0))
-        vc = jax.lax.dynamic_update_slice(vcache, v.astype(vcache.dtype),
-                                          (0, 0, pos, 0))
-        out_box.append((kc, vc))
-        B, H, _, hd = q.shape
-        hkv = kc.shape[1]
-        qg = q[:, :, 0].reshape(B, hkv, H // hkv, hd)
-        scores = jnp.einsum("bkgd,bkld->bkgl", qg.astype(jnp.float32),
-                            kc.astype(jnp.float32)) / np.sqrt(hd)
-        valid = (jnp.arange(kc.shape[2]) <= pos)[None, None, None, :]
-        scores = jnp.where(valid, scores, NEG_INF)
-        probs = jax.nn.softmax(scores, axis=-1)
-        ctx = jnp.einsum("bkgl,bkld->bkgd", probs,
-                         vc.astype(jnp.float32))
-        return ctx.reshape(B, H, 1, hd).astype(q.dtype)
-    return attn
+    """Single-position decode hook (llama_generate's scan): every row
+    writes/attends at the same scalar ``pos`` — the offset-cache GQA
+    attention with a broadcast position vector (one copy of the cache
+    attention math; the cache stays kv_heads-sized)."""
+    B = kcache.shape[0]
+    return _gqa_offset_cache_attention(
+        kcache, vcache, jnp.full((B,), pos, jnp.int32), out_box)
 
 
 def llama_generate(params, config: LlamaConfig, prompt_ids,

@@ -62,6 +62,13 @@ TAG_MEM_DELTA = "Memory/step_delta_bytes"
 TAG_DISPATCHES = "Observability/dispatches"       # cumulative jit calls
 TAG_HOST_SYNCS = "Observability/host_syncs"       # cumulative forced syncs
 TAG_HOST_GAP = "Observability/host_gap_ms"        # per-step host gap time
+# serving telemetry tags, re-exported into this registry from their
+# canonical home (utils/monitor.py write_serving_metrics, which writes
+# them; stdlib-only tools/obs_report.py mirrors the strings and the
+# pair is pinned by tests/unit/test_inference.py)
+from deepspeed_tpu.utils.monitor import (  # noqa: E402,F401
+    TAG_SERVE_OCCUPANCY, TAG_SERVE_QUEUE_DEPTH, TAG_SERVE_TOKEN_LATENCY,
+    TAG_SERVE_TPS, TAG_SERVE_TTFT)
 
 
 class Observer:

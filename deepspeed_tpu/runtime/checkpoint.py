@@ -370,6 +370,65 @@ def load_tree_sharded(ckpt_dir: str, name: str, template: Any,
     return treedef.unflatten(out)
 
 
+def load_params_only(ckpt_dir: str, template: Any,
+                     shardings: Optional[Any] = None) -> Any:
+    """Params-only load mode: restore exactly the ``model_states`` group
+    of a committed checkpoint — never optimizer moments, loss scale, or
+    host offload state. The checkpoint -> serving bridge
+    (``InferenceEngine.from_checkpoint``): a serving replica needs the
+    weights (1x model size), not the 3-4x training state the full
+    ``load_checkpoint`` path reassembles. Works against both the sharded
+    per-process format (elastic resharding onto any serving mesh via
+    ``shardings``) and the legacy single-file ``model_states.npz``."""
+    if sharded_exists(ckpt_dir, "model_states"):
+        return load_tree_sharded(ckpt_dir, "model_states", template,
+                                 shardings)
+    single = os.path.join(ckpt_dir, "model_states.npz")
+    if os.path.isfile(single):
+        return load_tree(single, template, shardings)
+    raise FileNotFoundError(
+        f"no model_states (sharded or single-file) in {ckpt_dir}")
+
+
+# state groups a tag directory may carry, in report order; "extras" are
+# engine-subclass files sealed via _save_checkpoint_extras (pipe layout)
+_STATE_GROUP_NAMES = ("model_states", "optim_states")
+
+
+def state_groups(ckpt_dir: str) -> Dict[str, Any]:
+    """Which state groups a checkpoint directory contains.
+
+    Returns ``{group: "sharded" | "single-file" | None}`` for the
+    array groups, plus ``cpu_optim_states``/``meta`` booleans and the
+    list of extra sealed files. Consumed by ``tools/verify_checkpoint.py``
+    (report) and the serving bridge (a params-only consumer can tell up
+    front whether a tag even carries weights)."""
+    groups: Dict[str, Any] = {}
+    for name in _STATE_GROUP_NAMES:
+        if sharded_exists(ckpt_dir, name):
+            groups[name] = "sharded"
+        elif os.path.isfile(os.path.join(ckpt_dir, f"{name}.npz")):
+            groups[name] = "single-file"
+        else:
+            groups[name] = None
+    groups["cpu_optim_states"] = os.path.isfile(
+        os.path.join(ckpt_dir, "cpu_optim_states.npz"))
+    groups["meta"] = os.path.isfile(os.path.join(ckpt_dir, "meta.json"))
+    known_prefixes = tuple(f"{n}.shard_" for n in _STATE_GROUP_NAMES)
+    known = {COMMIT_MARKER, "meta.json", "cpu_optim_states.npz",
+             "model_states.npz", "optim_states.npz"}
+    extras = []
+    if os.path.isdir(ckpt_dir):
+        for fn in sorted(os.listdir(ckpt_dir)):
+            if fn in known or fn.startswith(known_prefixes) or \
+                    fn.endswith(".part"):
+                continue
+            if os.path.isfile(os.path.join(ckpt_dir, fn)):
+                extras.append(fn)
+    groups["extras"] = extras
+    return groups
+
+
 def write_meta(ckpt_dir: str, meta: Dict) -> None:
     _atomic_write_bytes(
         os.path.join(ckpt_dir, "meta.json"),

@@ -445,6 +445,62 @@ def get_checkpoint_config(param_dict):
     }
 
 
+def get_inference_config(param_dict):
+    """Serving-engine knobs (deepspeed_tpu/inference/; docs/inference.md).
+    Bucket lists are validated up front — a malformed bucket table would
+    otherwise surface as silent steady-state recompiles, the exact
+    failure mode the buckets exist to prevent."""
+    from deepspeed_tpu.inference.buckets import validate_buckets
+    sub = param_dict.get(C.INFERENCE, {})
+    cfg = {
+        "max_batch_size": int(sub.get(C.INF_MAX_BATCH_SIZE,
+                                      C.INF_MAX_BATCH_SIZE_DEFAULT)),
+        "prompt_buckets": list(sub.get(C.INF_PROMPT_BUCKETS,
+                                       C.INF_PROMPT_BUCKETS_DEFAULT)),
+        "batch_buckets": list(sub.get(C.INF_BATCH_BUCKETS,
+                                      C.INF_BATCH_BUCKETS_DEFAULT)),
+        "max_seq_len": int(sub.get(C.INF_MAX_SEQ_LEN,
+                                   C.INF_MAX_SEQ_LEN_DEFAULT)),
+        "max_new_tokens": int(sub.get(C.INF_MAX_NEW_TOKENS,
+                                      C.INF_MAX_NEW_TOKENS_DEFAULT)),
+        "temperature": float(sub.get(C.INF_TEMPERATURE,
+                                     C.INF_TEMPERATURE_DEFAULT)),
+        "top_k": int(sub.get(C.INF_TOP_K, C.INF_TOP_K_DEFAULT)),
+        "eos_token_id": sub.get(C.INF_EOS_TOKEN_ID,
+                                C.INF_EOS_TOKEN_ID_DEFAULT),
+        "events_dir": sub.get(C.INF_EVENTS_DIR, C.INF_EVENTS_DIR_DEFAULT),
+        "quantize_weights": bool(sub.get(C.INF_QUANTIZE_WEIGHTS,
+                                         C.INF_QUANTIZE_WEIGHTS_DEFAULT)),
+        "quantize_block": int(sub.get(C.INF_QUANTIZE_BLOCK,
+                                      C.INF_QUANTIZE_BLOCK_DEFAULT)),
+    }
+    try:
+        cfg["prompt_buckets"] = list(validate_buckets(
+            cfg["prompt_buckets"], "inference.prompt_buckets"))
+        cfg["batch_buckets"] = list(validate_buckets(
+            cfg["batch_buckets"], "inference.batch_buckets"))
+    except ValueError as e:
+        raise DeepSpeedConfigError(str(e))
+    if cfg["max_batch_size"] < 1:
+        raise DeepSpeedConfigError(
+            f"inference.max_batch_size must be >= 1, got "
+            f"{cfg['max_batch_size']}")
+    if max(cfg["batch_buckets"]) > cfg["max_batch_size"]:
+        raise DeepSpeedConfigError(
+            f"inference.batch_buckets max ({max(cfg['batch_buckets'])}) "
+            f"exceeds max_batch_size ({cfg['max_batch_size']})")
+    if max(cfg["prompt_buckets"]) > cfg["max_seq_len"]:
+        raise DeepSpeedConfigError(
+            f"inference.prompt_buckets max ({max(cfg['prompt_buckets'])}) "
+            f"exceeds max_seq_len ({cfg['max_seq_len']})")
+    if cfg["max_new_tokens"] < 1 or cfg["top_k"] < 0 or \
+            cfg["quantize_block"] < 8:
+        raise DeepSpeedConfigError(
+            "inference: max_new_tokens >= 1, top_k >= 0 and "
+            "quantize_block >= 8 required")
+    return cfg
+
+
 def get_tensorboard_enabled(param_dict):
     if C.TENSORBOARD in param_dict:
         return get_scalar_param(param_dict[C.TENSORBOARD], C.TENSORBOARD_ENABLED,
@@ -549,6 +605,7 @@ class DeepSpeedConfig:
         self.compressed_allreduce_config = self.quantized_comm_config
         self.memory_breakdown = get_memory_breakdown(param_dict)
         self.checkpoint_config = get_checkpoint_config(param_dict)
+        self.inference_config = get_inference_config(param_dict)
         self.tensorboard_enabled = get_tensorboard_enabled(param_dict)
         self.tensorboard_output_path = get_tensorboard_output_path(param_dict)
         self.tensorboard_job_name = get_tensorboard_job_name(param_dict)

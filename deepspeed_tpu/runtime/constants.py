@@ -323,6 +323,50 @@ CHECKPOINT_IO_RETRIES_DEFAULT = 3
 CHECKPOINT_IO_RETRY_BACKOFF = "io_retry_backoff"
 CHECKPOINT_IO_RETRY_BACKOFF_DEFAULT = 0.05
 
+#############################################
+# Inference serving engine (TPU-native extension: the reference
+# snapshot is training-only. Bucketed prefill/decode over a
+# preallocated donated KV cache + continuous-batching scheduler;
+# see deepspeed_tpu/inference/ and docs/inference.md.)
+#
+# "inference": {
+#   "max_batch_size": 8,          # concurrent decode slots
+#   "prompt_buckets": [64, 256],  # prompt pad lengths (ascending)
+#   "batch_buckets": [1, 8],      # prefill batch pad sizes (ascending)
+#   "max_seq_len": 1024,          # KV-cache length (prompt + generated)
+#   "max_new_tokens": 128,        # per-request default
+#   "temperature": 0.0,           # 0 = greedy (per-request overridable)
+#   "top_k": 0,                   # engine-global (compiled-in) filter
+#   "eos_token_id": null,         # default stop token
+#   "events_dir": "",             # serving events.jsonl ("" disables)
+#   "quantize_weights": false,    # qwZ int8 block weight distribution
+#   "quantize_block": 256         # qwZ block size
+# }
+#############################################
+INFERENCE = "inference"
+INF_MAX_BATCH_SIZE = "max_batch_size"
+INF_MAX_BATCH_SIZE_DEFAULT = 8
+INF_PROMPT_BUCKETS = "prompt_buckets"
+INF_PROMPT_BUCKETS_DEFAULT = (64, 256)
+INF_BATCH_BUCKETS = "batch_buckets"
+INF_BATCH_BUCKETS_DEFAULT = (1, 8)
+INF_MAX_SEQ_LEN = "max_seq_len"
+INF_MAX_SEQ_LEN_DEFAULT = 1024
+INF_MAX_NEW_TOKENS = "max_new_tokens"
+INF_MAX_NEW_TOKENS_DEFAULT = 128
+INF_TEMPERATURE = "temperature"
+INF_TEMPERATURE_DEFAULT = 0.0
+INF_TOP_K = "top_k"
+INF_TOP_K_DEFAULT = 0
+INF_EOS_TOKEN_ID = "eos_token_id"
+INF_EOS_TOKEN_ID_DEFAULT = None
+INF_EVENTS_DIR = "events_dir"
+INF_EVENTS_DIR_DEFAULT = ""
+INF_QUANTIZE_WEIGHTS = "quantize_weights"
+INF_QUANTIZE_WEIGHTS_DEFAULT = False
+INF_QUANTIZE_BLOCK = "quantize_block"
+INF_QUANTIZE_BLOCK_DEFAULT = 256
+
 TENSORBOARD = "tensorboard"
 TENSORBOARD_ENABLED = "enabled"
 TENSORBOARD_ENABLED_DEFAULT = False

@@ -23,6 +23,17 @@ from deepspeed_tpu.utils.logging import logger
 
 __all__ = ["TensorBoardMonitor", "get_summary_writer"]
 
+# serving telemetry tags (written by write_serving_metrics for the
+# inference engine; x-axis = cumulative generated tokens). Canonical
+# home — profiling/__init__.py re-exports them into its tag registry;
+# stdlib-only tools/obs_report.py mirrors the strings (pinned together
+# by tests/unit/test_inference.py).
+TAG_SERVE_TTFT = "Serve/ttft_ms"                    # per admitted request
+TAG_SERVE_TOKEN_LATENCY = "Serve/token_latency_ms"  # per decode dispatch
+TAG_SERVE_TPS = "Serve/tokens_per_sec"              # cumulative rate
+TAG_SERVE_QUEUE_DEPTH = "Serve/queue_depth"         # waiting requests
+TAG_SERVE_OCCUPANCY = "Serve/batch_occupancy"       # active / total slots
+
 
 class _JsonlWriter:
     """Fallback SummaryWriter look-alike: one JSON object per scalar.
@@ -189,6 +200,35 @@ class TensorBoardMonitor:
         # like every other write_* method: without the flush, comm
         # telemetry buffered in the writer is lost on crash/preemption
         self.flush()
+
+    def write_serving_metrics(self, *, ttft_ms=None, token_latency_ms=None,
+                              tokens_per_sec=None, queue_depth=None,
+                              batch_occupancy=None, tokens: int = 0,
+                              flush: bool = True):
+        """Serving telemetry (inference engine; TPU-native extension —
+        the reference snapshot is training-only): time-to-first-token
+        per admitted request, per-decode-step token latency, cumulative
+        tokens/s, request-queue depth and decode-slot occupancy. The
+        x-axis is cumulative generated tokens (the serving analog of
+        the training samples axis). Tags are pinned by
+        tests/unit/test_inference.py and rendered by
+        tools/obs_report.py's serving section."""
+        if not self._writes():
+            return
+        if ttft_ms is not None:
+            self.write_scalar(TAG_SERVE_TTFT, ttft_ms, tokens)
+        if token_latency_ms is not None:
+            self.write_scalar(TAG_SERVE_TOKEN_LATENCY, token_latency_ms,
+                              tokens)
+        if tokens_per_sec is not None:
+            self.write_scalar(TAG_SERVE_TPS, tokens_per_sec, tokens)
+        if queue_depth is not None:
+            self.write_scalar(TAG_SERVE_QUEUE_DEPTH, queue_depth, tokens)
+        if batch_occupancy is not None:
+            self.write_scalar(TAG_SERVE_OCCUPANCY, batch_occupancy,
+                              tokens)
+        if flush:
+            self.flush()
 
     def write_timer_values(self, timer_values: dict, samples: int = 0):
         """Per-timer milliseconds (engine.py:950-974 pattern)."""

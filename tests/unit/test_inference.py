@@ -1,0 +1,526 @@
+"""Inference serving engine (deepspeed_tpu/inference/): bucketed
+prefill/decode with KV cache, continuous batching, checkpoint bridge,
+serving telemetry.
+
+Tier-1 acceptance pins (ISSUE 5):
+- greedy ``generate()`` exactly matches a one-shot full-sequence
+  forward argmax loop on CPU for BOTH model families;
+- steady-state decode performs ZERO recompiles after bucket warmup
+  (CompileTracker-counted);
+- scheduler admission/eviction/slot-reuse semantics and deterministic
+  per-request sampling with fixed keys.
+"""
+
+import importlib.util
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def tiny_gpt2():
+    from deepspeed_tpu.models.gpt2 import GPT2Config, init_gpt2_params
+    cfg = GPT2Config(vocab_size=61, max_position_embeddings=32,
+                     hidden_size=32, num_layers=2, num_heads=4,
+                     embd_dropout=0.0, attn_dropout=0.0,
+                     resid_dropout=0.0)
+    return cfg, init_gpt2_params(cfg, jax.random.PRNGKey(3))
+
+
+def tiny_llama():
+    from deepspeed_tpu.models.llama import LlamaConfig, init_llama_params
+    cfg = LlamaConfig(vocab_size=61, hidden_size=32, num_layers=2,
+                      num_heads=4, num_kv_heads=2,
+                      max_position_embeddings=32)
+    return cfg, init_llama_params(cfg, jax.random.PRNGKey(4))
+
+
+TINY_INF = {"max_batch_size": 3, "prompt_buckets": [4, 8],
+            "batch_buckets": [1, 2], "max_seq_len": 32,
+            "max_new_tokens": 4}
+
+
+def greedy_reference(forward, params, cfg, prompt, n):
+    """No-cache argmax loop: one full forward per generated token."""
+    ids = jnp.asarray([prompt], jnp.int32)
+    for _ in range(n):
+        logits = forward(params, cfg, ids, dtype=jnp.float32)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        ids = jnp.concatenate([ids, nxt[:, None]], axis=1)
+    return np.asarray(ids)[0].tolist()
+
+
+# --------------------------------------------------------------------- #
+# buckets
+# --------------------------------------------------------------------- #
+class TestBuckets:
+    def test_pick_bucket(self):
+        from deepspeed_tpu.inference.buckets import pick_bucket
+        assert pick_bucket(1, (4, 8)) == 4
+        assert pick_bucket(4, (4, 8)) == 4
+        assert pick_bucket(5, (4, 8)) == 8
+        with pytest.raises(ValueError, match="exceeds the largest"):
+            pick_bucket(9, (4, 8))
+
+    def test_validate_buckets(self):
+        from deepspeed_tpu.inference.buckets import validate_buckets
+        assert validate_buckets([4, 8], "b") == (4, 8)
+        for bad in ([], [0, 4], [8, 4], [4, 4]):
+            with pytest.raises(ValueError):
+                validate_buckets(bad, "b")
+
+    def test_pad_prompts(self):
+        from deepspeed_tpu.inference.buckets import pad_prompts
+        ids, lengths = pad_prompts([[1, 2], [3, 4, 5]], 4, 3)
+        assert ids.shape == (3, 4)
+        np.testing.assert_array_equal(lengths, [2, 3, 1])  # pad row len 1
+        np.testing.assert_array_equal(ids[0], [1, 2, 0, 0])
+        np.testing.assert_array_equal(ids[2], [0, 0, 0, 0])
+        with pytest.raises(ValueError):
+            pad_prompts([[1] * 5], 4, 1)          # prompt > bucket
+        with pytest.raises(ValueError):
+            pad_prompts([[1], [2]], 4, 1)         # batch > bucket
+
+
+# --------------------------------------------------------------------- #
+# scheduler (pure host-side: no jax)
+# --------------------------------------------------------------------- #
+class TestScheduler:
+    def _sched(self, slots=3, clock=None):
+        from deepspeed_tpu.inference.scheduler import Scheduler
+        kw = {"clock": clock} if clock else {}
+        return Scheduler(slots, (4, 8), (1, 2), 32, **kw)
+
+    def test_submit_validation(self):
+        from deepspeed_tpu.inference.scheduler import Request
+        s = self._sched()
+        with pytest.raises(ValueError, match="largest prompt bucket"):
+            s.submit(Request(prompt=list(range(1, 10))))
+        with pytest.raises(ValueError, match="max_len"):
+            s.submit(Request(prompt=[1, 2, 3], max_new_tokens=30))
+        with pytest.raises(ValueError, match="empty"):
+            Request(prompt=[])
+
+    def test_admission_groups_by_bucket_fifo(self):
+        from deepspeed_tpu.inference.scheduler import Request
+        s = self._sched(slots=3)
+        r1 = Request(prompt=[1, 2, 3], max_new_tokens=4)        # bucket 4
+        r2 = Request(prompt=[1] * 7, max_new_tokens=4)          # bucket 8
+        r3 = Request(prompt=[4, 5], max_new_tokens=4)           # bucket 4
+        for r in (r1, r2, r3):
+            s.submit(r)
+        batches = s.admit()
+        # head (r1) fixes bucket 4; r3 rides along; r2 admits second
+        assert len(batches) == 2
+        assert batches[0].prompt_bucket == 4
+        assert [r.uid for r in batches[0].requests] == [r1.uid, r3.uid]
+        assert batches[0].batch_bucket == 2
+        assert batches[1].prompt_bucket == 8
+        assert [r.uid for r in batches[1].requests] == [r2.uid]
+        assert batches[1].batch_bucket == 1
+        assert s.queue_depth == 0 and s.occupancy == 1.0
+
+    def test_eviction_and_slot_reuse(self):
+        from deepspeed_tpu.inference.scheduler import Request
+        s = self._sched(slots=1)
+        a = Request(prompt=[1, 2], max_new_tokens=2)
+        b = Request(prompt=[3], max_new_tokens=1, eos_id=9)
+        s.submit(a)
+        s.submit(b)
+        (batch,) = s.admit()
+        assert [r.uid for r in batch.requests] == [a.uid]
+        sid = batch.slot_ids[0]
+        assert s.record_tokens({sid: 5}) == []        # 1/2 tokens
+        assert s.admit() == []                        # slot still busy
+        done = s.record_tokens({sid: 6})
+        assert [f.uid for f in done] == [a.uid]
+        assert done[0].tokens == [5, 6]
+        assert done[0].finish_reason == "length"
+        # slot freed -> b admitted into the SAME slot
+        (batch2,) = s.admit()
+        assert batch2.slot_ids == [sid]
+        done = s.record_tokens({sid: 9})              # eos on first token
+        assert done[0].finish_reason == "eos"
+        assert s.idle()
+
+    def test_decode_state_bookkeeping(self):
+        from deepspeed_tpu.inference.scheduler import Request
+        s = self._sched(slots=2)
+        s.submit(Request(prompt=[1, 2, 3], max_new_tokens=3,
+                         temperature=0.7, seed=42))
+        (batch,) = s.admit()
+        sid = batch.slot_ids[0]
+        assert s.decode_state()[0] == []        # first token still pending
+        s.record_tokens({sid: 7})               # prefill's first token
+        sids, toks, poss, temps, seeds = s.decode_state()
+        assert sids == [sid] and toks == [7]
+        assert poss == [3]                      # prompt tokens in cache
+        assert temps == [0.7] and seeds == [42]
+        s.record_tokens({sid: 8})               # decode wrote tok 7 at 3
+        assert s.decode_state()[2] == [4]
+
+    def test_ttft_drain(self):
+        from deepspeed_tpu.inference.scheduler import Request
+        t = [0.0]
+        s = self._sched(slots=1, clock=lambda: t[0])
+        s.submit(Request(prompt=[1], max_new_tokens=2))
+        (batch,) = s.admit()
+        t[0] = 0.25
+        s.record_tokens({batch.slot_ids[0]: 1})
+        assert s.drain_ttfts() == [250.0]
+        assert s.drain_ttfts() == []
+
+
+# --------------------------------------------------------------------- #
+# model-level cached forward (satellite: training signature unchanged)
+# --------------------------------------------------------------------- #
+class TestCachedForward:
+    def test_causal_cache_mask(self):
+        from deepspeed_tpu.models.gpt2 import causal_cache_mask
+        m = np.asarray(causal_cache_mask(jnp.asarray([0, 2]), 2, 5))
+        assert m.shape == (2, 1, 2, 5)
+        # row 0 at offset 0: query j attends k <= j
+        np.testing.assert_array_equal(m[0, 0, 0], [1, 0, 0, 0, 0])
+        np.testing.assert_array_equal(m[0, 0, 1], [1, 1, 0, 0, 0])
+        # row 1 at offset 2: query 0 sits at absolute position 2
+        np.testing.assert_array_equal(m[1, 0, 0], [1, 1, 1, 0, 0])
+        np.testing.assert_array_equal(m[1, 0, 1], [1, 1, 1, 1, 0])
+
+    @pytest.mark.parametrize("family", ["gpt2", "llama"])
+    def test_chunked_cached_forward_matches_oneshot(self, family):
+        if family == "gpt2":
+            from deepspeed_tpu.models.gpt2 import gpt2_forward as fwd
+            cfg, params = tiny_gpt2()
+            heads = cfg.num_heads
+        else:
+            from deepspeed_tpu.models.llama import llama_forward as fwd
+            cfg, params = tiny_llama()
+            heads = cfg.kv_heads      # GQA cache stays kv_heads-sized
+        hd = cfg.hidden_size // cfg.num_heads
+        B, S, max_len = 2, 7, 16
+        ids = jnp.asarray(np.random.RandomState(0).randint(0, 61, (B, S)),
+                          jnp.int32)
+        ref = fwd(params, cfg, ids, dtype=jnp.float32)
+        cache = tuple(jnp.zeros((cfg.num_layers, B, heads, max_len, hd),
+                                jnp.float32) for _ in range(2))
+        # prefill 4 tokens into the cache, then decode 3 one by one
+        lg, cache = fwd(params, cfg, ids[:, :4], dtype=jnp.float32,
+                        kv_cache=cache)
+        outs = [lg]
+        for t in range(4, S):
+            lg, cache = fwd(params, cfg, ids[:, t:t + 1],
+                            dtype=jnp.float32, kv_cache=cache,
+                            cache_position=jnp.full((B,), t, jnp.int32))
+            outs.append(lg)
+        got = jnp.concatenate(outs, axis=1)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=2e-4)
+
+
+# --------------------------------------------------------------------- #
+# the serving engine
+# --------------------------------------------------------------------- #
+class TestInferenceEngine:
+    @pytest.mark.parametrize("family", ["gpt2", "llama"])
+    def test_greedy_generate_parity(self, family):
+        """ISSUE 5 acceptance: token-by-token greedy parity with the
+        one-shot full-forward argmax loop, under continuous batching
+        (6 mixed-length requests over 3 slots -> slot reuse on the
+        real path)."""
+        from deepspeed_tpu.inference import InferenceEngine
+        if family == "gpt2":
+            from deepspeed_tpu.models.gpt2 import gpt2_forward as fwd
+            cfg, params = tiny_gpt2()
+        else:
+            from deepspeed_tpu.models.llama import llama_forward as fwd
+            cfg, params = tiny_llama()
+        engine = InferenceEngine(cfg, params, TINY_INF,
+                                 dtype=jnp.float32)
+        rng = np.random.RandomState(1)
+        prompts = [rng.randint(1, 61, (n,)).tolist()
+                   for n in (3, 5, 7, 2, 8, 4)]
+        outs = engine.generate(prompts, max_new_tokens=4, temperature=0.0)
+        for prompt, out in zip(prompts, outs):
+            assert out == greedy_reference(fwd, params, cfg, prompt, 4)
+
+    def test_zero_steady_state_recompiles_after_warmup(self):
+        """ISSUE 5 acceptance: warmup compiles exactly
+        len(batch_buckets) x len(prompt_buckets) prefill programs + 1
+        decode program; serving traffic that stays inside the bucket
+        table compiles NOTHING more (CompileTracker-exact)."""
+        from deepspeed_tpu.inference import InferenceEngine
+        cfg, params = tiny_gpt2()
+        engine = InferenceEngine(cfg, params, TINY_INF,
+                                 dtype=jnp.float32)
+        assert engine.steady_state_recompiles == -1   # before warmup
+        programs = engine.warmup()
+        assert programs == 2 * 2 + 1
+        assert engine.compile_tracker.counts == {"prefill": 4,
+                                                 "decode": 1}
+        rng = np.random.RandomState(2)
+        prompts = [rng.randint(1, 61, (n,)).tolist()
+                   for n in (1, 4, 5, 8, 3, 6, 2, 7)]
+        engine.generate(prompts, max_new_tokens=3)
+        engine.generate(prompts[:2], max_new_tokens=5, temperature=0.5)
+        assert engine.steady_state_recompiles == 0
+        assert engine.compile_tracker.total_compiles == programs
+
+    def test_sampling_deterministic_per_request_keys(self):
+        """Same seeds -> identical streams regardless of runs; seeds are
+        per-request, so a request's stream does not depend on what else
+        shares the batch."""
+        from deepspeed_tpu.inference import InferenceEngine
+        cfg, params = tiny_gpt2()
+        engine = InferenceEngine(cfg, params, TINY_INF,
+                                 dtype=jnp.float32)
+        prompts = [[1, 2, 3], [4, 5]]
+        a = engine.generate(prompts, max_new_tokens=6, temperature=0.8,
+                            seeds=[7, 8])
+        b = engine.generate(prompts, max_new_tokens=6, temperature=0.8,
+                            seeds=[7, 8])
+        assert a == b
+        c = engine.generate(prompts, max_new_tokens=6, temperature=0.8,
+                            seeds=[70, 80])
+        assert a != c
+        # request 0 alone samples the same stream as batched with 1
+        solo = engine.generate([prompts[0]], max_new_tokens=6,
+                               temperature=0.8, seeds=[7])
+        assert solo[0] == a[0]
+        assert all(0 <= t < 61 for out in a for t in out)
+
+    def test_eos_stops_generation(self):
+        from deepspeed_tpu.inference import InferenceEngine
+        cfg, params = tiny_gpt2()
+        engine = InferenceEngine(cfg, params, TINY_INF,
+                                 dtype=jnp.float32)
+        prompt = [1, 2, 3]
+        full = engine.generate([prompt], max_new_tokens=6,
+                               temperature=0.0)[0]
+        gen = full[len(prompt):]
+        # declare a token greedy decoding is known to emit as EOS: the
+        # rerun must stop at its FIRST occurrence, inclusive
+        eos = gen[1]
+        stop = gen.index(eos)
+        stopped = engine.generate([prompt], max_new_tokens=6,
+                                  temperature=0.0, eos_id=eos)[0]
+        assert stopped == full[:len(prompt) + stop + 1]
+
+    def test_serving_telemetry_and_report(self, tmp_path):
+        """Serve/* scalars + serve events land in events.jsonl; the
+        obs_report serving section renders them (function AND CLI —
+        the tier-1 serving-report smoke)."""
+        from deepspeed_tpu.inference import InferenceEngine
+        cfg, params = tiny_gpt2()
+        icfg = dict(TINY_INF, events_dir=str(tmp_path))
+        engine = InferenceEngine(cfg, params, icfg, dtype=jnp.float32)
+        engine.warmup()
+        prompts = [[1, 2, 3], [4, 5], [6, 7, 8, 9], [10]]
+        engine.generate(prompts, max_new_tokens=4)
+        engine.close()
+
+        rows = [json.loads(line)
+                for line in open(tmp_path / "events.jsonl")]
+        tags = {r["tag"] for r in rows if "tag" in r}
+        # tag schema pinned (utils/monitor.write_serving_metrics)
+        assert {"Serve/ttft_ms", "Serve/token_latency_ms",
+                "Serve/tokens_per_sec", "Serve/queue_depth",
+                "Serve/batch_occupancy"} <= tags
+        events = {r["event"] for r in rows if "event" in r}
+        assert {"serve_warmup", "serve_finish", "compile"} <= events
+        assert sum(1 for r in rows
+                   if r.get("tag") == "Serve/ttft_ms") == len(prompts)
+
+        obs_report = _load_tool("obs_report")
+        s = obs_report.summarize(str(tmp_path))
+        sv = s["serving"]
+        assert sv["requests"] == len(prompts)
+        assert sv["decode_steps"] >= 1
+        assert sv["ttft_ms"]["p50"] is not None
+        assert sv["ttft_ms"]["p95"] >= sv["ttft_ms"]["p50"]
+        assert sv["token_latency_ms"]["p95"] is not None
+        assert sv["tokens_per_sec"]["last"] > 0
+        assert 0 < sv["batch_occupancy_mean"] <= 1
+        text = obs_report.render(s)
+        assert "serving" in text and "ttft_ms" in text
+        assert obs_report.main([str(tmp_path)]) == 0
+        assert obs_report.main([str(tmp_path), "--json"]) == 0
+
+    def test_serve_tag_registry_in_sync(self):
+        """One tag, three homes: the monitor (canonical writer), the
+        profiling registry (re-export), and stdlib-only obs_report
+        (mirrored strings) must agree."""
+        from deepspeed_tpu import profiling as prof
+        from deepspeed_tpu.utils import monitor as m
+        obs_report = _load_tool("obs_report")
+        assert m.TAG_SERVE_TTFT == prof.TAG_SERVE_TTFT == \
+            obs_report.T_TTFT
+        assert m.TAG_SERVE_TOKEN_LATENCY == \
+            prof.TAG_SERVE_TOKEN_LATENCY == obs_report.T_TOK_LAT
+        assert m.TAG_SERVE_TPS == prof.TAG_SERVE_TPS == obs_report.T_TPS
+        assert m.TAG_SERVE_QUEUE_DEPTH == prof.TAG_SERVE_QUEUE_DEPTH == \
+            obs_report.T_QDEPTH
+        assert m.TAG_SERVE_OCCUPANCY == prof.TAG_SERVE_OCCUPANCY == \
+            obs_report.T_OCC
+
+    def test_rejects_unservable_config(self):
+        from deepspeed_tpu.inference import InferenceEngine
+        cfg, params = tiny_gpt2()
+        with pytest.raises(ValueError, match="prompt_buckets"):
+            # buckets exceed the model's position table after clamping
+            InferenceEngine(cfg, params,
+                            dict(TINY_INF, prompt_buckets=[4, 64],
+                                 max_seq_len=1024))
+
+
+# --------------------------------------------------------------------- #
+# checkpoint -> serving bridge
+# --------------------------------------------------------------------- #
+class TestFromCheckpoint:
+    def _save_training_checkpoint(self, tmp_path, cfg, params):
+        import deepspeed_tpu
+        from deepspeed_tpu.models.gpt2 import gpt2_loss_fn
+        engine, *_ = deepspeed_tpu.initialize(
+            model=gpt2_loss_fn(cfg, dtype=jnp.float32,
+                               deterministic=True),
+            model_parameters=params,
+            config={"train_micro_batch_size_per_gpu": 1,
+                    "gradient_accumulation_steps": 1,
+                    "steps_per_print": 10**9,
+                    "optimizer": {"type": "Adam",
+                                  "params": {"lr": 1e-3}}})
+        return engine.save_checkpoint(str(tmp_path))
+
+    def test_params_only_load_and_parity(self, tmp_path):
+        """A committed PR-1 training checkpoint serves: params-only load
+        (no optimizer state touched), greedy outputs identical to an
+        engine built from the in-memory params."""
+        from deepspeed_tpu.inference import InferenceEngine
+        from deepspeed_tpu.runtime import checkpoint as ckpt
+        cfg, params = tiny_gpt2()
+        self._save_training_checkpoint(tmp_path, cfg, params)
+
+        groups = ckpt.state_groups(
+            os.path.join(str(tmp_path), ckpt.read_latest(str(tmp_path))))
+        assert groups["model_states"] == "sharded"
+        assert groups["optim_states"] == "sharded"
+        assert groups["meta"]
+
+        served = InferenceEngine.from_checkpoint(
+            str(tmp_path), cfg, inference_config=TINY_INF,
+            dtype=jnp.float32)
+        direct = InferenceEngine(cfg, params, TINY_INF,
+                                 dtype=jnp.float32)
+        prompts = [[1, 2, 3], [4, 5, 6, 7]]
+        assert served.generate(prompts, max_new_tokens=4) == \
+            direct.generate(prompts, max_new_tokens=4)
+
+    def test_params_only_checkpoint_is_servable(self, tmp_path):
+        """A tag carrying ONLY model_states (no optimizer group at all)
+        loads — proof the bridge never requires training state."""
+        from deepspeed_tpu.inference import InferenceEngine
+        from deepspeed_tpu.runtime import checkpoint as ckpt
+        cfg, params = tiny_gpt2()
+        tag_dir = tmp_path / "weights_only"
+        tag_dir.mkdir()
+        ckpt.save_tree_sharded(str(tag_dir), "model_states", params)
+        ckpt.write_meta(str(tag_dir), {"global_step": 0})
+        ckpt.write_commit_marker(str(tag_dir))
+        ckpt.write_latest(str(tmp_path), "weights_only")
+        groups = ckpt.state_groups(str(tag_dir))
+        assert groups["model_states"] == "sharded"
+        assert groups["optim_states"] is None
+        engine = InferenceEngine.from_checkpoint(
+            str(tmp_path), cfg, inference_config=TINY_INF,
+            dtype=jnp.float32)
+        out = engine.generate([[1, 2, 3]], max_new_tokens=2)[0]
+        assert len(out) == 5
+
+    def test_qwz_quantized_weight_path(self, tmp_path):
+        """quantize_weights=True ships params through the qwZ int8
+        block format: the engine still serves, and greedy outputs stay
+        close to the fp32 weights' (identical at this size — int8
+        block quantization error is far below the logit gaps)."""
+        from deepspeed_tpu.inference import InferenceEngine
+        cfg, params = tiny_gpt2()
+        self._save_training_checkpoint(tmp_path, cfg, params)
+        q = InferenceEngine.from_checkpoint(
+            str(tmp_path), cfg, inference_config=TINY_INF,
+            dtype=jnp.float32, quantize_weights=True)
+        # weights really were roundtripped through int8 blocks
+        assert not np.allclose(np.asarray(q.params["wte"]),
+                               np.asarray(params["wte"]))
+        out = q.generate([[1, 2, 3]], max_new_tokens=3)[0]
+        assert len(out) == 6 and all(0 <= t < 61 for t in out)
+
+    def test_verify_checkpoint_cli_reports_state_groups(self, tmp_path,
+                                                        capsys):
+        """tools/verify_checkpoint.py names the state groups a committed
+        tag contains (the satellite's reporting requirement)."""
+        cfg, params = tiny_gpt2()
+        self._save_training_checkpoint(tmp_path, cfg, params)
+        vc = _load_tool("verify_checkpoint")
+        assert vc.main([str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "state groups:" in out
+        assert "model_states(sharded)" in out
+        assert "optim_states(sharded)" in out
+
+    def test_from_checkpoint_rejects_corrupt(self, tmp_path):
+        from deepspeed_tpu.inference import InferenceEngine
+        cfg, _ = tiny_gpt2()
+        with pytest.raises(FileNotFoundError):
+            InferenceEngine.from_checkpoint(
+                str(tmp_path), cfg, inference_config=TINY_INF)
+
+
+# --------------------------------------------------------------------- #
+# config section
+# --------------------------------------------------------------------- #
+class TestInferenceConfigSection:
+    def test_defaults_parse(self):
+        from deepspeed_tpu.runtime.config import get_inference_config
+        cfg = get_inference_config({})
+        assert cfg["max_batch_size"] == 8
+        assert cfg["prompt_buckets"] == [64, 256]
+        assert cfg["batch_buckets"] == [1, 8]
+        assert cfg["temperature"] == 0.0 and cfg["top_k"] == 0
+
+    def test_validation(self):
+        from deepspeed_tpu.runtime.config import (DeepSpeedConfigError,
+                                                  get_inference_config)
+        with pytest.raises(DeepSpeedConfigError):
+            get_inference_config(
+                {"inference": {"prompt_buckets": [8, 4]}})
+        with pytest.raises(DeepSpeedConfigError):
+            get_inference_config(
+                {"inference": {"batch_buckets": [16],
+                               "max_batch_size": 8}})
+        with pytest.raises(DeepSpeedConfigError):
+            get_inference_config(
+                {"inference": {"prompt_buckets": [2048],
+                               "max_seq_len": 1024}})
+
+    def test_rides_deepspeed_config(self):
+        from deepspeed_tpu.runtime.config import DeepSpeedConfig
+        cfg = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 1,
+                               "inference": {"max_batch_size": 2,
+                                             "prompt_buckets": [16],
+                                             "batch_buckets": [2],
+                                             "max_seq_len": 64}},
+                              world_size=1)
+        assert cfg.inference_config["max_batch_size"] == 2
+        assert cfg.inference_config["prompt_buckets"] == [16]

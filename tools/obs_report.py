@@ -15,6 +15,8 @@ tests/unit/test_monitor.py) and prints the run report:
   threshold fraction of step time (--host-gap-threshold)
 - memory watermarks (peak / last in-use)
 - checkpoint events (saves / loads / fallbacks)
+- serving section (inference-engine runs): requests, TTFT p50/p95,
+  per-token latency p50/p95, tokens/s, slot occupancy, queue depth
 - loss trajectory (first -> last)
 
 Usage::
@@ -50,6 +52,14 @@ T_HOST_SYNCS = "Observability/host_syncs"
 T_HOST_GAP = "Observability/host_gap_ms"
 T_MEM_PEAK = "Memory/peak_bytes_in_use"
 T_MEM_USE = "Memory/bytes_in_use"
+# serving telemetry (inference engine; utils/monitor.py
+# write_serving_metrics — one ttft row per admitted request, one
+# latency/occupancy row per decode step)
+T_TTFT = "Serve/ttft_ms"
+T_TOK_LAT = "Serve/token_latency_ms"
+T_TPS = "Serve/tokens_per_sec"
+T_QDEPTH = "Serve/queue_depth"
+T_OCC = "Serve/batch_occupancy"
 
 # host gap above this fraction of step time flags the run: the device
 # is waiting on the host often enough to cost real throughput
@@ -160,6 +170,28 @@ def summarize(path, host_gap_threshold=DEFAULT_HOST_GAP_THRESHOLD):
 
     mem_peak = _vals(scalars, T_MEM_PEAK)
 
+    # serving section (inference engine runs): p50/p95 latency is the
+    # serving headline — step-time percentiles mean nothing to a user
+    # waiting on a token
+    ttft = _vals(scalars, T_TTFT)
+    tok_lat = _vals(scalars, T_TOK_LAT)
+    tps = _vals(scalars, T_TPS)
+    occ = _vals(scalars, T_OCC)
+    qdepth = _vals(scalars, T_QDEPTH)
+    serve_finish = [e for e in events if e.get("event") == "serve_finish"]
+    serving = {
+        "requests": len(ttft) or len(serve_finish),
+        "decode_steps": len(tok_lat),
+        "ttft_ms": {"p50": percentile(ttft, 0.50),
+                    "p95": percentile(ttft, 0.95)},
+        "token_latency_ms": {"p50": percentile(tok_lat, 0.50),
+                             "p95": percentile(tok_lat, 0.95)},
+        "tokens_per_sec": {"last": tps[-1] if tps else None,
+                           "best": max(tps) if tps else None},
+        "batch_occupancy_mean": (sum(occ) / len(occ)) if occ else None,
+        "queue_depth_max": max(qdepth) if qdepth else None,
+    }
+
     ckpt = {"saves": 0, "loads": 0, "fallbacks": 0, "save_ms": []}
     for tag, rows in scalars.items():
         if tag.endswith("checkpoint_save_ok"):
@@ -211,6 +243,7 @@ def summarize(path, host_gap_threshold=DEFAULT_HOST_GAP_THRESHOLD):
             "peak_bytes_in_use": max(mem_peak) if mem_peak else None,
             "last_bytes_in_use": _last(scalars, T_MEM_USE),
         },
+        "serving": serving,
         "checkpoints": {
             "saves": ckpt["saves"], "loads": ckpt["loads"],
             "fallbacks": ckpt["fallbacks"],
@@ -276,6 +309,23 @@ def render(s):
                      "is waiting on the host (check prefetch depth / "
                      "per-step syncs) **")
         lines.append(line)
+    sv = s.get("serving") or {}
+    if sv.get("requests"):
+        lines += [
+            f"  serving           : requests={sv['requests']} "
+            f"decode_steps={sv['decode_steps']} "
+            f"tokens/s last={_fmt(sv['tokens_per_sec']['last'])} "
+            f"best={_fmt(sv['tokens_per_sec']['best'])}",
+            f"    ttft_ms         : p50={_fmt(sv['ttft_ms']['p50'])} "
+            f"p95={_fmt(sv['ttft_ms']['p95'])}",
+            f"    token_latency_ms: "
+            f"p50={_fmt(sv['token_latency_ms']['p50'])} "
+            f"p95={_fmt(sv['token_latency_ms']['p95'])}",
+            f"    occupancy       : "
+            f"mean={_fmt(sv['batch_occupancy_mean'], '{:.1%}')} "
+            f"queue_depth_max="
+            f"{_fmt(sv['queue_depth_max'], '{:.0f}')}",
+        ]
     lines += [
         f"  memory            : "
         f"peak={_fmt_bytes(s['memory']['peak_bytes_in_use'])} "

@@ -69,6 +69,25 @@ def verify_tag_dir(ckpt_dir, check_crc=True):
     if ok:
         print(f"  file integrity: OK "
               f"({'sizes+crc32' if check_crc and marker else 'sizes' if marker else 'legacy best-effort'})")
+    # which state groups this tag carries — a params-only consumer
+    # (InferenceEngine.from_checkpoint) needs model_states and nothing
+    # else; a training resume needs optim_states (+ cpu_optim_states
+    # under ZeRO-Offload) too
+    groups = ckpt.state_groups(ckpt_dir)
+    parts = []
+    for name in ("model_states", "optim_states"):
+        fmt = groups[name]
+        parts.append(f"{name}({fmt})" if fmt else f"{name}(MISSING)")
+    if groups["cpu_optim_states"]:
+        parts.append("cpu_optim_states")
+    if groups["meta"]:
+        parts.append("meta")
+    if groups["extras"]:
+        parts.append(f"extras={groups['extras']}")
+    print(f"  state groups: {', '.join(parts)}")
+    if groups["model_states"] and not groups["optim_states"]:
+        print("  note: params-only checkpoint (serving-loadable; not a "
+              "training resume point)")
     for name in ("model_states", "optim_states"):
         try:
             rows = _leaf_coverage(ckpt_dir, name)
