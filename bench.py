@@ -11,6 +11,13 @@ Metrics (BASELINE.md rows):
   gradient, counted from the partitioned HLO on a forced 8-device CPU
   mesh (same accounting as tests/unit/test_hlo_quantized_comm.py);
   vs_baseline = quantized / dense-bf16-ring ratio (acceptance: <= 0.6)
+- comm_overlap_structure : HARDWARE-FREE — structural compute/comm
+  overlap of the comm_autotune fused step: fraction of grad-exchange
+  collectives in the scan body whose operand cone is dot-general-free
+  (data-independent of the iteration's compute -> schedulable under it;
+  serial = 0, overlapped = 1), counted from the partitioned HLO on the
+  forced 8-device CPU mesh; vs_baseline = modeled overlapped/serial
+  step time from the comm_autotune cost model
 - mfu_cost_model : HARDWARE-FREE — XLA cost-analysis FLOPs/token of the
   compiled GPT-2 micro-step (the same record the observability layer's
   flops profiler writes per run), on the forced 8-device CPU mesh;
@@ -79,6 +86,7 @@ _EMIT_LOCK = threading.Lock()
 # virtual CPU mesh) and runs first: it lands even when the tunnel is dead.
 METRICS = [
     "comm_wire_bytes_per_step",
+    "comm_overlap_structure",
     "mfu_cost_model",
     "host_dispatch_overhead",
     "decode_throughput",
@@ -91,8 +99,9 @@ METRICS = [
 HEADLINE = "gpt2_train_mfu"
 # metrics that never touch the device tunnel: forced onto a virtual
 # 8-device CPU mesh in their child, runnable with the tunnel down
-HW_FREE = {"comm_wire_bytes_per_step", "mfu_cost_model",
-           "host_dispatch_overhead", "decode_throughput"}
+HW_FREE = {"comm_wire_bytes_per_step", "comm_overlap_structure",
+           "mfu_cost_model", "host_dispatch_overhead",
+           "decode_throughput"}
 
 PARTIAL_PATH = os.environ.get(
     "BENCH_PARTIAL", "/tmp/dstpu_bench_partial.jsonl")
@@ -694,6 +703,112 @@ def bench_comm_wire_bytes(on_tpu, rtt):
                   "source": "partitioned-HLO audit (hardware-free)"})
 
 
+def bench_comm_overlap_structure(on_tpu, rtt):
+    """Hardware-free row: structural compute/comm overlap of the
+    comm_autotune fused step (ISSUE 6), from the partitioned HLO of a
+    tiny quantized-comm engine on the virtual 8-device CPU mesh.
+
+    value = fraction of grad-exchange collectives inside the scan body
+    whose operand cone contains NO dot-general — i.e. they consume only
+    the double-buffered carry, so the scheduler can run them under the
+    iteration's compute (serial exchange scores 0, overlapped 1; the
+    same dependence audit tier-1 pins in test_hlo_quantized_comm.py).
+    vs_baseline = modeled overlapped/serial step time from the
+    comm_autotune cost model + the program's cost-analysis FLOPs at the
+    45%-MFU v5e bar (< 1.0 = overlap pays). detail carries the serial
+    program's fractions (sanity: ~0), the post-scan flush count, and
+    the positional interleave view (printed HLO order — NOT schedule
+    order on CPU, reported for reference only).
+    """
+    del on_tpu, rtt           # compiled-HLO accounting; no device timing
+    import jax
+    import jax.numpy as jnp
+    import deepspeed_tpu
+    from jax.sharding import NamedSharding, PartitionSpec
+    from deepspeed_tpu.profiling.flops import profile_jit_fn
+    from deepspeed_tpu.runtime.comm_autotune import (LinkModel,
+                                                     exchange_time_us)
+    from deepspeed_tpu.utils.hlo_audit import overlap_structure
+
+    gas, d_in, d_h = 3, 64, 256
+    n_dev = jax.device_count()
+
+    def loss_fn(params, batch, rngs=None):
+        h = jnp.tanh(batch["x"] @ params["w1"])
+        return jnp.mean((h @ params["w2"] - batch["y"]) ** 2)
+
+    key = jax.random.PRNGKey(0)
+    params = {"w1": jax.random.normal(key, (d_in, d_h)) * 0.1,
+              "w2": jax.random.normal(key, (d_h, d_in)) * 0.1}
+
+    def fused_hlo(overlap):
+        engine, *_ = deepspeed_tpu.initialize(
+            model=loss_fn, model_parameters=params,
+            config={"train_micro_batch_size_per_gpu": 4,
+                    "gradient_accumulation_steps": gas,
+                    "steps_per_print": 10**9,
+                    "quantized_comm": {"enabled": True},
+                    "comm_autotune": {"enabled": True, "overlap": overlap},
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}})
+        rs = np.random.RandomState(0)
+        shd = NamedSharding(engine.mesh,
+                            PartitionSpec(engine._dp_axis_entry))
+        b = {"x": jax.device_put(rs.randn(4 * n_dev, d_in)
+                                 .astype(np.float32), shd),
+             "y": jax.device_put(rs.randn(4 * n_dev, d_in)
+                                 .astype(np.float32), shd)}
+        stacked = jax.device_put(
+            jax.tree_util.tree_map(
+                lambda x: np.stack([np.asarray(x)] * gas), b),
+            engine._stacked_batch_sharding())
+        assert engine._batch_path() and engine._overlap_path() == overlap
+        step = engine._get_compiled_batch_step()
+        txt = step.lower(engine.state, stacked).compile().as_text()
+        return engine, step, (engine.state, stacked), txt
+
+    engine, step, args, txt_o = fused_hlo(True)
+    stats_o = overlap_structure(txt_o)
+    _beat()
+    _eng_s, _step_s, _args_s, txt_s = fused_hlo(False)
+    stats_s = overlap_structure(txt_s)
+    _beat()
+
+    # modeled step-time gain: per-micro exchange time from the cost
+    # model, per-micro compute time from the program's cost-analysis
+    # FLOPs at the reference 45%-MFU v5e bar; the overlapped window
+    # hides gas-1 of the gas exchanges under the next micro's compute
+    sizes = [p.size for p in jax.tree_util.tree_leaves(params)]
+    t_ex = exchange_time_us(sizes, engine.dp_world_size,
+                            block=engine._quant_block,
+                            algo=engine._quant_algo, link=LinkModel())
+    prof = profile_jit_fn(step, args, name="fused_step")
+    t_c = prof.flops / gas / (0.45 * 197e12) * 1e6   # us per micro
+    serial_us = gas * (t_c + t_ex)
+    overlap_us = gas * max(t_c, t_ex) + min(t_c, t_ex)
+    return _emit("comm_overlap_structure",
+                 round(stats_o["overlap_fraction"], 4),
+                 "fraction_exchange_collectives_dot_free",
+                 round(overlap_us / serial_us, 4),
+                 {"gas": gas, "world": engine.dp_world_size,
+                  "serial_overlap_fraction":
+                      round(stats_s["overlap_fraction"], 4),
+                  "flush_outside_loop": stats_o["flush_outside_loop"],
+                  "serial_flush_outside_loop":
+                      stats_s["flush_outside_loop"],
+                  "exchange_collectives_in_body":
+                      stats_o["exchange_collectives"],
+                  "positional_interleaved_fraction":
+                      round(stats_o["interleaved_fraction"], 4),
+                  "modeled_exchange_us_per_micro": round(t_ex, 3),
+                  "modeled_compute_us_per_micro_at_45pct_v5e":
+                      round(t_c, 3),
+                  "modeled_serial_step_us": round(serial_us, 3),
+                  "modeled_overlapped_step_us": round(overlap_us, 3),
+                  "backend": jax.default_backend(),
+                  "source": "partitioned-HLO dependence audit + "
+                            "comm_autotune cost model (hardware-free)"})
+
+
 def bench_mfu_cost_model(on_tpu, rtt):
     """Hardware-free row: cost-analysis FLOPs per token of the compiled
     GPT-2 micro-step (fwd + bwd + Adam update, ZeRO-2 over the virtual
@@ -943,9 +1058,9 @@ def run_child(metric):
             time.sleep(30)
             if time.monotonic() - _BEAT[0] > STALL_TIMEOUT:
                 _emit(metric, 0.0, "error", 0.0,
-                      {"error": "device unreachable: no benchmark "
+                      {"error": "device_unreachable: no benchmark "
                                 f"progress for {STALL_TIMEOUT}s "
-                                "(tunnel down?)"})
+                                "(tunnel down?)", "skipped": True})
                 os._exit(2)
 
     threading.Thread(target=_watchdog, daemon=True).start()
@@ -971,6 +1086,8 @@ def run_child(metric):
 
     if metric == "comm_wire_bytes_per_step":
         bench_comm_wire_bytes(on_tpu, rtt)
+    elif metric == "comm_overlap_structure":
+        bench_comm_overlap_structure(on_tpu, rtt)
     elif metric == "mfu_cost_model":
         bench_mfu_cost_model(on_tpu, rtt)
     elif metric == "host_dispatch_overhead":
@@ -1146,13 +1263,44 @@ def _probe_tunnel(timeout=300):
         return False
 
 
+def _last_metric_row(stdout, metric):
+    """Last JSON row for ``metric`` in a child's stdout, preferring
+    VALUE rows over error rows: a child whose stall watchdog fired
+    during teardown — AFTER the measurement row streamed — appends a
+    ``device_unreachable`` error row last, and taking it would discard
+    a completed measurement (the same teardown-hang failure the
+    TimeoutExpired salvage covers, via the in-child watchdog instead of
+    the parent timeout). None when no row matched."""
+    row = err_row = None
+    for line in (stdout or "").splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                cand = json.loads(line)
+            except ValueError:
+                continue
+            if cand.get("metric") == metric:
+                if cand.get("unit") == "error":
+                    err_row = cand
+                else:
+                    row = cand
+    return row if row is not None else err_row
+
+
 def _run_metric_subprocess(metric):
     """(row, err): parse the child's last JSON row; err string on failure.
 
     Per-row time budget: hardware-free rows get the tight
     HW_FREE_TIMEOUT, device rows the full METRIC_TIMEOUT, and BOTH are
     clamped to what is left of the overall ladder budget — a slow row
-    can delay later rows but never erase already-streamed ones."""
+    can delay later rows but never erase already-streamed ones.
+
+    Rows are streamed by the child the moment they land, so a child
+    killed by the timeout may STILL have finished its measurement (a
+    teardown hang — historically a dead tunnel during device shutdown):
+    the captured-so-far stdout is parsed and a completed value row is
+    salvaged instead of discarded (the r02–r05 "one hang zeroed the
+    revision" fix)."""
     cmd = [sys.executable, os.path.abspath(__file__), "--metric", metric]
     env = None
     timeout = HW_FREE_TIMEOUT if metric in HW_FREE else METRIC_TIMEOUT
@@ -1172,23 +1320,30 @@ def _run_metric_subprocess(metric):
     try:
         r = subprocess.run(cmd, capture_output=True, text=True,
                            timeout=timeout, env=env)
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
+        out = e.stdout
+        if isinstance(out, bytes):
+            out = out.decode(errors="replace")
+        row = _last_metric_row(out, metric)
+        if row is not None and row.get("unit") != "error":
+            row.setdefault("detail", {})["salvaged"] = (
+                f"child exceeded {timeout}s after the row landed "
+                "(teardown hang); measurement kept")
+            return row, None
         return None, f"metric subprocess exceeded {timeout}s (killed)"
-    row = None
-    for line in r.stdout.splitlines():
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                cand = json.loads(line)
-                if cand.get("metric") == metric:
-                    row = cand
-            except ValueError:
-                pass
+    row = _last_metric_row(r.stdout, metric)
     if row is None:
         tail = (r.stderr or r.stdout or "").strip().splitlines()[-3:]
         return None, f"child rc={r.returncode}, no row; tail={' | '.join(tail)}"
     if row.get("unit") == "error":
         return None, str(row.get("detail", {}).get("error", "child error row"))
+    if r.returncode != 0:
+        # value row streamed, then the child died (in-child watchdog
+        # os._exit, teardown crash): the measurement is complete — keep
+        # it, flagged
+        row.setdefault("detail", {})["salvaged"] = (
+            f"child exited rc={r.returncode} after the row landed; "
+            "measurement kept")
     return row, None
 
 
@@ -1249,10 +1404,11 @@ def main():
         if not _probe_tunnel(probe_t) and \
                 (time.sleep(min(60, probe_t)) or not _probe_tunnel(probe_t)):
             tunnel_dead = True
-            err = ("device unreachable at bench start (2 probes failed "
-                   "to complete a matmul on the tpu backend)")
+            err = ("device_unreachable: probe-before-run failed twice "
+                   "to complete a matmul on the tpu backend — hardware "
+                   "rows skipped fast instead of hanging per-metric")
             stale = _stale_partial(head)
-            detail = {"error": err}
+            detail = {"error": err, "skipped": True}
             if stale:
                 detail["last_completed_ladder"] = stale
             for metric in need_hw:

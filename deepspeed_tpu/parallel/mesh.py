@@ -105,6 +105,46 @@ def _order_axes(axes: Dict[str, int]) -> Dict[str, int]:
     return ordered
 
 
+def resolve_axis_sizes(axes: Optional[Dict[str, int]],
+                       n_devices: int) -> Dict[str, int]:
+    """Concrete axis sizes for an axes dict that may carry one ``-1``
+    (inferred), ordered canonically — the same resolution
+    :func:`build_mesh` applies, callable BEFORE any mesh exists (the
+    comm autotuner plans the hierarchy split pre-mesh)."""
+    if not axes:
+        return {"data": n_devices}
+    axes = _order_axes(dict(axes))
+    unknown = [k for k, v in axes.items() if v == -1]
+    if len(unknown) > 1:
+        raise ValueError(f"at most one mesh axis may be -1, got {axes}")
+    if unknown:
+        known = math.prod(v for v in axes.values() if v != -1)
+        if n_devices % known != 0:
+            raise ValueError(
+                f"cannot infer axis {unknown[0]}: {n_devices} devices not "
+                f"divisible by {known}")
+        axes[unknown[0]] = n_devices // known
+    return axes
+
+
+def natural_intra_size(devices: Optional[Sequence] = None) -> int:
+    """Physical intra-slice hint for the comm autotuner: devices per
+    process (the host-local ICI island — cross-process hops ride the
+    slow DCN wire). 0 when the topology offers no meaningful split
+    (single process, uneven spread, or fewer than 2 local devices)."""
+    if devices is None:
+        devices = jax.devices()
+    per_proc: Dict[int, int] = {}
+    for d in devices:
+        pi = getattr(d, "process_index", 0)
+        per_proc[pi] = per_proc.get(pi, 0) + 1
+    counts = set(per_proc.values())
+    if len(per_proc) < 2 or len(counts) != 1:
+        return 0
+    local = counts.pop()
+    return local if local >= 2 else 0
+
+
 def build_mesh(axes: Optional[Dict[str, int]] = None,
                devices: Optional[Sequence] = None) -> Mesh:
     """Build a named-axis Mesh over the available devices.
@@ -116,27 +156,14 @@ def build_mesh(axes: Optional[Dict[str, int]] = None,
         devices = jax.devices()
     n = len(devices)
 
-    if not axes:
-        axes = {"data": n}
-    axes = _order_axes(dict(axes))
-
-    # resolve a single -1
-    unknown = [k for k, v in axes.items() if v == -1]
-    if len(unknown) > 1:
-        raise ValueError(f"at most one mesh axis may be -1, got {axes}")
-    if unknown:
-        known = math.prod(v for v in axes.values() if v != -1)
-        if n % known != 0:
-            raise ValueError(
-                f"cannot infer axis {unknown[0]}: {n} devices not divisible "
-                f"by {known}")
-        axes[unknown[0]] = n // known
+    axes = resolve_axis_sizes(axes, n)
 
     size = math.prod(axes.values())
-    if size < n and not unknown:
-        # explicit axes asking for fewer devices than exist: run on a
-        # subset — the elastic-resume case (reference reloads ZeRO state
-        # under a smaller dp world, stage2.py:1785-1793)
+    if size < n:
+        # explicit axes asking for fewer devices than exist (a resolved
+        # -1 always covers all of them): run on a subset — the
+        # elastic-resume case (reference reloads ZeRO state under a
+        # smaller dp world, stage2.py:1785-1793)
         devices = list(devices)[:size]
         n = size
     if size != n:

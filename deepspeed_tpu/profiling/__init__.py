@@ -151,6 +151,14 @@ class Observer:
         self.event("compile", fn=ev.fn_name, count=ev.count,
                    wall_ms=round(ev.wall_ms, 3), step=ev.step)
 
+    def record_comm_plan(self, **plan_fields) -> None:
+        """One ``comm_plan`` event row: the collective autotuner's
+        decision (algo/block/hierarchy), its cost-model evidence, and
+        any calibration result (runtime/comm_autotune.py) — rendered by
+        tools/obs_report.py next to the per-step comm bytes so a run's
+        wire numbers carry the WHY of the exchange that produced them."""
+        self.event("comm_plan", **plan_fields)
+
     # ------------------------------------------------------------ probes
     def wrap_jit(self, fn, name: str):
         """Wrap a jit-compiled callable for compile tracking; identity

@@ -345,7 +345,67 @@ def get_quantized_comm_config(param_dict):
         "secondary_partition": sub.get(
             C.QUANTIZED_COMM_SECONDARY_PARTITION,
             C.QUANTIZED_COMM_SECONDARY_PARTITION_DEFAULT),
+        # which knobs the user set EXPLICITLY: with comm_autotune
+        # enabled these act as overrides that pin the candidate set
+        # (runtime/comm_autotune.plan_comm); without it they are simply
+        # the values in effect
+        "explicit": {
+            "algo": C.QUANTIZED_COMM_ALGO in sub,
+            "block": (C.QUANTIZED_COMM_BLOCK in sub
+                      or C.COMPRESSED_ALLREDUCE_BLOCK in legacy),
+            "hierarchical": C.QUANTIZED_COMM_HIERARCHICAL in sub,
+        },
     }
+
+
+def get_comm_autotune_config(param_dict):
+    """Topology-aware collective autotuner + compute/comm overlap
+    (runtime/comm_autotune.py; docs/performance.md). Off by default;
+    when enabled it selects the quantized_comm exchange per topology
+    and overlaps the gradient exchange with the next micro-step's
+    compute inside the fused scan."""
+    from deepspeed_tpu.runtime.comm_autotune import (
+        DEFAULT_BLOCK_CANDIDATES, DEFAULT_INTER_GBPS,
+        DEFAULT_INTER_LATENCY_US, DEFAULT_INTRA_GBPS,
+        DEFAULT_INTRA_LATENCY_US)
+    sub = param_dict.get(C.COMM_AUTOTUNE, {})
+    overlap = sub.get(C.COMM_AUTOTUNE_OVERLAP,
+                      C.COMM_AUTOTUNE_OVERLAP_DEFAULT)
+    if isinstance(overlap, int) and not isinstance(overlap, bool):
+        # JSON 0/1 must mean false/true downstream, where the overlap
+        # decision tests `is False` — identity, not truthiness
+        overlap = bool(overlap)
+    try:
+        return {
+            "enabled": sub.get(C.COMM_AUTOTUNE_ENABLED,
+                               C.COMM_AUTOTUNE_ENABLED_DEFAULT),
+            "overlap": overlap,
+            "calibrate": sub.get(C.COMM_AUTOTUNE_CALIBRATE,
+                                 C.COMM_AUTOTUNE_CALIBRATE_DEFAULT),
+            "intra_size": int(sub.get(C.COMM_AUTOTUNE_INTRA_SIZE,
+                                      C.COMM_AUTOTUNE_INTRA_SIZE_DEFAULT)
+                              or 0),
+            "intra_gbps": float(sub.get(C.COMM_AUTOTUNE_INTRA_GBPS,
+                                        DEFAULT_INTRA_GBPS)),
+            "inter_gbps": float(sub.get(C.COMM_AUTOTUNE_INTER_GBPS,
+                                        DEFAULT_INTER_GBPS)),
+            "intra_latency_us": float(sub.get(
+                C.COMM_AUTOTUNE_INTRA_LATENCY_US,
+                DEFAULT_INTRA_LATENCY_US)),
+            "inter_latency_us": float(sub.get(
+                C.COMM_AUTOTUNE_INTER_LATENCY_US,
+                DEFAULT_INTER_LATENCY_US)),
+            "block_candidates": list(sub.get(
+                C.COMM_AUTOTUNE_BLOCK_CANDIDATES,
+                DEFAULT_BLOCK_CANDIDATES)),
+        }
+    except (TypeError, ValueError) as e:
+        # the coercions run at parse time (before _do_sanity_check),
+        # so malformed values get the section's curated error here
+        raise DeepSpeedConfigError(
+            f"comm_autotune: malformed value ({e}); intra_size and "
+            "latencies/bandwidths must be numbers, block_candidates a "
+            "list of ints")
 
 
 def get_async_pipeline_config(param_dict):
@@ -601,6 +661,7 @@ class DeepSpeedConfig:
         self.profiler_config = self.observability_config["trace"]
         self.compile_cache_config = get_compile_cache_config(param_dict)
         self.quantized_comm_config = get_quantized_comm_config(param_dict)
+        self.comm_autotune_config = get_comm_autotune_config(param_dict)
         # legacy attribute name, kept for scripts written against it
         self.compressed_allreduce_config = self.quantized_comm_config
         self.memory_breakdown = get_memory_breakdown(param_dict)
@@ -736,6 +797,33 @@ class DeepSpeedConfig:
                     "quantized_comm.hierarchical does not compose with "
                     "OnebitAdam (its compressed exchange is written "
                     "against the flat 'data' axis)")
+        ca = self.comm_autotune_config
+        if ca["overlap"] not in (True, False, "auto"):
+            raise DeepSpeedConfigError(
+                "comm_autotune.overlap must be true, false or \"auto\", "
+                f"got {ca['overlap']!r}")
+        if ca["intra_size"] == 1 or ca["intra_size"] < 0:
+            raise DeepSpeedConfigError(
+                "comm_autotune.intra_size must be 0 (infer) or the "
+                f"fast-wire extent >= 2, got {ca['intra_size']}")
+        if ca["intra_gbps"] <= 0 or ca["inter_gbps"] <= 0:
+            raise DeepSpeedConfigError(
+                "comm_autotune bandwidths must be > 0 GBit/s, got "
+                f"intra={ca['intra_gbps']} inter={ca['inter_gbps']}")
+        if ca["intra_latency_us"] < 0 or ca["inter_latency_us"] < 0:
+            raise DeepSpeedConfigError(
+                "comm_autotune latencies must be >= 0 us")
+        if not ca["block_candidates"] or \
+                any(int(b) < 8 for b in ca["block_candidates"]):
+            raise DeepSpeedConfigError(
+                "comm_autotune.block_candidates must be a non-empty "
+                f"list of ints >= 8, got {ca['block_candidates']}")
+        if ca["enabled"] and not qc["enabled"]:
+            logger.warning(
+                "comm_autotune.enabled has no exchange to tune: "
+                "quantized_comm is disabled (the dense GSPMD allreduce "
+                "is compiler-scheduled); enable quantized_comm or drop "
+                "the section")
         ap = self.async_pipeline_config
         if not isinstance(ap["prefetch_depth"], int) or \
                 ap["prefetch_depth"] < 0:

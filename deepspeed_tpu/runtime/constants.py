@@ -191,6 +191,46 @@ QUANTIZED_COMM_SECONDARY_PARTITION = "secondary_partition"
 QUANTIZED_COMM_SECONDARY_PARTITION_DEFAULT = False
 
 #############################################
+# Topology-aware collective autotuner + compute/comm overlap
+# (runtime/comm_autotune.py; docs/performance.md "Collective
+# autotuner"). Picks the quantized_comm exchange (algo / block /
+# hierarchy split) per mesh topology and gradient-size histogram from
+# a per-hop latency+bandwidth cost model, and overlaps the gradient
+# exchange of micro-step i with micro-step i+1's compute inside the
+# fused scan. Explicit quantized_comm.{algo,block,hierarchical} keys
+# act as overrides.
+#
+# "comm_autotune": {
+#   "enabled": false,
+#   "overlap": "auto",          # true | false | "auto" (on when the
+#                               # fused quantized exchange is active)
+#   "calibrate": false,         # verify wire model vs compiled HLO at
+#                               # init (best-effort probe)
+#   "intra_size": 0,            # fast-wire extent of the data axis
+#                               # (0 = infer: devices per process)
+#   "intra_gbps": 75.0,         # fast (ICI) per-direction bandwidth
+#   "inter_gbps": 12.5,         # slow (DCN/inter-slice) bandwidth
+#   "intra_latency_us": 1.0,
+#   "inter_latency_us": 10.0,
+#   "block_candidates": [64, 128, 256]
+# }
+#############################################
+COMM_AUTOTUNE = "comm_autotune"
+COMM_AUTOTUNE_ENABLED = "enabled"
+COMM_AUTOTUNE_ENABLED_DEFAULT = False
+COMM_AUTOTUNE_OVERLAP = "overlap"
+COMM_AUTOTUNE_OVERLAP_DEFAULT = "auto"
+COMM_AUTOTUNE_CALIBRATE = "calibrate"
+COMM_AUTOTUNE_CALIBRATE_DEFAULT = False
+COMM_AUTOTUNE_INTRA_SIZE = "intra_size"
+COMM_AUTOTUNE_INTRA_SIZE_DEFAULT = 0
+COMM_AUTOTUNE_INTRA_GBPS = "intra_gbps"
+COMM_AUTOTUNE_INTER_GBPS = "inter_gbps"
+COMM_AUTOTUNE_INTRA_LATENCY_US = "intra_latency_us"
+COMM_AUTOTUNE_INTER_LATENCY_US = "inter_latency_us"
+COMM_AUTOTUNE_BLOCK_CANDIDATES = "block_candidates"
+
+#############################################
 # Profiler (TPU-native: jax.profiler trace capture; SURVEY.md §5 —
 # the reference's wall_clock_breakdown/timers ladder, plus XLA traces)
 #

@@ -149,14 +149,29 @@ class DeepSpeedEngine:
         # axis into data_inter x data_intra BEFORE the mesh is built, so
         # every downstream sharding sees the 2D form
         _qc_hier = 0
+        self._comm_plan = None
         if isinstance(raw, dict):
-            from deepspeed_tpu.runtime.config import get_quantized_comm_config
+            from deepspeed_tpu.runtime.config import (
+                get_comm_autotune_config, get_quantized_comm_config)
             _qc_raw = get_quantized_comm_config(raw)
             # the split is gated on enabled: a disabled quantized_comm
             # block must leave the mesh (and every 'data'-keyed path)
             # exactly as before
             if _qc_raw["enabled"]:
                 _qc_hier = int(_qc_raw["hierarchical"])
+                if get_comm_autotune_config(raw)["enabled"]:
+                    # topology-aware autotuner: picks algo/block AND the
+                    # hierarchy split, which must be known pre-mesh
+                    self._comm_plan = self._plan_comm_autotune(
+                        raw, _qc_raw, mesh_axes, model_parameters)
+                if self._comm_plan is not None:
+                    _qc_hier = self._comm_plan.hierarchical
+                    if _qc_hier >= 2:
+                        from deepspeed_tpu.parallel.mesh import \
+                            resolve_axis_sizes
+                        # the split below needs concrete sizes, not -1
+                        mesh_axes = resolve_axis_sizes(
+                            mesh_axes, len(jax.devices()))
         if _qc_hier >= 2:
             if mesh_axes is None:
                 mesh_axes = {"data": len(jax.devices())}
@@ -564,6 +579,41 @@ class DeepSpeedEngine:
         # GSPMD (non-shard_map) path where the gather exists, with a
         # compute-dtype cast to ride (stage 3 skips the up-front cast —
         # its per-use-site gathers are already the lean shape).
+        # comm_autotune: the plan (computed pre-mesh) now overrides the
+        # static algo/block; hierarchy already shaped the mesh above
+        self._autotune_cfg = self._config.comm_autotune_config
+        if self._comm_plan is not None and self._quant_allreduce:
+            if self._comm_plan.world != self.dp_world_size:
+                logger.warning(
+                    "comm_autotune: planned against dp=%d but the mesh "
+                    "built dp=%d — plan dropped, static quantized_comm "
+                    "config in effect", self._comm_plan.world,
+                    self.dp_world_size)
+                self._comm_plan = None
+            else:
+                self._quant_algo = self._comm_plan.algo
+                self._quant_block = int(self._comm_plan.block)
+        if self._comm_plan is not None and self._quant_allreduce and \
+                self._autotune_cfg["calibrate"]:
+            # opt-in drift check of the wire model against the compiled
+            # exchange — best-effort: a dead device must never fail init
+            try:
+                from deepspeed_tpu.runtime.comm_autotune import \
+                    calibrate_wire_model
+                cal = calibrate_wire_model(
+                    world=self.dp_world_size, algo=self._quant_algo,
+                    block=self._quant_block,
+                    hierarchical=self._comm_plan.hierarchical, n=1 << 14)
+                self._comm_plan = self._comm_plan._replace(calibration=cal)
+                if abs(cal["drift"]) > 0.05:
+                    logger.warning(
+                        "comm_autotune: wire model drifts %.1f%% from "
+                        "the compiled HLO byte accounting — the cost "
+                        "model's inputs may have rotted",
+                        cal["drift"] * 100.0)
+            except Exception as e:
+                logger.warning(f"comm_autotune: calibration skipped "
+                               f"({e!r})")
         self._qwz = bool(qc["enabled"] and qc["quantize_weights"]
                          and 1 <= self.zero_stage <= 2
                          and self.compute_dtype is not None
@@ -600,6 +650,7 @@ class DeepSpeedEngine:
         self._sync_loss_every_step = bool(ap["sync_loss_every_step"])
         self._prefetch_depth = int(ap["prefetch_depth"])
         self._use_fused_batch = None     # decided once, at first train_batch
+        self._use_overlap = None         # comm_autotune exchange overlap
         self._prefetcher = None
         self._train_iter = None
         self._stacked_shd = None
@@ -640,6 +691,29 @@ class DeepSpeedEngine:
         # authoritative).
         self._host_micro_step = 0
         self._host_global_step = 0
+
+        # the one-line which-exchange log (mirrors the which-path-
+        # compiled log of the async pipeline): chosen algo/block/
+        # hierarchy and why — plus the comm_plan event obs_report shows
+        if self._quant_allreduce:
+            from deepspeed_tpu.runtime.comm_autotune import candidate_label
+            hier = (axis_size(self.mesh, "data_intra")
+                    if self._dp_hierarchical else 0)
+            label = candidate_label(self._quant_algo, self._quant_block,
+                                    hier)
+            why = (self._comm_plan.reason if self._comm_plan is not None
+                   else "static quantized_comm config")
+            log_dist(f"quantized_comm exchange = {label} "
+                     f"[{'autotuned' if self._comm_plan is not None else 'static'}] "
+                     f"({why})", ranks=[0])
+            if self._comm_plan is not None:
+                p = self._comm_plan
+                self.observability.record_comm_plan(
+                    algo=p.algo, block=p.block,
+                    hierarchical=p.hierarchical, world=p.world,
+                    topo_intra=p.topo_intra, reason=p.reason,
+                    overridden=p.overridden, modeled_us=p.modeled_us,
+                    calibration=p.calibration)
 
         # per-step DP comm-bytes model (host math on leaf shapes; the
         # wire shape itself is pinned by the HLO audits) — written to the
@@ -938,6 +1012,83 @@ class DeepSpeedEngine:
             return sch.mom_at(step)
         return None
 
+    def _plan_comm_autotune(self, raw, qc, mesh_axes, model_parameters):
+        """Run the topology-aware exchange autotuner
+        (runtime/comm_autotune.py) BEFORE the mesh exists: the plan's
+        hierarchy split shapes the mesh itself. Pure host math over the
+        gradient-size histogram; returns a CommPlan or None (config the
+        quantized exchange refuses, or nothing to tune). Called only
+        from __init__ — must not touch engine attributes."""
+        opt_name = ((raw.get("optimizer", {}) or {}).get("type") or "")
+        if "onebit" in opt_name.lower().replace("_", ""):
+            logger.warning("comm_autotune: skipped (OnebitAdam owns its "
+                           "own compressed exchange)")
+            return None
+        if raw.get("sparse_gradients"):
+            logger.warning("comm_autotune: skipped (sparse_gradients "
+                           "owns the CSR exchange)")
+            return None
+        from deepspeed_tpu.parallel.mesh import (natural_intra_size,
+                                                 resolve_axis_sizes)
+        from deepspeed_tpu.runtime.comm_autotune import plan_comm
+        try:
+            axes = resolve_axis_sizes(mesh_axes, len(jax.devices()))
+        except ValueError:
+            return None          # build_mesh will raise the real error
+        if all(a in axes for a in ("data_inter", "data_intra")):
+            # an explicitly 2D mesh IS a topology statement: the split
+            # is pinned, the autotuner still prices algo/block
+            world = axes["data_inter"] * axes["data_intra"]
+            qc = dict(qc, hierarchical=axes["data_intra"],
+                      explicit=dict(qc["explicit"], hierarchical=True))
+            intra_hint = axes["data_intra"]
+        elif "data" in axes:
+            world = axes["data"]
+            # physical fallback hint (no comm_autotune.intra_size):
+            # devices-per-process is the fast-wire island, but the data
+            # axis only spans it at a stride of the MINOR axes' product
+            # (model/seq/expert sit after 'data' in the canonical
+            # device-mesh order) — a {'data': 4, 'model': 2} mesh on
+            # 4-device hosts has data extent 2 per host, not 4.
+            # Approximate (create_device_mesh may reorder devices for
+            # ICI contiguity); comm_autotune.intra_size overrides.
+            stride = 1
+            past_data = False
+            for name, size in axes.items():
+                if name == "data":
+                    past_data = True
+                elif past_data:
+                    stride *= size
+            local = natural_intra_size()
+            intra_hint = (local // stride
+                          if local and local % stride == 0 else 0)
+            if intra_hint < 2 or world % intra_hint:
+                intra_hint = 0
+        else:
+            return None          # no data axis: no gradient exchange
+        if world <= 1:
+            return None
+        sizes = [leaf.size for leaf in
+                 jax.tree_util.tree_leaves(model_parameters)
+                 if hasattr(leaf, "dtype")
+                 and jnp.issubdtype(leaf.dtype, jnp.floating)]
+        if not sizes:
+            return None
+        from deepspeed_tpu.runtime.config import get_comm_autotune_config
+        try:
+            return plan_comm(sizes, world, qc,
+                             get_comm_autotune_config(raw),
+                             intra_hint=intra_hint)
+        except Exception as e:
+            # planning runs BEFORE DeepSpeedConfig validation: an
+            # invalid quantized_comm combo (pinned hierarchy with a
+            # pinned non-twohop algo, typo'd algo, ...) must surface
+            # the config layer's curated error a few lines later, not
+            # a raw planner exception here
+            logger.warning(f"comm_autotune: planning skipped ({e!r}); "
+                           "static quantized_comm config in effect")
+            return None
+
     def _cast_for_loss(self, params, constrain=True):
         """fp32 master -> compute dtype, unless the loss fn owns the cast
         (pipeline loss fns cast inside shard_map so grad psums stay fp32).
@@ -1184,61 +1335,46 @@ class DeepSpeedEngine:
                 "tied LM head). Disable sparse_gradients for this model.")
 
     # -- int8 quantized allreduce path ------------------------------------
-    def _compute_quantized_grads(self, params, batch, rng, scale):
-        """Backward under shard_map over the data axes with the int8
-        block-quantized gradient exchange
-        (runtime/quantized_collectives.py).
+    def _quant_exchange_parts(self):
+        """``(detect_ovf, exchange_tree)`` closures over the engine's
+        quantized-comm config — the ONE copy of the per-leaf exchange
+        and the fp16 nonfinite sentinel, shared by the serial
+        in-shard_map exchange and the overlapped deferred one
+        (:meth:`_quant_exchange_stacked`), so the bitwise-parity
+        contract between the two paths cannot drift across hand-kept
+        copies. Both closures must run INSIDE shard_map over the data
+        axes.
 
-        algo='twohop' (default) is the qgZ shape: per-rank wire ~2n int8
-        bytes independent of dp degree. algo='allgather' is the legacy
-        O(W*n) exchange (only sane at dp=2). With
-        quantized_comm.hierarchical the bandwidth-heavy hops run over
-        'data_intra' and only the reduced 1/W_intra chunk crosses
-        'data_inter'. Leaves smaller than one quantization block ship
-        dense (pmean)."""
+        ``detect_ovf``: fp16 overflow sentinel — quantization destroys
+        inf/nan (the absmax scale goes inf -> q garbage), so nonfinite
+        is detected BEFORE the exchange and ``exchange_tree`` re-poisons
+        the result, keeping the engine's has_overflow skip-step
+        machinery working. ``exchange_tree``: leaves smaller than one
+        quantization block ship dense (pmean); the rest take the
+        flat/hierarchical quantized mean."""
         from deepspeed_tpu.runtime.quantized_collectives import (
             hierarchical_quantized_allreduce_mean, quantized_allreduce_mean)
-        P = PartitionSpec
-        repl = lambda tree: jax.tree_util.tree_map(lambda _: P(), tree)
-        # Gather + cast ONCE in GSPMD land before entering shard_map:
-        # in_specs=repl would otherwise coerce the ZeRO-sharded fp32
-        # masters to replicated — an f32 all-gather on the wire where a
-        # compute-dtype (or, under qwZ, int8) gather would do. The cast
-        # rides qwZ/hpZ when enabled; inside the shard_map the re-cast
-        # is a no-op.
-        params = self._cast_for_loss(params, constrain=True)
         block = self._quant_block
         algo = self._quant_algo
         dp_axes = self.dp_axes
-        batch_entry = self._dp_axis_entry
         hierarchical = self._dp_hierarchical
         if hierarchical:
             inter_size = axis_size(self.mesh, "data_inter")
             intra_size = axis_size(self.mesh, "data_intra")
         world = self.dp_world_size
+        fp16 = self.fp16_enabled
 
-        def inner(p, b, r, s):
-            idx = jax.lax.axis_index(dp_axes[0])
-            for ax in dp_axes[1:]:
-                idx = idx * axis_size(self.mesh, ax) + \
-                    jax.lax.axis_index(ax)
-            r = jax.random.fold_in(r, idx)
-            loss, _aux, g = self._compute_loss_and_grads(
-                p, b, r, s, constrain_cast=False)
-            loss = jax.lax.pmean(loss, dp_axes)
-
-            # fp16 overflow sentinel: quantization destroys inf/nan (the
-            # absmax scale goes inf -> q garbage), so detect nonfinite
-            # BEFORE the exchange and re-poison the result, keeping the
-            # engine's has_overflow skip-step machinery working
+        def detect_ovf(g):
             ovf = jnp.zeros((), bool)
-            if self.fp16_enabled:
+            if fp16:
                 for leaf in jax.tree_util.tree_leaves(g):
                     ovf = jnp.logical_or(
                         ovf, jnp.any(~jnp.isfinite(leaf)))
                 ovf = jax.lax.pmax(ovf.astype(jnp.int32),
                                    dp_axes).astype(bool)
+            return ovf
 
+        def exchange_tree(g, ovf):
             def exchange(grad):
                 if grad.size < block:
                     return jax.lax.pmean(grad, dp_axes)
@@ -1250,11 +1386,48 @@ class DeepSpeedEngine:
                     out = quantized_allreduce_mean(
                         grad, dp_axes[0], block, algo=algo,
                         world_size=world)
-                if self.fp16_enabled:
+                if fp16:
                     out = jnp.where(ovf, jnp.nan, out)
                 return out
 
-            g = jax.tree_util.tree_map(exchange, g)
+            return jax.tree_util.tree_map(exchange, g)
+
+        return detect_ovf, exchange_tree
+
+    def _compute_quantized_grads(self, params, batch, rng, scale):
+        """Backward under shard_map over the data axes with the int8
+        block-quantized gradient exchange
+        (runtime/quantized_collectives.py).
+
+        algo='twohop' (default) is the qgZ shape: per-rank wire ~2n int8
+        bytes independent of dp degree. algo='allgather' is the legacy
+        O(W*n) exchange (only sane at dp=2). With
+        quantized_comm.hierarchical the bandwidth-heavy hops run over
+        'data_intra' and only the reduced 1/W_intra chunk crosses
+        'data_inter'."""
+        P = PartitionSpec
+        repl = lambda tree: jax.tree_util.tree_map(lambda _: P(), tree)
+        # Gather + cast ONCE in GSPMD land before entering shard_map:
+        # in_specs=repl would otherwise coerce the ZeRO-sharded fp32
+        # masters to replicated — an f32 all-gather on the wire where a
+        # compute-dtype (or, under qwZ, int8) gather would do. The cast
+        # rides qwZ/hpZ when enabled; inside the shard_map the re-cast
+        # is a no-op.
+        params = self._cast_for_loss(params, constrain=True)
+        dp_axes = self.dp_axes
+        batch_entry = self._dp_axis_entry
+        detect_ovf, exchange_tree = self._quant_exchange_parts()
+
+        def inner(p, b, r, s):
+            idx = jax.lax.axis_index(dp_axes[0])
+            for ax in dp_axes[1:]:
+                idx = idx * axis_size(self.mesh, ax) + \
+                    jax.lax.axis_index(ax)
+            r = jax.random.fold_in(r, idx)
+            loss, _aux, g = self._compute_loss_and_grads(
+                p, b, r, s, constrain_cast=False)
+            loss = jax.lax.pmean(loss, dp_axes)
+            g = exchange_tree(g, detect_ovf(g))
             return loss, g
 
         loss, grads = jax.shard_map(
@@ -1505,6 +1678,157 @@ class DeepSpeedEngine:
             total = total + losses[i]
         return state, total / gas
 
+    # -- comm_autotune: compute/comm overlap inside the fused window ------
+    #
+    # The serial scan body computes micro-step i's gradients AND
+    # exchanges them in the same iteration — the exchange collectives
+    # depend on that iteration's backward dots, so the ICI idles during
+    # compute and the MXU idles during the exchange. The overlapped
+    # shape double-buffers: iteration i carries micro-step i-1's LOCAL
+    # (unexchanged) gradients and issues their exchange alongside
+    # micro-step i's forward/backward — the exchange reads only the
+    # loop carry, making it data-independent of the iteration's compute
+    # (pinned structurally by the HLO operand-cone audit in
+    # tests/unit/test_hlo_quantized_comm.py), so XLA's scheduler can
+    # run the two concurrently. The last window's exchange flushes
+    # after the scan, then the boundary apply runs. Exchange inputs,
+    # math, and accumulation order are IDENTICAL to the serial path —
+    # losses and updates are bitwise-equal (tier-1 pinned).
+
+    def _quant_local_grads(self, params, batch, rng, scale):
+        """One micro-step's loss + LOCAL (pre-exchange) gradients under
+        shard_map over the data axes, stacked on a leading dp-sharded
+        axis — the double-buffered carry of the overlapped scan.
+        ``params`` are already cast/gathered by the caller (the qwZ
+        weight gather is hoisted out of the scan: params are constant
+        within the window, so one gather serves all ``gas`` micros)."""
+        P = PartitionSpec
+        repl = lambda tree: jax.tree_util.tree_map(lambda _: P(), tree)
+        dp_axes = self.dp_axes
+        batch_entry = self._dp_axis_entry
+        stacked = lambda tree: jax.tree_util.tree_map(
+            lambda _: P(batch_entry), tree)
+
+        def inner(p, b, r, s):
+            idx = jax.lax.axis_index(dp_axes[0])
+            for ax in dp_axes[1:]:
+                idx = idx * axis_size(self.mesh, ax) + \
+                    jax.lax.axis_index(ax)
+            r = jax.random.fold_in(r, idx)
+            loss, _aux, g = self._compute_loss_and_grads(
+                p, b, r, s, constrain_cast=False)
+            loss = jax.lax.pmean(loss, dp_axes)
+            return loss, jax.tree_util.tree_map(lambda x: x[None], g)
+
+        loss, local = jax.shard_map(
+            inner, mesh=self.mesh,
+            in_specs=(repl(params),
+                      jax.tree_util.tree_map(lambda _: P(batch_entry),
+                                             batch),
+                      P(), P()),
+            out_specs=(P(), stacked(params)),
+            check_vma=False)(params, batch, rng, scale)
+        return loss, local
+
+    def _quant_exchange_stacked(self, local):
+        """The deferred half of the quantized exchange: stacked local
+        gradients in, replicated fp32 mean out. Shares the per-leaf
+        exchange (and fp16 nonfinite-poisoning) closures with the
+        serial :meth:`_compute_quantized_grads` via
+        :meth:`_quant_exchange_parts` — only the issue POINT moved, so
+        the result is bitwise what the serial path produces for the
+        same local gradients."""
+        P = PartitionSpec
+        repl = lambda tree: jax.tree_util.tree_map(lambda _: P(), tree)
+        batch_entry = self._dp_axis_entry
+        detect_ovf, exchange_tree = self._quant_exchange_parts()
+
+        def inner(stacked):
+            g = jax.tree_util.tree_map(lambda x: x[0], stacked)
+            return exchange_tree(g, detect_ovf(g))
+
+        return jax.shard_map(
+            inner, mesh=self.mesh,
+            in_specs=(jax.tree_util.tree_map(lambda _: P(batch_entry),
+                                             local),),
+            out_specs=repl(local),
+            check_vma=False)(local)
+
+    def _batch_step_overlapped(self, state: TrainState, stacked
+                               ) -> Tuple[TrainState, Any]:
+        """The fused window with the exchange double-buffered: micro 0
+        computes outside the scan, each scan iteration exchanges the
+        PREVIOUS micro's gradients while computing its own, the last
+        exchange flushes after the scan, then the boundary apply runs.
+        Same rng stream, same exchange math, same accumulation order as
+        the serial :meth:`_batch_step` — bitwise-equal losses/params
+        (tests/unit/test_comm_autotune.py pins this)."""
+        gas = self.gradient_accumulation_steps
+        # hoisted weight gather: params are constant within the window,
+        # so the (qwZ/hpZ-riding) cast+gather runs once per window, not
+        # once per micro — the prefetched next-step weights of the
+        # ZeRO++ playbook, as a loop-invariant the partitioner can
+        # schedule ahead of the first micro's compute
+        cast = self._cast_for_loss(state.params, constrain=True)
+        scale = state.loss_scale.scale
+        rng, sub = jax.random.split(state.rng)
+        micro0 = jax.tree_util.tree_map(lambda x: x[0], stacked)
+        loss0, pending = self._quant_local_grads(cast, micro0, sub, scale)
+
+        def body(carry, batch):
+            rng, accum, pending = carry
+            rng, sub = jax.random.split(rng)
+            loss, local = self._quant_local_grads(cast, batch, sub, scale)
+            exchanged = self._quant_exchange_stacked(pending)
+            accum = jax.tree_util.tree_map(jnp.add, accum, exchanged)
+            return (rng, accum, local), loss
+
+        rest = jax.tree_util.tree_map(lambda x: x[1:], stacked)
+        (rng, accum, pending), losses = jax.lax.scan(
+            body, (rng, state.accum_grads, pending), rest)
+        # flush: the last micro's exchange has no next compute to hide
+        # under (the NEXT window's first micro would — across-dispatch
+        # overlap is the async dispatch queue's job)
+        exchanged = self._quant_exchange_stacked(pending)
+        accum = jax.tree_util.tree_map(jnp.add, accum, exchanged)
+        state = state._replace(rng=rng,
+                               micro_step=state.micro_step + gas)
+        state = self._apply_update(state, accum)
+        total = loss0
+        for i in range(gas - 1):
+            total = total + losses[i]
+        return state, total / gas
+
+    def _select_overlap_path(self):
+        """(overlap?, why) — the exchange-overlap analog of
+        :meth:`_select_batch_path`; only consulted on the fused path."""
+        ca = self._autotune_cfg
+        if not ca["enabled"]:
+            return False, "comm_autotune disabled"
+        if ca["overlap"] is False:
+            return False, "comm_autotune.overlap=false"
+        if self.gradient_accumulation_steps < 2:
+            return False, ("gas=1: no next micro-step to hide the "
+                           "exchange under")
+        if not self._quant_allreduce:
+            return False, ("no explicit exchange to defer (dense GSPMD "
+                           "/ CSR / 1-bit paths own their schedules)")
+        return True, ("grad exchange of micro-step i issued alongside "
+                      "micro-step i+1's compute (double-buffered carry, "
+                      "post-scan flush)")
+
+    def _overlap_path(self) -> bool:
+        """Decide once which fused-step body compiles (overlapped or
+        serial exchange), with its own one-line log."""
+        if self._use_overlap is None:
+            ov, why = self._select_overlap_path()
+            self._use_overlap = ov
+            if self._autotune_cfg["enabled"]:
+                log_dist("comm_autotune: exchange overlap = "
+                         + ("on" if ov else "off") + f" ({why})",
+                         ranks=[0])
+        return self._use_overlap
+
     def _select_batch_path(self):
         """(fused?, why) for this engine's configuration. The fused path
         covers the default configs (bf16/fp16/fp32 x ZeRO 0-2 x dense or
@@ -1538,8 +1862,10 @@ class DeepSpeedEngine:
 
     def _get_compiled_batch_step(self):
         if self._compiled_batch_step is None:
+            body = (self._batch_step_overlapped if self._overlap_path()
+                    else self._batch_step)
             self._compiled_batch_step = self.observability.wrap_jit(
-                jax.jit(self._batch_step, donate_argnums=(0,)),
+                jax.jit(body, donate_argnums=(0,)),
                 "batch_step")
         return self._compiled_batch_step
 
@@ -2163,7 +2489,9 @@ class DeepSpeedEngine:
             self.monitor.write_comm_metrics(
                 bytes_per_step=self._comm_stats["bytes_per_step"],
                 compression_ratio=self._comm_stats["compression_ratio"],
-                samples=samples)
+                samples=samples,
+                mode=(self._comm_stats["mode"]
+                      + ("+overlap" if self._use_overlap else "")))
         # dynamic fp16 scaling: snapshot the per-step scale (jnp.copy —
         # the state leaf itself is donated to the next dispatch) so the
         # flushed scale trajectory attributes backoffs to the right

@@ -54,7 +54,7 @@ from deepspeed_tpu.runtime.custom_collectives import (pad_flat_to_multiple,
 
 __all__ = ["quantize_blockwise", "dequantize_blockwise",
            "quantized_allreduce_mean", "hierarchical_quantized_allreduce_mean",
-           "wire_bytes", "wire_bytes_by_axis",
+           "wire_bytes", "wire_bytes_by_axis", "wire_hops",
            "ALGO_ALLGATHER", "ALGO_TWOHOP", "QUANTIZED_ALGOS"]
 
 DEFAULT_BLOCK = 256
@@ -229,15 +229,7 @@ def wire_bytes(n: int, world_size: int, block: int = DEFAULT_BLOCK,
         per_axis = wire_bytes_by_axis(n, hierarchical[0], hierarchical[1],
                                       block)
         return per_axis["intra"] + per_axis["inter"], dense
-    padded = pad_to_multiple(n, W * block)
-    payload = _scaled_payload(padded, block)
-    if algo == ALGO_ALLGATHER:
-        return (W - 1) * payload, dense
-    if algo != ALGO_TWOHOP:
-        raise ValueError(f"unknown quantized allreduce algo {algo!r}")
-    # hop 1 all_to_all: (W-1)/W of the payload; hop 2 chunk all_gather:
-    # (W-1) chunks of payload/W — 2 * (W-1)/W * payload total
-    return 2 * (W - 1) * payload // W, dense
+    return sum(b for _, b in wire_hops(n, W, block, algo=algo)), dense
 
 
 def wire_bytes_by_axis(n: int, inter_size: int, intra_size: int,
@@ -245,11 +237,53 @@ def wire_bytes_by_axis(n: int, inter_size: int, intra_size: int,
     """Per-axis per-rank wire bytes of the hierarchical two-hop mean:
     ``{'intra': fast-axis bytes (~2n), 'inter': slow-axis bytes
     (~2n/intra)}``."""
-    Wi, Wo = max(intra_size, 1), max(inter_size, 1)
-    padded = pad_to_multiple(n, Wi * block)
-    intra = (2 * (Wi - 1) * _scaled_payload(padded, block) // Wi
-             if Wi > 1 else 0)
-    chunk = pad_to_multiple(padded // Wi, Wo * block)
-    inter = (2 * (Wo - 1) * _scaled_payload(chunk, block) // Wo
-             if Wo > 1 else 0)
-    return {"intra": intra, "inter": inter}
+    Wo, Wi = max(inter_size, 1), max(intra_size, 1)
+    hops = wire_hops(n, Wo * Wi, block, hierarchical=(Wo, Wi))
+    return {"intra": sum(b for a, b in hops if a == "intra"),
+            "inter": sum(b for a, b in hops if a == "inter")}
+
+
+def wire_hops(n: int, world_size: int, block: int = DEFAULT_BLOCK,
+              algo: str = ALGO_TWOHOP,
+              hierarchical: Optional[Tuple[int, int]] = None) -> list:
+    """Per-hop breakdown of one quantized mean-allreduce: a list of
+    ``(axis, bytes)`` tuples, one per dependent collective hop, where
+    ``axis`` is ``'intra'`` (fast wire) or ``'inter'`` (slow wire) and
+    ``bytes`` is the per-rank send volume of that hop.
+
+    This is the hop-level view the topology-aware autotuner's time
+    model consumes (``runtime/comm_autotune.py``): each hop pays one
+    link latency plus ``bytes / bandwidth(axis)``, so latency-bound
+    small messages and bandwidth-bound large ones price differently —
+    the EQuARX-style crossover structure. Flat algorithms report every
+    hop as ``'intra'``. This is the SINGLE copy of the payload/padding
+    math: :func:`wire_bytes` and :func:`wire_bytes_by_axis` are sums
+    over this hop list, so the autotuner's time model and the byte
+    model cannot desynchronize.
+    """
+    W = max(world_size, 1)
+    if hierarchical is not None:
+        Wo, Wi = max(hierarchical[0], 1), max(hierarchical[1], 1)
+        padded = pad_to_multiple(n, Wi * block)
+        payload = _scaled_payload(padded, block)
+        hops = []
+        if Wi > 1:           # intra all_to_all of the full payload
+            hops.append(("intra", (Wi - 1) * payload // Wi))
+        if Wo > 1:           # inter two-hop on the reduced 1/Wi chunk
+            chunk = pad_to_multiple(padded // Wi, Wo * block)
+            cpay = _scaled_payload(chunk, block)
+            hops.append(("inter", (Wo - 1) * cpay // Wo))
+            hops.append(("inter", (Wo - 1) * cpay // Wo))
+        if Wi > 1:           # intra all_gather of the reduced chunk
+            hops.append(("intra", (Wi - 1) * payload // Wi))
+        return hops
+    if W == 1:
+        return []
+    padded = pad_to_multiple(n, W * block)
+    payload = _scaled_payload(padded, block)
+    if algo == ALGO_ALLGATHER:
+        return [("intra", (W - 1) * payload)]
+    if algo != ALGO_TWOHOP:
+        raise ValueError(f"unknown quantized allreduce algo {algo!r}")
+    leg = (W - 1) * payload // W
+    return [("intra", leg), ("intra", leg)]

@@ -26,8 +26,10 @@ from typing import List, NamedTuple, Optional
 
 __all__ = ["HLO_DTYPE_BYTES", "shape_elems", "shape_bytes",
            "Collective", "collect_collectives", "collect_collectives_full",
-           "wire_elements", "wire_bytes_of", "conditional_branch_comps",
-           "hlo_computation_body", "dense_allreduce_ring_bytes"]
+           "wire_elements", "wire_bytes_of", "send_bytes_of",
+           "conditional_branch_comps", "hlo_computation_body",
+           "dense_allreduce_ring_bytes", "while_body_comps",
+           "cone_reaches_compute", "overlap_structure"]
 
 # dtype name -> byte width; accounting by ELEMENTS uses only the names
 HLO_DTYPE_BYTES = {"f64": 8, "s64": 8, "u64": 8,
@@ -167,6 +169,186 @@ def dense_allreduce_ring_bytes(n: int, world: int,
     """Theory baseline: per-rank bytes of a dense ring allreduce of
     ``n`` elements (reduce-scatter + all-gather legs)."""
     return 2 * (world - 1) * n * dtype_bytes // world
+
+
+def send_bytes_of(colls, default_group: Optional[int] = None) -> int:
+    """Per-rank SEND volume in bytes: result-payload bytes converted by
+    each collective's replica-group size. An all-gather / all-to-all
+    result of ``n`` bytes over a group of ``g`` means each rank sent
+    (and received) ``(g-1)/g * n`` — its own chunk never crossed the
+    wire; an all-reduce ring costs 2x that. This is the convention the
+    host-side wire model (``quantized_collectives.wire_bytes``) reports,
+    so model-vs-HLO drift checks compare like for like instead of
+    carrying the W/(W-1) fudge factor around. ``default_group`` covers
+    collectives with no replica_groups attribute (single whole-world
+    group)."""
+    total = 0.0
+    for c in colls:
+        g = c.group_size or default_group
+        f = (g - 1) / g if g and g > 1 else 1.0
+        total += c.bytes * f * (2 if c.op == "all-reduce" else 1)
+    return int(round(total))
+
+
+def while_body_comps(hlo_text):
+    """Names of computations used as while-loop bodies (lax.scan /
+    fori_loop lower to these)."""
+    return {m.group(1)
+            for m in re.finditer(r"\bbody=%?([\w.\-]+)", hlo_text)}
+
+
+_DEF_PAT = re.compile(r"^\s*(?:ROOT\s+)?%?([\w.\-]+) = ")
+# compute markers: a dot-general, a convolution, or a backend matmul
+# custom-call (the CPU backend may rewrite dots to oneDNN custom-calls)
+_COMPUTE_PAT = re.compile(r"\b(?:dot|convolution)\(|__onednn|\$matmul|"
+                          r"custom-call.*gemm", re.IGNORECASE)
+_CALLS_PAT = re.compile(r"(?:calls|to_apply|body|condition|"
+                        r"true_computation|false_computation)="
+                        r"%?([\w.\-]+)")
+
+
+def _body_defs(hlo_text, comp_name):
+    """{instr name: line} for one computation's body."""
+    defs = {}
+    for line in hlo_computation_body(hlo_text, comp_name):
+        m = _DEF_PAT.match(line)
+        if m:
+            defs[m.group(1)] = line
+    return defs
+
+
+def _comp_has_compute(hlo_text, comp_name, _memo=None):
+    """True if a computation (or anything it calls) contains a
+    dot/convolution/matmul instruction."""
+    if _memo is None:
+        _memo = {}
+    if comp_name in _memo:
+        return _memo[comp_name]
+    _memo[comp_name] = False          # cycle guard
+    hit = False
+    for line in hlo_computation_body(hlo_text, comp_name):
+        if _COMPUTE_PAT.search(line):
+            hit = True
+            break
+        for cm in _CALLS_PAT.finditer(line):
+            if _comp_has_compute(hlo_text, cm.group(1), _memo):
+                hit = True
+                break
+        if hit:
+            break
+    _memo[comp_name] = hit
+    return hit
+
+
+def _line_operands(line):
+    """Names referenced after the '=' of an instruction line (operands
+    plus called-computation attrs — the cone walk filters by the body's
+    def map, and inspects called computations separately)."""
+    eq = line.find(" = ")
+    return re.findall(r"%([\w.\-]+)", line[eq + 3:] if eq >= 0 else line)
+
+
+def _cone_walk(hlo_text, defs, root_names, memo):
+    """BFS over the operand cone of ``root_names`` within one body's
+    ``defs`` map; True when it reaches compute (directly or inside a
+    called computation). ``memo`` caches per-computation compute
+    lookups across walks — overlap_structure shares one across every
+    collective it audits."""
+    seen = set()
+    frontier = []
+    for r in root_names:
+        frontier.extend(o for o in _line_operands(defs[r]) if o in defs)
+    while frontier:
+        name = frontier.pop()
+        if name in seen:
+            continue
+        seen.add(name)
+        line = defs[name]
+        if _COMPUTE_PAT.search(line):
+            return True
+        for cm in _CALLS_PAT.finditer(line):
+            if _comp_has_compute(hlo_text, cm.group(1), memo):
+                return True
+        frontier.extend(o for o in _line_operands(line) if o in defs)
+    return False
+
+
+def cone_reaches_compute(hlo_text, comp_name, root_pred):
+    """Dependence audit for compute/comm overlap: does the operand cone
+    of any instruction matching ``root_pred`` (a predicate on the raw
+    line) inside computation ``comp_name`` reach a dot-general /
+    convolution / matmul — transitively through operands, and through
+    fusion/call bodies?
+
+    A SERIAL exchange consumes gradients produced by the same
+    iteration's backward, so its cone contains dot-generals. An
+    OVERLAPPED (double-buffered) exchange consumes only the loop carry
+    — its cone is dot-free, which is exactly the structural fact that
+    lets the scheduler run it concurrently with the next micro-step's
+    compute. Scheduler- and backend-independent, unlike textual
+    instruction order."""
+    defs = _body_defs(hlo_text, comp_name)
+    roots = [name for name, line in defs.items() if root_pred(line)]
+    return _cone_walk(hlo_text, defs, roots, {})
+
+
+def overlap_structure(hlo_text, payload_pred=lambda line: "s8[" in line):
+    """Structural overlap report of a compiled fused-step program, for
+    the hardware-free ``comm_overlap_structure`` bench row and the
+    tier-1 overlap audits.
+
+    Looks at every while-loop body that contains both compute
+    (dot-general/matmul) and collectives whose line matches
+    ``payload_pred`` (default: int8 payloads — the quantized exchange),
+    and reports::
+
+        exchange_collectives   total matching collectives in loop bodies
+        overlap_free           how many have a dot-free operand cone
+                               (structurally overlappable with the
+                               iteration's compute)
+        overlap_fraction       overlap_free / exchange_collectives
+        interleaved_fraction   fraction positioned between the first
+                               and last dot-general in the printed body
+                               (schedule-order view; serial ~ tail)
+        flush_outside_loop     matching collectives OUTSIDE loop bodies
+                               (the post-scan flush of the last window)
+    """
+    bodies = while_body_comps(hlo_text)
+    total = free = 0
+    interleaved = 0
+    in_body_names = set()
+    memo = {}          # shared per-computation compute cache
+    for comp in bodies:
+        defs = _body_defs(hlo_text, comp)
+        in_body_names |= set(defs)
+        lines = list(defs.items())
+        coll = [(i, name) for i, (name, line) in enumerate(lines)
+                if any(op + "(" in line for op in _COLLECTIVES)
+                and payload_pred(line)]
+        dots = [i for i, (_, line) in enumerate(lines)
+                if _COMPUTE_PAT.search(line)]
+        if not coll or not dots:
+            continue
+        total += len(coll)
+        lo, hi = min(dots), max(dots)
+        interleaved += sum(1 for i, _ in coll if lo < i < hi)
+        for _, name in coll:
+            if not _cone_walk(hlo_text, defs, [name], memo):
+                free += 1
+    outside = 0
+    for c in collect_collectives_full(hlo_text):
+        if payload_pred(c.line):
+            m = _DEF_PAT.match(c.line)
+            name = m.group(1) if m else None
+            if name not in in_body_names:
+                outside += 1
+    return {
+        "exchange_collectives": total,
+        "overlap_free": free,
+        "overlap_fraction": (free / total) if total else 0.0,
+        "interleaved_fraction": (interleaved / total) if total else 0.0,
+        "flush_outside_loop": outside,
+    }
 
 
 def conditional_branch_comps(hlo_text):

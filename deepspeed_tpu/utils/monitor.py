@@ -183,12 +183,17 @@ class TensorBoardMonitor:
         self.flush()
 
     def write_comm_metrics(self, *, bytes_per_step=None,
-                           compression_ratio=None, samples: int = 0):
+                           compression_ratio=None, samples: int = 0,
+                           mode: Optional[str] = None):
         """Per-step data-parallel communication telemetry (TPU-native
         extension): modeled wire bytes per rank per optimizer step and
         the compression ratio vs a dense fp32 ring allreduce — so a
         quantized_comm config change shows up on the same samples x-axis
-        as loss/throughput."""
+        as loss/throughput. ``mode`` tags WHICH exchange produced the
+        bytes (e.g. ``"hierarchical-twohop+overlap"``; the comm
+        autotuner's choice): strings can't ride the scalar stream, so a
+        ``comm_mode`` event row lands in the mirror log whenever the
+        mode changes — obs_report shows it per run."""
         if not self._writes():
             return
         if bytes_per_step is not None:
@@ -197,6 +202,12 @@ class TensorBoardMonitor:
         if compression_ratio is not None:
             self.write_scalar("Train/Samples/comm_compression_ratio",
                               compression_ratio, samples)
+        if mode is not None and \
+                mode != getattr(self, "_last_comm_mode", None):
+            self._last_comm_mode = mode
+            if self.mirror is not None:
+                self.mirror.add_event("comm_mode", mode=str(mode),
+                                      step=int(samples))
         # like every other write_* method: without the flush, comm
         # telemetry buffered in the writer is lost on crash/preemption
         self.flush()

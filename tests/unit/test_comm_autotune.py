@@ -1,0 +1,466 @@
+"""Topology-aware collective autotuner + compute/comm overlap (ISSUE 6).
+
+Three contracts pinned tier-1:
+
+1. **Golden decision table** — the autotuner reproduces PR 2's pinned
+   crossovers as *decisions*: dp=2 -> legacy allgather (one-hop latency
+   win at equal bytes), flat W>=4 -> qgZ two-hop (O(n) wire), an
+   inter×intra topology -> hierarchical 2D. Explicit
+   ``quantized_comm.{algo,block,hierarchical}`` keys act as overrides.
+2. **Cost-model drift guard** — ``wire_bytes``/``wire_bytes_by_axis``
+   predictions match the compiled-HLO byte accounting
+   (``hlo_audit.send_bytes_of``) for each algo×topology config, so the
+   autotuner's inputs can't silently rot (the mfu_cost_model pattern).
+3. **Overlap parity** — the double-buffered overlapped fused step is
+   BITWISE equal to the serial-exchange fused step: fp32/bf16 losses
+   and params, fp16 loss-scale skips. Exchange inputs, math, and
+   accumulation order are identical; only the issue point moves.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.runtime.comm_autotune import (
+    LinkModel, calibrate_wire_model, candidate_label, exchange_time_us,
+    plan_comm)
+from deepspeed_tpu.runtime.config import (DeepSpeedConfig,
+                                          DeepSpeedConfigError)
+from deepspeed_tpu.runtime.quantized_collectives import (
+    ALGO_ALLGATHER, ALGO_TWOHOP, wire_bytes, wire_hops)
+
+SIZES = [1 << 20, 1 << 18, 4096]          # typical gradient histogram
+
+
+def _qc(**over):
+    qc = {"enabled": True, "algo": "twohop", "block": 256,
+          "hierarchical": 0, "quantize_weights": False,
+          "secondary_partition": False,
+          "explicit": {"algo": False, "block": False,
+                       "hierarchical": False}}
+    ex = over.pop("explicit", {})
+    qc.update(over)
+    qc["explicit"] = {**qc["explicit"], **ex}
+    return qc
+
+
+def _ca(**over):
+    ca = {"enabled": True, "overlap": "auto", "calibrate": False,
+          "intra_size": 0, "intra_gbps": 75.0, "inter_gbps": 12.5,
+          "intra_latency_us": 1.0, "inter_latency_us": 10.0,
+          "block_candidates": [64, 128, 256]}
+    ca.update(over)
+    return ca
+
+
+# ------------------------------------------------ golden decision table
+
+
+def test_decision_dp2_prefers_legacy_allgather():
+    """At dp=2 allgather and two-hop move the same bytes; the single
+    hop wins on latency — the PR 2 'allgather only sane at dp=2' rule,
+    now derived instead of hand-configured."""
+    plan = plan_comm(SIZES, 2, _qc(), _ca())
+    assert plan.algo == ALGO_ALLGATHER and plan.hierarchical == 0, plan
+
+
+@pytest.mark.parametrize("world", [4, 8])
+def test_decision_flat_w4_plus_prefers_twohop(world):
+    """Flat W>=4: allgather is O(W*n), two-hop O(n) — the qgZ shape
+    wins regardless of block choice."""
+    plan = plan_comm(SIZES, world, _qc(), _ca())
+    assert plan.algo == ALGO_TWOHOP and plan.hierarchical == 0, plan
+    assert plan.block == 256            # large leaves: fewest scale bytes
+
+
+def test_decision_split_topology_prefers_hierarchical():
+    """A 2x4 inter×intra fabric: flat collectives price at the slow
+    wire end-to-end, the 2D shape ships only the reduced 1/W_intra
+    chunk across it -> hierarchical twohop at the physical split."""
+    plan = plan_comm(SIZES, 8, _qc(), _ca(intra_size=4))
+    assert plan.algo == ALGO_TWOHOP and plan.hierarchical == 4, plan
+    assert "2x4" in plan.reason
+    # every candidate was priced and the table is part of the evidence
+    assert candidate_label(ALGO_TWOHOP, 256, 4) in plan.modeled_us
+    assert candidate_label(ALGO_TWOHOP, 256, 0) in plan.modeled_us
+
+
+def test_decision_uniform_fabric_stays_flat():
+    """No topology signal (intra_size 0, single process): hierarchical
+    costs an extra requantize round-trip for nothing — never chosen."""
+    plan = plan_comm(SIZES, 8, _qc(), _ca(intra_size=0))
+    assert plan.hierarchical == 0
+
+
+def test_decision_block_tuning_follows_padding():
+    """Small tensors pay pad_to_multiple(n, W*block): a sub-block-sized
+    histogram picks a smaller block than the large-tensor default."""
+    small = plan_comm([600, 300, 900], 8, _qc(), _ca())
+    big = plan_comm([1 << 20], 8, _qc(), _ca())
+    assert small.block < big.block == 256, (small.block, big.block)
+
+
+def test_explicit_config_acts_as_override():
+    """Static quantized_comm keys pin the candidate set — the
+    pre-autotuner behavior, now opt-out (and flagged in the plan)."""
+    plan = plan_comm(SIZES, 8, _qc(algo="allgather",
+                                   explicit={"algo": True}), _ca())
+    assert plan.algo == ALGO_ALLGATHER and plan.overridden
+    assert "pinned" in plan.reason
+    plan = plan_comm(SIZES, 8, _qc(block=128, explicit={"block": True}),
+                     _ca())
+    assert plan.block == 128 and plan.overridden
+    # pinned hierarchy: planned even without an intra_size hint
+    plan = plan_comm(SIZES, 8, _qc(hierarchical=2,
+                                   explicit={"hierarchical": True}),
+                     _ca())
+    assert plan.hierarchical == 2 and plan.algo == ALGO_TWOHOP
+
+
+# ------------------------------------------------------- cost model
+
+
+def test_cost_model_reproduces_wire_crossovers():
+    link = LinkModel()
+    n = [1 << 20]
+    # W=8 flat: two-hop beats allgather by ~W/2x in bytes
+    t2 = exchange_time_us(n, 8, algo=ALGO_TWOHOP, link=link)
+    tl = exchange_time_us(n, 8, algo=ALGO_ALLGATHER, link=link)
+    assert t2 < 0.5 * tl, (t2, tl)
+    # W=2: equal bytes, allgather saves one hop latency
+    t2 = exchange_time_us(n, 2, algo=ALGO_TWOHOP, link=link)
+    tl = exchange_time_us(n, 2, algo=ALGO_ALLGATHER, link=link)
+    assert tl < t2
+    # split fabric: hierarchical keeps the bulk off the slow wire
+    flat = exchange_time_us(n, 8, algo=ALGO_TWOHOP, topo_intra=4,
+                            link=link)
+    hier = exchange_time_us(n, 8, algo=ALGO_TWOHOP, hierarchical=4,
+                            topo_intra=4, link=link)
+    assert hier < flat, (hier, flat)
+    # uniform fabric: the flat shape is at least as good (fewer hops)
+    flat_u = exchange_time_us(n, 8, algo=ALGO_TWOHOP, link=link)
+    hier_u = exchange_time_us(n, 8, algo=ALGO_TWOHOP, hierarchical=4,
+                              link=link)
+    assert flat_u <= hier_u
+
+
+def test_wire_hops_totals_match_wire_bytes():
+    """The hop-level view must sum to the total-bytes model exactly —
+    they are two projections of the same accounting."""
+    n = 1 << 20
+    for W in (2, 4, 8):
+        for algo in (ALGO_TWOHOP, ALGO_ALLGATHER):
+            total, _ = wire_bytes(n, W, algo=algo)
+            assert sum(b for _, b in wire_hops(n, W, algo=algo)) == total
+    from deepspeed_tpu.runtime.quantized_collectives import \
+        wire_bytes_by_axis
+    per_axis = wire_bytes_by_axis(n, 2, 4)
+    hops = wire_hops(n, 8, hierarchical=(2, 4))
+    assert sum(b for a, b in hops if a == "intra") == per_axis["intra"]
+    assert sum(b for a, b in hops if a == "inter") == per_axis["inter"]
+
+
+# ------------------------------------- cost-model drift guard (tier-1)
+
+
+@pytest.mark.parametrize("algo,world,hier", [
+    (ALGO_ALLGATHER, 4, 0),
+    (ALGO_ALLGATHER, 8, 0),
+    (ALGO_TWOHOP, 4, 0),
+    (ALGO_TWOHOP, 8, 0),
+    (ALGO_TWOHOP, 8, 4),       # 2x4 hierarchical
+    (ALGO_TWOHOP, 8, 2),       # 4x2 hierarchical
+])
+def test_wire_model_matches_compiled_hlo(algo, world, hier):
+    """wire_bytes / wire_bytes_by_axis predictions vs partitioned-HLO
+    send-byte accounting, per algo×topology — the autotuner's inputs
+    can't silently rot (mfu_cost_model pattern)."""
+    cal = calibrate_wire_model(world=world, algo=algo, hierarchical=hier,
+                               n=1 << 16)
+    assert abs(cal["drift"]) <= 0.05, cal
+
+
+# ------------------------------------------------------------- config
+
+
+def test_config_validation():
+    base = {"train_micro_batch_size_per_gpu": 1}
+    for bad in [{"overlap": "yes"}, {"intra_size": 1},
+                {"intra_gbps": 0}, {"inter_gbps": -1},
+                {"intra_latency_us": -1},
+                {"block_candidates": []},
+                {"block_candidates": [4]},
+                # malformed values get the curated error too, not a
+                # raw TypeError/ValueError from the parse-time coercion
+                {"block_candidates": 256},
+                {"intra_gbps": "fast"}]:
+        with pytest.raises(DeepSpeedConfigError):
+            DeepSpeedConfig({**base, "comm_autotune": bad})
+    cfg = DeepSpeedConfig({**base, "comm_autotune": {"enabled": True},
+                           "quantized_comm": {"enabled": True}})
+    assert cfg.comm_autotune_config["enabled"]
+    assert cfg.comm_autotune_config["overlap"] == "auto"
+    # JSON 0/1 normalize to real bools: the overlap decision tests
+    # identity (`is False`), so 0 must actually DISABLE overlap
+    assert DeepSpeedConfig({**base, "comm_autotune": {"overlap": 0}}
+                           ).comm_autotune_config["overlap"] is False
+    assert DeepSpeedConfig({**base, "comm_autotune": {"overlap": 1}}
+                           ).comm_autotune_config["overlap"] is True
+    # explicitness tracking feeds the override behavior
+    qc = cfg.quantized_comm_config
+    assert not qc["explicit"]["algo"] and not qc["explicit"]["block"]
+    qc2 = DeepSpeedConfig({**base, "quantized_comm": {
+        "enabled": True, "algo": "allgather"}}).quantized_comm_config
+    assert qc2["explicit"]["algo"] and not qc2["explicit"]["hierarchical"]
+    # the legacy alias's block counts as explicit
+    qc3 = DeepSpeedConfig({**base, "compressed_allreduce": {
+        "enabled": True, "block": 128}}).quantized_comm_config
+    assert qc3["explicit"]["block"]
+
+
+# ------------------------------------------------- engine integration
+
+
+def _mlp(seed=0, hidden=(64, 256, 64)):
+    d_in, d_h, d_out = hidden
+
+    def loss_fn(params, batch, rngs=None):
+        h = jnp.tanh(batch["x"] @ params["w1"])
+        return jnp.mean((h @ params["w2"] - batch["y"]) ** 2)
+
+    key = jax.random.PRNGKey(seed)
+    params = {"w1": jax.random.normal(key, (d_in, d_h)) * 0.1,
+              "w2": jax.random.normal(key, (d_h, d_out)) * 0.1}
+    return loss_fn, params
+
+
+def _engine(cfg_extra, seed=0):
+    loss_fn, params = _mlp(seed)
+    engine, *_ = ds.initialize(
+        model=loss_fn, model_parameters=params,
+        config={"train_micro_batch_size_per_gpu": 4,
+                "steps_per_print": 10**9,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                **cfg_extra})
+    return engine
+
+
+def _batches(engine, n, seed=0, d=64):
+    rs = np.random.RandomState(seed)
+    shd = NamedSharding(engine.mesh, P(engine._dp_axis_entry))
+    bs = 4 * engine.dp_world_size
+    return [{"x": jax.device_put(rs.randn(bs, d).astype(np.float32), shd),
+             "y": jax.device_put(rs.randn(bs, d).astype(np.float32), shd)}
+            for _ in range(n)]
+
+
+def test_engine_applies_plan_dp2():
+    engine = _engine({"quantized_comm": {"enabled": True},
+                      "comm_autotune": {"enabled": True},
+                      "mesh": {"axes": {"data": 2}}})
+    assert engine.dp_world_size == 2
+    assert engine._comm_plan is not None
+    assert engine._quant_algo == ALGO_ALLGATHER
+
+
+def test_engine_applies_plan_hierarchical():
+    """comm_autotune.intra_size shapes the MESH itself: the plan's
+    hierarchy split runs before build_mesh."""
+    engine = _engine({"quantized_comm": {"enabled": True},
+                      "comm_autotune": {"enabled": True, "intra_size": 4}})
+    assert engine._dp_hierarchical
+    assert dict(engine.mesh.shape) == {"data_inter": 2, "data_intra": 4}
+    assert engine._quant_algo == ALGO_TWOHOP
+    assert engine._comm_plan.hierarchical == 4
+
+
+def test_engine_static_algo_overrides_plan():
+    engine = _engine({"quantized_comm": {"enabled": True,
+                                         "algo": "allgather"},
+                      "comm_autotune": {"enabled": True}})
+    assert engine._quant_algo == ALGO_ALLGATHER
+    assert engine._comm_plan.overridden
+
+
+def test_engine_calibrate_records_drift():
+    engine = _engine({"quantized_comm": {"enabled": True},
+                      "comm_autotune": {"enabled": True,
+                                        "calibrate": True}})
+    cal = engine._comm_plan.calibration
+    assert cal is not None and abs(cal["drift"]) <= 0.05, cal
+
+
+def test_degenerate_pinned_hierarchy_equal_to_world_still_plans():
+    """quantized_comm.hierarchical == dp world (inter=1) is the legal
+    degenerate split — split_data_axis and the exchange both accept it,
+    so turning the autotuner on must not brick the config."""
+    plan = plan_comm(SIZES, 8, _qc(hierarchical=8,
+                                   explicit={"hierarchical": True}),
+                     _ca())
+    assert plan.hierarchical == 8
+    engine = _engine({"quantized_comm": {"enabled": True,
+                                         "hierarchical": 8},
+                      "comm_autotune": {"enabled": True}})
+    assert engine._dp_hierarchical
+    assert dict(engine.mesh.shape) == {"data_inter": 1, "data_intra": 8}
+
+
+def test_invalid_pinned_combo_surfaces_the_config_error():
+    """Planning runs before DeepSpeedConfig validation; an invalid
+    quantized_comm combo must still raise the config layer's curated
+    error, never a raw planner exception."""
+    with pytest.raises(DeepSpeedConfigError, match="twohop"):
+        _engine({"quantized_comm": {"enabled": True, "algo": "allgather",
+                                    "hierarchical": 4},
+                 "comm_autotune": {"enabled": True}})
+    with pytest.raises(DeepSpeedConfigError, match="algo"):
+        _engine({"quantized_comm": {"enabled": True, "algo": "typo"},
+                 "comm_autotune": {"enabled": True}})
+
+
+def test_sparse_and_onebit_configs_skip_the_plan():
+    engine = _engine({"quantized_comm": {"enabled": True},
+                      "comm_autotune": {"enabled": True},
+                      "sparse_gradients": True})
+    assert engine._comm_plan is None
+
+
+# ------------------------------------------------ overlap parity (bitwise)
+
+
+def _run_pair(cfg_extra, gas=3, steps=4, seed=0):
+    """(losses, engine) for overlap=True and overlap=False on identical
+    data — everything else about the two engines is the same."""
+    out = []
+    for overlap in (True, False):
+        qc = {"enabled": True}
+        qc.update(cfg_extra.get("quantized_comm", {}))
+        extra = {k: v for k, v in cfg_extra.items()
+                 if k != "quantized_comm"}
+        engine = _engine({
+            "gradient_accumulation_steps": gas,
+            "quantized_comm": qc,
+            "comm_autotune": {"enabled": True, "overlap": overlap},
+            **extra}, seed=seed)
+        assert engine._batch_path()
+        assert engine._overlap_path() is overlap
+        batches = _batches(engine, steps * gas, seed=seed + 1)
+        losses = [engine.train_batch(iter(batches[i * gas:(i + 1) * gas]))
+                  for i in range(steps)]
+        out.append(([float(l) for l in losses], engine))
+    return out
+
+
+def _assert_bitwise_params(e1, e0):
+    for a, b in zip(jax.tree_util.tree_leaves(e1.state.params),
+                    jax.tree_util.tree_leaves(e0.state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_overlap_parity_fp32_bitwise():
+    (l1, e1), (l0, e0) = _run_pair({})
+    assert l1 == l0, (l1, l0)            # bitwise, not approximately
+    _assert_bitwise_params(e1, e0)
+    assert e1.global_steps == e0.global_steps == 4
+
+
+def test_overlap_parity_bf16_bitwise():
+    (l1, e1), (l0, e0) = _run_pair({"bf16": {"enabled": True}})
+    assert l1 == l0, (l1, l0)
+    _assert_bitwise_params(e1, e0)
+
+
+def test_overlap_parity_hierarchical_qwz_bitwise():
+    """The hoisted weight gather + hierarchical 2D exchange: still
+    bitwise (params constant within a window — one gather serves all
+    gas micros)."""
+    (l1, e1), (l0, e0) = _run_pair({
+        "quantized_comm": {"enabled": True, "quantize_weights": True,
+                           "hierarchical": 4},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 2}})
+    assert e1._dp_hierarchical and e1._qwz
+    assert l1 == l0, (l1, l0)
+    _assert_bitwise_params(e1, e0)
+
+
+def test_overlap_fp16_loss_scale_skip_parity():
+    """An overflowing first window (initial scale 2^32) must be skipped
+    identically: same skipped_steps, same post-backoff scale, same
+    params — the deferred exchange carries the nonfinite poison exactly
+    like the serial one."""
+    (l1, e1), (l0, e0) = _run_pair(
+        {"fp16": {"enabled": True, "initial_scale_power": 32,
+                  "loss_scale_window": 1000}}, steps=5)
+    assert e1.skipped_steps == e0.skipped_steps > 0
+    assert e1.loss_scale() == e0.loss_scale()
+    assert e1.global_steps == e0.global_steps
+    assert l1 == l0
+    _assert_bitwise_params(e1, e0)
+
+
+# ------------------------------------------------------- auto-fallback
+
+
+def test_overlap_falls_back_without_quantized_exchange():
+    """Dense GSPMD configs have no explicit exchange to defer: overlap
+    auto-falls back (logged), training runs."""
+    engine = _engine({"gradient_accumulation_steps": 2,
+                      "comm_autotune": {"enabled": True}})
+    assert engine._batch_path() and not engine._overlap_path()
+    batches = _batches(engine, 4)
+    loss = engine.train_batch(iter(batches[:2]))
+    assert np.isfinite(float(loss))
+
+
+def test_overlap_falls_back_at_gas1():
+    engine = _engine({"quantized_comm": {"enabled": True},
+                      "comm_autotune": {"enabled": True}})
+    assert not engine._overlap_path()
+    ov, why = engine._select_overlap_path()
+    assert not ov and "gas=1" in why
+
+
+def test_overlap_off_when_autotune_disabled():
+    engine = _engine({"gradient_accumulation_steps": 2,
+                      "quantized_comm": {"enabled": True}})
+    assert engine._batch_path() and not engine._overlap_path()
+
+
+# ------------------------------------------------------------ telemetry
+
+
+def test_comm_plan_event_and_mode_land_in_events_log(tmp_path):
+    import json
+    engine = _engine({"gradient_accumulation_steps": 2,
+                      "quantized_comm": {"enabled": True},
+                      "comm_autotune": {"enabled": True},
+                      "observability": {"enabled": True,
+                                        "events_dir": str(tmp_path),
+                                        "flops_profiler": False,
+                                        "memory_watermarks": False}})
+    batches = _batches(engine, 2)
+    engine.train_batch(iter(batches))
+    engine.last_loss()
+    engine.close()
+    rows = [json.loads(l) for l in
+            (tmp_path / "events.jsonl").read_text().splitlines()]
+    plans = [r for r in rows if r.get("event") == "comm_plan"]
+    assert plans and plans[0]["algo"] == ALGO_TWOHOP
+    assert plans[0]["block"] == 256 and "dp=8" in plans[0]["reason"]
+    modes = [r for r in rows if r.get("event") == "comm_mode"]
+    assert modes and modes[-1]["mode"] == "twohop+overlap"
+    # and obs_report surfaces both
+    import sys
+    sys.path.insert(0, "tools")
+    try:
+        import obs_report
+        s = obs_report.summarize(str(tmp_path))
+    finally:
+        sys.path.pop(0)
+    assert s["comm"]["mode"] == "twohop+overlap"
+    assert s["comm"]["plan"]["algo"] == ALGO_TWOHOP
+    assert "comm_plan" in obs_report.render(s)

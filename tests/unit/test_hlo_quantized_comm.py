@@ -262,6 +262,94 @@ def test_hpz_weight_bytes_cross_inter_only():
     assert intra_bytes > inter_bytes, (intra_bytes, inter_bytes)
 
 
+def _fused_hlo(engine, batch, gas):
+    stacked = jax.device_put(
+        jax.tree_util.tree_map(lambda x: np.stack([np.asarray(x)] * gas),
+                               batch),
+        engine._stacked_batch_sharding())
+    assert engine._batch_path()
+    engine._overlap_path()
+    return (engine._get_compiled_batch_step()
+            .lower(engine.state, stacked).compile().as_text())
+
+
+def test_overlapped_fused_step_interleaves_exchange_with_compute():
+    """ISSUE 6 acceptance audit: in the OVERLAPPED fused program every
+    grad-exchange collective inside the scan body has a dot-general-
+    free operand cone — it consumes only the double-buffered carry, so
+    the scheduler can interleave it with the iteration's forward/
+    backward dots — and the last window's exchange flushes OUTSIDE the
+    loop. The serial program is the control: its exchange depends on
+    the same iteration's backward (cone contains dots) and nothing
+    flushes outside. Dependence, not textual order — backend- and
+    scheduler-invariant, like the byte audits above."""
+    from deepspeed_tpu.utils.hlo_audit import overlap_structure
+    gas = 3
+
+    def build(overlap):
+        engine, batch, _ = _mlp_engine(
+            {"quantized_comm": {"enabled": True},
+             "comm_autotune": {"enabled": True, "overlap": overlap},
+             "gradient_accumulation_steps": gas})
+        assert engine._quant_allreduce
+        return overlap_structure(_fused_hlo(engine, batch, gas))
+
+    o = build(True)
+    s = build(False)
+    # overlapped: every exchange collective in the body is compute-
+    # independent, and the flush exists past the scan
+    assert o["exchange_collectives"] >= 2, o
+    assert o["overlap_fraction"] == 1.0, o
+    assert o["flush_outside_loop"] >= 2, o
+    # serial control: same collectives, all compute-dependent, no flush
+    assert s["exchange_collectives"] >= 2, s
+    assert s["overlap_fraction"] == 0.0, s
+    assert s["flush_outside_loop"] == 0, s
+
+
+def test_overlapped_step_hoists_qwz_weight_gather_out_of_scan():
+    """With qwZ, the serial scan body re-gathers the int8 weights every
+    iteration; the overlapped step hoists the gather out of the loop
+    (params are constant within the window) — the s8 all-gather count
+    inside the while body drops and weight-scale gathers appear outside
+    it."""
+    from deepspeed_tpu.utils.hlo_audit import (hlo_computation_body,
+                                               while_body_comps)
+    gas = 3
+
+    def s8_gather_counts(txt):
+        body_names = while_body_comps(txt)
+        inside = outside = 0
+        body_lines = []
+        for comp in body_names:
+            body_lines.extend(hlo_computation_body(txt, comp))
+        in_body = {l.strip() for l in body_lines}
+        for line in txt.splitlines():
+            if "all-gather" in line and "s8[" in line and " = " in line:
+                if line.strip() in in_body:
+                    inside += 1
+                else:
+                    outside += 1
+        return inside, outside
+
+    def build(overlap):
+        engine, batch, P_total = _mlp_engine(
+            {"quantized_comm": {"enabled": True,
+                                "quantize_weights": True},
+             "comm_autotune": {"enabled": True, "overlap": overlap},
+             "bf16": {"enabled": True},
+             "zero_optimization": {"stage": 2},
+             "gradient_accumulation_steps": gas})
+        assert engine._qwz
+        return s8_gather_counts(_fused_hlo(engine, batch, gas))
+
+    in_o, out_o = build(True)
+    in_s, out_s = build(False)
+    # hoisting moved the per-iteration weight gathers out of the body
+    assert in_o < in_s, (in_o, in_s)
+    assert out_o > out_s, (out_o, out_s)
+
+
 def test_engine_comm_stats_model():
     """The engine's per-step comm telemetry model reports compression
     vs the dense fp32 ring and the active mode string."""

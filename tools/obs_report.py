@@ -225,6 +225,17 @@ def summarize(path, host_gap_threshold=DEFAULT_HOST_GAP_THRESHOLD):
         "comm": {
             "bytes_per_step": _last(scalars, T_COMM_BYTES),
             "compression_ratio": _last(scalars, T_COMM_RATIO),
+            # which exchange produced the bytes (comm autotuner /
+            # static quantized_comm): last comm_mode event + the full
+            # comm_plan decision row when the autotuner ran
+            "mode": next((str(e.get("mode")) for e in reversed(events)
+                          if e.get("event") == "comm_mode"), None),
+            "plan": next((
+                {k: e.get(k) for k in ("algo", "block", "hierarchical",
+                                       "world", "topo_intra", "reason",
+                                       "overridden")}
+                for e in reversed(events)
+                if e.get("event") == "comm_plan"), None),
         },
         "recompiles": {
             "count": int(recompiles) if recompiles is not None else 0,
@@ -286,11 +297,25 @@ def render(s):
         f"{_fmt(s['flops_per_step'], '{:.3e}')}",
         f"  comm_bytes_per_step: "
         f"{_fmt_bytes(s['comm']['bytes_per_step'])} "
-        f"(compression {_fmt(s['comm']['compression_ratio'])}x)",
+        f"(compression {_fmt(s['comm']['compression_ratio'])}x"
+        + (f", mode={s['comm'].get('mode')}"
+           if s['comm'].get('mode') else "") + ")",
         f"  recompiles        : {s['recompiles']['count']}"
         + (f" (total {_fmt(s['recompiles']['total_compile_ms'], '{:.0f}')}"
            " ms)" if s['recompiles']['total_compile_ms'] else ""),
     ]
+    plan = s["comm"].get("plan")
+    if plan:
+        hier = plan.get("hierarchical") or 0
+        # anchored to the comm-bytes line it annotates, not a position
+        idx = next((i for i, l in enumerate(lines)
+                    if l.startswith("  comm_bytes_per_step")),
+                   len(lines) - 1)
+        lines.insert(idx + 1, "  comm_plan         : "
+                     f"{'hier%s-' % hier if hier else ''}{plan.get('algo')}"
+                     f"/b{plan.get('block')} "
+                     f"({'pinned' if plan.get('overridden') else 'autotuned'}"
+                     f"; {plan.get('reason')})")
     for fn, d in s["recompiles"]["per_fn"].items():
         lines.append(f"    - {fn}: {d['count']} compile(s), "
                      f"{d['wall_ms']:.0f} ms")
