@@ -35,6 +35,13 @@ Metrics (BASELINE.md rows):
   CompileTracker count is in detail and must be 0); vs_baseline =
   cached decode tokens/s / a no-cache full-forward-per-token loop at
   the same batch size (isolates the KV-cache payoff from batching)
+- paged_kv_occupancy : HARDWARE-FREE — serving-capacity payoff of the
+  paged KV cache on a mixed-length workload at EQUAL cache HBM budget:
+  value = peak live tokens in flight per cache KiB for the paged
+  engine, vs_baseline = that density / the dense slot x max_len
+  engine's (acceptance: >= 2x); detail carries both engines' decode
+  tokens/s, peak concurrency, prefix hit rate, and the paged engine's
+  0-steady-state-recompile pin under the mixed-length churn
 - bert_large_samples_per_s : BERT-large fused-layer training @ seq 128
   (reference: 272 samples/s on 1x V100, fastest-bert post :38-40)
 - bert_onebit_samples_per_s : BERT + 1-bit Adam in the compression
@@ -90,6 +97,7 @@ METRICS = [
     "mfu_cost_model",
     "host_dispatch_overhead",
     "decode_throughput",
+    "paged_kv_occupancy",
     "bert_large_samples_per_s",
     "bert_onebit_samples_per_s",
     "sparse_attention_speedup_s8k",
@@ -101,7 +109,7 @@ HEADLINE = "gpt2_train_mfu"
 # 8-device CPU mesh in their child, runnable with the tunnel down
 HW_FREE = {"comm_wire_bytes_per_step", "comm_overlap_structure",
            "mfu_cost_model", "host_dispatch_overhead",
-           "decode_throughput"}
+           "decode_throughput", "paged_kv_occupancy"}
 
 PARTIAL_PATH = os.environ.get(
     "BENCH_PARTIAL", "/tmp/dstpu_bench_partial.jsonl")
@@ -1040,6 +1048,101 @@ def bench_decode_throughput(on_tpu, rtt):
                             "CompileTracker (hardware-free)"})
 
 
+def bench_paged_kv_occupancy(on_tpu, rtt):
+    """Hardware-free row: paged vs dense KV cache serving capacity at
+    EQUAL cache HBM budget on a mixed-length workload (tiny GPT-2,
+    CPU).
+
+    Both engines get the same cache byte budget (dense: 4 slots x
+    max_len 128 + scratch; paged: the same token capacity as a page
+    pool). The paged engine runs 16 decode slots over it — dense can't,
+    its geometry charges every slot max_len up front. value = the paged
+    engine's peak live tokens in flight per cache KiB; vs_baseline =
+    that density / the dense engine's (ISSUE 7 acceptance: >= 2x on the
+    mixed-length workload). detail pins `steady_state_recompiles == 0`
+    for the paged engine under the mixed-length churn, and carries both
+    engines' decode tokens/s so the capacity win is visibly not bought
+    with throughput.
+    """
+    del on_tpu, rtt        # CPU-only accounting + wall clock, tiny model
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.inference import InferenceEngine, kv_cache_bytes, \
+        paged_kv_bytes
+    from deepspeed_tpu.models.gpt2 import GPT2Config, init_gpt2_params
+
+    cfg = GPT2Config(vocab_size=256, max_position_embeddings=128,
+                     hidden_size=64, num_layers=2, num_heads=4,
+                     embd_dropout=0.0, attn_dropout=0.0,
+                     resid_dropout=0.0)
+    params = init_gpt2_params(cfg, jax.random.PRNGKey(0))
+    max_len, new_tokens, ps = 128, 16, 16
+    dense_slots = 4
+    # equal budget: dense (slots+1) rows x max_len tokens == page pool
+    num_pages = (dense_slots + 1) * (max_len // ps)        # 40 pages
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, 256, (l,)).tolist()
+               for l in (5, 9, 14, 3, 16, 7, 12, 4, 10, 6,
+                         15, 8, 5, 11, 3, 13)]
+
+    def serve(engine):
+        engine.warmup()
+        _beat()
+        t0 = time.perf_counter()
+        outs = engine.generate(prompts, max_new_tokens=new_tokens,
+                               temperature=0.0)
+        wall = time.perf_counter() - t0
+        gen = sum(len(o) - len(p) for o, p in zip(outs, prompts))
+        return outs, gen / wall
+
+    paged = InferenceEngine(cfg, params, {
+        "max_batch_size": 16, "prompt_buckets": [8, 16],
+        "batch_buckets": [1, 4, 16], "max_seq_len": max_len,
+        "max_new_tokens": new_tokens,
+        "paged_kv": {"page_size": ps, "num_pages": num_pages}},
+        dtype=jnp.float32)
+    paged_bytes = paged_kv_bytes(paged.paged_spec)
+    paged_outs, paged_tps = serve(paged)
+    paged_recompiles = paged.steady_state_recompiles
+    paged_peak = paged.scheduler.peak_tokens_in_flight
+    alloc = paged.scheduler.allocator
+    seen = alloc.prefix_hit_tokens + alloc.prefix_miss_tokens
+    _beat()
+
+    dense = InferenceEngine(cfg, params, {
+        "max_batch_size": dense_slots, "prompt_buckets": [8, 16],
+        "batch_buckets": [1, 4], "max_seq_len": max_len,
+        "max_new_tokens": new_tokens,
+        "paged_kv": {"enabled": False}}, dtype=jnp.float32)
+    dense_bytes = kv_cache_bytes(dense.cache_spec)
+    dense_outs, dense_tps = serve(dense)
+    dense_peak = dense.scheduler.peak_tokens_in_flight
+    _beat()
+
+    parity = paged_outs == dense_outs
+    paged_density = paged_peak / (paged_bytes / 1024)
+    dense_density = dense_peak / (dense_bytes / 1024)
+    return _emit("paged_kv_occupancy", round(paged_density, 4),
+                 "tokens_in_flight_per_cache_kib",
+                 round(paged_density / dense_density, 3)
+                 if dense_density > 0 else 0.0,
+                 {"requests": len(prompts), "new_tokens": new_tokens,
+                  "page_size": ps, "num_pages": num_pages,
+                  "cache_bytes": {"paged": paged_bytes,
+                                  "dense": dense_bytes},
+                  "peak_tokens_in_flight": {"paged": paged_peak,
+                                            "dense": dense_peak},
+                  "decode_tokens_per_s": {"paged": round(paged_tps, 2),
+                                          "dense": round(dense_tps, 2)},
+                  "greedy_outputs_match_dense": bool(parity),
+                  "steady_state_recompiles": paged_recompiles,
+                  "prefix_hit_rate": round(
+                      alloc.prefix_hit_tokens / seen, 4) if seen else 0.0,
+                  "backend": jax.default_backend(),
+                  "source": "inference engine scheduler accounting "
+                            "(hardware-free)"})
+
+
 # ------------------------------------------------------------- child mode
 
 
@@ -1094,6 +1197,8 @@ def run_child(metric):
         bench_host_dispatch_overhead(on_tpu, rtt)
     elif metric == "decode_throughput":
         bench_decode_throughput(on_tpu, rtt)
+    elif metric == "paged_kv_occupancy":
+        bench_paged_kv_occupancy(on_tpu, rtt)
     elif metric == "bert_large_samples_per_s":
         bench_bert_large(on_tpu, rtt)
     elif metric == "bert_onebit_samples_per_s":

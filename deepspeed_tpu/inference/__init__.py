@@ -2,19 +2,25 @@
 DeepSpeed v0.3.0 snapshot is training-only).
 
 Bucketed jit-compiled prefill/decode over a preallocated, donated KV
-cache; continuous-batching scheduler; checkpoint -> serving bridge
-(params-only load, optional qwZ int8 weight distribution); serving
-telemetry through the observability event log. See
-``deepspeed_tpu/inference/engine.py`` and ``docs/inference.md``.
+cache — paged (block tables + refcounted prefix caching) by default,
+dense slot x max_len behind ``paged_kv.enabled: false`` — optionally
+GSPMD-sharded over a serving mesh; continuous-batching scheduler with
+bounded-lookahead admission; checkpoint -> serving bridge (params-only
+load with serving-mesh resharding, optional qwZ int8 weight
+distribution); serving telemetry through the observability event log.
+See ``deepspeed_tpu/inference/engine.py`` and ``docs/inference.md``.
 """
 
 from deepspeed_tpu.inference.buckets import (pad_prompts, pick_bucket,
                                              validate_buckets, warmup_plan)
 from deepspeed_tpu.inference.engine import (InferenceEngine,
                                             qwz_distribute_params)
-from deepspeed_tpu.inference.kv_cache import (KVCacheSpec, cache_spec_for,
+from deepspeed_tpu.inference.kv_cache import (KVCacheSpec, PageAllocator,
+                                              PagedKVSpec, cache_spec_for,
                                               init_kv_cache,
-                                              kv_cache_bytes)
+                                              init_paged_kv_cache,
+                                              kv_cache_bytes, paged_kv_bytes,
+                                              paged_spec_for, pages_for)
 from deepspeed_tpu.inference.scheduler import (FinishedRequest,
                                                PrefillBatch, Request,
                                                Scheduler)
@@ -22,6 +28,8 @@ from deepspeed_tpu.inference.scheduler import (FinishedRequest,
 __all__ = [
     "InferenceEngine", "Request", "FinishedRequest", "PrefillBatch",
     "Scheduler", "KVCacheSpec", "cache_spec_for", "init_kv_cache",
-    "kv_cache_bytes", "pick_bucket", "pad_prompts", "validate_buckets",
-    "warmup_plan", "qwz_distribute_params",
+    "kv_cache_bytes", "PagedKVSpec", "PageAllocator", "paged_spec_for",
+    "init_paged_kv_cache", "paged_kv_bytes", "pages_for", "pick_bucket",
+    "pad_prompts", "validate_buckets", "warmup_plan",
+    "qwz_distribute_params",
 ]

@@ -4,14 +4,23 @@ The reference snapshot (DeepSpeed v0.3.0) is training-only; this is the
 serving half the ROADMAP's "heavy traffic" north star needs, built
 TPU-first:
 
-- **Two compiled programs, fixed shapes.** A jit-compiled *prefill*
+- **Fixed program set, fixed shapes.** A jit-compiled *prefill*
   runs the padded prompt batch through the model's cached forward
   (``models/*`` ``kv_cache=`` mode — the SAME blocks as training) and
-  scatters the prompt K/V into the persistent slot cache; a
-  jit-compiled single-token *decode* advances every slot one position.
-  Both carry the preallocated KV cache ``(layers, rows, kv_heads,
-  max_len, head_dim)`` as a **donated** argument — steady state
-  allocates nothing.
+  writes the prompt K/V into the cache; a jit-compiled single-token
+  *decode* advances every slot one position. Both carry the
+  preallocated cache as a **donated** argument — steady state allocates
+  nothing.
+- **Paged KV cache (default).** The cache is a pool of fixed
+  ``(kv_heads, page_size, head_dim)`` pages addressed through
+  static-shape per-slot block tables (``inference/kv_cache.py``); HBM
+  occupancy is bounded by the tokens reserved in flight, not
+  ``slots x max_len``, and page-aligned shared prompt prefixes
+  hash-dedup so a fleet of requests on one system prompt prefills it
+  once. Page allocation is host-side (scheduler) — the compiled
+  programs never see it. ``paged_kv.enabled: false`` restores the dense
+  slot x max_len cache (the PR-5 layout, kept as the parity/bench
+  baseline).
 - **Bucketed shapes.** Prompts pad to configured ``prompt_buckets`` and
   prefill batches to ``batch_buckets`` (inference/buckets.py), so
   steady-state serving dispatches exactly
@@ -19,20 +28,29 @@ TPU-first:
   decode program — all compiled by :meth:`InferenceEngine.warmup` and
   pinned by the engine's CompileTracker (``steady_state_recompiles``
   must stay 0; tier-1 asserted).
+- **Serving mesh.** With ``inference.mesh.axes`` set (e.g.
+  ``{"model": 4}``) the programs jit with GSPMD NamedShardings over a
+  ``parallel/mesh.py`` mesh: params carry the families' Megatron
+  column/row PartitionSpecs, the KV cache/pool shards over its kv_heads
+  dim — tensor-parallel prefill/decode over ICI.
+  :meth:`from_checkpoint` reshards committed train-mesh params onto the
+  serving mesh on load (portable array redistribution: the checkpoint
+  is logically indexed, ``load_params_only`` materializes straight into
+  the serving shardings).
 - **Continuous batching.** The host-side :class:`~.scheduler.Scheduler`
   admits queued requests into freed decode slots every step and evicts
-  finished sequences (EOS / max_tokens) — iteration-level scheduling,
-  per-request sampling state (greedy / temperature+top-k with
-  per-request PRNG keys).
+  finished sequences (EOS / max_tokens) — iteration-level scheduling
+  with bounded-lookahead admission (a head that doesn't fit the free
+  pages can't stall the queue), per-request sampling state.
 - **Checkpoint -> serving bridge.** :meth:`from_checkpoint` loads a
   committed PR-1 checkpoint's ``model_states`` group only
   (``runtime/checkpoint.load_params_only``), optionally shipping the
-  weights through the qwZ int8 block wire format
-  (``runtime/quantized_collectives``) — the ZeRO++ weight-gather
-  numerics applied to serving-replica distribution.
+  weights through the qwZ int8 block format
+  (``runtime/quantized_collectives``).
 - **Serving telemetry.** TTFT, per-token latency, tokens/s, queue
-  depth and slot occupancy stream through the PR-3 monitor into
-  ``events.jsonl`` (``Serve/*`` tags), rendered by
+  depth, slot occupancy — plus paged-cache occupancy (pages in use,
+  tokens in flight, prefix hit rate) — stream through the PR-3 monitor
+  into ``events.jsonl`` (``Serve/*`` tags), rendered by
   ``tools/obs_report.py``'s serving section.
 """
 
@@ -43,17 +61,22 @@ from typing import Any, Dict, List, Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from deepspeed_tpu.inference.buckets import pad_prompts, warmup_plan
-from deepspeed_tpu.inference.kv_cache import (cache_spec_for, init_kv_cache,
-                                              kv_cache_bytes)
+from deepspeed_tpu.inference.kv_cache import (PageAllocator, cache_spec_for,
+                                              init_kv_cache,
+                                              init_paged_kv_cache,
+                                              kv_cache_bytes, paged_kv_bytes,
+                                              paged_spec_for, pages_for)
 from deepspeed_tpu.inference.scheduler import (FinishedRequest, Request,
                                                Scheduler)
 from deepspeed_tpu.models.gpt2 import (GPT2Config, gpt2_forward,
-                                       init_gpt2_params)
+                                       gpt2_param_specs, init_gpt2_params)
 from deepspeed_tpu.models.llama import (LlamaConfig, init_llama_params,
-                                        llama_forward)
+                                        llama_forward, llama_param_specs)
 from deepspeed_tpu.ops.attention.flash import NEG_INF
+from deepspeed_tpu.parallel.mesh import axis_size, build_mesh
 from deepspeed_tpu.profiling.recompile import CompileTracker
 from deepspeed_tpu.profiling.spans import trace_span
 from deepspeed_tpu.utils.logging import logger
@@ -62,8 +85,10 @@ from deepspeed_tpu.utils.monitor import TensorBoardMonitor, _JsonlWriter
 __all__ = ["InferenceEngine"]
 
 _FAMILIES = {
-    GPT2Config: ("gpt2", gpt2_forward, init_gpt2_params),
-    LlamaConfig: ("llama", llama_forward, init_llama_params),
+    GPT2Config: ("gpt2", gpt2_forward, init_gpt2_params,
+                 gpt2_param_specs),
+    LlamaConfig: ("llama", llama_forward, init_llama_params,
+                  llama_param_specs),
 }
 
 
@@ -80,6 +105,41 @@ def _normalize_inference_config(inference_config) -> Dict[str, Any]:
     from deepspeed_tpu.runtime.config import get_inference_config
     return get_inference_config(
         {"inference": dict(inference_config or {})})
+
+
+def _serving_mesh(cfg, mesh=None):
+    """The serving mesh from ``inference.mesh.axes`` (or an injected
+    one); None for single-device serving."""
+    if mesh is not None:
+        return mesh
+    axes = dict(cfg["mesh"]["axes"])
+    return build_mesh(axes) if axes else None
+
+
+def _leaf_sharding(mesh, spec, shape) -> NamedSharding:
+    """A leaf's serving NamedSharding: the family's TP spec, with any
+    dim the mesh axis doesn't divide falling back to replication (the
+    zero_shardings discipline — small/indivisible leaves are cheap to
+    replicate; device_put requires exact divisibility)."""
+    dims = list(spec) + [None] * (len(shape) - len(spec))
+    fixed = []
+    for d, ax in zip(shape, dims):
+        if ax is None:
+            fixed.append(None)
+            continue
+        n = 1
+        for a in (ax if isinstance(ax, tuple) else (ax,)):
+            n *= axis_size(mesh, a)
+        fixed.append(ax if n > 0 and d % n == 0 else None)
+    return NamedSharding(mesh, P(*fixed))
+
+
+def _param_shardings(mesh, specs_fn, model_config, template):
+    """Per-leaf serving shardings for a params pytree (``template``:
+    real arrays or ``jax.eval_shape`` structs — only shapes are read)."""
+    return jax.tree_util.tree_map(
+        lambda leaf, s: _leaf_sharding(mesh, s, leaf.shape),
+        template, specs_fn(model_config))
 
 
 def qwz_distribute_params(params, block: int = 256):
@@ -102,18 +162,20 @@ def qwz_distribute_params(params, block: int = 256):
 
 
 class InferenceEngine:
-    """Bucketed prefill/decode serving over a continuous-batching
-    scheduler. See the module docstring for the architecture;
+    """Paged (or dense) bucketed prefill/decode serving over a
+    continuous-batching scheduler, optionally sharded over a serving
+    mesh. See the module docstring for the architecture;
     ``docs/inference.md`` for usage."""
 
     def __init__(self, model_config, params, inference_config=None,
-                 dtype=jnp.bfloat16, monitor: Optional[Any] = None):
+                 dtype=jnp.bfloat16, monitor: Optional[Any] = None,
+                 mesh: Optional[Any] = None):
         self.model_config = model_config
-        self.family, self._forward, _ = _family_of(model_config)
+        (self.family, self._forward, _,
+         self._param_specs_fn) = _family_of(model_config)
         self.dtype = dtype
         cfg = _normalize_inference_config(inference_config)
         self.config = cfg
-        self.params = jax.tree_util.tree_map(jnp.asarray, params)
 
         self.num_slots = cfg["max_batch_size"]
         self._rows = self.num_slots + 1          # +1 scratch row
@@ -132,11 +194,62 @@ class InferenceEngine:
         self._vocab = model_config.vocab_size
         self._top_k = min(cfg["top_k"], self._vocab)
 
-        self.cache_spec = cache_spec_for(model_config, self._rows,
-                                         max_len, dtype=dtype)
-        self._cache = init_kv_cache(self.cache_spec)
+        # ---------------------------------------------- serving mesh
+        self.mesh = _serving_mesh(cfg, mesh)
+        self._param_shardings = None
+        self._cache_sharding = None
+        if self.mesh is not None:
+            tp = axis_size(self.mesh, "model")
+            kv_heads = getattr(model_config, "kv_heads", None) or \
+                model_config.num_heads
+            if model_config.num_heads % tp or kv_heads % tp:
+                raise ValueError(
+                    f"inference.mesh model axis ({tp}) must divide "
+                    f"num_heads ({model_config.num_heads}) and kv_heads "
+                    f"({kv_heads})")
+            self._param_shardings = _param_shardings(
+                self.mesh, self._param_specs_fn, model_config, params)
+            # dense cache and paged pool alike carry kv_heads at dim 2
+            self._cache_sharding = NamedSharding(
+                self.mesh, P(None, None, "model"))
+            self.params = jax.tree_util.tree_map(
+                lambda x, s: jax.device_put(jnp.asarray(x), s),
+                params, self._param_shardings)
+        else:
+            self.params = jax.tree_util.tree_map(jnp.asarray, params)
+
+        # ------------------------------------------------- KV cache
+        pk = cfg["paged_kv"]
+        self.paged = bool(pk["enabled"])
+        allocator = None
+        if self.paged:
+            ps = pk["page_size"]
+            # auto pool: the dense-equivalent worst case (+ null page) —
+            # same capacity, but shared/short requests no longer charge
+            # max_len each
+            num_pages = pk["num_pages"] or (
+                self.num_slots * pages_for(max_len, ps) + 1)
+            self.paged_spec = paged_spec_for(model_config, num_pages, ps,
+                                             max_len, dtype=dtype)
+            self.cache_spec = None
+            self._cache = init_paged_kv_cache(self.paged_spec)
+            allocator = PageAllocator(num_pages, ps,
+                                      prefix_cache=pk["prefix_cache"])
+            cache_bytes = paged_kv_bytes(self.paged_spec)
+        else:
+            self.paged_spec = None
+            self.cache_spec = cache_spec_for(model_config, self._rows,
+                                             max_len, dtype=dtype)
+            self._cache = init_kv_cache(self.cache_spec)
+            cache_bytes = kv_cache_bytes(self.cache_spec)
+        if self._cache_sharding is not None:
+            self._cache = tuple(
+                jax.device_put(c, self._cache_sharding)
+                for c in self._cache)
         self.scheduler = Scheduler(self.num_slots, cfg["prompt_buckets"],
-                                   cfg["batch_buckets"], max_len)
+                                   cfg["batch_buckets"], max_len,
+                                   allocator=allocator,
+                                   lookahead=cfg["admit_lookahead"])
 
         # telemetry: monitor (PR-3 pattern) + crash-safe events.jsonl
         self.monitor = monitor if monitor is not None else \
@@ -154,15 +267,45 @@ class InferenceEngine:
         self._serve_secs = 0.0
         self._key_cache: Dict[int, np.ndarray] = {}
 
-        self._prefill = self.compile_tracker.wrap(
-            jax.jit(self._prefill_impl, donate_argnums=(1,)), "prefill")
-        self._decode = self.compile_tracker.wrap(
-            jax.jit(self._decode_impl, donate_argnums=(1,)), "decode")
+        if self.paged:
+            self._prefill = self._wrap_program(
+                self._prefill_paged_impl, 8, "prefill")
+            self._decode = self._wrap_program(
+                self._decode_paged_impl, 7, "decode")
+            geom = (f"paged KV cache: {self.paged_spec.num_pages} pages "
+                    f"x {self.paged_spec.page_size} tokens "
+                    f"({cache_bytes / 2**20:.1f} MiB), prefix cache "
+                    f"{'on' if pk['prefix_cache'] else 'off'}")
+        else:
+            self._prefill = self._wrap_program(
+                self._prefill_impl, 7, "prefill")
+            self._decode = self._wrap_program(
+                self._decode_impl, 6, "decode")
+            geom = (f"dense KV cache "
+                    f"{cache_bytes / 2**20:.1f} MiB")
+        mesh_note = (f", mesh {dict(self.mesh.shape)}"
+                     if self.mesh is not None else "")
         logger.info(
             f"inference engine: {self.family}, {self.num_slots} slots, "
             f"max_len {max_len}, prompt buckets {cfg['prompt_buckets']}, "
-            f"batch buckets {cfg['batch_buckets']}, KV cache "
-            f"{kv_cache_bytes(self.cache_spec) / 2**20:.1f} MiB")
+            f"batch buckets {cfg['batch_buckets']}, {geom}{mesh_note}")
+
+    def _wrap_program(self, fn, nargs: int, name: str):
+        """jit + CompileTracker wrap; with a serving mesh, pin GSPMD
+        NamedShardings (params on their TP specs, cache on the kv_heads
+        split, host arrays replicated) so every dispatch hits the same
+        partitioned program."""
+        if self.mesh is None:
+            jitted = jax.jit(fn, donate_argnums=(1,))
+        else:
+            repl = NamedSharding(self.mesh, P())
+            cache_sh = (self._cache_sharding, self._cache_sharding)
+            in_sh = (self._param_shardings, cache_sh) + \
+                (repl,) * (nargs - 2)
+            jitted = jax.jit(fn, donate_argnums=(1,),
+                             in_shardings=in_sh,
+                             out_shardings=(repl, cache_sh))
+        return self.compile_tracker.wrap(jitted, name)
 
     # -------------------------------------------------- compiled programs
     def _sample_tokens(self, logits, keys, temps):
@@ -180,11 +323,12 @@ class InferenceEngine:
 
     def _prefill_impl(self, params, cache, ids, lengths, slots, keys,
                       temps):
-        """One bucketed prefill: run the padded prompt batch through the
-        model's cached forward against a fresh (bucket-batch-sized)
-        cache, scatter its rows into the persistent slot cache at
-        ``slots`` (pad rows target the scratch row), and sample each
-        row's FIRST token from its last true prompt position."""
+        """One bucketed DENSE prefill: run the padded prompt batch
+        through the model's cached forward against a fresh
+        (bucket-batch-sized) cache, scatter its rows into the persistent
+        slot cache at ``slots`` (pad rows target the scratch row), and
+        sample each row's FIRST token from its last true prompt
+        position."""
         kc, vc = cache
         Bb = ids.shape[0]
         spec = self.cache_spec
@@ -204,13 +348,50 @@ class InferenceEngine:
         return first, (kc, vc)
 
     def _decode_impl(self, params, cache, toks, positions, keys, temps):
-        """One decode step over the FULL slot table: write each slot's
-        pending token at its own position, sample the next. Inactive
-        rows compute garbage that the host discards — uniform shapes
-        are what keep this a single compiled program."""
+        """One DENSE decode step over the FULL slot table: write each
+        slot's pending token at its own position, sample the next.
+        Inactive rows compute garbage that the host discards — uniform
+        shapes are what keep this a single compiled program."""
         logits, cache = self._forward(
             params, self.model_config, toks[:, None], dtype=self.dtype,
             kv_cache=cache, cache_position=positions)
+        step_keys = jax.vmap(jax.random.fold_in)(keys, positions + 1)
+        nxt = self._sample_tokens(logits[:, 0], step_keys, temps)
+        return nxt, cache
+
+    def _prefill_paged_impl(self, params, cache, ids, lengths, positions,
+                            tables, keys, temps):
+        """One bucketed PAGED prefill: run each row's un-prefixed prompt
+        suffix (``ids``, true lengths ``lengths``) through the cached
+        forward starting at its ``positions`` offset (= tokens covered
+        by shared prefix pages), scattering K/V straight into the page
+        pool via ``tables`` — no per-bucket temp cache, no row copy; pad
+        rows carry all-null tables so their garbage lands in the null
+        page. Samples each row's FIRST token from its last true prompt
+        position (absolute position ``positions + lengths`` — the same
+        key schedule as the dense path)."""
+        Bb = ids.shape[0]
+        logits, cache = self._forward(
+            params, self.model_config, ids, dtype=self.dtype,
+            kv_cache=cache, cache_position=positions,
+            block_tables=tables)
+        last = logits[jnp.arange(Bb), lengths - 1]          # (Bb, V)
+        first_keys = jax.vmap(jax.random.fold_in)(keys,
+                                                  positions + lengths)
+        first = self._sample_tokens(last, first_keys, temps)
+        return first, cache
+
+    def _decode_paged_impl(self, params, cache, toks, positions, tables,
+                           keys, temps):
+        """One PAGED decode step over the full slot table: each slot's
+        pending token scatters into its block table's page at its own
+        position; attention gathers the slot's logical stripe back from
+        the pool. Inactive rows carry all-null tables — garbage in,
+        garbage discarded."""
+        logits, cache = self._forward(
+            params, self.model_config, toks[:, None], dtype=self.dtype,
+            kv_cache=cache, cache_position=positions,
+            block_tables=tables)
         step_keys = jax.vmap(jax.random.fold_in)(keys, positions + 1)
         nxt = self._sample_tokens(logits[:, 0], step_keys, temps)
         return nxt, cache
@@ -231,14 +412,11 @@ class InferenceEngine:
         return key
 
     def submit(self, request: Request) -> int:
-        """Queue one request; returns its uid (serving order is FIFO)."""
+        """Queue one request; returns its uid (serving order is FIFO
+        with bounded-lookahead admission)."""
         return self.scheduler.submit(request)
 
     def _run_prefill(self, batch) -> np.ndarray:
-        ids, lengths = pad_prompts([r.prompt for r in batch.requests],
-                                   batch.prompt_bucket, batch.batch_bucket)
-        slots = np.full((batch.batch_bucket,), self._scratch, np.int32)
-        slots[:len(batch.slot_ids)] = batch.slot_ids
         keys = np.zeros((batch.batch_bucket, 2), np.uint32)
         temps = np.zeros((batch.batch_bucket,), np.float32)
         for i, req in enumerate(batch.requests):
@@ -246,10 +424,35 @@ class InferenceEngine:
             temps[i] = req.temperature
         with trace_span("serve/prefill", batch=batch.batch_bucket,
                         prompt=batch.prompt_bucket):
-            first, self._cache = self._prefill(
-                self.params, self._cache, jnp.asarray(ids),
-                jnp.asarray(lengths), jnp.asarray(slots),
-                jnp.asarray(keys), jnp.asarray(temps))
+            if self.paged:
+                suffixes = [r.prompt[pl:] for r, pl in
+                            zip(batch.requests, batch.prefix_lens)]
+                ids, lengths = pad_prompts(suffixes, batch.prompt_bucket,
+                                           batch.batch_bucket)
+                positions = np.zeros((batch.batch_bucket,), np.int32)
+                tables = np.zeros(
+                    (batch.batch_bucket, self.paged_spec.pages_per_seq),
+                    np.int32)
+                for i, (pl, pages) in enumerate(
+                        zip(batch.prefix_lens, batch.page_tables)):
+                    positions[i] = pl
+                    tables[i, :len(pages)] = pages
+                first, self._cache = self._prefill(
+                    self.params, self._cache, jnp.asarray(ids),
+                    jnp.asarray(lengths), jnp.asarray(positions),
+                    jnp.asarray(tables), jnp.asarray(keys),
+                    jnp.asarray(temps))
+            else:
+                ids, lengths = pad_prompts(
+                    [r.prompt for r in batch.requests],
+                    batch.prompt_bucket, batch.batch_bucket)
+                slots = np.full((batch.batch_bucket,), self._scratch,
+                                np.int32)
+                slots[:len(batch.slot_ids)] = batch.slot_ids
+                first, self._cache = self._prefill(
+                    self.params, self._cache, jnp.asarray(ids),
+                    jnp.asarray(lengths), jnp.asarray(slots),
+                    jnp.asarray(keys), jnp.asarray(temps))
             return np.asarray(first)
 
     def step(self) -> List[FinishedRequest]:
@@ -285,10 +488,18 @@ class InferenceEngine:
                 keys_a[sid] = self._key_for(seed)
             t0 = time.perf_counter()
             with trace_span("serve/decode", active=len(sids)):
-                nxt, self._cache = self._decode(
-                    self.params, self._cache, jnp.asarray(toks_a),
-                    jnp.asarray(poss_a), jnp.asarray(keys_a),
-                    jnp.asarray(temps_a))
+                if self.paged:
+                    tables = sched.block_table_rows(
+                        self._rows, self.paged_spec.pages_per_seq)
+                    nxt, self._cache = self._decode(
+                        self.params, self._cache, jnp.asarray(toks_a),
+                        jnp.asarray(poss_a), jnp.asarray(tables),
+                        jnp.asarray(keys_a), jnp.asarray(temps_a))
+                else:
+                    nxt, self._cache = self._decode(
+                        self.params, self._cache, jnp.asarray(toks_a),
+                        jnp.asarray(poss_a), jnp.asarray(keys_a),
+                        jnp.asarray(temps_a))
                 # host sync: the scheduler needs the token values
                 nxt = np.asarray(nxt)
             tok_ms = (time.perf_counter() - t0) * 1e3
@@ -297,10 +508,19 @@ class InferenceEngine:
             self._serve_secs += time.perf_counter() - t_start
             tps = (sched.total_tokens / self._serve_secs
                    if self._serve_secs > 0 else 0.0)
+            paged_kw = {}
+            if self.paged:
+                alloc = sched.allocator
+                seen = alloc.prefix_hit_tokens + alloc.prefix_miss_tokens
+                paged_kw = dict(
+                    kv_pages_in_use=alloc.pages_in_use,
+                    tokens_in_flight=sched.tokens_in_flight,
+                    prefix_hit_rate=(alloc.prefix_hit_tokens / seen
+                                     if seen else 0.0))
             self.monitor.write_serving_metrics(
                 token_latency_ms=tok_ms, tokens_per_sec=tps,
                 queue_depth=sched.queue_depth, batch_occupancy=occupancy,
-                tokens=sched.total_tokens, flush=False)
+                tokens=sched.total_tokens, flush=False, **paged_kw)
         else:
             self._serve_secs += time.perf_counter() - t_start
 
@@ -351,35 +571,56 @@ class InferenceEngine:
     def warmup(self):
         """Compile the steady-state program set: one prefill per
         (batch bucket, prompt bucket) pair + the decode program, all
-        against the scratch row (the live cache stays untouched where
-        it matters — must run while no requests are in flight). After
-        this, :attr:`steady_state_recompiles` staying 0 is the serving
-        latency contract."""
+        against scratch state (the dense scratch row / the paged null
+        page — the live cache stays untouched where it matters; must run
+        while no requests are in flight). After this,
+        :attr:`steady_state_recompiles` staying 0 is the serving latency
+        contract."""
         assert self.scheduler.idle(), "warmup with requests in flight"
         for bb, sb in warmup_plan(self.config["batch_buckets"],
                                   self.config["prompt_buckets"]):
             ids = np.zeros((bb, sb), np.int32)
             lengths = np.ones((bb,), np.int32)
-            slots = np.full((bb,), self._scratch, np.int32)
             keys = np.zeros((bb, 2), np.uint32)
             temps = np.zeros((bb,), np.float32)
-            first, self._cache = self._prefill(
-                self.params, self._cache, jnp.asarray(ids),
-                jnp.asarray(lengths), jnp.asarray(slots),
-                jnp.asarray(keys), jnp.asarray(temps))
-        nxt, self._cache = self._decode(
-            self.params, self._cache,
-            jnp.zeros((self._rows,), jnp.int32),
-            jnp.zeros((self._rows,), jnp.int32),
-            jnp.zeros((self._rows, 2), jnp.uint32),
-            jnp.zeros((self._rows,), jnp.float32))
+            if self.paged:
+                first, self._cache = self._prefill(
+                    self.params, self._cache, jnp.asarray(ids),
+                    jnp.asarray(lengths),
+                    jnp.zeros((bb,), jnp.int32),
+                    jnp.zeros((bb, self.paged_spec.pages_per_seq),
+                              jnp.int32),
+                    jnp.asarray(keys), jnp.asarray(temps))
+            else:
+                slots = np.full((bb,), self._scratch, np.int32)
+                first, self._cache = self._prefill(
+                    self.params, self._cache, jnp.asarray(ids),
+                    jnp.asarray(lengths), jnp.asarray(slots),
+                    jnp.asarray(keys), jnp.asarray(temps))
+        if self.paged:
+            nxt, self._cache = self._decode(
+                self.params, self._cache,
+                jnp.zeros((self._rows,), jnp.int32),
+                jnp.zeros((self._rows,), jnp.int32),
+                jnp.zeros((self._rows, self.paged_spec.pages_per_seq),
+                          jnp.int32),
+                jnp.zeros((self._rows, 2), jnp.uint32),
+                jnp.zeros((self._rows,), jnp.float32))
+        else:
+            nxt, self._cache = self._decode(
+                self.params, self._cache,
+                jnp.zeros((self._rows,), jnp.int32),
+                jnp.zeros((self._rows,), jnp.int32),
+                jnp.zeros((self._rows, 2), jnp.uint32),
+                jnp.zeros((self._rows,), jnp.float32))
         jax.block_until_ready(nxt)
         self._warm_compiles = self.compile_tracker.total_compiles
         if self._log is not None:
             self._log.add_event("serve_warmup",
                                 programs=self._warm_compiles,
                                 batch_buckets=self.config["batch_buckets"],
-                                prompt_buckets=self.config["prompt_buckets"])
+                                prompt_buckets=self.config["prompt_buckets"],
+                                paged=self.paged)
         return self._warm_compiles
 
     @property
@@ -404,7 +645,12 @@ class InferenceEngine:
         optimizer moments and loss scale never touch the serving
         replica). With ``tag=None`` the newest committed-and-verified
         tag wins, skipping corrupt/uncommitted ones (the PR-1 fallback
-        discipline). ``quantize_weights`` (default: the
+        discipline). With ``inference.mesh.axes`` configured the params
+        are RESHARDED onto the serving mesh as they load — the
+        checkpoint's shards are logically indexed, so a tag written by
+        any train mesh restores onto any serving mesh
+        (``load_params_only`` materializes straight into the serving
+        NamedShardings). ``quantize_weights`` (default: the
         ``inference.quantize_weights`` config) ships the weights
         through the qwZ int8 block wire format
         (:func:`qwz_distribute_params`)."""
@@ -426,10 +672,17 @@ class InferenceEngine:
             raise FileNotFoundError(
                 f"no loadable committed checkpoint with model_states "
                 f"under {load_dir} (tag={tag!r})")
-        _, _, init_fn = _family_of(model_config)
+        _, _, init_fn, specs_fn = _family_of(model_config)
         template = jax.eval_shape(
             lambda k: init_fn(model_config, k), jax.random.PRNGKey(0))
-        params = ckptlib.load_params_only(chosen, template)
+        mesh = _serving_mesh(cfg)
+        shardings = None
+        if mesh is not None:
+            shardings = _param_shardings(mesh, specs_fn, model_config,
+                                         template)
+            logger.info(f"from_checkpoint: resharding params onto the "
+                        f"serving mesh {dict(mesh.shape)}")
+        params = ckptlib.load_params_only(chosen, template, shardings)
         if quantize_weights is None:
             quantize_weights = cfg["quantize_weights"]
         if quantize_weights:
@@ -437,7 +690,7 @@ class InferenceEngine:
             logger.info(f"from_checkpoint: params distributed via qwZ "
                         f"int8 (block {cfg['quantize_block']})")
         engine = cls(model_config, params, cfg, dtype=dtype,
-                     monitor=monitor)
+                     monitor=monitor, mesh=mesh)
         if engine._log is not None:
             engine._log.add_event(
                 "serve_load", checkpoint=chosen,

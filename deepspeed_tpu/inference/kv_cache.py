@@ -1,19 +1,35 @@
 """KV-cache allocation and accounting for the serving engine.
 
-The cache is ONE preallocated pair of arrays ``(kc, vc)``, each shaped
-``(layers, batch_rows, kv_heads, max_len, head_dim)`` — the static
-buffer the jit-compiled prefill/decode programs carry (and donate) so
-steady-state serving never allocates, never reshapes, and therefore
-never recompiles. ``batch_rows`` is ``max_batch_size + 1``: the extra
-row is the *scratch slot* — padding rows of a partially-filled prefill
-bucket scatter their (garbage) K/V there instead of corrupting a live
-request's slot.
+Two cache geometries live here:
+
+**Dense (legacy, ``paged_kv.enabled: false``)** — ONE preallocated pair
+of arrays ``(kc, vc)``, each shaped ``(layers, batch_rows, kv_heads,
+max_len, head_dim)``: every serving slot owns a full ``max_len`` stripe
+whether its request is 6 tokens or 6000. ``batch_rows`` is
+``max_batch_size + 1``: the extra row is the *scratch slot* — padding
+rows of a partially-filled prefill bucket scatter their (garbage) K/V
+there instead of corrupting a live request's slot.
+
+**Paged (default)** — a fixed pool of ``num_pages`` pages, each
+``(kv_heads, page_size, head_dim)``, as one pair of arrays shaped
+``(layers, num_pages, kv_heads, page_size, head_dim)``, plus a
+host-side :class:`PageAllocator`. A request occupies
+``ceil(total_tokens / page_size)`` pages mapped through a static-shape
+per-slot *block table*; HBM occupancy is therefore bounded by the
+tokens actually reserved in flight, not ``slots x max_len``. Page 0 is
+reserved as the *null page*: unallocated block-table entries and
+padding-row writes all land there (its contents are garbage by design
+and never read unmasked — ``causal_cache_mask`` hides every position a
+query has not reached). The allocator also implements **prefix
+caching**: full, page-aligned prompt prefixes are chain-hashed and
+refcounted, so concurrent requests sharing a system prompt prefill the
+shared pages once.
 
 Writes happen inside the model forwards via
-:func:`deepspeed_tpu.models.gpt2.write_kv_cache` (per-row
-``lax.dynamic_update_slice``); this module only owns allocation, the
-family-specific geometry (GQA caches are kv_heads-sized), and byte
-accounting for telemetry.
+:func:`deepspeed_tpu.models.gpt2.write_kv_cache` (dense) /
+:func:`deepspeed_tpu.models.gpt2.write_paged_kv_cache` (paged); this
+module only owns allocation, the family-specific geometry (GQA caches
+are kv_heads-sized), and byte accounting for telemetry.
 """
 
 from typing import Any, NamedTuple, Tuple
@@ -21,12 +37,16 @@ from typing import Any, NamedTuple, Tuple
 import jax.numpy as jnp
 import numpy as np
 
+from deepspeed_tpu.inference.paging import PageAllocator, pages_for
+
 __all__ = ["KVCacheSpec", "cache_spec_for", "init_kv_cache",
-           "kv_cache_bytes"]
+           "kv_cache_bytes", "PagedKVSpec", "paged_spec_for",
+           "init_paged_kv_cache", "paged_kv_bytes", "pages_for",
+           "PageAllocator"]
 
 
 class KVCacheSpec(NamedTuple):
-    """Static geometry of the serving KV cache."""
+    """Static geometry of the dense serving KV cache."""
     num_layers: int
     batch_rows: int      # serving slots + 1 scratch row
     kv_heads: int        # GQA: the cache stays kv_heads-sized
@@ -40,14 +60,20 @@ class KVCacheSpec(NamedTuple):
                 self.max_len, self.head_dim)
 
 
-def cache_spec_for(model_config, batch_rows: int, max_len: int,
-                   dtype=jnp.bfloat16) -> KVCacheSpec:
-    """Cache geometry from a model config (GPT2Config / LlamaConfig):
-    kv_heads-sized for GQA families, head-count-sized otherwise."""
+def _model_kv_geometry(model_config):
     kv_heads = getattr(model_config, "kv_heads", None) or \
         model_config.num_heads
     head_dim = getattr(model_config, "head_dim", None) or (
         model_config.hidden_size // model_config.num_heads)
+    return kv_heads, head_dim
+
+
+def cache_spec_for(model_config, batch_rows: int, max_len: int,
+                   dtype=jnp.bfloat16) -> KVCacheSpec:
+    """Dense cache geometry from a model config (GPT2Config /
+    LlamaConfig): kv_heads-sized for GQA families, head-count-sized
+    otherwise."""
+    kv_heads, head_dim = _model_kv_geometry(model_config)
     if max_len > model_config.max_position_embeddings:
         raise ValueError(
             f"kv cache max_len {max_len} exceeds the model's "
@@ -63,6 +89,68 @@ def init_kv_cache(spec: KVCacheSpec):
             jnp.zeros(spec.shape, spec.dtype))
 
 
+def _pair_bytes(spec) -> int:
+    """Bytes of a (kc, vc) array pair with ``spec.shape``/``spec.dtype``
+    — the one accounting both cache geometries report."""
+    return 2 * int(np.prod(spec.shape)) * jnp.dtype(spec.dtype).itemsize
+
+
 def kv_cache_bytes(spec: KVCacheSpec) -> int:
     """Total bytes of the (kc, vc) pair — the serving memory headline."""
-    return 2 * int(np.prod(spec.shape)) * jnp.dtype(spec.dtype).itemsize
+    return _pair_bytes(spec)
+
+
+# --------------------------------------------------------------------- #
+# paged cache
+# --------------------------------------------------------------------- #
+class PagedKVSpec(NamedTuple):
+    """Static geometry of the paged serving KV cache. ``pages_per_seq``
+    is the block-table width: every slot's table maps that many logical
+    page positions (covering ``max_len`` tokens), entries beyond its
+    reservation pointing at the null page 0."""
+    num_layers: int
+    num_pages: int       # pool size, INCLUDING the reserved null page 0
+    page_size: int
+    kv_heads: int
+    head_dim: int
+    pages_per_seq: int
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def shape(self) -> Tuple[int, int, int, int, int]:
+        return (self.num_layers, self.num_pages, self.kv_heads,
+                self.page_size, self.head_dim)
+
+
+def paged_spec_for(model_config, num_pages: int, page_size: int,
+                   max_len: int, dtype=jnp.bfloat16) -> PagedKVSpec:
+    """Paged cache geometry from a model config. ``num_pages == 0``
+    auto-sizes the pool to the dense worst case (every slot is not known
+    here, so callers pass the resolved count); the engine resolves 0
+    before calling."""
+    kv_heads, head_dim = _model_kv_geometry(model_config)
+    if max_len > model_config.max_position_embeddings:
+        raise ValueError(
+            f"paged kv cache max_len {max_len} exceeds the model's "
+            f"max_position_embeddings {model_config.max_position_embeddings}")
+    if page_size < 1 or num_pages < 2:
+        raise ValueError(
+            f"paged kv cache needs page_size >= 1 and num_pages >= 2 "
+            f"(one null + one usable), got page_size={page_size}, "
+            f"num_pages={num_pages}")
+    return PagedKVSpec(num_layers=model_config.num_layers,
+                       num_pages=num_pages, page_size=page_size,
+                       kv_heads=kv_heads, head_dim=head_dim,
+                       pages_per_seq=pages_for(max_len, page_size),
+                       dtype=dtype)
+
+
+def init_paged_kv_cache(spec: PagedKVSpec):
+    """Allocate the zeroed paged ``(kc, vc)`` pool pair."""
+    return (jnp.zeros(spec.shape, spec.dtype),
+            jnp.zeros(spec.shape, spec.dtype))
+
+
+def paged_kv_bytes(spec: PagedKVSpec) -> int:
+    """Total bytes of the paged (kc, vc) pool pair."""
+    return _pair_bytes(spec)

@@ -8,11 +8,22 @@ max_tokens) are evicted and waiting requests are admitted into the
 freed slots via a bucketed prefill — occupancy stays high under
 heterogeneous request lengths.
 
-This module is the pure host-side half: FIFO queue, slot table, bucket
-grouping for admission, per-request sampling state (temperature + PRNG
-seed — deterministic per request, independent of what else shares the
-batch), and completion bookkeeping (TTFT, per-request token counts).
-The jit-facing half (padded arrays, cache scatter) lives in
+This module is the pure host-side half: the admission queue (FIFO with
+a bounded lookahead window so one request that doesn't fit the free
+pages cannot stall everything behind it), slot table, bucket grouping
+for admission, per-request sampling state (temperature + PRNG seed —
+deterministic per request, independent of what else shares the batch),
+and completion bookkeeping (TTFT, per-request token counts).
+
+With a :class:`~deepspeed_tpu.inference.paging.PageAllocator` the
+scheduler also owns PAGE management (the jit programs only ever see the
+static-shape block tables it produces): admission reserves
+``ceil((prompt + max_new_tokens) / page_size)`` pages up front (no
+mid-flight eviction needed), prefix-cache hits replace the leading
+page-aligned prompt pages with shared refcounted ones (the engine then
+prefills only the suffix), and eviction returns pages to the pool.
+
+The jit-facing half (padded arrays, paged scatter/gather) lives in
 ``inference/engine.py``; nothing here imports jax, so scheduler policy
 is unit-testable in microseconds.
 """
@@ -20,11 +31,12 @@ is unit-testable in microseconds.
 import itertools
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from deepspeed_tpu.inference.buckets import pick_bucket
+from deepspeed_tpu.inference.paging import PageAllocator, pages_for
 
 __all__ = ["Request", "FinishedRequest", "PrefillBatch", "Scheduler"]
 
@@ -66,11 +78,18 @@ class FinishedRequest:
 class PrefillBatch:
     """One bucketed prefill the engine must run: ``requests[i]`` lands
     in serving slot ``slot_ids[i]``; the engine pads to
-    (batch_bucket, prompt_bucket) and scatters pad rows to scratch."""
+    (batch_bucket, prompt_bucket) and routes pad rows to scratch (dense)
+    or the null page (paged). Paged engines additionally read
+    ``prefix_lens[i]`` (tokens already covered by shared prefix pages —
+    the engine prefills only ``prompt[prefix_lens[i]:]``) and
+    ``page_tables[i]`` (the slot's full page list, shared prefix pages
+    first)."""
     slot_ids: List[int]
     requests: List[Request]
     batch_bucket: int
     prompt_bucket: int
+    prefix_lens: List[int] = field(default_factory=list)
+    page_tables: List[List[int]] = field(default_factory=list)
 
 
 @dataclass
@@ -81,25 +100,39 @@ class _Slot:
     tokens: List[int]
     t_submit: float
     ttft_ms: Optional[float] = None
+    pages: List[int] = field(default_factory=list)   # paged mode only
+    prefix_len: int = 0          # tokens reused from the prefix cache
 
 
 class Scheduler:
-    """FIFO continuous-batching scheduler over ``num_slots`` decode
-    slots. The engine drives it: ``submit`` -> ``admit`` (bucketed
-    prefill batches for free slots) -> ``record_tokens`` (one sampled
-    token per active slot; evicts finished sequences and frees their
-    slots). ``clock`` is injectable for deterministic tests."""
+    """Continuous-batching scheduler over ``num_slots`` decode slots.
+
+    The engine drives it: ``submit`` -> ``admit`` (bucketed prefill
+    batches for free slots) -> ``record_tokens`` (one sampled token per
+    active slot; evicts finished sequences and frees their slots and
+    pages). ``clock`` is injectable for deterministic tests.
+
+    ``allocator`` (paged mode) makes admission page-aware; ``lookahead``
+    bounds how many queued requests past the head are scanned for one
+    that fits when the head doesn't (head-of-line fix; 0 = strict FIFO).
+    """
 
     def __init__(self, num_slots: int, prompt_buckets: Sequence[int],
                  batch_buckets: Sequence[int], max_len: int,
-                 clock=time.monotonic):
+                 clock=time.monotonic,
+                 allocator: Optional[PageAllocator] = None,
+                 lookahead: int = 0):
         if num_slots < 1:
             raise ValueError("num_slots must be >= 1")
+        if lookahead < 0:
+            raise ValueError("lookahead must be >= 0")
         self.num_slots = int(num_slots)
         self.prompt_buckets = tuple(int(b) for b in prompt_buckets)
         self.batch_buckets = tuple(int(b) for b in batch_buckets)
         self.max_len = int(max_len)
         self._clock = clock
+        self.allocator = allocator
+        self.lookahead = int(lookahead)
         self.queue: List[Request] = []
         self.slots: List[Optional[_Slot]] = [None] * self.num_slots
         self._submit_time: Dict[int, float] = {}
@@ -108,6 +141,7 @@ class Scheduler:
         # cumulative counters (serving telemetry)
         self.total_admitted = 0
         self.total_tokens = 0
+        self.peak_tokens_in_flight = 0
 
     # ------------------------------------------------------------ state
     def free_slots(self) -> List[int]:
@@ -123,6 +157,17 @@ class Scheduler:
     @property
     def occupancy(self) -> float:
         return 1.0 - len(self.free_slots()) / self.num_slots
+
+    @property
+    def tokens_in_flight(self) -> int:
+        """Live cache tokens across active slots — what the pool
+        actually holds. Shared prefix pages are deduplicated via the
+        allocator's refcounts (only prefix sharing raises a refcount
+        above 1); dense slots never share."""
+        n = sum(s.position for s in self.slots if s is not None)
+        if self.allocator is not None:
+            n -= self.allocator.shared_duplicate_tokens
+        return n
 
     def idle(self) -> bool:
         return not self.queue and not self.active_slots()
@@ -141,49 +186,133 @@ class Scheduler:
             raise ValueError(
                 f"prompt ({plen}) + max_new_tokens "
                 f"({request.max_new_tokens}) exceeds max_len {self.max_len}")
+        if self.allocator is not None:
+            total = pages_for(plen + request.max_new_tokens,
+                              self.allocator.page_size)
+            if total > self.allocator.num_pages - 1:
+                raise ValueError(
+                    f"request needs {total} pages but the pool has "
+                    f"{self.allocator.num_pages - 1} usable")
         self._submit_time[request.uid] = self._clock()
         self.queue.append(request)
         return request.uid
 
     # ------------------------------------------------------------ admit
+    def _match_prefix(self, req: Request) -> Tuple[List[int], int]:
+        """Cached prefix pages reusable by ``req`` — capped one token
+        short of the full prompt: the last prompt token must run through
+        prefill to produce the first-token logits."""
+        if self.allocator is None:
+            return [], 0
+        shared, reused = self.allocator.match_prefix(req.prompt)
+        ps = self.allocator.page_size
+        cap = (len(req.prompt) - 1) // ps
+        shared = shared[:cap]
+        return shared, len(shared) * ps
+
+    def _try_reserve(self, req: Request,
+                     match: Optional[Tuple[List[int], int]] = None
+                     ) -> Optional[Tuple[List[int], int]]:
+        """Commit page reservations for ``req``: incref its shared
+        prefix pages and allocate the rest (whole lifetime —
+        ``ceil((prompt + max_new) / page_size)``), or None (nothing
+        taken) when the pool can't supply them. ``match`` reuses a
+        just-computed ``_match_prefix`` result (admission's bucket
+        pre-check) instead of re-hashing the prompt."""
+        if self.allocator is None:
+            return [], 0
+        shared, reused = match if match is not None else \
+            self._match_prefix(req)
+        total = pages_for(len(req.prompt) + req.max_new_tokens,
+                          self.allocator.page_size)
+        fresh = self.allocator.alloc(total - len(shared))
+        if fresh is None:
+            return None
+        self.allocator.incref(shared)
+        self.allocator.prefix_hit_tokens += reused
+        self.allocator.prefix_miss_tokens += len(req.prompt) - reused
+        pages = shared + fresh
+        # publish this prompt's full pages for later (or same-batch)
+        # requests sharing the prefix — content is determined by the
+        # prompt alone, and every reader's gather runs after this
+        # request's prefill scatter (same or later dispatch)
+        self.allocator.register_prefix(req.prompt, pages)
+        return pages, reused
+
+    def _release(self, slot: _Slot):
+        if self.allocator is not None and slot.pages:
+            self.allocator.free(slot.pages)
+            slot.pages = []
+
     def admit(self) -> List[PrefillBatch]:
         """Assign waiting requests to free slots, grouped into bucketed
         prefill batches.
 
-        FIFO with same-bucket batching: the head of the queue fixes the
-        prompt bucket; later queued requests sharing that bucket may
-        ride along (up to the largest batch bucket / free slots), which
-        keeps arrival order *across admissions* while letting one
-        prefill program serve several requests. Repeats until slots or
-        queue run out.
+        FIFO with same-bucket batching and bounded lookahead: the HEAD
+        is the first request in the ``lookahead + 1``-deep window whose
+        pages fit the pool (strict FIFO head when everything fits, or in
+        dense mode); it fixes the prompt bucket (of its un-prefixed
+        SUFFIX, in paged mode). Later queued requests sharing that
+        bucket — and fitting the remaining pages — ride along (up to the
+        largest batch bucket / free slots). Repeats until slots, pages,
+        or queue run out. A too-big head therefore delays, but never
+        blocks, everything behind it. The window bounds how far FIFO
+        order is violated per admission, NOT the head's wait: under a
+        sustained stream of small requests an oversized head can wait
+        indefinitely (no aging/reservation yet) — set ``lookahead=0``
+        for strict FIFO when that matters more than utilization.
         """
         batches: List[PrefillBatch] = []
         free = self.free_slots()
         while free and self.queue:
-            head_bucket = pick_bucket(len(self.queue[0].prompt),
+            # head selection within the lookahead window
+            head_idx = None
+            head_res = None
+            for i, req in enumerate(
+                    self.queue[:self.lookahead + 1]):
+                res = self._try_reserve(req)
+                if res is not None:
+                    head_idx, head_res = i, res
+                    break
+            if head_idx is None:
+                break
+            head = self.queue[head_idx]
+            head_bucket = pick_bucket(len(head.prompt) - head_res[1],
                                       self.prompt_buckets)
             cap = min(len(free), max(self.batch_buckets))
-            take: List[Request] = []
-            for req in self.queue:
+            take: List[Request] = [head]
+            reserved: List[Tuple[List[int], int]] = [head_res]
+            for req in self.queue[head_idx + 1:]:
                 if len(take) >= cap:
                     break
-                if pick_bucket(len(req.prompt),
-                               self.prompt_buckets) == head_bucket:
-                    take.append(req)
+                match = self._match_prefix(req)
+                if pick_bucket(len(req.prompt) - match[1],
+                               self.prompt_buckets) != head_bucket:
+                    continue
+                res = self._try_reserve(req, match)
+                if res is None:
+                    continue
+                take.append(req)
+                reserved.append(res)
             for req in take:
                 self.queue.remove(req)
             batch_bucket = pick_bucket(len(take), self.batch_buckets)
             slot_ids = [free.pop(0) for _ in take]
             now = self._clock()
-            for sid, req in zip(slot_ids, take):
+            for sid, req, (pages, reused) in zip(slot_ids, take, reserved):
                 self.slots[sid] = _Slot(
                     request=req, position=len(req.prompt),
                     pending_tok=None, tokens=[],
-                    t_submit=self._submit_time.pop(req.uid, now))
+                    t_submit=self._submit_time.pop(req.uid, now),
+                    pages=pages, prefix_len=reused)
             self.total_admitted += len(take)
             batches.append(PrefillBatch(
                 slot_ids=slot_ids, requests=take,
-                batch_bucket=batch_bucket, prompt_bucket=head_bucket))
+                batch_bucket=batch_bucket, prompt_bucket=head_bucket,
+                prefix_lens=[r for _, r in reserved],
+                page_tables=[p for p, _ in reserved]))
+        self.peak_tokens_in_flight = max(self.peak_tokens_in_flight,
+                                         self.tokens_in_flight)
         return batches
 
     # ----------------------------------------------------- token stream
@@ -192,8 +321,9 @@ class Scheduler:
         """Record one sampled token per slot (``{slot_id: token}``) —
         from a prefill's first token or a decode step — advancing each
         slot's pending/position bookkeeping. Finished sequences (EOS or
-        max_new_tokens) are evicted; their slots free immediately for
-        the next ``admit``. Returns the newly finished requests."""
+        max_new_tokens) are evicted; their slots (and pages) free
+        immediately for the next ``admit``. Returns the newly finished
+        requests."""
         now = self._clock()
         done: List[FinishedRequest] = []
         for sid, tok in tokens.items():
@@ -220,8 +350,11 @@ class Scheduler:
                     finish_reason="eos" if hit_eos else "length",
                     ttft_ms=slot.ttft_ms,
                     latency_ms=(now - slot.t_submit) * 1e3))
+                self._release(slot)
                 self.slots[sid] = None
         self.finished.extend(done)
+        self.peak_tokens_in_flight = max(self.peak_tokens_in_flight,
+                                         self.tokens_in_flight)
         return done
 
     def drain_ttfts(self) -> List[float]:
@@ -249,3 +382,14 @@ class Scheduler:
             temps.append(slot.request.temperature)
             seeds.append(slot.request.seed)
         return sids, toks, poss, temps, seeds
+
+    def block_table_rows(self, rows: int, pages_per_seq: int) -> np.ndarray:
+        """The decode dispatch's static-shape block tables: one
+        (rows, pages_per_seq) int32 array, active slots' pages in their
+        rows, everything else 0 (the null page — inactive rows write
+        and read only garbage the mask hides)."""
+        out = np.zeros((rows, pages_per_seq), np.int32)
+        for sid in self.active_slots():
+            pages = self.slots[sid].pages
+            out[sid, :len(pages)] = pages
+        return out

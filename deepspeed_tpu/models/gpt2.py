@@ -328,7 +328,7 @@ def _gpt2_trunk(params, config: GPT2Config, input_ids, rng=None,
 
 
 def _gpt2_trunk_cached(params, config: GPT2Config, input_ids, kv_cache,
-                       cache_position, dtype):
+                       cache_position, dtype, block_tables=None):
     """Cache-carrying trunk: run ``input_ids`` (B, S) through the SAME
     gpt2_block as training with attention over the provided KV cache
     (``kv_cache = (kc, vc)``, each (layers, B, heads, max_len, hd)),
@@ -336,7 +336,12 @@ def _gpt2_trunk_cached(params, config: GPT2Config, input_ids, kv_cache,
     Returns (final hidden states after ln_f, updated kv_cache). Serves
     prefill (S = padded prompt, cache_position = 0) and decode (S = 1,
     per-slot positions) with one code path — no second copy of the
-    block math to drift."""
+    block math to drift.
+
+    With ``block_tables`` ((B, pages_per_seq) int32) the cache is the
+    PAGED pool pair (each (layers, num_pages, heads, page_size, hd)) and
+    attention runs the scatter/gather paged path
+    (:func:`_paged_cache_attention`) — same block, same mask."""
     kc, vc = kv_cache
     B, S = input_ids.shape
     pos = cache_position[:, None] + jnp.arange(S)[None, :]
@@ -344,10 +349,14 @@ def _gpt2_trunk_cached(params, config: GPT2Config, input_ids, kv_cache,
     new_kc, new_vc = [], []
     for i in range(config.num_layers):
         box = []
+        if block_tables is not None:
+            attn = _paged_cache_attention(kc[i], vc[i], block_tables,
+                                          cache_position, box)
+        else:
+            attn = _offset_cache_attention(kc[i], vc[i], cache_position,
+                                           box)
         x = gpt2_block(layer_params(params, config, i), config, x, None,
-                       True, dtype,
-                       attention_fn=_offset_cache_attention(
-                           kc[i], vc[i], cache_position, box))
+                       True, dtype, attention_fn=attn)
         ki, vi = box[0]
         new_kc.append(ki)
         new_vc.append(vi)
@@ -357,7 +366,8 @@ def _gpt2_trunk_cached(params, config: GPT2Config, input_ids, kv_cache,
 
 def gpt2_forward(params, config: GPT2Config, input_ids, rng=None,
                  deterministic: bool = True, dtype=jnp.bfloat16,
-                 remat: bool = False, kv_cache=None, cache_position=None):
+                 remat: bool = False, kv_cache=None, cache_position=None,
+                 block_tables=None):
     """Logits (B, S, vocab). Embedding output layer is tied to wte.
 
     KV-cache mode (serving): with ``kv_cache=(kc, vc)`` (each
@@ -365,13 +375,16 @@ def gpt2_forward(params, config: GPT2Config, input_ids, rng=None,
     int32 — tokens already in each row's cache), the forward writes this
     call's K/V into the cache at each row's offset, attends with
     :func:`causal_cache_mask`, and returns ``(logits, updated_cache)``
-    instead of bare logits. The training call signature is unchanged
-    (both arguments default to None)."""
+    instead of bare logits. ``block_tables`` ((B, pages_per_seq) int32)
+    switches the cache interpretation to the paged pool pair (each
+    ``(layers, num_pages, heads, page_size, hd)``). The training call
+    signature is unchanged (all three arguments default to None)."""
     if kv_cache is not None:
         if cache_position is None:
             cache_position = jnp.zeros((input_ids.shape[0],), jnp.int32)
         x, cache = _gpt2_trunk_cached(params, config, input_ids, kv_cache,
-                                      cache_position, dtype)
+                                      cache_position, dtype,
+                                      block_tables=block_tables)
         return _tied_logits(x, params["wte"], dtype), cache
     x = _gpt2_trunk(params, config, input_ids, rng=rng,
                     deterministic=deterministic, dtype=dtype, remat=remat)
@@ -471,6 +484,78 @@ def write_kv_cache(cache, new, cache_position):
     return jax.vmap(
         lambda c, u, s: jax.lax.dynamic_update_slice(c, u, (0, s, 0))
     )(cache, new.astype(cache.dtype), cache_position)
+
+
+def write_paged_kv_cache(pool, new, block_table, cache_position):
+    """Scatter ``new`` (B, heads, S, hd) into a paged pool
+    ``(num_pages, heads, page_size, hd)``: row b's token j lands in page
+    ``block_table[b, (cache_position[b]+j) // page_size]`` at offset
+    ``(cache_position[b]+j) % page_size``. Positions past the table's
+    logical extent — and unreserved table entries, which the host
+    allocator leaves at 0 — land in the reserved null page 0, whose
+    garbage ``causal_cache_mask`` keeps unread. One scatter per call,
+    static shapes throughout: the serving paged programs never reshape.
+    """
+    B, H, S, hd = new.shape
+    P = block_table.shape[1]
+    ps = pool.shape[2]
+    pos = cache_position[:, None] + jnp.arange(S)[None, :]       # (B, S)
+    slot = pos // ps
+    page = jnp.where(
+        slot < P,
+        jnp.take_along_axis(block_table, jnp.minimum(slot, P - 1), axis=1),
+        0)
+    vals = new.astype(pool.dtype).transpose(0, 2, 1, 3).reshape(
+        B * S, H, hd)
+    return pool.at[page.reshape(-1), :, (pos % ps).reshape(-1)].set(vals)
+
+
+def gather_paged_kv(pool, block_table):
+    """Assemble each row's logical K or V stripe from the paged pool:
+    ``(B, pages_per_seq)`` block table over ``(num_pages, heads,
+    page_size, hd)`` -> ``(B, heads, pages_per_seq * page_size, hd)``.
+    Gathered position ``t * page_size + o`` is the row's absolute cache
+    position, so :func:`causal_cache_mask` applies unchanged — unmapped
+    table entries surface the null page, always masked.
+
+    NB: this materializes each row's full logical stripe
+    (``pages_per_seq * page_size >= max_len`` positions) every call, so
+    at the XLA level the paged path's per-step decode reads stay
+    bounded by ``max_len`` — like the dense path, plus the gather copy
+    unless XLA fuses it into the contraction. Paging's win is
+    *occupancy/capacity* (HBM bounded by tokens reserved in flight, and
+    prefix pages shared), not per-step decode bandwidth; collapsing the
+    gather into a fused paged-attention Pallas kernel is ROADMAP item
+    2."""
+    B, P = block_table.shape
+    _, H, ps, hd = pool.shape
+    return pool[block_table].transpose(0, 2, 1, 3, 4).reshape(
+        B, H, P * ps, hd)
+
+
+def _paged_cache_attention(kpool, vpool, block_table, cache_position,
+                           out_box):
+    """attention_fn for the paged cached forward (prefill-into-pages and
+    paged decode alike): scatter this call's K/V into the page pool via
+    the block table, gather each row's logical stripe back, attend under
+    the shared ``causal_cache_mask``. Updated pools return through
+    ``out_box``."""
+    def attn(q, k, v, rate, rng):
+        del rate, rng                  # cached forward is deterministic
+        kp = write_paged_kv_cache(kpool, k, block_table, cache_position)
+        vp = write_paged_kv_cache(vpool, v, block_table, cache_position)
+        out_box.append((kp, vp))
+        kc = gather_paged_kv(kp, block_table)
+        vc = gather_paged_kv(vp, block_table)
+        hd = q.shape[-1]
+        scores = jnp.einsum("bhqd,bhld->bhql", q.astype(jnp.float32),
+                            kc.astype(jnp.float32)) / np.sqrt(hd)
+        mask = causal_cache_mask(cache_position, q.shape[2], kc.shape[2])
+        scores = jnp.where(mask, scores, NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1)
+        return jnp.einsum("bhql,bhld->bhqd", probs,
+                          vc.astype(jnp.float32)).astype(q.dtype)
+    return attn
 
 
 def _offset_cache_attention(kcache, vcache, cache_position, out_box):

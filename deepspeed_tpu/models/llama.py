@@ -239,16 +239,52 @@ def _gqa_offset_cache_attention(kcache, vcache, cache_position, out_box):
     return attn
 
 
+def _gqa_paged_cache_attention(kpool, vpool, block_table, cache_position,
+                               out_box):
+    """Paged attention_fn for the cached llama forward: scatter this
+    call's post-RoPE K/V into the kv_heads-sized page pool via the block
+    table (``gpt2.write_paged_kv_cache``), gather each row's logical
+    stripe back, attend group-wise under the shared
+    ``causal_cache_mask``. Updated pools return through ``out_box``."""
+    from deepspeed_tpu.models.gpt2 import (causal_cache_mask,
+                                           gather_paged_kv,
+                                           write_paged_kv_cache)
+
+    def attn(q, k, v):
+        kp = write_paged_kv_cache(kpool, k, block_table, cache_position)
+        vp = write_paged_kv_cache(vpool, v, block_table, cache_position)
+        out_box.append((kp, vp))
+        kc = gather_paged_kv(kp, block_table)
+        vc = gather_paged_kv(vp, block_table)
+        B, H, S, hd = q.shape
+        hkv = kc.shape[1]
+        qg = q.reshape(B, hkv, H // hkv, S, hd)
+        scores = jnp.einsum("bkgsd,bkld->bkgsl", qg.astype(jnp.float32),
+                            kc.astype(jnp.float32)) / np.sqrt(hd)
+        mask = causal_cache_mask(cache_position, S, kc.shape[2])
+        scores = jnp.where(mask[:, :, None], scores, NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1)
+        ctx = jnp.einsum("bkgsl,bkld->bkgsd", probs,
+                         vc.astype(jnp.float32))
+        return ctx.reshape(B, H, S, hd).astype(q.dtype)
+    return attn
+
+
 def _llama_trunk_cached(params, config: LlamaConfig, input_ids, kv_cache,
-                        cache_position, dtype):
+                        cache_position, dtype, block_tables=None):
     """Cache-carrying trunk (see gpt2._gpt2_trunk_cached): one code path
     for prefill-into-cache and decode, through the SAME llama_block as
     training. RoPE angles are gathered per row at each token's absolute
-    position. Returns (hidden states after ln_f, updated kv_cache)."""
+    position. Returns (hidden states after ln_f, updated kv_cache).
+    ``block_tables`` switches to the paged pool pair (each
+    (layers, num_pages, kv_heads, page_size, hd))."""
     from deepspeed_tpu.models.gpt2 import layer_params
     kc, vc = kv_cache
     B, S = input_ids.shape
-    max_len = kc.shape[3]
+    if block_tables is not None:
+        max_len = block_tables.shape[1] * kc.shape[3]  # pages x page_size
+    else:
+        max_len = kc.shape[3]
     pos = cache_position[:, None] + jnp.arange(S)[None, :]
     cos_full, sin_full = rope_cos_sin(max_len, config.head_dim,
                                       config.rope_theta)
@@ -257,10 +293,14 @@ def _llama_trunk_cached(params, config: LlamaConfig, input_ids, kv_cache,
     new_kc, new_vc = [], []
     for i in range(config.num_layers):
         box = []
+        if block_tables is not None:
+            attn = _gqa_paged_cache_attention(kc[i], vc[i], block_tables,
+                                              cache_position, box)
+        else:
+            attn = _gqa_offset_cache_attention(kc[i], vc[i],
+                                               cache_position, box)
         x = llama_block(layer_params(params, config, i), config, x,
-                        cos_b, sin_b, dtype,
-                        attention_fn=_gqa_offset_cache_attention(
-                            kc[i], vc[i], cache_position, box))
+                        cos_b, sin_b, dtype, attention_fn=attn)
         ki, vi = box[0]
         new_kc.append(ki)
         new_vc.append(vi)
@@ -270,21 +310,23 @@ def _llama_trunk_cached(params, config: LlamaConfig, input_ids, kv_cache,
 
 def llama_forward(params, config: LlamaConfig, input_ids,
                   dtype=jnp.bfloat16, remat: bool = False,
-                  kv_cache=None, cache_position=None):
+                  kv_cache=None, cache_position=None, block_tables=None):
     """Logits (B, S, vocab).
 
     KV-cache mode (serving): with ``kv_cache=(kc, vc)`` (each
     ``(layers, B, kv_heads, max_len, hd)``) and ``cache_position``
     ((B,) int32), writes this call's K/V at each row's offset and
     returns ``(logits, updated_cache)`` — same contract as
-    :func:`deepspeed_tpu.models.gpt2.gpt2_forward`. Training call
+    :func:`deepspeed_tpu.models.gpt2.gpt2_forward`, including the
+    paged-pool interpretation under ``block_tables``. Training call
     signature unchanged."""
     from deepspeed_tpu.models.gpt2 import _tied_logits
     if kv_cache is not None:
         if cache_position is None:
             cache_position = jnp.zeros((input_ids.shape[0],), jnp.int32)
         x, cache = _llama_trunk_cached(params, config, input_ids,
-                                       kv_cache, cache_position, dtype)
+                                       kv_cache, cache_position, dtype,
+                                       block_tables=block_tables)
         return _tied_logits(x, params["lm_head"], dtype), cache
     x = _llama_trunk(params, config, input_ids, dtype=dtype, remat=remat)
     return _tied_logits(x, params["lm_head"], dtype)

@@ -44,23 +44,82 @@ opt-in init-time check (``comm_autotune.calibrate``) when a device (or
 the virtual CPU mesh) is reachable.
 """
 
+import json
+import os
 from typing import Dict, Iterable, List, NamedTuple, Optional, Sequence, Tuple
 
 from deepspeed_tpu.runtime.quantized_collectives import (
     ALGO_ALLGATHER, ALGO_TWOHOP, DEFAULT_BLOCK, QUANTIZED_ALGOS, wire_hops)
 
 __all__ = ["LinkModel", "CommPlan", "exchange_time_us", "plan_comm",
-           "calibrate_wire_model", "candidate_label"]
+           "calibrate_wire_model", "candidate_label",
+           "wire_calibration_path", "save_wire_calibration",
+           "load_wire_calibration", "measure_link_constants"]
 
 # nominal link defaults (per-direction): ICI-class fast wire vs
 # DCN/inter-slice slow wire. Deliberately round numbers — the DECISIONS
 # depend on byte/hop ratios, not absolute magnitudes; override via the
-# comm_autotune config when the real fabric is known.
+# comm_autotune config when the real fabric is known, or let a
+# calibration artifact from a prior hardware run (see
+# ``load_wire_calibration``) replace them wholesale.
 DEFAULT_INTRA_GBPS = 75.0
 DEFAULT_INTER_GBPS = 12.5
 DEFAULT_INTRA_LATENCY_US = 1.0
 DEFAULT_INTER_LATENCY_US = 10.0
 DEFAULT_BLOCK_CANDIDATES = (64, 128, 256)
+
+# measured-link-constants artifact (ROADMAP item 3 follow-on): a prior
+# run that measured the fabric (``measure_link_constants`` /
+# ``calibrate_wire_model`` on real hardware) persists its constants
+# here; later runs pick them up as the LinkModel defaults. Precedence:
+# explicit comm_autotune config keys > artifact > nominal constants.
+WIRE_CALIBRATION_ENV = "DSTPU_WIRE_MODEL"
+_LINK_KEYS = ("intra_gbps", "inter_gbps", "intra_latency_us",
+              "inter_latency_us")
+
+
+def wire_calibration_path(path: Optional[str] = None) -> str:
+    """Resolve the artifact path: explicit arg > $DSTPU_WIRE_MODEL >
+    the per-user cache default."""
+    return path or os.environ.get(WIRE_CALIBRATION_ENV) or os.path.join(
+        os.path.expanduser("~"), ".cache", "deepspeed_tpu",
+        "wire_model.json")
+
+
+def save_wire_calibration(cal: Dict, path: Optional[str] = None) -> str:
+    """Persist measured link constants (any subset of ``intra_gbps``,
+    ``inter_gbps``, ``intra_latency_us``, ``inter_latency_us``, plus
+    free-form provenance fields) for later runs to load as LinkModel
+    defaults. Returns the path written."""
+    p = wire_calibration_path(path)
+    os.makedirs(os.path.dirname(p), exist_ok=True)
+    tmp = p + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(cal, f, indent=1, sort_keys=True, default=str)
+    os.replace(tmp, p)
+    return p
+
+
+def load_wire_calibration(path: Optional[str] = None) -> Optional[Dict]:
+    """Load the measured-constants artifact; None when absent or
+    malformed (a stale/corrupt artifact must never fail planning —
+    the nominal constants are always a working fallback)."""
+    p = wire_calibration_path(path)
+    try:
+        with open(p) as f:
+            raw = json.load(f)
+    except (OSError, ValueError):
+        return None
+    out = {}
+    for k in _LINK_KEYS:
+        if k in raw:
+            try:
+                v = float(raw[k])
+            except (TypeError, ValueError):
+                continue
+            if v > 0:
+                out[k] = v
+    return out or None
 
 
 class LinkModel(NamedTuple):
@@ -79,15 +138,31 @@ class LinkModel(NamedTuple):
                 else self.inter_latency_us)
 
     @classmethod
-    def from_config(cls, ca: Dict) -> "LinkModel":
-        return cls(intra_gbps=float(ca.get("intra_gbps",
-                                           DEFAULT_INTRA_GBPS)),
-                   inter_gbps=float(ca.get("inter_gbps",
-                                           DEFAULT_INTER_GBPS)),
-                   intra_latency_us=float(ca.get(
-                       "intra_latency_us", DEFAULT_INTRA_LATENCY_US)),
-                   inter_latency_us=float(ca.get(
-                       "inter_latency_us", DEFAULT_INTER_LATENCY_US)))
+    def from_config(cls, ca: Dict,
+                    calibration: Optional[Dict] = None) -> "LinkModel":
+        """Per-key precedence: an EXPLICITLY configured value wins;
+        otherwise a measured calibration artifact (``calibration``, or
+        the on-disk one when None); otherwise the nominal defaults.
+        ``ca["explicit"]`` (config layer) records which keys the user
+        set; a hand-built dict without it treats key presence as
+        explicit — the pre-artifact behavior."""
+        explicit = ca.get("explicit")
+        if explicit is None:
+            explicit = {k: k in ca for k in _LINK_KEYS}
+        if calibration is None:
+            calibration = load_wire_calibration() or {}
+        defaults = {"intra_gbps": DEFAULT_INTRA_GBPS,
+                    "inter_gbps": DEFAULT_INTER_GBPS,
+                    "intra_latency_us": DEFAULT_INTRA_LATENCY_US,
+                    "inter_latency_us": DEFAULT_INTER_LATENCY_US}
+
+        def pick(key):
+            if explicit.get(key):
+                return float(ca[key])
+            if key in calibration:
+                return float(calibration[key])
+            return float(ca.get(key, defaults[key]))
+        return cls(*(pick(k) for k in _LINK_KEYS))
 
 
 class CommPlan(NamedTuple):
@@ -184,7 +259,16 @@ def plan_comm(sizes: Sequence[int], world: int, qc: Dict,
     hint). ``intra_hint``: physical fallback hint (devices per process)
     used when the config gives none.
     """
-    link = LinkModel.from_config(ca)
+    cal = load_wire_calibration()
+    link = LinkModel.from_config(ca, calibration=cal)
+    # "measured" iff some artifact key actually WON in from_config —
+    # mirror its explicitness rule (hand-built dicts without an
+    # "explicit" map treat key presence as explicit)
+    explicit_links = ca.get("explicit")
+    if explicit_links is None:
+        explicit_links = {k: k in ca for k in _LINK_KEYS}
+    measured = bool(cal) and any(
+        not explicit_links.get(k) for k in cal)
     topo_intra = int(ca.get("intra_size") or 0) or int(intra_hint or 0)
     explicit = qc.get("explicit", {})
 
@@ -238,6 +322,8 @@ def plan_comm(sizes: Sequence[int], world: int, qc: Dict,
     else:
         why.append("uniform fabric")
     why.append(f"modeled {table[label]:.1f}us/step")
+    if measured:
+        why.append("measured link constants (wire_model artifact)")
     if others:
         why.append(f"next best {others[0][1]} {others[0][0]:.1f}us")
     if overridden:
@@ -310,3 +396,75 @@ def calibrate_wire_model(world: int = 8, algo: str = ALGO_TWOHOP,
             "drift": (hlo / model - 1.0) if model else 0.0,
             "world": world, "algo": algo, "block": block,
             "hierarchical": hierarchical, "elements": n}
+
+
+def uniform_fabric(topo_intra: int, world: int) -> bool:
+    """True only when the fabric is KNOWN to be uniform (every rank on
+    the fast wire: ``topo_intra >= world``). Unknown topology
+    (``topo_intra == 0``) is NOT uniform: a flat probe whose slowest
+    hop might be the DCN must never persist as the intra constants."""
+    return int(topo_intra or 0) >= int(world)
+
+
+def measure_link_constants(world: int = 8, algo: str = ALGO_TWOHOP,
+                           block: int = DEFAULT_BLOCK,
+                           sizes: Tuple[int, int] = (1 << 16, 1 << 20),
+                           iters: int = 5) -> Dict:
+    """Measure effective link constants by TIMING the compiled flat
+    exchange at two message sizes and solving the two-term model
+    ``t = latency + bytes / bandwidth`` (two sizes, two unknowns).
+
+    Returns ``{"intra_gbps", "intra_latency_us", ...provenance}`` —
+    on a uniform fabric everything is the intra wire; callers on a
+    split fabric run it per axis. Only meaningful on real hardware (a
+    CPU "mesh" measures dispatch overhead, not a wire): callers gate
+    persistence (``save_wire_calibration``) on the backend. Best-of-N
+    timing so a stray scheduling hiccup can't poison the artifact.
+    """
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from deepspeed_tpu.parallel.mesh import build_mesh
+    from deepspeed_tpu.runtime.quantized_collectives import (
+        quantized_allreduce_mean, wire_bytes)
+
+    devices = jax.devices()
+    if len(devices) < world:
+        raise RuntimeError(
+            f"link measurement needs {world} devices, have {len(devices)}")
+    mesh = build_mesh({"data": world}, devices=devices[:world])
+    points = []
+    for n in sizes:
+        fn = jax.jit(jax.shard_map(
+            lambda x: quantized_allreduce_mean(
+                x[0], "data", block, algo=algo, world_size=world),
+            mesh=mesh, in_specs=(P("data"),), out_specs=P(),
+            check_vma=False))
+        x = jnp.ones((world, n), jnp.float32)
+        jax.block_until_ready(fn(x))           # compile outside timing
+        best = min(
+            _timed(time, fn, x) for _ in range(max(1, iters)))
+        b, _dense = wire_bytes(n, world, block, algo=algo)
+        points.append((float(b), best * 1e6))  # (bytes, microseconds)
+    (b1, t1), (b2, t2) = points
+    if b2 == b1 or t2 <= t1:
+        # degenerate measurement: report pure-bandwidth estimate
+        bw_bytes_per_us = b2 / max(t2, 1e-9)
+        lat = 0.0
+    else:
+        bw_bytes_per_us = (b2 - b1) / (t2 - t1)
+        lat = max(0.0, t1 - b1 / bw_bytes_per_us)
+    return {"intra_gbps": bw_bytes_per_us * 1e6 * 8 / 1e9,
+            "intra_latency_us": lat, "world": world, "algo": algo,
+            "block": block, "sizes": list(sizes),
+            "backend": jax.default_backend()}
+
+
+def _timed(time_mod, fn, x) -> float:
+    t0 = time_mod.perf_counter()
+    import jax
+    jax.block_until_ready(fn(x))
+    return time_mod.perf_counter() - t0

@@ -398,6 +398,17 @@ def get_comm_autotune_config(param_dict):
             "block_candidates": list(sub.get(
                 C.COMM_AUTOTUNE_BLOCK_CANDIDATES,
                 DEFAULT_BLOCK_CANDIDATES)),
+            # which link-model knobs the user set EXPLICITLY: explicit
+            # values always win; otherwise a calibrate_wire_model()
+            # artifact from a prior run (comm_autotune.load_wire_
+            # calibration) overrides the hardcoded nominal constants
+            "explicit": {
+                k: k in sub
+                for k in (C.COMM_AUTOTUNE_INTRA_GBPS,
+                          C.COMM_AUTOTUNE_INTER_GBPS,
+                          C.COMM_AUTOTUNE_INTRA_LATENCY_US,
+                          C.COMM_AUTOTUNE_INTER_LATENCY_US)
+            },
         }
     except (TypeError, ValueError) as e:
         # the coercions run at parse time (before _do_sanity_check),
@@ -533,7 +544,22 @@ def get_inference_config(param_dict):
                                          C.INF_QUANTIZE_WEIGHTS_DEFAULT)),
         "quantize_block": int(sub.get(C.INF_QUANTIZE_BLOCK,
                                       C.INF_QUANTIZE_BLOCK_DEFAULT)),
+        "admit_lookahead": int(sub.get(C.INF_ADMIT_LOOKAHEAD,
+                                       C.INF_ADMIT_LOOKAHEAD_DEFAULT)),
     }
+    pk = sub.get(C.INF_PAGED_KV, {}) or {}
+    cfg["paged_kv"] = {
+        "enabled": bool(pk.get(C.INF_PAGED_ENABLED,
+                               C.INF_PAGED_ENABLED_DEFAULT)),
+        "page_size": int(pk.get(C.INF_PAGED_PAGE_SIZE,
+                                C.INF_PAGED_PAGE_SIZE_DEFAULT)),
+        "num_pages": int(pk.get(C.INF_PAGED_NUM_PAGES,
+                                C.INF_PAGED_NUM_PAGES_DEFAULT)),
+        "prefix_cache": bool(pk.get(C.INF_PAGED_PREFIX_CACHE,
+                                    C.INF_PAGED_PREFIX_CACHE_DEFAULT)),
+    }
+    mesh_sub = sub.get(C.INF_MESH, {}) or {}
+    cfg["mesh"] = {"axes": dict(mesh_sub.get(C.INF_MESH_AXES, {}) or {})}
     try:
         cfg["prompt_buckets"] = list(validate_buckets(
             cfg["prompt_buckets"], "inference.prompt_buckets"))
@@ -558,6 +584,32 @@ def get_inference_config(param_dict):
         raise DeepSpeedConfigError(
             "inference: max_new_tokens >= 1, top_k >= 0 and "
             "quantize_block >= 8 required")
+    if cfg["admit_lookahead"] < 0:
+        raise DeepSpeedConfigError(
+            f"inference.admit_lookahead must be >= 0, got "
+            f"{cfg['admit_lookahead']}")
+    pkc = cfg["paged_kv"]
+    if pkc["page_size"] < 1 or pkc["page_size"] > cfg["max_seq_len"]:
+        raise DeepSpeedConfigError(
+            f"inference.paged_kv.page_size must be in [1, max_seq_len], "
+            f"got {pkc['page_size']}")
+    if pkc["num_pages"] < 0 or pkc["num_pages"] == 1:
+        # 0 = auto-size; an explicit pool needs >= 2 (null + 1 usable)
+        raise DeepSpeedConfigError(
+            f"inference.paged_kv.num_pages must be 0 (auto) or >= 2, "
+            f"got {pkc['num_pages']}")
+    for name, size in cfg["mesh"]["axes"].items():
+        if name != "model":
+            # the serving programs shard params/cache over the 'model'
+            # axis only today; an unknown axis would otherwise surface
+            # as an opaque jax resource error deep in engine init
+            raise DeepSpeedConfigError(
+                f"inference.mesh.axes supports only the 'model' "
+                f"(tensor-parallel) axis, got {name!r}")
+        if not isinstance(size, int) or size < 1:
+            raise DeepSpeedConfigError(
+                f"inference.mesh.axes entries must be positive ints, "
+                f"got {name}={size!r}")
     return cfg
 
 

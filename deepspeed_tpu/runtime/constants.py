@@ -380,7 +380,22 @@ CHECKPOINT_IO_RETRY_BACKOFF_DEFAULT = 0.05
 #   "eos_token_id": null,         # default stop token
 #   "events_dir": "",             # serving events.jsonl ("" disables)
 #   "quantize_weights": false,    # qwZ int8 block weight distribution
-#   "quantize_block": 256         # qwZ block size
+#   "quantize_block": 256,        # qwZ block size
+#   "admit_lookahead": 4,         # HOL fix: queue entries scanned for a
+#                                 # head that fits (0 = strict FIFO)
+#   "paged_kv": {                 # paged/block KV cache (default path;
+#                                 # occupancy ~ tokens in flight, not
+#                                 # slots x max_len)
+#     "enabled": true,            # false = dense slot x max_len cache
+#     "page_size": 16,            # tokens per page
+#     "num_pages": 0,             # pool size incl. null page; 0 = auto
+#                                 # (dense-equivalent worst case)
+#     "prefix_cache": true        # hash-dedup shared prompt prefixes
+#   },
+#   "mesh": {                     # serving mesh (GSPMD NamedShardings)
+#     "axes": {}                  # e.g. {"model": 4}: tensor-parallel
+#                                 # prefill/decode over ICI
+#   }
 # }
 #############################################
 INFERENCE = "inference"
@@ -406,6 +421,19 @@ INF_QUANTIZE_WEIGHTS = "quantize_weights"
 INF_QUANTIZE_WEIGHTS_DEFAULT = False
 INF_QUANTIZE_BLOCK = "quantize_block"
 INF_QUANTIZE_BLOCK_DEFAULT = 256
+INF_ADMIT_LOOKAHEAD = "admit_lookahead"
+INF_ADMIT_LOOKAHEAD_DEFAULT = 4
+INF_PAGED_KV = "paged_kv"
+INF_PAGED_ENABLED = "enabled"
+INF_PAGED_ENABLED_DEFAULT = True
+INF_PAGED_PAGE_SIZE = "page_size"
+INF_PAGED_PAGE_SIZE_DEFAULT = 16
+INF_PAGED_NUM_PAGES = "num_pages"
+INF_PAGED_NUM_PAGES_DEFAULT = 0     # 0 = auto (dense-equivalent pool)
+INF_PAGED_PREFIX_CACHE = "prefix_cache"
+INF_PAGED_PREFIX_CACHE_DEFAULT = True
+INF_MESH = "mesh"
+INF_MESH_AXES = "axes"
 
 TENSORBOARD = "tensorboard"
 TENSORBOARD_ENABLED = "enabled"

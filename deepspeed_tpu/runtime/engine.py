@@ -611,6 +611,32 @@ class DeepSpeedEngine:
                         "the compiled HLO byte accounting — the cost "
                         "model's inputs may have rotted",
                         cal["drift"] * 100.0)
+                # on real hardware, also TIME the exchange and persist
+                # the measured link constants: the next run's LinkModel
+                # then plans against the fabric as measured, not the
+                # nominal round numbers (explicit config keys still win).
+                # KNOWN-uniform fabric only (unknown topology counts as
+                # split): the flat probe's slowest hop on a split fabric
+                # is the DCN, and persisting that as the INTRA constants
+                # would collapse the planner's fast/slow-wire
+                # distinction for every later run
+                import jax as _jax
+                from deepspeed_tpu.runtime.comm_autotune import \
+                    uniform_fabric
+                uniform = uniform_fabric(self._comm_plan.topo_intra,
+                                         self.dp_world_size)
+                if _jax.default_backend() == "tpu" and uniform:
+                    from deepspeed_tpu.runtime.comm_autotune import (
+                        measure_link_constants, save_wire_calibration)
+                    measured = measure_link_constants(
+                        world=self.dp_world_size, algo=self._quant_algo,
+                        block=self._quant_block)
+                    path = save_wire_calibration(measured)
+                    logger.info(
+                        "comm_autotune: measured link constants "
+                        f"({measured['intra_gbps']:.1f} gbps, "
+                        f"{measured['intra_latency_us']:.1f} us) saved "
+                        f"to {path}")
             except Exception as e:
                 logger.warning(f"comm_autotune: calibration skipped "
                                f"({e!r})")

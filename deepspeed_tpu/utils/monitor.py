@@ -33,6 +33,9 @@ TAG_SERVE_TOKEN_LATENCY = "Serve/token_latency_ms"  # per decode dispatch
 TAG_SERVE_TPS = "Serve/tokens_per_sec"              # cumulative rate
 TAG_SERVE_QUEUE_DEPTH = "Serve/queue_depth"         # waiting requests
 TAG_SERVE_OCCUPANCY = "Serve/batch_occupancy"       # active / total slots
+TAG_SERVE_KV_PAGES = "Serve/kv_pages_in_use"        # paged pool occupancy
+TAG_SERVE_TOKENS_IN_FLIGHT = "Serve/tokens_in_flight"  # live cache tokens
+TAG_SERVE_PREFIX_HIT = "Serve/prefix_hit_rate"      # prompt tokens reused
 
 
 class _JsonlWriter:
@@ -214,14 +217,17 @@ class TensorBoardMonitor:
 
     def write_serving_metrics(self, *, ttft_ms=None, token_latency_ms=None,
                               tokens_per_sec=None, queue_depth=None,
-                              batch_occupancy=None, tokens: int = 0,
-                              flush: bool = True):
+                              batch_occupancy=None, kv_pages_in_use=None,
+                              tokens_in_flight=None, prefix_hit_rate=None,
+                              tokens: int = 0, flush: bool = True):
         """Serving telemetry (inference engine; TPU-native extension —
         the reference snapshot is training-only): time-to-first-token
         per admitted request, per-decode-step token latency, cumulative
-        tokens/s, request-queue depth and decode-slot occupancy. The
-        x-axis is cumulative generated tokens (the serving analog of
-        the training samples axis). Tags are pinned by
+        tokens/s, request-queue depth and decode-slot occupancy, plus
+        the paged-cache view (pool pages in use, live cache tokens in
+        flight, prefix-cache hit rate over prompt tokens). The x-axis
+        is cumulative generated tokens (the serving analog of the
+        training samples axis). Tags are pinned by
         tests/unit/test_inference.py and rendered by
         tools/obs_report.py's serving section."""
         if not self._writes():
@@ -237,6 +243,14 @@ class TensorBoardMonitor:
             self.write_scalar(TAG_SERVE_QUEUE_DEPTH, queue_depth, tokens)
         if batch_occupancy is not None:
             self.write_scalar(TAG_SERVE_OCCUPANCY, batch_occupancy,
+                              tokens)
+        if kv_pages_in_use is not None:
+            self.write_scalar(TAG_SERVE_KV_PAGES, kv_pages_in_use, tokens)
+        if tokens_in_flight is not None:
+            self.write_scalar(TAG_SERVE_TOKENS_IN_FLIGHT,
+                              tokens_in_flight, tokens)
+        if prefix_hit_rate is not None:
+            self.write_scalar(TAG_SERVE_PREFIX_HIT, prefix_hit_rate,
                               tokens)
         if flush:
             self.flush()

@@ -15,6 +15,11 @@ import os
 os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
                            " --xla_force_host_platform_device_count=8")
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+# hermetic comm-autotune planning: a measured wire_model.json — whether
+# in the user cache OR exported via DSTPU_WIRE_MODEL in the shell — must
+# not skew the golden decision tables, so pin unconditionally (tests
+# that WANT an artifact monkeypatch this to a tmp file)
+os.environ["DSTPU_WIRE_MODEL"] = "/nonexistent/dstpu_wire_model.json"
 
 import jax  # noqa: E402
 

@@ -99,6 +99,30 @@ class TestBuckets:
 # --------------------------------------------------------------------- #
 # scheduler (pure host-side: no jax)
 # --------------------------------------------------------------------- #
+def test_host_side_scheduling_modules_stay_jax_free():
+    """scheduler.py advertises "nothing here imports jax, so scheduler
+    policy is unit-testable in microseconds" — pin that at the source
+    level for the whole host-side chain it pulls in (scheduler ->
+    paging, buckets), so a convenience import can't quietly drag jax
+    back into admission policy."""
+    import ast
+    import pathlib
+
+    import deepspeed_tpu.inference as inf
+    root = pathlib.Path(inf.__file__).parent
+    for mod in ("scheduler.py", "paging.py", "buckets.py"):
+        for node in ast.walk(ast.parse((root / mod).read_text())):
+            if isinstance(node, ast.Import):
+                names = [a.name for a in node.names]
+            elif isinstance(node, ast.ImportFrom):
+                names = [node.module or ""]
+            else:
+                continue
+            for n in names:
+                assert n != "jax" and not n.startswith("jax."), \
+                    f"{mod} imports {n}"
+
+
 class TestScheduler:
     def _sched(self, slots=3, clock=None):
         from deepspeed_tpu.inference.scheduler import Scheduler
@@ -375,6 +399,12 @@ class TestInferenceEngine:
             obs_report.T_QDEPTH
         assert m.TAG_SERVE_OCCUPANCY == prof.TAG_SERVE_OCCUPANCY == \
             obs_report.T_OCC
+        assert m.TAG_SERVE_KV_PAGES == prof.TAG_SERVE_KV_PAGES == \
+            obs_report.T_KV_PAGES
+        assert m.TAG_SERVE_TOKENS_IN_FLIGHT == \
+            prof.TAG_SERVE_TOKENS_IN_FLIGHT == obs_report.T_TOKENS_IN_FLIGHT
+        assert m.TAG_SERVE_PREFIX_HIT == prof.TAG_SERVE_PREFIX_HIT == \
+            obs_report.T_PREFIX_HIT
 
     def test_rejects_unservable_config(self):
         from deepspeed_tpu.inference import InferenceEngine
@@ -524,3 +554,474 @@ class TestInferenceConfigSection:
                               world_size=1)
         assert cfg.inference_config["max_batch_size"] == 2
         assert cfg.inference_config["prompt_buckets"] == [16]
+
+
+# --------------------------------------------------------------------- #
+# paged KV cache (ISSUE 7 tentpole): page pool + block tables + prefix
+# caching; occupancy bounded by tokens in flight, not slots x max_len
+# --------------------------------------------------------------------- #
+class TestPageAllocator:
+    def _alloc(self, pages=9, ps=4, prefix=True):
+        from deepspeed_tpu.inference.kv_cache import PageAllocator
+        return PageAllocator(pages, ps, prefix_cache=prefix)
+
+    def test_alloc_free_refcount(self):
+        al = self._alloc()
+        assert al.free_pages == 8 and al.pages_in_use == 0
+        a = al.alloc(3)
+        assert len(a) == 3 and al.pages_in_use == 3
+        assert all(al.refcount(p) == 1 for p in a)
+        assert al.alloc(6) is None          # partial grabs never happen
+        assert al.free_pages == 5
+        al.free(a)
+        assert al.free_pages == 8 and al.pages_in_use == 0
+        with pytest.raises(ValueError, match="unowned"):
+            al.free(a[:1])
+
+    def test_prefix_survives_until_last_reader_evicts(self):
+        al = self._alloc()
+        prompt = list(range(10))            # 2 full pages of 4 + tail
+        owner = al.alloc(3)
+        al.register_prefix(prompt, owner)
+        shared, reused = al.match_prefix(prompt)
+        assert shared == owner[:2] and reused == 8
+        # a reader takes references on the shared pages
+        al.incref(shared)
+        assert al.refcount(owner[0]) == 2
+        # owner evicts: shared pages SURVIVE (reader still holds them)
+        al.free(owner)
+        assert al.refcount(owner[0]) == 1
+        assert al.match_prefix(prompt)[0] == owner[:2]
+        # last reader evicts: pages return AND the prefix entry drops
+        al.free(shared)
+        assert al.free_pages == 8
+        assert al.match_prefix(prompt) == ([], 0)
+
+    def test_prefix_disabled(self):
+        al = self._alloc(prefix=False)
+        pages = al.alloc(2)
+        al.register_prefix(list(range(8)), pages)
+        assert al.match_prefix(list(range(8))) == ([], 0)
+
+    def test_prefix_hit_verifies_content_not_just_hash(self):
+        """A chain-hash collision (builtin tuple hashing is predictable,
+        so craftable) must NOT hand one request another prompt's KV
+        pages: hits verify the stored page's tokens."""
+        al = self._alloc()
+        prompt = list(range(8))
+        owner = al.alloc(2)
+        al.register_prefix(prompt, owner)
+        other = [99] * 8
+        # simulate the collision: point other's chain hash at owner's page
+        h_other = next(al._chain_hashes(other))
+        al._prefix[h_other] = owner[0]
+        assert al.match_prefix(other) == ([], 0)     # content rejects
+        assert al.match_prefix(prompt)[1] == 8       # genuine hit holds
+
+    def test_prefix_hit_verifies_parent_chain_not_just_chunk(self):
+        """Deep-layer K/V of page i depends on the WHOLE prefix before
+        it, not just page i's own tokens — so a colliding entry whose
+        chunk MATCHES but whose registered context differs must still be
+        rejected. The parent-link check pins this: a hit at page i
+        requires the candidate's registered predecessor to be the exact
+        physical page matched at i-1."""
+        al = self._alloc(pages=9, ps=4)
+        attacker = [7, 7, 7, 7] + [1, 2, 3, 4]   # context A + chunk C
+        ap = al.alloc(2)
+        al.register_prefix(attacker, ap)
+        victim = [0, 1, 2, 3] + [1, 2, 3, 4]     # context V + same chunk C
+        vp = al.alloc(1)
+        al.register_prefix(victim[:4], vp)        # page 0 registered honestly
+        # simulate a chain-hash collision at the victim's page 1: the
+        # index hands back the attacker's page, whose own chunk equals
+        # the victim's — the old content-only check would accept it
+        h_victim = list(al._chain_hashes(victim))[1]
+        al._prefix[h_victim] = ap[1]
+        got, n = al.match_prefix(victim)
+        assert got == vp and n == 4      # page 1 rejected: wrong parent
+        assert al.match_prefix(attacker)[0] == ap    # honest chain holds
+
+    def test_divergent_prompts_share_only_common_pages(self):
+        al = self._alloc(pages=17)
+        a = list(range(12))
+        b = list(range(8)) + [99, 98, 97, 96]    # diverges at page 2
+        pa = al.alloc(3)
+        al.register_prefix(a, pa)
+        shared, reused = al.match_prefix(b)
+        assert shared == pa[:2] and reused == 8
+
+    def test_shared_duplicate_tokens(self):
+        """Per-reader context sums count shared prefix pages once per
+        reader; the allocator reports the exact overcount so
+        ``tokens_in_flight`` can deduplicate."""
+        al = self._alloc()
+        owner = al.alloc(2)                      # 2 full shared pages
+        al.register_prefix(list(range(8)), owner)
+        assert al.shared_duplicate_tokens == 0   # one owner, no dupes
+        al.incref(owner)                         # reader 1
+        al.incref(owner)                         # reader 2
+        assert al.shared_duplicate_tokens == 2 * 2 * 4
+        al.free(owner)                           # one reference drops
+        assert al.shared_duplicate_tokens == 2 * 4
+        al.free(owner)
+        al.free(owner)
+        assert al.shared_duplicate_tokens == 0
+
+
+class TestPagedServing:
+    @pytest.mark.parametrize("family", ["gpt2", "llama"])
+    def test_paged_vs_dense_generate_parity_small_pool(self, family):
+        """ISSUE 7 acceptance: a mixed-length workload whose DENSE
+        footprint exceeds the page pool (6 live requests x max_len 32 =
+        192 token-slots dense; the pool holds 44) serves with greedy
+        outputs EXACTLY matching the dense path, for both families,
+        under continuous batching."""
+        from deepspeed_tpu.inference import InferenceEngine
+        cfg, params = tiny_gpt2() if family == "gpt2" else tiny_llama()
+        rng = np.random.RandomState(7)
+        prompts = [rng.randint(1, 61, (n,)).tolist()
+                   for n in (3, 5, 7, 2, 8, 4, 6, 1)]
+        dense = InferenceEngine(
+            cfg, params, dict(TINY_INF, paged_kv={"enabled": False}),
+            dtype=jnp.float32)
+        ref = dense.generate(prompts, max_new_tokens=4, temperature=0.0)
+        paged = InferenceEngine(
+            cfg, params,
+            dict(TINY_INF, paged_kv={"page_size": 4, "num_pages": 12}),
+            dtype=jnp.float32)
+        assert paged.paged and paged.scheduler.allocator is not None
+        got = paged.generate(prompts, max_new_tokens=4, temperature=0.0)
+        assert got == ref
+        # every page returned once the workload drained
+        al = paged.scheduler.allocator
+        assert al.pages_in_use == 0 and al.free_pages == 11
+        assert paged.scheduler.peak_tokens_in_flight > 0
+
+    def test_paged_sampling_parity_with_dense(self):
+        """Temperature sampling keys are position-based: the paged path
+        must reproduce the dense stream exactly (same fold_in schedule
+        even when a prefix offset splits prefill)."""
+        from deepspeed_tpu.inference import InferenceEngine
+        cfg, params = tiny_gpt2()
+        prompts = [[1, 2, 3], [4, 5], [6, 7, 8, 9]]
+        dense = InferenceEngine(
+            cfg, params, dict(TINY_INF, paged_kv={"enabled": False}),
+            dtype=jnp.float32)
+        paged = InferenceEngine(
+            cfg, params,
+            dict(TINY_INF, paged_kv={"page_size": 4, "num_pages": 16}),
+            dtype=jnp.float32)
+        kw = dict(max_new_tokens=5, temperature=0.8, seeds=[7, 8, 9])
+        assert paged.generate(prompts, **kw) == dense.generate(prompts,
+                                                               **kw)
+
+    def test_prefix_cache_shares_pages_with_parity(self):
+        """Repeated system prompts prefill once: later requests reuse
+        the registered pages (hit tokens > 0), outputs stay exactly the
+        dense path's, and the shared pages free only after the last
+        reader evicts."""
+        from deepspeed_tpu.inference import InferenceEngine
+        cfg, params = tiny_gpt2()
+        sys_prompt = list(range(1, 9))          # 2 full pages of 4
+        prompts = [sys_prompt + [10], sys_prompt + [20, 21],
+                   sys_prompt[:]]
+        icfg = dict(TINY_INF, prompt_buckets=[4, 16], max_seq_len=32,
+                    paged_kv={"page_size": 4, "num_pages": 20})
+        dense = InferenceEngine(
+            cfg, params, dict(icfg, paged_kv={"enabled": False}),
+            dtype=jnp.float32)
+        ref = dense.generate(prompts, max_new_tokens=3, temperature=0.0)
+        paged = InferenceEngine(cfg, params, icfg, dtype=jnp.float32)
+        got = paged.generate(prompts, max_new_tokens=3, temperature=0.0)
+        assert got == ref
+        al = paged.scheduler.allocator
+        assert al.prefix_hit_tokens >= 8        # later prompts reused
+        assert al.pages_in_use == 0             # all returned at drain
+
+    def test_prefix_cache_off_still_serves(self):
+        from deepspeed_tpu.inference import InferenceEngine
+        cfg, params = tiny_gpt2()
+        paged = InferenceEngine(
+            cfg, params,
+            dict(TINY_INF, paged_kv={"page_size": 4, "num_pages": 20,
+                                     "prefix_cache": False}),
+            dtype=jnp.float32)
+        outs = paged.generate([[1, 2, 3], [1, 2, 3]], max_new_tokens=3)
+        assert outs[0] == outs[1]
+        assert paged.scheduler.allocator.prefix_hit_tokens == 0
+
+    def test_warmup_program_count_and_zero_recompiles_under_churn(self):
+        """ISSUE 7 CI satellite: with paging enabled, warmup compiles
+        EXACTLY len(batch_buckets) x len(prompt_buckets) prefill
+        programs + the one paged decode program; a mixed-length churn
+        workload (page alloc/free + prefix reuse + slot turnover) then
+        compiles NOTHING more (CompileTracker-exact)."""
+        from deepspeed_tpu.inference import InferenceEngine
+        cfg, params = tiny_gpt2()
+        engine = InferenceEngine(
+            cfg, params,
+            dict(TINY_INF, paged_kv={"page_size": 4, "num_pages": 14}),
+            dtype=jnp.float32)
+        programs = engine.warmup()
+        assert programs == 2 * 2 + 1
+        assert engine.compile_tracker.counts == {"prefill": 4,
+                                                 "decode": 1}
+        rng = np.random.RandomState(5)
+        sys_prompt = rng.randint(1, 61, (4,)).tolist()
+        churn = [rng.randint(1, 61, (n,)).tolist()
+                 for n in (1, 4, 5, 8, 3, 6, 2, 7)]
+        churn += [sys_prompt + [int(t)] for t in rng.randint(1, 61, (4,))]
+        engine.generate(churn, max_new_tokens=3)
+        engine.generate(churn[:3], max_new_tokens=5, temperature=0.5)
+        assert engine.steady_state_recompiles == 0
+        assert engine.compile_tracker.total_compiles == programs
+
+    def test_paged_telemetry_lands_in_events(self, tmp_path):
+        from deepspeed_tpu.inference import InferenceEngine
+        cfg, params = tiny_gpt2()
+        icfg = dict(TINY_INF, events_dir=str(tmp_path),
+                    paged_kv={"page_size": 4, "num_pages": 20})
+        engine = InferenceEngine(cfg, params, icfg, dtype=jnp.float32)
+        engine.warmup()
+        engine.generate([[1, 2, 3], [1, 2, 3], [4, 5]],
+                        max_new_tokens=4)
+        engine.close()
+        rows = [json.loads(line)
+                for line in open(tmp_path / "events.jsonl")]
+        tags = {r["tag"] for r in rows if "tag" in r}
+        assert {"Serve/kv_pages_in_use", "Serve/tokens_in_flight",
+                "Serve/prefix_hit_rate"} <= tags
+        pages = [r["value"] for r in rows
+                 if r.get("tag") == "Serve/kv_pages_in_use"]
+        assert max(pages) > 0
+        obs_report = _load_tool("obs_report")
+        s = obs_report.summarize(str(tmp_path))
+        pk = s["serving"]["paged_kv"]
+        assert pk["pages_in_use_peak"] > 0
+        assert pk["tokens_in_flight_peak"] > 0
+        assert "paged_kv" in obs_report.render(s)
+
+    def test_submit_rejects_request_larger_than_pool(self):
+        from deepspeed_tpu.inference import InferenceEngine
+        from deepspeed_tpu.inference.scheduler import Request
+        cfg, params = tiny_gpt2()
+        engine = InferenceEngine(
+            cfg, params,
+            dict(TINY_INF, paged_kv={"page_size": 4, "num_pages": 3}),
+            dtype=jnp.float32)
+        with pytest.raises(ValueError, match="pages"):
+            engine.submit(Request(prompt=[1, 2, 3], max_new_tokens=8))
+
+
+class TestLookaheadAdmission:
+    """ISSUE 7 satellite: bounded-lookahead admission — a head request
+    that doesn't fit the free pages must not stall the whole queue."""
+
+    def _sched(self, lookahead, pages=10, ps=4, occupy=True):
+        """Scheduler with 9 usable pages; ``occupy`` admits a resident
+        8-page request so only ONE page stays free — a later 8-page head
+        fits the pool in principle (submit accepts it) but not the
+        current free pages (admission must look past it)."""
+        from deepspeed_tpu.inference.kv_cache import PageAllocator
+        from deepspeed_tpu.inference.scheduler import Request, Scheduler
+        s = Scheduler(3, (4, 16), (1, 2), 32,
+                      allocator=PageAllocator(pages, ps),
+                      lookahead=lookahead)
+        if occupy:
+            resident = Request(prompt=[9] * 16, max_new_tokens=16)
+            s.submit(resident)
+            (batch,) = s.admit()
+            s.record_tokens({batch.slot_ids[0]: 1})   # mid-decode
+            assert s.allocator.free_pages == pages - 1 - 8
+        return s
+
+    def test_small_request_behind_big_head_lands(self):
+        from deepspeed_tpu.inference.scheduler import Request
+        s = self._sched(lookahead=4)
+        big = Request(prompt=[1] * 16, max_new_tokens=16)   # 8 pages
+        small = Request(prompt=[2, 3], max_new_tokens=2)    # 1 page
+        s.submit(big)
+        s.submit(small)
+        (batch,) = s.admit()
+        assert [r.uid for r in batch.requests] == [small.uid]
+        assert s.queue_depth == 1                # big still waiting
+        # small finishes -> its page frees -> big still blocked (needs
+        # 8, 2 free): the queue drains only when capacity appears
+        sid = batch.slot_ids[0]
+        s.record_tokens({sid: 1})
+        s.record_tokens({sid: 2})
+        assert s.admit() == []
+
+    def test_strict_fifo_blocks_without_lookahead(self):
+        from deepspeed_tpu.inference.scheduler import Request
+        s = self._sched(lookahead=0)
+        s.submit(Request(prompt=[1] * 16, max_new_tokens=16))
+        s.submit(Request(prompt=[2, 3], max_new_tokens=2))
+        assert s.admit() == []                   # head-of-line blocked
+
+    def test_lookahead_window_is_bounded(self):
+        from deepspeed_tpu.inference.scheduler import Request
+        s = self._sched(lookahead=1)
+        s.submit(Request(prompt=[1] * 16, max_new_tokens=16))
+        s.submit(Request(prompt=[3] * 16, max_new_tokens=16))
+        fits = Request(prompt=[2, 3], max_new_tokens=2)
+        s.submit(fits)                           # position 2 > window
+        assert s.admit() == []
+        s2 = self._sched(lookahead=2)
+        s2.submit(Request(prompt=[1] * 16, max_new_tokens=16))
+        s2.submit(Request(prompt=[3] * 16, max_new_tokens=16))
+        fits2 = Request(prompt=[2, 3], max_new_tokens=2)
+        s2.submit(fits2)
+        (batch,) = s2.admit()
+        assert [r.uid for r in batch.requests] == [fits2.uid]
+
+    def test_fifo_order_restored_when_head_fits(self):
+        from deepspeed_tpu.inference.scheduler import Request
+        s = self._sched(lookahead=4, pages=20)
+        a = Request(prompt=[1, 2], max_new_tokens=2)
+        b = Request(prompt=[3, 4], max_new_tokens=2)
+        s.submit(a)
+        s.submit(b)
+        (batch,) = s.admit()
+        assert [r.uid for r in batch.requests] == [a.uid, b.uid]
+
+
+class TestTokensInFlight:
+    def test_shared_prefix_counted_once(self):
+        """``tokens_in_flight`` reports physical pool occupancy: a
+        prefix shared by N readers lands once, not N times."""
+        from deepspeed_tpu.inference.kv_cache import PageAllocator
+        from deepspeed_tpu.inference.scheduler import Request, Scheduler
+        s = Scheduler(3, (4, 16), (1, 2), 32,
+                      allocator=PageAllocator(20, 4))
+        prompt = [1, 2, 3, 4, 5, 6, 7, 8]
+        s.submit(Request(prompt=prompt, max_new_tokens=4))
+        s.submit(Request(prompt=prompt, max_new_tokens=4))
+        s.admit()
+        # reuse caps one token short of the prompt -> the second reader
+        # shares exactly the first page (4 of its 8 context tokens)
+        assert s.allocator.shared_duplicate_tokens == 4
+        assert s.tokens_in_flight == 8 + 8 - 4
+        assert s.peak_tokens_in_flight == 12
+class TestServingMesh:
+    MESH_INF = dict(TINY_INF, mesh={"axes": {"model": 2}})
+
+    @pytest.mark.parametrize("family", ["gpt2", "llama"])
+    def test_sharded_decode_parity(self, family):
+        """Tensor-parallel serving over a 2-way CPU mesh: greedy outputs
+        exactly match the unsharded engine for both families (llama
+        exercises the GQA kv_heads split)."""
+        from deepspeed_tpu.inference import InferenceEngine
+        cfg, params = tiny_gpt2() if family == "gpt2" else tiny_llama()
+        rng = np.random.RandomState(3)
+        prompts = [rng.randint(1, 61, (n,)).tolist()
+                   for n in (3, 5, 7, 2, 8)]
+        base = InferenceEngine(cfg, params, TINY_INF, dtype=jnp.float32)
+        ref = base.generate(prompts, max_new_tokens=4, temperature=0.0)
+        sharded = InferenceEngine(cfg, params, self.MESH_INF,
+                                  dtype=jnp.float32)
+        assert sharded.mesh is not None
+        assert dict(sharded.mesh.shape) == {"model": 2}
+        got = sharded.generate(prompts, max_new_tokens=4,
+                               temperature=0.0)
+        assert got == ref
+        # params really live sharded: a column-parallel leaf is split
+        from jax.sharding import PartitionSpec as P
+        leaf = sharded.params["h_0"]["attn"][
+            "qkvw" if family == "gpt2" else "wq"]
+        assert leaf.sharding.spec == P(None, "model")
+
+    def test_sharded_zero_steady_state_recompiles(self):
+        from deepspeed_tpu.inference import InferenceEngine
+        cfg, params = tiny_gpt2()
+        engine = InferenceEngine(cfg, params, self.MESH_INF,
+                                 dtype=jnp.float32)
+        programs = engine.warmup()
+        assert programs == 2 * 2 + 1
+        rng = np.random.RandomState(2)
+        prompts = [rng.randint(1, 61, (n,)).tolist()
+                   for n in (1, 4, 5, 8, 3)]
+        engine.generate(prompts, max_new_tokens=3)
+        assert engine.steady_state_recompiles == 0
+
+    def test_from_checkpoint_reshards_onto_serving_mesh(self, tmp_path):
+        """Train on the default (unsharded) layout, serve on a model=2
+        mesh: from_checkpoint materializes the params straight into the
+        serving NamedShardings and outputs match the in-memory
+        engine."""
+        import deepspeed_tpu
+        from deepspeed_tpu.inference import InferenceEngine
+        from deepspeed_tpu.models.gpt2 import gpt2_loss_fn
+        cfg, params = tiny_gpt2()
+        engine, *_ = deepspeed_tpu.initialize(
+            model=gpt2_loss_fn(cfg, dtype=jnp.float32,
+                               deterministic=True),
+            model_parameters=params,
+            config={"train_micro_batch_size_per_gpu": 1,
+                    "gradient_accumulation_steps": 1,
+                    "steps_per_print": 10**9,
+                    "optimizer": {"type": "Adam",
+                                  "params": {"lr": 1e-3}}})
+        engine.save_checkpoint(str(tmp_path))
+        served = InferenceEngine.from_checkpoint(
+            str(tmp_path), cfg, inference_config=self.MESH_INF,
+            dtype=jnp.float32)
+        assert served.mesh is not None
+        from jax.sharding import PartitionSpec as P
+        assert served.params["h_0"]["mlp"]["fc_w"].sharding.spec == \
+            P(None, "model")
+        direct = InferenceEngine(cfg, params, TINY_INF,
+                                 dtype=jnp.float32)
+        prompts = [[1, 2, 3], [4, 5, 6, 7]]
+        assert served.generate(prompts, max_new_tokens=4) == \
+            direct.generate(prompts, max_new_tokens=4)
+
+    def test_mesh_rejects_indivisible_heads(self):
+        from deepspeed_tpu.inference import InferenceEngine
+        cfg, params = tiny_gpt2()          # 4 heads
+        with pytest.raises(ValueError, match="divide"):
+            InferenceEngine(cfg, params,
+                            dict(TINY_INF, mesh={"axes": {"model": 3}}),
+                            dtype=jnp.float32)
+
+
+# --------------------------------------------------------------------- #
+# new config keys
+# --------------------------------------------------------------------- #
+class TestPagedConfigSection:
+    def test_defaults(self):
+        from deepspeed_tpu.runtime.config import get_inference_config
+        cfg = get_inference_config({})
+        assert cfg["paged_kv"] == {"enabled": True, "page_size": 16,
+                                   "num_pages": 0, "prefix_cache": True}
+        assert cfg["mesh"] == {"axes": {}}
+        assert cfg["admit_lookahead"] == 4
+
+    def test_validation(self):
+        from deepspeed_tpu.runtime.config import (DeepSpeedConfigError,
+                                                  get_inference_config)
+        with pytest.raises(DeepSpeedConfigError, match="page_size"):
+            get_inference_config(
+                {"inference": {"paged_kv": {"page_size": 0}}})
+        with pytest.raises(DeepSpeedConfigError, match="num_pages"):
+            get_inference_config(
+                {"inference": {"paged_kv": {"num_pages": 1}}})
+        with pytest.raises(DeepSpeedConfigError, match="admit_lookahead"):
+            get_inference_config({"inference": {"admit_lookahead": -1}})
+        with pytest.raises(DeepSpeedConfigError, match="mesh.axes"):
+            get_inference_config(
+                {"inference": {"mesh": {"axes": {"model": 0}}}})
+        # unknown axis names fail HERE with a curated message, not as
+        # an opaque jax resource error deep in engine init
+        with pytest.raises(DeepSpeedConfigError, match="'model'"):
+            get_inference_config(
+                {"inference": {"mesh": {"axes": {"tp": 2}}}})
+
+    def test_auto_pool_matches_dense_worst_case(self):
+        from deepspeed_tpu.inference import InferenceEngine
+        cfg, params = tiny_gpt2()
+        engine = InferenceEngine(cfg, params, TINY_INF,
+                                 dtype=jnp.float32)
+        # max_batch_size 3, max_len 32, page_size 16 -> 3*2 + null
+        assert engine.paged_spec.num_pages == 7
+        assert engine.paged_spec.pages_per_seq == 2

@@ -60,6 +60,9 @@ T_TOK_LAT = "Serve/token_latency_ms"
 T_TPS = "Serve/tokens_per_sec"
 T_QDEPTH = "Serve/queue_depth"
 T_OCC = "Serve/batch_occupancy"
+T_KV_PAGES = "Serve/kv_pages_in_use"
+T_TOKENS_IN_FLIGHT = "Serve/tokens_in_flight"
+T_PREFIX_HIT = "Serve/prefix_hit_rate"
 
 # host gap above this fraction of step time flags the run: the device
 # is waiting on the host often enough to cost real throughput
@@ -190,6 +193,15 @@ def summarize(path, host_gap_threshold=DEFAULT_HOST_GAP_THRESHOLD):
                            "best": max(tps) if tps else None},
         "batch_occupancy_mean": (sum(occ) / len(occ)) if occ else None,
         "queue_depth_max": max(qdepth) if qdepth else None,
+    }
+    # paged-KV view (absent on dense-cache runs: no rows, keys -> None)
+    pages = _vals(scalars, T_KV_PAGES)
+    in_flight = _vals(scalars, T_TOKENS_IN_FLIGHT)
+    prefix_hit = _vals(scalars, T_PREFIX_HIT)
+    serving["paged_kv"] = {
+        "pages_in_use_peak": max(pages) if pages else None,
+        "tokens_in_flight_peak": max(in_flight) if in_flight else None,
+        "prefix_hit_rate": prefix_hit[-1] if prefix_hit else None,
     }
 
     ckpt = {"saves": 0, "loads": 0, "fallbacks": 0, "save_ms": []}
@@ -351,6 +363,15 @@ def render(s):
             f"queue_depth_max="
             f"{_fmt(sv['queue_depth_max'], '{:.0f}')}",
         ]
+        pk = sv.get("paged_kv") or {}
+        if pk.get("pages_in_use_peak") is not None:
+            lines.append(
+                f"    paged_kv        : "
+                f"pages_peak={_fmt(pk['pages_in_use_peak'], '{:.0f}')} "
+                f"tokens_in_flight_peak="
+                f"{_fmt(pk['tokens_in_flight_peak'], '{:.0f}')} "
+                f"prefix_hit_rate="
+                f"{_fmt(pk['prefix_hit_rate'], '{:.1%}')}")
     lines += [
         f"  memory            : "
         f"peak={_fmt_bytes(s['memory']['peak_bytes_in_use'])} "
