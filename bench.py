@@ -42,6 +42,20 @@ Metrics (BASELINE.md rows):
   engine's (acceptance: >= 2x); detail carries both engines' decode
   tokens/s, peak concurrency, prefix hit rate, and the paged engine's
   0-steady-state-recompile pin under the mixed-length churn
+- paged_decode_bytes : HARDWARE-FREE — serving-BANDWIDTH payoff of the
+  fused Pallas paged-decode kernel (ops/attention/paged.py): the
+  compiled pallas decode program is audited gather-free (no
+  max_len-sized stripe materialization; the gather fallback's program
+  shows the per-layer stripe gather as the contrast), and a bytes-read
+  cost model (live pages streamed vs the full table-width stripe, the
+  mfu_cost_model pattern) prices the mixed-length reference workload:
+  value = modeled pallas KiB/decode-step, vs_baseline = stripe bytes /
+  pallas bytes (ISSUE 8 acceptance: >= 2x reduction)
+- paged_decode_tokens_per_s : TPU — wall-clock decode tokens/s of the
+  serving engine with the compiled Pallas paged-decode kernel at a
+  TPU-legal geometry (head_dim 128), vs_baseline = pallas tokens/s /
+  the gather-fallback engine's at identical config; pins
+  0 steady-state recompiles for both (next hardware window)
 - bert_large_samples_per_s : BERT-large fused-layer training @ seq 128
   (reference: 272 samples/s on 1x V100, fastest-bert post :38-40)
 - bert_onebit_samples_per_s : BERT + 1-bit Adam in the compression
@@ -98,6 +112,8 @@ METRICS = [
     "host_dispatch_overhead",
     "decode_throughput",
     "paged_kv_occupancy",
+    "paged_decode_bytes",
+    "paged_decode_tokens_per_s",
     "bert_large_samples_per_s",
     "bert_onebit_samples_per_s",
     "sparse_attention_speedup_s8k",
@@ -109,7 +125,8 @@ HEADLINE = "gpt2_train_mfu"
 # 8-device CPU mesh in their child, runnable with the tunnel down
 HW_FREE = {"comm_wire_bytes_per_step", "comm_overlap_structure",
            "mfu_cost_model", "host_dispatch_overhead",
-           "decode_throughput", "paged_kv_occupancy"}
+           "decode_throughput", "paged_kv_occupancy",
+           "paged_decode_bytes"}
 
 PARTIAL_PATH = os.environ.get(
     "BENCH_PARTIAL", "/tmp/dstpu_bench_partial.jsonl")
@@ -1143,6 +1160,164 @@ def bench_paged_kv_occupancy(on_tpu, rtt):
                             "(hardware-free)"})
 
 
+def bench_paged_decode_bytes(on_tpu, rtt):
+    """Hardware-free row: decode-BANDWIDTH payoff of the fused Pallas
+    paged-attention kernel, pinned two independent ways (the
+    mfu_cost_model pattern: a structural compiled-program audit plus an
+    analytic cost model it cross-checks).
+
+    (1) HLO audit: compile the serving engine's paged decode program
+    with ``attn_kernel: "pallas"`` and with ``"gather"`` (CPU,
+    interpret-mode kernel — the same jaxpr structure the TPU program
+    partitions from) and walk both for ``gather`` instructions. The
+    gather program materializes each layer's
+    (rows, pages_per_seq, kv_heads, page_size, hd) stripe — a
+    max_len-bounded tensor; the pallas program must contain NO gather
+    that large (its pool reads are per-page dynamic slices).
+
+    (2) Bytes-read cost model: on the mixed-length reference workload
+    (the paged_kv_occupancy prompt mix mid-decode), model the K+V bytes
+    one decode step reads — live pages streamed (pallas) vs the full
+    table-width stripe (gather). value = modeled pallas KiB/step,
+    vs_baseline = stripe/pallas (acceptance >= 2x).
+    """
+    del on_tpu, rtt        # CPU-only compile + accounting, tiny model
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.inference import InferenceEngine
+    from deepspeed_tpu.models.gpt2 import GPT2Config, init_gpt2_params
+    from deepspeed_tpu.ops.attention.paged import decode_read_bytes
+    from deepspeed_tpu.utils.hlo_audit import max_gather_elems
+
+    cfg = GPT2Config(vocab_size=256, max_position_embeddings=128,
+                     hidden_size=64, num_layers=2, num_heads=4,
+                     embd_dropout=0.0, attn_dropout=0.0,
+                     resid_dropout=0.0)
+    params = init_gpt2_params(cfg, jax.random.PRNGKey(0))
+    max_len, ps, slots = 128, 16, 16
+    spec_cfg = {"max_batch_size": slots, "prompt_buckets": [8, 16],
+                "batch_buckets": [1, 4, 16], "max_seq_len": max_len,
+                "max_new_tokens": 16}
+
+    def decode_hlo(attn_kernel):
+        eng = InferenceEngine(cfg, params, dict(
+            spec_cfg, paged_kv={"page_size": ps,
+                                "attn_kernel": attn_kernel}),
+            dtype=jnp.float32)
+        rows = eng.num_slots + 1
+        pps = eng.paged_spec.pages_per_seq
+        args = (eng.params, eng._cache,
+                jnp.zeros((rows,), jnp.int32),
+                jnp.zeros((rows,), jnp.int32),
+                jnp.zeros((rows, pps), jnp.int32),
+                jnp.zeros((rows, 2), jnp.uint32),
+                jnp.zeros((rows,), jnp.float32))
+        spec = eng.paged_spec
+        hlo = jax.jit(eng._decode_paged_impl).lower(
+            *args).compile().as_text()
+        return hlo, spec
+    hlo_pallas, spec = decode_hlo("pallas")
+    _beat()
+    hlo_gather, _ = decode_hlo("gather")
+    _beat()
+
+    # one layer's stripe: every table entry's page for every row
+    stripe_elems = ((slots + 1) * spec.pages_per_seq * spec.kv_heads
+                    * spec.page_size * spec.head_dim)
+    pallas_max = max_gather_elems(hlo_pallas)
+    gather_max = max_gather_elems(hlo_gather)
+    pallas_gather_free = pallas_max < stripe_elems
+    gather_shows_stripe = gather_max >= stripe_elems
+
+    # mixed-length reference workload: the paged_kv_occupancy prompt mix
+    # mid-decode (each request 8 tokens into its generation)
+    lens = (5, 9, 14, 3, 16, 7, 12, 4, 10, 6, 15, 8, 5, 11, 3, 13)
+    positions = [l + 8 for l in lens]
+    pallas_bytes, gather_bytes = decode_read_bytes(
+        positions, ps, spec.pages_per_seq, spec.kv_heads,
+        spec.head_dim, dtype_bytes=2)          # priced at bf16 serving
+    pallas_bytes *= spec.num_layers
+    gather_bytes *= spec.num_layers
+    reduction = gather_bytes / pallas_bytes if pallas_bytes else 0.0
+    return _emit(
+        "paged_decode_bytes", round(pallas_bytes / 1024, 2),
+        "modeled_kib_per_decode_step",
+        round(reduction, 3),
+        {"pallas_gather_free": bool(pallas_gather_free),
+         "gather_shows_stripe": bool(gather_shows_stripe),
+         "max_gather_elems": {"pallas": int(pallas_max),
+                              "gather": int(gather_max)},
+         "stripe_elems_per_layer": int(stripe_elems),
+         "modeled_bytes_per_step": {"pallas": int(pallas_bytes),
+                                    "gather_stripe": int(gather_bytes)},
+         "workload_positions": positions, "page_size": ps,
+         "pages_per_seq": spec.pages_per_seq,
+         "backend": jax.default_backend(),
+         "source": "compiled-HLO gather audit + live-page bytes cost "
+                   "model (hardware-free)"})
+
+
+def bench_paged_decode_tokens_per_s(on_tpu, rtt):
+    """TPU ladder row (next hardware window): wall-clock decode
+    tokens/s of the serving engine running the COMPILED Pallas
+    paged-decode kernel, vs the gather-fallback engine at identical
+    config. Geometry is TPU-legal for the kernel (head_dim 128,
+    page_size 16); both engines must hold 0 steady-state recompiles.
+    On a non-TPU backend the kernel runs interpret mode — the row is
+    then a functional pin, not a perf number (backend in detail).
+    """
+    del rtt
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.inference import InferenceEngine
+    from deepspeed_tpu.models.gpt2 import GPT2Config, init_gpt2_params
+
+    cfg = GPT2Config(vocab_size=256, max_position_embeddings=512,
+                     hidden_size=512, num_layers=2, num_heads=4,
+                     embd_dropout=0.0, attn_dropout=0.0,
+                     resid_dropout=0.0)          # head_dim 128
+    params = init_gpt2_params(cfg, jax.random.PRNGKey(0))
+    new_tokens = 64
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, 256, (l,)).tolist()
+               for l in (5, 8, 13, 3, 16, 7, 11, 4)]
+
+    def serve(attn_kernel):
+        eng = InferenceEngine(cfg, params, {
+            "max_batch_size": 8, "prompt_buckets": [16],
+            "batch_buckets": [8], "max_seq_len": 256,
+            "max_new_tokens": new_tokens,
+            "paged_kv": {"page_size": 16,
+                         "attn_kernel": attn_kernel}}, dtype=dtype)
+        path = eng._decode_attn_path
+        eng.warmup()
+        _beat()
+        t0 = time.perf_counter()
+        outs = eng.generate(prompts, max_new_tokens=new_tokens,
+                            temperature=0.0)
+        wall = time.perf_counter() - t0
+        gen = sum(len(o) - len(p) for o, p in zip(outs, prompts))
+        return gen / wall, path, eng.steady_state_recompiles, outs
+    pallas_tps, pallas_path, pallas_rc, pallas_outs = serve("pallas")
+    gather_tps, _, gather_rc, gather_outs = serve("gather")
+    _beat()
+    return _emit(
+        "paged_decode_tokens_per_s", round(pallas_tps, 2),
+        "tokens_per_s",
+        round(pallas_tps / gather_tps, 3) if gather_tps > 0 else 0.0,
+        {"gather_tokens_per_s": round(gather_tps, 2),
+         "decode_attn_path": pallas_path,
+         "steady_state_recompiles": {"pallas": pallas_rc,
+                                     "gather": gather_rc},
+         "greedy_outputs_match_gather": bool(pallas_outs == gather_outs),
+         "new_tokens": new_tokens, "requests": len(prompts),
+         "hbm_peak_mb": _hbm_peak_mb(),
+         "backend": jax.default_backend(),
+         "source": "inference engine wall clock, pallas vs gather "
+                   "decode"})
+
+
 # ------------------------------------------------------------- child mode
 
 
@@ -1199,6 +1374,10 @@ def run_child(metric):
         bench_decode_throughput(on_tpu, rtt)
     elif metric == "paged_kv_occupancy":
         bench_paged_kv_occupancy(on_tpu, rtt)
+    elif metric == "paged_decode_bytes":
+        bench_paged_decode_bytes(on_tpu, rtt)
+    elif metric == "paged_decode_tokens_per_s":
+        bench_paged_decode_tokens_per_s(on_tpu, rtt)
     elif metric == "bert_large_samples_per_s":
         bench_bert_large(on_tpu, rtt)
     elif metric == "bert_onebit_samples_per_s":

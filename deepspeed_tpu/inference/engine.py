@@ -21,6 +21,19 @@ TPU-first:
   programs never see it. ``paged_kv.enabled: false`` restores the dense
   slot x max_len cache (the PR-5 layout, kept as the parity/bench
   baseline).
+- **Fused paged-decode attention (default).** The decode step computes
+  attention *directly against the page pool* through the Pallas
+  paged-attention kernel (``ops/attention/paged.py``): block tables in
+  SMEM drive per-sequence page walks, only each row's live pages are
+  streamed (double-buffered DMA), so per-step decode reads are O(live
+  tokens) instead of the ``max_len``-bounded stripe the gather path
+  materializes. ``paged_kv.attn_kernel: "gather"`` pins the stripe
+  path (the numerics oracle); unsupported geometries fall back to it
+  automatically with a one-line log and a ``Serve/decode_attn_path``
+  telemetry tag. The decode dispatch additionally clamps its block
+  tables to the batch's live-page bucket
+  (``paged_kv.decode_page_buckets``), so even the gather fallback
+  stops paying full ``max_len`` bandwidth.
 - **Bucketed shapes.** Prompts pad to configured ``prompt_buckets`` and
   prefill batches to ``batch_buckets`` (inference/buckets.py), so
   steady-state serving dispatches exactly
@@ -63,7 +76,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from deepspeed_tpu.inference.buckets import pad_prompts, warmup_plan
+from deepspeed_tpu.inference.buckets import (pad_prompts, pick_bucket,
+                                             warmup_plan)
 from deepspeed_tpu.inference.kv_cache import (PageAllocator, cache_spec_for,
                                               init_kv_cache,
                                               init_paged_kv_cache,
@@ -222,6 +236,9 @@ class InferenceEngine:
         pk = cfg["paged_kv"]
         self.paged = bool(pk["enabled"])
         allocator = None
+        self._decode_attn_path = None          # "pallas" | "gather" (paged)
+        self._decode_attn_reason = None
+        self._decode_page_buckets = ()
         if self.paged:
             ps = pk["page_size"]
             # auto pool: the dense-equivalent worst case (+ null page) —
@@ -236,6 +253,7 @@ class InferenceEngine:
             allocator = PageAllocator(num_pages, ps,
                                       prefix_cache=pk["prefix_cache"])
             cache_bytes = paged_kv_bytes(self.paged_spec)
+            self._resolve_decode_attn(pk)
         else:
             self.paged_spec = None
             self.cache_spec = cache_spec_for(model_config, self._rows,
@@ -275,7 +293,21 @@ class InferenceEngine:
             geom = (f"paged KV cache: {self.paged_spec.num_pages} pages "
                     f"x {self.paged_spec.page_size} tokens "
                     f"({cache_bytes / 2**20:.1f} MiB), prefix cache "
-                    f"{'on' if pk['prefix_cache'] else 'off'}")
+                    f"{'on' if pk['prefix_cache'] else 'off'}, "
+                    f"decode attn {self._decode_attn_path}")
+            # the which-decode-attention-compiled line (PR 6's
+            # which-exchange pattern): a silent fallback to the
+            # stripe-gather path must be visible in logs + run reports
+            logger.info(
+                f"inference decode attention: {self._decode_attn_path} "
+                f"({self._decode_attn_reason}; page walk widths "
+                f"{list(self._decode_page_buckets)})")
+            if self._log is not None:
+                self._log.add_event(
+                    "decode_attn_path", path=self._decode_attn_path,
+                    reason=self._decode_attn_reason,
+                    requested=pk["attn_kernel"],
+                    decode_page_buckets=list(self._decode_page_buckets))
         else:
             self._prefill = self._wrap_program(
                 self._prefill_impl, 7, "prefill")
@@ -289,6 +321,47 @@ class InferenceEngine:
             f"inference engine: {self.family}, {self.num_slots} slots, "
             f"max_len {max_len}, prompt buckets {cfg['prompt_buckets']}, "
             f"batch buckets {cfg['batch_buckets']}, {geom}{mesh_note}")
+
+    def _resolve_decode_attn(self, pk):
+        """Pick the paged decode attention path once, at init (the
+        compiled program set is fixed, so the choice is too):
+        ``attn_kernel: "pallas"`` runs the fused paged-attention Pallas
+        kernel (``ops/attention/paged.py`` — O(live tokens) pool reads)
+        wherever it can compile, with the stripe-gather path as the
+        automatic fallback; ``"gather"`` pins the fallback. Also
+        resolves the decode table-width buckets: the decode dispatch
+        clamps its block tables to the smallest bucket covering the
+        batch's live pages, so the gather fallback's bandwidth scales
+        with tokens in flight too (one compiled decode program per
+        width; default = a single full-width program, preserving the
+        PR 5/7 warmup program count)."""
+        from deepspeed_tpu.ops.attention.paged import \
+            paged_decode_supported
+        requested = pk["attn_kernel"]
+        if requested != "pallas":
+            self._decode_attn_path = "gather"
+            self._decode_attn_reason = "configured"
+        elif self.mesh is not None:
+            # a pallas_call can't be auto-partitioned by GSPMD; until
+            # the kernel is shard_mapped over kv_heads, sharded serving
+            # stays on the gather path (docs/inference.md fallback
+            # matrix)
+            self._decode_attn_path = "gather"
+            self._decode_attn_reason = ("serving mesh: pallas decode "
+                                        "pending shard_map wrap")
+        else:
+            ok, why = paged_decode_supported(
+                self.paged_spec.page_size, self.paged_spec.head_dim,
+                dtype=self.paged_spec.dtype)
+            if ok:
+                self._decode_attn_path = "pallas"
+                self._decode_attn_reason = why
+            else:
+                self._decode_attn_path = "gather"
+                self._decode_attn_reason = f"pallas unsupported: {why}"
+        pps = self.paged_spec.pages_per_seq
+        widths = [int(b) for b in pk["decode_page_buckets"] if b < pps]
+        self._decode_page_buckets = tuple(widths) + (pps,)
 
     def _wrap_program(self, fn, nargs: int, name: str):
         """jit + CompileTracker wrap; with a serving mesh, pin GSPMD
@@ -374,7 +447,8 @@ class InferenceEngine:
         logits, cache = self._forward(
             params, self.model_config, ids, dtype=self.dtype,
             kv_cache=cache, cache_position=positions,
-            block_tables=tables)
+            block_tables=tables,
+            paged_attn_kernel=self._decode_attn_path)
         last = logits[jnp.arange(Bb), lengths - 1]          # (Bb, V)
         first_keys = jax.vmap(jax.random.fold_in)(keys,
                                                   positions + lengths)
@@ -385,13 +459,19 @@ class InferenceEngine:
                            keys, temps):
         """One PAGED decode step over the full slot table: each slot's
         pending token scatters into its block table's page at its own
-        position; attention gathers the slot's logical stripe back from
-        the pool. Inactive rows carry all-null tables — garbage in,
-        garbage discarded."""
+        position; attention then runs straight off the pool — the
+        fused Pallas paged kernel walks only each row's live pages
+        (``_decode_attn_path == "pallas"``), or the gather fallback
+        assembles the table-width stripe. The table WIDTH is the
+        dispatch's live-page bucket (one compiled program per width),
+        so even the fallback's reads scale with tokens in flight.
+        Inactive rows carry all-null tables — garbage in, garbage
+        discarded."""
         logits, cache = self._forward(
             params, self.model_config, toks[:, None], dtype=self.dtype,
             kv_cache=cache, cache_position=positions,
-            block_tables=tables)
+            block_tables=tables,
+            paged_attn_kernel=self._decode_attn_path)
         step_keys = jax.vmap(jax.random.fold_in)(keys, positions + 1)
         nxt = self._sample_tokens(logits[:, 0], step_keys, temps)
         return nxt, cache
@@ -489,8 +569,15 @@ class InferenceEngine:
             t0 = time.perf_counter()
             with trace_span("serve/decode", active=len(sids)):
                 if self.paged:
-                    tables = sched.block_table_rows(
-                        self._rows, self.paged_spec.pages_per_seq)
+                    # clamp the dispatch's table width to the batch's
+                    # live-page bucket: reads (kernel walk or gather
+                    # stripe) scale with tokens in flight, and every
+                    # width was compiled at warmup
+                    width = pick_bucket(
+                        min(sched.max_live_pages(),
+                            self.paged_spec.pages_per_seq),
+                        self._decode_page_buckets)
+                    tables = sched.block_table_rows(self._rows, width)
                     nxt, self._cache = self._decode(
                         self.params, self._cache, jnp.asarray(toks_a),
                         jnp.asarray(poss_a), jnp.asarray(tables),
@@ -516,7 +603,10 @@ class InferenceEngine:
                     kv_pages_in_use=alloc.pages_in_use,
                     tokens_in_flight=sched.tokens_in_flight,
                     prefix_hit_rate=(alloc.prefix_hit_tokens / seen
-                                     if seen else 0.0))
+                                     if seen else 0.0),
+                    decode_attn_path=(
+                        1.0 if self._decode_attn_path == "pallas"
+                        else 0.0))
             self.monitor.write_serving_metrics(
                 token_latency_ms=tok_ms, tokens_per_sec=tps,
                 queue_depth=sched.queue_depth, batch_occupancy=occupancy,
@@ -570,12 +660,14 @@ class InferenceEngine:
     # ----------------------------------------------------------- warmup
     def warmup(self):
         """Compile the steady-state program set: one prefill per
-        (batch bucket, prompt bucket) pair + the decode program, all
-        against scratch state (the dense scratch row / the paged null
-        page — the live cache stays untouched where it matters; must run
-        while no requests are in flight). After this,
-        :attr:`steady_state_recompiles` staying 0 is the serving latency
-        contract."""
+        (batch bucket, prompt bucket) pair + one decode program per
+        decode table-width bucket (exactly ONE at the default
+        full-width ``decode_page_buckets: []`` — the PR 5/7 program
+        count), all against scratch state (the dense scratch row / the
+        paged null page — the live cache stays untouched where it
+        matters; must run while no requests are in flight). After
+        this, :attr:`steady_state_recompiles` staying 0 is the serving
+        latency contract."""
         assert self.scheduler.idle(), "warmup with requests in flight"
         for bb, sb in warmup_plan(self.config["batch_buckets"],
                                   self.config["prompt_buckets"]):
@@ -598,14 +690,14 @@ class InferenceEngine:
                     jnp.asarray(lengths), jnp.asarray(slots),
                     jnp.asarray(keys), jnp.asarray(temps))
         if self.paged:
-            nxt, self._cache = self._decode(
-                self.params, self._cache,
-                jnp.zeros((self._rows,), jnp.int32),
-                jnp.zeros((self._rows,), jnp.int32),
-                jnp.zeros((self._rows, self.paged_spec.pages_per_seq),
-                          jnp.int32),
-                jnp.zeros((self._rows, 2), jnp.uint32),
-                jnp.zeros((self._rows,), jnp.float32))
+            for w in self._decode_page_buckets:
+                nxt, self._cache = self._decode(
+                    self.params, self._cache,
+                    jnp.zeros((self._rows,), jnp.int32),
+                    jnp.zeros((self._rows,), jnp.int32),
+                    jnp.zeros((self._rows, w), jnp.int32),
+                    jnp.zeros((self._rows, 2), jnp.uint32),
+                    jnp.zeros((self._rows,), jnp.float32))
         else:
             nxt, self._cache = self._decode(
                 self.params, self._cache,

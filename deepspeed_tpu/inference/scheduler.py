@@ -387,9 +387,26 @@ class Scheduler:
         """The decode dispatch's static-shape block tables: one
         (rows, pages_per_seq) int32 array, active slots' pages in their
         rows, everything else 0 (the null page — inactive rows write
-        and read only garbage the mask hides)."""
+        and read only garbage the mask hides). ``pages_per_seq`` may be
+        NARROWER than a slot's full reservation (the engine's
+        live-page-bucketed decode width): the tail entries dropped are
+        reserved-but-unreached pages this step can neither write nor
+        read, so the clamp is exact."""
         out = np.zeros((rows, pages_per_seq), np.int32)
         for sid in self.active_slots():
-            pages = self.slots[sid].pages
+            pages = self.slots[sid].pages[:pages_per_seq]
             out[sid, :len(pages)] = pages
         return out
+
+    def max_live_pages(self) -> int:
+        """Widest live page count across active slots for ONE decode
+        step: slot at ``position`` writes its pending token at
+        ``position`` and attends positions ``<= position`` —
+        ``position // page_size + 1`` pages. The engine buckets this up
+        to a compiled decode width (never below 1: an idle table still
+        needs its null column)."""
+        if self.allocator is None:
+            return 1
+        ps = self.allocator.page_size
+        return max((s.position // ps + 1
+                    for s in self.slots if s is not None), default=1)

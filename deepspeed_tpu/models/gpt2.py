@@ -328,7 +328,8 @@ def _gpt2_trunk(params, config: GPT2Config, input_ids, rng=None,
 
 
 def _gpt2_trunk_cached(params, config: GPT2Config, input_ids, kv_cache,
-                       cache_position, dtype, block_tables=None):
+                       cache_position, dtype, block_tables=None,
+                       paged_attn_kernel: str = "gather"):
     """Cache-carrying trunk: run ``input_ids`` (B, S) through the SAME
     gpt2_block as training with attention over the provided KV cache
     (``kv_cache = (kc, vc)``, each (layers, B, heads, max_len, hd)),
@@ -340,8 +341,10 @@ def _gpt2_trunk_cached(params, config: GPT2Config, input_ids, kv_cache,
 
     With ``block_tables`` ((B, pages_per_seq) int32) the cache is the
     PAGED pool pair (each (layers, num_pages, heads, page_size, hd)) and
-    attention runs the scatter/gather paged path
-    (:func:`_paged_cache_attention`) — same block, same mask."""
+    attention runs the paged path (:func:`_paged_cache_attention`) —
+    same block, same mask; ``paged_attn_kernel`` picks the fused Pallas
+    decode kernel ("pallas") or the gather oracle ("gather") for seq-1
+    queries."""
     kc, vc = kv_cache
     B, S = input_ids.shape
     pos = cache_position[:, None] + jnp.arange(S)[None, :]
@@ -351,7 +354,8 @@ def _gpt2_trunk_cached(params, config: GPT2Config, input_ids, kv_cache,
         box = []
         if block_tables is not None:
             attn = _paged_cache_attention(kc[i], vc[i], block_tables,
-                                          cache_position, box)
+                                          cache_position, box,
+                                          attn_kernel=paged_attn_kernel)
         else:
             attn = _offset_cache_attention(kc[i], vc[i], cache_position,
                                            box)
@@ -367,7 +371,7 @@ def _gpt2_trunk_cached(params, config: GPT2Config, input_ids, kv_cache,
 def gpt2_forward(params, config: GPT2Config, input_ids, rng=None,
                  deterministic: bool = True, dtype=jnp.bfloat16,
                  remat: bool = False, kv_cache=None, cache_position=None,
-                 block_tables=None):
+                 block_tables=None, paged_attn_kernel: str = "gather"):
     """Logits (B, S, vocab). Embedding output layer is tied to wte.
 
     KV-cache mode (serving): with ``kv_cache=(kc, vc)`` (each
@@ -377,14 +381,18 @@ def gpt2_forward(params, config: GPT2Config, input_ids, rng=None,
     :func:`causal_cache_mask`, and returns ``(logits, updated_cache)``
     instead of bare logits. ``block_tables`` ((B, pages_per_seq) int32)
     switches the cache interpretation to the paged pool pair (each
-    ``(layers, num_pages, heads, page_size, hd)``). The training call
-    signature is unchanged (all three arguments default to None)."""
+    ``(layers, num_pages, heads, page_size, hd)``);
+    ``paged_attn_kernel="pallas"`` routes seq-1 queries through the
+    fused Pallas paged-decode kernel instead of the stripe gather. The
+    training call signature is unchanged (the serving arguments all
+    default off)."""
     if kv_cache is not None:
         if cache_position is None:
             cache_position = jnp.zeros((input_ids.shape[0],), jnp.int32)
         x, cache = _gpt2_trunk_cached(params, config, input_ids, kv_cache,
                                       cache_position, dtype,
-                                      block_tables=block_tables)
+                                      block_tables=block_tables,
+                                      paged_attn_kernel=paged_attn_kernel)
         return _tied_logits(x, params["wte"], dtype), cache
     x = _gpt2_trunk(params, config, input_ids, rng=rng,
                     deterministic=deterministic, dtype=dtype, remat=remat)
@@ -518,33 +526,52 @@ def gather_paged_kv(pool, block_table):
     position, so :func:`causal_cache_mask` applies unchanged — unmapped
     table entries surface the null page, always masked.
 
-    NB: this materializes each row's full logical stripe
-    (``pages_per_seq * page_size >= max_len`` positions) every call, so
-    at the XLA level the paged path's per-step decode reads stay
-    bounded by ``max_len`` — like the dense path, plus the gather copy
-    unless XLA fuses it into the contraction. Paging's win is
-    *occupancy/capacity* (HBM bounded by tokens reserved in flight, and
-    prefix pages shared), not per-step decode bandwidth; collapsing the
-    gather into a fused paged-attention Pallas kernel is ROADMAP item
-    2."""
+    NB: this materializes each row's full logical stripe (every table
+    entry it is handed) each call — per-step decode reads are bounded
+    by the TABLE WIDTH, not the tokens actually live. It serves as the
+    paged paths' numerics oracle and as the fallback where the fused
+    Pallas decode kernel (``ops/attention/paged.py`` — reads only live
+    pages, O(live tokens)) can't run; the serving engine additionally
+    clamps the decode table width to the batch's live page bucket so
+    even this fallback stops paying full ``max_len`` bandwidth
+    (``inference.paged_kv.decode_page_buckets``)."""
     B, P = block_table.shape
     _, H, ps, hd = pool.shape
     return pool[block_table].transpose(0, 2, 1, 3, 4).reshape(
         B, H, P * ps, hd)
 
 
+def paged_decode_ctx(q, kpool, vpool, block_table, cache_position):
+    """The seq-1 fused-kernel dispatch both families share: run
+    :func:`deepspeed_tpu.ops.attention.paged.paged_decode_attention`
+    against the (already-written) pool and restore the (B, H, 1, hd)
+    context layout. One home so the kernel call contract cannot drift
+    between gpt2 and llama."""
+    from deepspeed_tpu.ops.attention.paged import paged_decode_attention
+    out = paged_decode_attention(q[:, :, 0], kpool, vpool, block_table,
+                                 cache_position)
+    return out[:, :, None, :]
+
+
 def _paged_cache_attention(kpool, vpool, block_table, cache_position,
-                           out_box):
+                           out_box, attn_kernel: str = "gather"):
     """attention_fn for the paged cached forward (prefill-into-pages and
     paged decode alike): scatter this call's K/V into the page pool via
-    the block table, gather each row's logical stripe back, attend under
-    the shared ``causal_cache_mask``. Updated pools return through
-    ``out_box``."""
+    the block table, then attend. Single-query calls (decode — and any
+    seq-1 prefill bucket) with ``attn_kernel="pallas"`` run the fused
+    paged-attention kernel straight against the pool
+    (:func:`paged_decode_ctx` — only live pages are read); everything
+    else gathers each row's logical stripe back and attends under the
+    shared ``causal_cache_mask`` (the numerics oracle / fallback).
+    Updated pools return through ``out_box``."""
     def attn(q, k, v, rate, rng):
         del rate, rng                  # cached forward is deterministic
         kp = write_paged_kv_cache(kpool, k, block_table, cache_position)
         vp = write_paged_kv_cache(vpool, v, block_table, cache_position)
         out_box.append((kp, vp))
+        if attn_kernel == "pallas" and q.shape[2] == 1:
+            return paged_decode_ctx(q, kp, vp, block_table,
+                                    cache_position)
         kc = gather_paged_kv(kp, block_table)
         vc = gather_paged_kv(vp, block_table)
         hd = q.shape[-1]

@@ -240,20 +240,29 @@ def _gqa_offset_cache_attention(kcache, vcache, cache_position, out_box):
 
 
 def _gqa_paged_cache_attention(kpool, vpool, block_table, cache_position,
-                               out_box):
+                               out_box, attn_kernel: str = "gather"):
     """Paged attention_fn for the cached llama forward: scatter this
     call's post-RoPE K/V into the kv_heads-sized page pool via the block
-    table (``gpt2.write_paged_kv_cache``), gather each row's logical
-    stripe back, attend group-wise under the shared
-    ``causal_cache_mask``. Updated pools return through ``out_box``."""
+    table (``gpt2.write_paged_kv_cache``), then attend. Single-query
+    calls with ``attn_kernel="pallas"`` run the fused paged-decode
+    kernel, which serves GQA natively — the q_heads/kv_heads query rows
+    of each group share their kv head's page stream inside the kernel,
+    so no head replication ever materializes. Otherwise gather each
+    row's logical stripe back and attend group-wise under the shared
+    ``causal_cache_mask`` (the oracle/fallback). Updated pools return
+    through ``out_box``."""
     from deepspeed_tpu.models.gpt2 import (causal_cache_mask,
                                            gather_paged_kv,
+                                           paged_decode_ctx,
                                            write_paged_kv_cache)
 
     def attn(q, k, v):
         kp = write_paged_kv_cache(kpool, k, block_table, cache_position)
         vp = write_paged_kv_cache(vpool, v, block_table, cache_position)
         out_box.append((kp, vp))
+        if attn_kernel == "pallas" and q.shape[2] == 1:
+            return paged_decode_ctx(q, kp, vp, block_table,
+                                    cache_position)
         kc = gather_paged_kv(kp, block_table)
         vc = gather_paged_kv(vp, block_table)
         B, H, S, hd = q.shape
@@ -271,13 +280,16 @@ def _gqa_paged_cache_attention(kpool, vpool, block_table, cache_position,
 
 
 def _llama_trunk_cached(params, config: LlamaConfig, input_ids, kv_cache,
-                        cache_position, dtype, block_tables=None):
+                        cache_position, dtype, block_tables=None,
+                        paged_attn_kernel: str = "gather"):
     """Cache-carrying trunk (see gpt2._gpt2_trunk_cached): one code path
     for prefill-into-cache and decode, through the SAME llama_block as
     training. RoPE angles are gathered per row at each token's absolute
     position. Returns (hidden states after ln_f, updated kv_cache).
     ``block_tables`` switches to the paged pool pair (each
-    (layers, num_pages, kv_heads, page_size, hd))."""
+    (layers, num_pages, kv_heads, page_size, hd));
+    ``paged_attn_kernel`` picks the fused Pallas decode kernel or the
+    gather oracle for seq-1 queries."""
     from deepspeed_tpu.models.gpt2 import layer_params
     kc, vc = kv_cache
     B, S = input_ids.shape
@@ -294,8 +306,9 @@ def _llama_trunk_cached(params, config: LlamaConfig, input_ids, kv_cache,
     for i in range(config.num_layers):
         box = []
         if block_tables is not None:
-            attn = _gqa_paged_cache_attention(kc[i], vc[i], block_tables,
-                                              cache_position, box)
+            attn = _gqa_paged_cache_attention(
+                kc[i], vc[i], block_tables, cache_position, box,
+                attn_kernel=paged_attn_kernel)
         else:
             attn = _gqa_offset_cache_attention(kc[i], vc[i],
                                                cache_position, box)
@@ -310,7 +323,8 @@ def _llama_trunk_cached(params, config: LlamaConfig, input_ids, kv_cache,
 
 def llama_forward(params, config: LlamaConfig, input_ids,
                   dtype=jnp.bfloat16, remat: bool = False,
-                  kv_cache=None, cache_position=None, block_tables=None):
+                  kv_cache=None, cache_position=None, block_tables=None,
+                  paged_attn_kernel: str = "gather"):
     """Logits (B, S, vocab).
 
     KV-cache mode (serving): with ``kv_cache=(kc, vc)`` (each
@@ -318,15 +332,17 @@ def llama_forward(params, config: LlamaConfig, input_ids,
     ((B,) int32), writes this call's K/V at each row's offset and
     returns ``(logits, updated_cache)`` — same contract as
     :func:`deepspeed_tpu.models.gpt2.gpt2_forward`, including the
-    paged-pool interpretation under ``block_tables``. Training call
-    signature unchanged."""
+    paged-pool interpretation under ``block_tables`` and the
+    ``paged_attn_kernel`` fused-decode switch. Training call signature
+    unchanged."""
     from deepspeed_tpu.models.gpt2 import _tied_logits
     if kv_cache is not None:
         if cache_position is None:
             cache_position = jnp.zeros((input_ids.shape[0],), jnp.int32)
         x, cache = _llama_trunk_cached(params, config, input_ids,
                                        kv_cache, cache_position, dtype,
-                                       block_tables=block_tables)
+                                       block_tables=block_tables,
+                                       paged_attn_kernel=paged_attn_kernel)
         return _tied_logits(x, params["lm_head"], dtype), cache
     x = _llama_trunk(params, config, input_ids, dtype=dtype, remat=remat)
     return _tied_logits(x, params["lm_head"], dtype)

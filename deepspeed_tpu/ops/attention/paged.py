@@ -1,0 +1,343 @@
+"""Pallas paged-attention decode kernel — serve from pages in place.
+
+The paged KV cache (PR 7, ``inference/kv_cache.py``) made serving
+*capacity* paged, but the decode step still materialized each row's
+full ``max_len``-bounded K/V stripe through
+:func:`~deepspeed_tpu.models.gpt2.gather_paged_kv` before running dense
+attention — per-step decode bandwidth stayed O(max_len) regardless of
+how many tokens were actually in flight. This module is the missing
+half of that design (vLLM's PagedAttention, PAPERS.md, fused with the
+flash online-softmax core this repo already carries in
+``ops/attention/flash.py``): a Pallas TPU kernel that computes decode
+attention *directly against the page pool*, so a row at cache position
+``p`` reads exactly its ``p // page_size + 1`` live pages — O(live
+tokens), not O(max_len).
+
+Design:
+
+- **Grid** ``(batch, kv_heads)``. Each program owns one sequence's
+  page walk for one kv head; the ``q_heads / kv_heads`` query rows of
+  that head's GQA group ride in the program's q block — K/V pages are
+  read once per group, never replicated per q head (llama serves with
+  no head expansion).
+- **Block tables in SMEM.** The per-slot block tables and cache
+  positions enter through ``PrefetchScalarGridSpec`` scalar prefetch,
+  so page ids are available to index DMAs before the kernel body runs.
+  The page walk is bounded by each row's OWN live page count — the
+  kernel never touches reserved-but-unwritten pages.
+- **Double-buffered DMA.** K and V page tiles stream
+  ``pool[page_id, kv_head]`` → VMEM through 2-deep async-copy buffers
+  (``flash.py``'s streaming idiom): page ``i+1``'s copy is issued
+  before page ``i`` is consumed — 2 tiles of VMEM per stream at any
+  pool size.
+- **Online softmax in fp32.** Running (m, l, acc) across the page walk,
+  MXU dots take the pool dtype (bf16 in production) with fp32
+  accumulation — the flash kernels' precision. Positions past the
+  row's cache position AND anything mapped to the reserved null page 0
+  are masked *inside* the kernel, so the all-null tables of inactive
+  slots produce finite garbage (discarded by the host) rather than
+  NaN.
+
+The same kernel runs under ``interpret=True`` on CPU — scalar
+prefetch, HBM refs, dynamic-index async copies and semaphores are all
+interpretable — which is what makes exact greedy parity against the
+gather path tier-1-testable without hardware
+(tests/unit/test_paged_attention.py).
+
+Compiled-TPU legality: Mosaic requires the DMA tile's lane (minor) dim
+to be 128-aligned; the streamed tile is ``(page_size, head_dim)``, so
+the compiled path needs ``head_dim % 128 == 0`` (plus a sublane-tile
+page size). :func:`paged_decode_supported` is the one predicate the
+serving engine consults; unsupported geometries fall back to the
+gather path with a one-line log (see docs/inference.md's fallback
+matrix) — the gather path remains the numerics oracle either way.
+"""
+
+import functools
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+from deepspeed_tpu.ops.attention.flash import NEG_INF
+
+__all__ = ["paged_decode_attention", "paged_decode_reference",
+           "paged_decode_supported", "decode_read_bytes",
+           "live_pages"]
+
+
+def live_pages(cache_position, page_size: int):
+    """Pages a row at ``cache_position`` (its just-written token's
+    position) actually reads: positions ``0..cache_position`` span
+    ``cache_position // page_size + 1`` pages. Works on ints and
+    arrays."""
+    return cache_position // page_size + 1
+
+
+def paged_decode_supported(page_size: int, head_dim: int,
+                           dtype=jnp.bfloat16,
+                           backend: Optional[str] = None
+                           ) -> Tuple[bool, str]:
+    """Can the Pallas decode kernel run for this cache geometry on this
+    backend? Returns ``(ok, reason)`` — the one predicate the serving
+    engine consults before compiling the paged decode program.
+
+    Off-TPU the kernel runs in interpret mode (pure jax semantics, no
+    layout constraints) — always supported. On TPU the DMA tile is
+    ``(page_size, head_dim)``: Mosaic needs the lane dim 128-aligned
+    (``head_dim % 128``) and the sublane dim a full tile
+    (8 fp32 / 16 bf16 rows), so small pages or narrow heads fall back
+    to the gather path.
+    """
+    if pltpu is None:
+        return False, "pallas tpu backend unavailable"
+    if backend is None:
+        try:
+            backend = jax.default_backend()
+        except Exception:
+            backend = "cpu"
+    if backend != "tpu":
+        return True, "interpret mode (CPU oracle path)"
+    if head_dim % 128 != 0:
+        return False, (f"head_dim {head_dim} not a multiple of 128 "
+                       "(DMA lane dim)")
+    sublane = 16 if jnp.dtype(dtype).itemsize < 4 else 8
+    if page_size % sublane != 0:
+        return False, (f"page_size {page_size} not a multiple of the "
+                       f"{sublane}-row sublane tile for "
+                       f"{jnp.dtype(dtype).name}")
+    return True, "compiled pallas kernel"
+
+
+def decode_read_bytes(cache_positions: Sequence[int], page_size: int,
+                      pages_per_seq: int, kv_heads: int, head_dim: int,
+                      dtype_bytes: int = 2):
+    """Modeled K+V bytes one decode step reads from the pool, paged
+    kernel vs gather stripe — the ``paged_decode_bytes`` bench row's
+    cost model (mfu_cost_model pattern: analytic accounting that the
+    compiled-HLO audit cross-checks structurally).
+
+    The kernel reads each row's live pages once per layer:
+    ``live_pages * page_size * kv_heads * head_dim`` K plus the same V.
+    The gather fallback materializes the full ``pages_per_seq``-wide
+    stripe per row regardless of how short the row is. Returns
+    ``(pallas_bytes, gather_bytes)`` per layer for the whole batch.
+    """
+    positions = [int(p) for p in cache_positions]
+    per_tok = kv_heads * head_dim * dtype_bytes * 2          # K and V
+    pallas = sum(live_pages(p, page_size) * page_size * per_tok
+                 for p in positions)
+    gather = len(positions) * pages_per_seq * page_size * per_tok
+    return pallas, gather
+
+
+# --------------------------------------------------------------------- #
+# reference (oracle / fallback) — the gather path's math, kept here so
+# kernel tests can pin parity without importing a model family
+# --------------------------------------------------------------------- #
+def paged_decode_reference(q, kpool, vpool, block_tables, cache_position,
+                           sm_scale: Optional[float] = None):
+    """Dense oracle: gather each row's full logical stripe from the
+    pool, mask positions past ``cache_position``, softmax in fp32 —
+    exactly what the models' gather fallback computes for a seq-1
+    query. q: (B, H, hd); pools: (num_pages, kv_heads, page_size, hd);
+    block_tables: (B, P) int32; cache_position: (B,) int32 (position of
+    the already-written current token). Returns (B, H, hd)."""
+    if sm_scale is None:
+        sm_scale = 1.0 / np.sqrt(q.shape[-1])
+    B, H, hd = q.shape
+    _, KH, ps, _ = kpool.shape
+    kc = kpool[block_tables].transpose(0, 2, 1, 3, 4).reshape(
+        B, KH, -1, hd)
+    vc = vpool[block_tables].transpose(0, 2, 1, 3, 4).reshape(
+        B, KH, -1, hd)
+    qg = q.reshape(B, KH, H // KH, hd)
+    s = jnp.einsum("bkgd,bkld->bkgl", qg.astype(jnp.float32),
+                   kc.astype(jnp.float32)) * sm_scale
+    k_idx = jnp.arange(kc.shape[2])
+    mask = k_idx[None, :] <= cache_position[:, None]        # (B, L)
+    s = jnp.where(mask[:, None, None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    ctx = jnp.einsum("bkgl,bkld->bkgd", p, vc.astype(jnp.float32))
+    return ctx.reshape(B, H, hd).astype(q.dtype)
+
+
+# --------------------------------------------------------------------- #
+# the kernel
+# --------------------------------------------------------------------- #
+def _decode_kernel(tables_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                   kbuf, vbuf, ksem, vsem, *, sm_scale, page_size):
+    """One (sequence, kv head) program: walk the row's live pages from
+    the pool via double-buffered DMA, online-softmax the GQA group's
+    queries against each streamed page tile."""
+    b = pl.program_id(0)
+    kh = pl.program_id(1)
+    pos = pos_ref[b]
+    # positions 0..pos are attended (this call's token was written
+    # BEFORE attention — write_paged_kv_cache runs first), spanning
+    # exactly pos // page_size + 1 pages: the O(live tokens) bound
+    num_pg = pos // page_size + 1
+    q = q_ref[0, 0]                                   # (G, hd)
+
+    def _start(i):
+        page = tables_ref[b, i]
+        slot = jax.lax.rem(i, 2)
+        pltpu.make_async_copy(k_ref.at[page, kh], kbuf.at[slot],
+                              ksem.at[slot]).start()
+        pltpu.make_async_copy(v_ref.at[page, kh], vbuf.at[slot],
+                              vsem.at[slot]).start()
+
+    _start(0)                                         # num_pg >= 1 always
+
+    def body(i, carry):
+        m, l, acc = carry
+
+        @pl.when(i + 1 < num_pg)
+        def _prefetch_next():
+            _start(i + 1)
+        page = tables_ref[b, i]
+        slot = jax.lax.rem(i, 2)
+        pltpu.make_async_copy(k_ref.at[page, kh], kbuf.at[slot],
+                              ksem.at[slot]).wait()
+        pltpu.make_async_copy(v_ref.at[page, kh], vbuf.at[slot],
+                              vsem.at[slot]).wait()
+        kt = kbuf[slot]                               # (page_size, hd)
+        vt = vbuf[slot]
+        s = jax.lax.dot_general(
+            q, kt, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale   # (G, ps)
+        # in-kernel masking: positions past the row's cache position,
+        # and anything the table maps to the reserved null page 0 (the
+        # all-null tables of inactive slots) — finite garbage out,
+        # never NaN
+        offs = i * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1)
+        valid = (offs <= pos) & (page != 0)
+        s = jnp.where(valid, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        # a fully-masked tile leaves m_new at NEG_INF and p at
+        # exp(0) = 1 — re-mask so masked positions never reach l/acc
+        p = jnp.where(valid, p, 0.0)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+            p.astype(vt.dtype), vt, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    G, hd = q.shape
+    m0 = jnp.full((G,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((G,), jnp.float32)
+    acc0 = jnp.zeros((G, hd), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, num_pg, body, (m0, l0, acc0))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0, 0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+
+
+def _use_pallas():
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def _compiler_params(interpret):
+    if pltpu is None or interpret:
+        return None
+    # 0.4.x spells it TPUCompilerParams; newer releases CompilerParams
+    cls = getattr(pltpu, "CompilerParams", None) or \
+        getattr(pltpu, "TPUCompilerParams", None)
+    if cls is None:                                   # pragma: no cover
+        return None
+    # batch programs are independent; the kv-head dim drives the DMA
+    # sequence and stays arbitrary
+    return cls(dimension_semantics=("parallel", "arbitrary"))
+
+
+@functools.partial(jax.jit, static_argnames=("sm_scale", "interpret"))
+def _paged_decode_call(q, kpool, vpool, block_tables, cache_position,
+                       sm_scale, interpret):
+    B, H, hd = q.shape
+    num_pages, KH, ps, _ = kpool.shape
+    G = H // KH
+    qg = q.reshape(B, KH, G, hd)
+    kernel = functools.partial(_decode_kernel, sm_scale=sm_scale,
+                               page_size=ps)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        # tables + positions prefetch into SMEM: page ids must be
+        # available to index the DMAs before the body runs
+        num_scalar_prefetch=2,
+        grid=(B, KH),
+        in_specs=[
+            pl.BlockSpec((1, 1, G, hd), lambda b, k, *_: (b, k, 0, 0)),
+            # pools stay pinned in HBM; the kernel DMAs one
+            # (page_size, hd) tile per walked page — never the stripe
+            pl.BlockSpec(memory_space=pltpu.HBM),
+            pl.BlockSpec(memory_space=pltpu.HBM),
+        ],
+        out_specs=pl.BlockSpec((1, 1, G, hd),
+                               lambda b, k, *_: (b, k, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, ps, hd), kpool.dtype),
+            pltpu.VMEM((2, ps, hd), vpool.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, KH, G, hd), q.dtype),
+        interpret=interpret,
+        compiler_params=_compiler_params(interpret),
+    )(block_tables, cache_position, qg, kpool, vpool)
+    return out.reshape(B, H, hd)
+
+
+def paged_decode_attention(q, kpool, vpool, block_tables, cache_position,
+                           sm_scale: Optional[float] = None,
+                           interpret: Optional[bool] = None):
+    """Decode attention straight from the page pool — O(live tokens).
+
+    q: ``(B, q_heads, head_dim)`` — ONE query token per row (the seq-1
+    decode specialization; q post-RoPE for llama). kpool/vpool:
+    ``(num_pages, kv_heads, page_size, head_dim)`` with
+    ``q_heads % kv_heads == 0`` (GQA served natively — each group of
+    ``q_heads/kv_heads`` query rows shares its kv head's page stream).
+    block_tables: ``(B, pages_per_seq)`` int32 (entries past a row's
+    reservation = the null page 0). cache_position: ``(B,)`` int32 —
+    the position of this call's ALREADY-WRITTEN token; the row attends
+    positions ``<= cache_position`` across its
+    ``cache_position // page_size + 1`` live pages, and nothing else is
+    read from HBM. Returns ``(B, q_heads, head_dim)`` in q's dtype,
+    matching the gather path's math (fp32 softmax, masked identically).
+
+    ``interpret=None`` auto-selects: compiled on TPU, interpret mode
+    elsewhere (the tier-1 CPU parity path). Callers gate the compiled
+    path on :func:`paged_decode_supported`.
+    """
+    assert q.ndim == 3, f"paged decode takes (B, H, hd) queries, got " \
+        f"{q.shape}"
+    B, H, hd = q.shape
+    KH = kpool.shape[1]
+    assert H % KH == 0 and kpool.shape == vpool.shape, (q.shape,
+                                                        kpool.shape,
+                                                        vpool.shape)
+    assert block_tables.shape[0] == B and cache_position.shape == (B,), (
+        block_tables.shape, cache_position.shape)
+    if sm_scale is None:
+        sm_scale = 1.0 / np.sqrt(hd)
+    if interpret is None:
+        interpret = not _use_pallas()
+    return _paged_decode_call(q, kpool, vpool,
+                              block_tables.astype(jnp.int32),
+                              cache_position.astype(jnp.int32),
+                              float(sm_scale), bool(interpret))

@@ -67,8 +67,8 @@ TAG_HOST_GAP = "Observability/host_gap_ms"        # per-step host gap time
 # them; stdlib-only tools/obs_report.py mirrors the strings and the
 # pair is pinned by tests/unit/test_inference.py)
 from deepspeed_tpu.utils.monitor import (  # noqa: E402,F401
-    TAG_SERVE_KV_PAGES, TAG_SERVE_OCCUPANCY, TAG_SERVE_PREFIX_HIT,
-    TAG_SERVE_QUEUE_DEPTH, TAG_SERVE_TOKEN_LATENCY,
+    TAG_SERVE_DECODE_ATTN, TAG_SERVE_KV_PAGES, TAG_SERVE_OCCUPANCY,
+    TAG_SERVE_PREFIX_HIT, TAG_SERVE_QUEUE_DEPTH, TAG_SERVE_TOKEN_LATENCY,
     TAG_SERVE_TOKENS_IN_FLIGHT, TAG_SERVE_TPS, TAG_SERVE_TTFT)
 
 

@@ -557,6 +557,11 @@ def get_inference_config(param_dict):
                                 C.INF_PAGED_NUM_PAGES_DEFAULT)),
         "prefix_cache": bool(pk.get(C.INF_PAGED_PREFIX_CACHE,
                                     C.INF_PAGED_PREFIX_CACHE_DEFAULT)),
+        "attn_kernel": str(pk.get(C.INF_PAGED_ATTN_KERNEL,
+                                  C.INF_PAGED_ATTN_KERNEL_DEFAULT)),
+        "decode_page_buckets": list(pk.get(
+            C.INF_PAGED_DECODE_PAGE_BUCKETS,
+            C.INF_PAGED_DECODE_PAGE_BUCKETS_DEFAULT)),
     }
     mesh_sub = sub.get(C.INF_MESH, {}) or {}
     cfg["mesh"] = {"axes": dict(mesh_sub.get(C.INF_MESH_AXES, {}) or {})}
@@ -598,6 +603,17 @@ def get_inference_config(param_dict):
         raise DeepSpeedConfigError(
             f"inference.paged_kv.num_pages must be 0 (auto) or >= 2, "
             f"got {pkc['num_pages']}")
+    if pkc["attn_kernel"] not in ("pallas", "gather"):
+        raise DeepSpeedConfigError(
+            f"inference.paged_kv.attn_kernel must be 'pallas' or "
+            f"'gather', got {pkc['attn_kernel']!r}")
+    if pkc["decode_page_buckets"]:
+        try:
+            pkc["decode_page_buckets"] = list(validate_buckets(
+                pkc["decode_page_buckets"],
+                "inference.paged_kv.decode_page_buckets"))
+        except ValueError as e:
+            raise DeepSpeedConfigError(str(e))
     for name, size in cfg["mesh"]["axes"].items():
         if name != "model":
             # the serving programs shard params/cache over the 'model'

@@ -390,7 +390,19 @@ CHECKPOINT_IO_RETRY_BACKOFF_DEFAULT = 0.05
 #     "page_size": 16,            # tokens per page
 #     "num_pages": 0,             # pool size incl. null page; 0 = auto
 #                                 # (dense-equivalent worst case)
-#     "prefix_cache": true        # hash-dedup shared prompt prefixes
+#     "prefix_cache": true,       # hash-dedup shared prompt prefixes
+#     "attn_kernel": "pallas",    # decode attention: fused Pallas
+#                                 # paged kernel (O(live tokens) pool
+#                                 # reads) | "gather" (stripe oracle);
+#                                 # unsupported geometries auto-fall
+#                                 # back to gather with a one-line log
+#     "decode_page_buckets": []   # table-width buckets (pages) for the
+#                                 # decode dispatch; [] = one program
+#                                 # at full pages_per_seq width. More
+#                                 # buckets = one decode program per
+#                                 # width at warmup; gather fallback
+#                                 # bandwidth then scales with the
+#                                 # batch's LIVE pages, not max_len
 #   },
 #   "mesh": {                     # serving mesh (GSPMD NamedShardings)
 #     "axes": {}                  # e.g. {"model": 4}: tensor-parallel
@@ -432,6 +444,10 @@ INF_PAGED_NUM_PAGES = "num_pages"
 INF_PAGED_NUM_PAGES_DEFAULT = 0     # 0 = auto (dense-equivalent pool)
 INF_PAGED_PREFIX_CACHE = "prefix_cache"
 INF_PAGED_PREFIX_CACHE_DEFAULT = True
+INF_PAGED_ATTN_KERNEL = "attn_kernel"
+INF_PAGED_ATTN_KERNEL_DEFAULT = "pallas"   # "gather" = stripe fallback
+INF_PAGED_DECODE_PAGE_BUCKETS = "decode_page_buckets"
+INF_PAGED_DECODE_PAGE_BUCKETS_DEFAULT = ()  # () = one full-width program
 INF_MESH = "mesh"
 INF_MESH_AXES = "axes"
 

@@ -22,14 +22,15 @@ Counting rules:
 """
 
 import re
-from typing import List, NamedTuple, Optional
+from typing import List, NamedTuple, Optional, Tuple
 
 __all__ = ["HLO_DTYPE_BYTES", "shape_elems", "shape_bytes",
            "Collective", "collect_collectives", "collect_collectives_full",
            "wire_elements", "wire_bytes_of", "send_bytes_of",
            "conditional_branch_comps", "hlo_computation_body",
            "dense_allreduce_ring_bytes", "while_body_comps",
-           "cone_reaches_compute", "overlap_structure"]
+           "cone_reaches_compute", "overlap_structure",
+           "gather_ops", "max_gather_elems"]
 
 # dtype name -> byte width; accounting by ELEMENTS uses only the names
 HLO_DTYPE_BYTES = {"f64": 8, "s64": 8, "u64": 8,
@@ -188,6 +189,34 @@ def send_bytes_of(colls, default_group: Optional[int] = None) -> int:
         f = (g - 1) / g if g and g > 1 else 1.0
         total += c.bytes * f * (2 if c.op == "all-reduce" else 1)
     return int(round(total))
+
+
+_GATHER_PAT = re.compile(
+    r"^\s*(?:ROOT\s+)?%?[\w.\-]+ = (\(.*?\)|\S+) gather\(")
+
+
+def gather_ops(hlo_text) -> List[Tuple[int, int, str]]:
+    """[(result_elems, result_bytes, result_shape)] for every ``gather``
+    instruction in a compiled HLO module. The paged-serving bandwidth
+    audits use this to pin WHERE decode reads come from: the
+    stripe-gather decode path materializes a gather of every table
+    entry's page per layer (a ``max_len``-bounded tensor), while the
+    fused Pallas decode kernel's program contains no pool-sized gather
+    at all — its pool reads are per-page dynamic slices."""
+    out = []
+    for line in hlo_text.splitlines():
+        m = _GATHER_PAT.match(line)
+        if m:
+            shape = m.group(1)
+            out.append((shape_elems(shape), shape_bytes(shape),
+                        shape.strip("()")))
+    return out
+
+
+def max_gather_elems(hlo_text) -> int:
+    """Largest single gather result (elements) in a compiled module;
+    0 when the program contains no gather."""
+    return max((e for e, _, _ in gather_ops(hlo_text)), default=0)
 
 
 def while_body_comps(hlo_text):

@@ -36,6 +36,8 @@ TAG_SERVE_OCCUPANCY = "Serve/batch_occupancy"       # active / total slots
 TAG_SERVE_KV_PAGES = "Serve/kv_pages_in_use"        # paged pool occupancy
 TAG_SERVE_TOKENS_IN_FLIGHT = "Serve/tokens_in_flight"  # live cache tokens
 TAG_SERVE_PREFIX_HIT = "Serve/prefix_hit_rate"      # prompt tokens reused
+TAG_SERVE_DECODE_ATTN = "Serve/decode_attn_path"    # 1 = pallas paged
+#                                                     kernel, 0 = gather
 
 
 class _JsonlWriter:
@@ -219,17 +221,22 @@ class TensorBoardMonitor:
                               tokens_per_sec=None, queue_depth=None,
                               batch_occupancy=None, kv_pages_in_use=None,
                               tokens_in_flight=None, prefix_hit_rate=None,
+                              decode_attn_path=None,
                               tokens: int = 0, flush: bool = True):
         """Serving telemetry (inference engine; TPU-native extension —
         the reference snapshot is training-only): time-to-first-token
         per admitted request, per-decode-step token latency, cumulative
         tokens/s, request-queue depth and decode-slot occupancy, plus
         the paged-cache view (pool pages in use, live cache tokens in
-        flight, prefix-cache hit rate over prompt tokens). The x-axis
-        is cumulative generated tokens (the serving analog of the
-        training samples axis). Tags are pinned by
-        tests/unit/test_inference.py and rendered by
-        tools/obs_report.py's serving section."""
+        flight, prefix-cache hit rate over prompt tokens, and WHICH
+        decode attention ran — 1.0 = fused Pallas paged kernel, 0.0 =
+        the gather fallback, so a silent fallback is visible in run
+        reports; the engine also logs a ``decode_attn_path`` event row
+        with the reason, mirroring the comm autotuner's
+        which-exchange-compiled telemetry). The x-axis is cumulative
+        generated tokens (the serving analog of the training samples
+        axis). Tags are pinned by tests/unit/test_inference.py and
+        rendered by tools/obs_report.py's serving section."""
         if not self._writes():
             return
         if ttft_ms is not None:
@@ -251,6 +258,9 @@ class TensorBoardMonitor:
                               tokens_in_flight, tokens)
         if prefix_hit_rate is not None:
             self.write_scalar(TAG_SERVE_PREFIX_HIT, prefix_hit_rate,
+                              tokens)
+        if decode_attn_path is not None:
+            self.write_scalar(TAG_SERVE_DECODE_ATTN, decode_attn_path,
                               tokens)
         if flush:
             self.flush()

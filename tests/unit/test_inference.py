@@ -104,14 +104,22 @@ def test_host_side_scheduling_modules_stay_jax_free():
     policy is unit-testable in microseconds" — pin that at the source
     level for the whole host-side chain it pulls in (scheduler ->
     paging, buckets), so a convenience import can't quietly drag jax
-    back into admission policy."""
+    back into admission policy.
+
+    ISSUE 8 extension: the same modules must also stay KERNEL-AGNOSTIC
+    — scheduling/paging policy must not know (or care) whether decode
+    attention runs the fused Pallas paged kernel or the gather
+    fallback, so no import from ops.attention (or any ops/ module) and
+    no kernel-path strings may appear. The engine owns the path choice;
+    the scheduler only ever produces block tables."""
     import ast
     import pathlib
 
     import deepspeed_tpu.inference as inf
     root = pathlib.Path(inf.__file__).parent
     for mod in ("scheduler.py", "paging.py", "buckets.py"):
-        for node in ast.walk(ast.parse((root / mod).read_text())):
+        src = (root / mod).read_text()
+        for node in ast.walk(ast.parse(src)):
             if isinstance(node, ast.Import):
                 names = [a.name for a in node.names]
             elif isinstance(node, ast.ImportFrom):
@@ -121,6 +129,11 @@ def test_host_side_scheduling_modules_stay_jax_free():
             for n in names:
                 assert n != "jax" and not n.startswith("jax."), \
                     f"{mod} imports {n}"
+                assert ".ops" not in n and not n.startswith("ops"), \
+                    f"{mod} imports kernel code: {n}"
+        assert "pallas" not in src.lower(), \
+            f"{mod} mentions a kernel path — scheduling must stay " \
+            f"kernel-agnostic"
 
 
 class TestScheduler:
@@ -993,7 +1006,9 @@ class TestPagedConfigSection:
         from deepspeed_tpu.runtime.config import get_inference_config
         cfg = get_inference_config({})
         assert cfg["paged_kv"] == {"enabled": True, "page_size": 16,
-                                   "num_pages": 0, "prefix_cache": True}
+                                   "num_pages": 0, "prefix_cache": True,
+                                   "attn_kernel": "pallas",
+                                   "decode_page_buckets": []}
         assert cfg["mesh"] == {"axes": {}}
         assert cfg["admit_lookahead"] == 4
 

@@ -1,0 +1,430 @@
+"""Pallas paged-attention decode kernel (ops/attention/paged.py) —
+ISSUE 8: serve from pages in place, O(live tokens) instead of
+O(max_len).
+
+Tier-1 acceptance pins:
+- kernel parity vs the gather oracle across page_size {8, 16, 128},
+  GQA ratios {1, 4}, and the cache_position edge cases (position 0,
+  exactly page-aligned, one-past-page, last slot of the table);
+- greedy engine outputs from the pallas decode path EXACTLY match the
+  gather path for gpt2 AND llama under continuous batching with prefix
+  reuse, warmup program count and steady_state_recompiles == 0
+  unchanged;
+- the compiled pallas decode program contains no max_len-sized gather
+  (the gather program's per-layer stripe is the contrast);
+- the which-decode-attention telemetry (Serve/decode_attn_path +
+  decode_attn_path event) lands in events.jsonl and obs_report.
+
+All kernel runs here are interpret-mode (CPU): scalar prefetch, HBM
+refs, dynamic-index DMA and semaphores interpret exactly, which is
+what makes the TPU kernel's numerics testable without hardware.
+"""
+
+import importlib.util
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.unit.test_inference import (TINY_INF, tiny_gpt2, tiny_llama)
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _pool_case(rng, kv_heads, gqa, page_size, pages_per_seq, hd=16,
+               num_pages=None, batch=5):
+    """One kernel test case: random pool + per-row tables of distinct
+    non-null pages + queries."""
+    H = kv_heads * gqa
+    num_pages = num_pages or (batch * pages_per_seq + 1)
+    kpool = jnp.asarray(rng.randn(num_pages, kv_heads, page_size, hd),
+                        jnp.float32)
+    vpool = jnp.asarray(rng.randn(num_pages, kv_heads, page_size, hd),
+                        jnp.float32)
+    q = jnp.asarray(rng.randn(batch, H, hd), jnp.float32)
+    tables = np.zeros((batch, pages_per_seq), np.int32)
+    avail = list(range(1, num_pages))
+    rng.shuffle(avail)
+    for b in range(batch):
+        tables[b] = [avail.pop() for _ in range(pages_per_seq)]
+    return q, kpool, vpool, tables
+
+
+class TestKernelParity:
+    @pytest.mark.parametrize("gqa", [1, 4])
+    @pytest.mark.parametrize("page_size", [8, 16, 128])
+    def test_parity_sweep_vs_gather_oracle(self, page_size, gqa):
+        """ISSUE 8 satellite: parity across page sizes and GQA ratios,
+        with cache_position edges in one batch — position 0 (only the
+        just-written token visible), last slot of page 0 (exactly
+        page-aligned context), first slot of page 1 (one-past-page),
+        and the table's final position."""
+        from deepspeed_tpu.ops.attention.paged import (
+            paged_decode_attention, paged_decode_reference)
+        rng = np.random.RandomState(page_size + gqa)
+        P = 3
+        q, kpool, vpool, tables = _pool_case(rng, kv_heads=2, gqa=gqa,
+                                             page_size=page_size,
+                                             pages_per_seq=P, batch=5)
+        pos = jnp.asarray([0, page_size - 1, page_size, page_size + 1,
+                           P * page_size - 1], jnp.int32)
+        tables = jnp.asarray(tables)
+        out = paged_decode_attention(q, kpool, vpool, tables, pos,
+                                     interpret=True)
+        ref = paged_decode_reference(q, kpool, vpool, tables, pos)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+
+    def test_shared_prefix_pages_two_rows_one_batch(self):
+        """Prefix-cache sharing at the kernel level: two rows whose
+        tables point at the SAME physical pages (one prefilled prefix,
+        two readers in one decode batch) read identical K/V — identical
+        queries at identical positions produce identical context."""
+        from deepspeed_tpu.ops.attention.paged import (
+            paged_decode_attention, paged_decode_reference)
+        rng = np.random.RandomState(0)
+        q, kpool, vpool, tables = _pool_case(rng, kv_heads=2, gqa=2,
+                                             page_size=8, pages_per_seq=3,
+                                             batch=3)
+        tables = np.asarray(tables)
+        tables[1, :2] = tables[0, :2]       # rows 0/1 share 2 prefix pages
+        q = q.at[1].set(q[0])
+        pos = jnp.asarray([17, 17, 5], jnp.int32)
+        tables = jnp.asarray(tables)
+        out = paged_decode_attention(q, kpool, vpool, tables, pos,
+                                     interpret=True)
+        ref = paged_decode_reference(q, kpool, vpool, tables, pos)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+        # divergence only past the shared pages: rows 0/1 differ (their
+        # third page differs) but both match the oracle exactly
+        assert not np.allclose(np.asarray(out[0]), np.asarray(out[2]))
+
+    def test_null_table_rows_stay_finite(self):
+        """Inactive slots carry all-null tables: everything is masked
+        inside the kernel, and the output must be finite garbage (the
+        host discards it), never NaN."""
+        from deepspeed_tpu.ops.attention.paged import \
+            paged_decode_attention
+        rng = np.random.RandomState(1)
+        q, kpool, vpool, _ = _pool_case(rng, kv_heads=2, gqa=1,
+                                        page_size=8, pages_per_seq=2,
+                                        batch=2)
+        out = paged_decode_attention(
+            q, kpool, vpool, jnp.zeros((2, 2), jnp.int32),
+            jnp.zeros((2,), jnp.int32), interpret=True)
+        assert bool(jnp.all(jnp.isfinite(out)))
+
+    def test_reads_only_live_pages(self):
+        """The O(live tokens) contract: garbage (NaN) planted in pages
+        past each row's live count — including the row's OWN reserved
+        but unreached pages — must not leak into the output."""
+        from deepspeed_tpu.ops.attention.paged import (
+            paged_decode_attention, paged_decode_reference)
+        rng = np.random.RandomState(2)
+        q, kpool, vpool, tables = _pool_case(rng, kv_heads=2, gqa=2,
+                                             page_size=8, pages_per_seq=4,
+                                             batch=2)
+        pos = jnp.asarray([9, 3], jnp.int32)    # live pages: 2 and 1
+        ref = paged_decode_reference(q, kpool, vpool,
+                                     jnp.asarray(tables), pos)
+        kpool_n, vpool_n = np.array(kpool), np.array(vpool)
+        kpool_n[tables[0, 2:]] = np.nan          # row 0: pages 2,3 dead
+        kpool_n[tables[1, 1:]] = np.nan          # row 1: pages 1..3 dead
+        vpool_n[tables[0, 2:]] = np.nan
+        vpool_n[tables[1, 1:]] = np.nan
+        out = paged_decode_attention(q, jnp.asarray(kpool_n),
+                                     jnp.asarray(vpool_n),
+                                     jnp.asarray(tables), pos,
+                                     interpret=True)
+        assert bool(jnp.all(jnp.isfinite(out)))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+
+
+class TestSupportPredicate:
+    def test_interpret_path_always_supported(self):
+        from deepspeed_tpu.ops.attention.paged import \
+            paged_decode_supported
+        ok, why = paged_decode_supported(4, 8, jnp.float32,
+                                         backend="cpu")
+        assert ok and "interpret" in why
+
+    def test_tpu_legality_matrix(self):
+        """Compiled-TPU DMA legality: head_dim must 128-align (lane
+        dim), page_size must fill the dtype's sublane tile."""
+        from deepspeed_tpu.ops.attention.paged import \
+            paged_decode_supported
+        assert paged_decode_supported(16, 128, jnp.bfloat16,
+                                      backend="tpu")[0]
+        assert paged_decode_supported(8, 128, jnp.float32,
+                                      backend="tpu")[0]
+        ok, why = paged_decode_supported(16, 64, jnp.bfloat16,
+                                         backend="tpu")
+        assert not ok and "head_dim" in why
+        ok, why = paged_decode_supported(8, 128, jnp.bfloat16,
+                                         backend="tpu")
+        assert not ok and "page_size" in why
+
+    def test_live_pages_and_bytes_model(self):
+        from deepspeed_tpu.ops.attention.paged import (decode_read_bytes,
+                                                       live_pages)
+        assert live_pages(0, 16) == 1
+        assert live_pages(15, 16) == 1
+        assert live_pages(16, 16) == 2
+        pallas, gather = decode_read_bytes(
+            [0, 15, 16], page_size=16, pages_per_seq=8, kv_heads=2,
+            head_dim=64, dtype_bytes=2)
+        per_page = 16 * 2 * 64 * 2 * 2                  # K and V
+        assert pallas == (1 + 1 + 2) * per_page
+        assert gather == 3 * 8 * per_page
+        assert gather / pallas > 2.0
+
+
+# --------------------------------------------------------------------- #
+# engine integration: the pallas path is the DEFAULT paged decode
+# --------------------------------------------------------------------- #
+PAGED_PALLAS = {"page_size": 4, "num_pages": 14, "attn_kernel": "pallas"}
+PAGED_GATHER = {"page_size": 4, "num_pages": 14, "attn_kernel": "gather"}
+
+
+class TestEngineParity:
+    @pytest.mark.parametrize("family", ["gpt2", "llama"])
+    def test_pallas_greedy_exactly_matches_gather(self, family):
+        """ISSUE 8 acceptance: greedy outputs from the pallas decode
+        path exactly match the gather path for both families under
+        continuous batching with prefix reuse (shared system prompt),
+        mixed lengths, tiny pool."""
+        from deepspeed_tpu.inference import InferenceEngine
+        cfg, params = tiny_gpt2() if family == "gpt2" else tiny_llama()
+        rng = np.random.RandomState(8)
+        sys_prompt = rng.randint(1, 61, (4,)).tolist()   # one full page
+        # the sys-prompt pair goes first so both are in flight together
+        # (prefix pages are shared while the owner still holds them)
+        prompts = [sys_prompt + [int(t)]
+                   for t in rng.randint(1, 61, (2,))]    # prefix reuse
+        prompts += [rng.randint(1, 61, (n,)).tolist()
+                    for n in (3, 5, 7, 2, 8)]
+        pallas = InferenceEngine(cfg, params,
+                                 dict(TINY_INF, paged_kv=PAGED_PALLAS),
+                                 dtype=jnp.float32)
+        assert pallas._decode_attn_path == "pallas"
+        gather = InferenceEngine(cfg, params,
+                                 dict(TINY_INF, paged_kv=PAGED_GATHER),
+                                 dtype=jnp.float32)
+        assert gather._decode_attn_path == "gather"
+        got = pallas.generate(prompts, max_new_tokens=4, temperature=0.0)
+        ref = gather.generate(prompts, max_new_tokens=4, temperature=0.0)
+        assert got == ref
+        assert pallas.scheduler.allocator.prefix_hit_tokens >= 4
+
+    def test_default_config_routes_decode_through_pallas(self):
+        """attn_kernel defaults to "pallas": an engine built from the
+        stock paged config resolves the kernel path (interpret mode on
+        CPU) — the O(live tokens) path is the default, not opt-in."""
+        from deepspeed_tpu.inference import InferenceEngine
+        cfg, params = tiny_gpt2()
+        engine = InferenceEngine(cfg, params, TINY_INF,
+                                 dtype=jnp.float32)
+        assert engine.config["paged_kv"]["attn_kernel"] == "pallas"
+        assert engine._decode_attn_path == "pallas"
+
+    def test_warmup_programs_and_zero_recompiles_unchanged(self):
+        """ISSUE 8 acceptance: the pallas default preserves PR 5/7's
+        program-set invariant — warmup compiles exactly
+        len(batch_buckets) x len(prompt_buckets) prefills + 1 decode,
+        and churn stays at 0 steady-state recompiles."""
+        from deepspeed_tpu.inference import InferenceEngine
+        cfg, params = tiny_gpt2()
+        engine = InferenceEngine(cfg, params,
+                                 dict(TINY_INF, paged_kv=PAGED_PALLAS),
+                                 dtype=jnp.float32)
+        programs = engine.warmup()
+        assert programs == 2 * 2 + 1
+        assert engine.compile_tracker.counts == {"prefill": 4,
+                                                 "decode": 1}
+        rng = np.random.RandomState(5)
+        churn = [rng.randint(1, 61, (n,)).tolist()
+                 for n in (1, 4, 5, 8, 3, 6)]
+        engine.generate(churn, max_new_tokens=3)
+        engine.generate(churn[:2], max_new_tokens=5, temperature=0.5)
+        assert engine.steady_state_recompiles == 0
+        assert engine.compile_tracker.total_compiles == programs
+
+    def test_mesh_serving_falls_back_to_gather(self):
+        """A pallas_call can't be auto-partitioned by GSPMD: sharded
+        serving must resolve to the gather path (fallback matrix), not
+        fail deep in compilation."""
+        from deepspeed_tpu.inference import InferenceEngine
+        cfg, params = tiny_gpt2()
+        engine = InferenceEngine(
+            cfg, params, dict(TINY_INF, mesh={"axes": {"model": 2}}),
+            dtype=jnp.float32)
+        assert engine._decode_attn_path == "gather"
+        assert "mesh" in engine._decode_attn_reason
+
+
+class TestDecodeWidthBuckets:
+    """ISSUE 8 satellite: the gather fallback's decode reads are
+    bounded by the batch's LIVE page bucket, not pages_per_seq."""
+
+    def test_width_bucketed_warmup_and_zero_recompiles(self):
+        """decode_page_buckets=[2] compiles one decode program per
+        width (2 and full) at warmup; mixed-length churn crossing the
+        bucket boundary compiles nothing more."""
+        from deepspeed_tpu.inference import InferenceEngine
+        cfg, params = tiny_gpt2()
+        engine = InferenceEngine(
+            cfg, params,
+            dict(TINY_INF, paged_kv=dict(PAGED_GATHER,
+                                         decode_page_buckets=[2])),
+            dtype=jnp.float32)
+        assert engine._decode_page_buckets == (2, 8)
+        programs = engine.warmup()
+        assert programs == 2 * 2 + 2
+        assert engine.compile_tracker.counts == {"prefill": 4,
+                                                 "decode": 2}
+        rng = np.random.RandomState(6)
+        # short requests decode at width 2; the 8-token prompts cross
+        # into the full-width program
+        prompts = [rng.randint(1, 61, (n,)).tolist()
+                   for n in (2, 3, 8, 7, 1, 8)]
+        outs = engine.generate(prompts, max_new_tokens=4)
+        assert engine.steady_state_recompiles == 0
+        assert engine.compile_tracker.total_compiles == programs
+        # numerics: identical to the single-width engine
+        ref = InferenceEngine(cfg, params,
+                              dict(TINY_INF, paged_kv=PAGED_GATHER),
+                              dtype=jnp.float32).generate(
+                                  prompts, max_new_tokens=4)
+        assert outs == ref
+
+    def test_scheduler_max_live_pages_and_table_clamp(self):
+        from deepspeed_tpu.inference.kv_cache import PageAllocator
+        from deepspeed_tpu.inference.scheduler import Request, Scheduler
+        s = Scheduler(3, (4, 16), (1, 2), 32,
+                      allocator=PageAllocator(20, 4))
+        assert s.max_live_pages() == 1          # idle: null column only
+        s.submit(Request(prompt=[1] * 9, max_new_tokens=4))   # pos 9
+        s.submit(Request(prompt=[2, 3], max_new_tokens=4))    # pos 2
+        s.admit()
+        # positions 9 and 2 -> 9//4+1 = 3 live pages max
+        assert s.max_live_pages() == 3
+        full = s.block_table_rows(4, 4)
+        clamped = s.block_table_rows(4, 3)
+        np.testing.assert_array_equal(clamped, full[:, :3])
+
+
+class TestDecodeAttnTelemetry:
+    def test_path_lands_in_events_and_report(self, tmp_path):
+        """Serve/decode_attn_path scalar + the decode_attn_path event
+        row (with the WHY) land in events.jsonl; obs_report renders the
+        path — a silent fallback to gather is visible in run
+        reports."""
+        from deepspeed_tpu.inference import InferenceEngine
+        cfg, params = tiny_gpt2()
+        icfg = dict(TINY_INF, events_dir=str(tmp_path),
+                    paged_kv=PAGED_PALLAS)
+        engine = InferenceEngine(cfg, params, icfg, dtype=jnp.float32)
+        engine.generate([[1, 2, 3], [4, 5]], max_new_tokens=3)
+        engine.close()
+        rows = [json.loads(line)
+                for line in open(tmp_path / "events.jsonl")]
+        vals = [r["value"] for r in rows
+                if r.get("tag") == "Serve/decode_attn_path"]
+        assert vals and all(v == 1.0 for v in vals)
+        ev = next(r for r in rows
+                  if r.get("event") == "decode_attn_path")
+        assert ev["path"] == "pallas" and ev["requested"] == "pallas"
+        assert ev["reason"]
+        obs_report = _load_tool("obs_report")
+        s = obs_report.summarize(str(tmp_path))
+        assert s["serving"]["paged_kv"]["decode_attn_path"] == "pallas"
+        assert "decode_attn     : pallas" in obs_report.render(s)
+
+    def test_gather_fallback_flagged_in_report(self, tmp_path):
+        from deepspeed_tpu.inference import InferenceEngine
+        cfg, params = tiny_gpt2()
+        icfg = dict(TINY_INF, events_dir=str(tmp_path),
+                    paged_kv=PAGED_GATHER)
+        engine = InferenceEngine(cfg, params, icfg, dtype=jnp.float32)
+        engine.generate([[1, 2, 3]], max_new_tokens=2)
+        engine.close()
+        obs_report = _load_tool("obs_report")
+        s = obs_report.summarize(str(tmp_path))
+        assert s["serving"]["paged_kv"]["decode_attn_path"] == "gather"
+        assert "fallback" in obs_report.render(s)
+
+    def test_tag_registry_in_sync(self):
+        from deepspeed_tpu import profiling as prof
+        from deepspeed_tpu.utils import monitor as m
+        obs_report = _load_tool("obs_report")
+        assert m.TAG_SERVE_DECODE_ATTN == prof.TAG_SERVE_DECODE_ATTN == \
+            obs_report.T_DECODE_ATTN
+
+
+class TestCompiledProgramAudit:
+    def test_pallas_decode_program_free_of_stripe_gathers(self):
+        """ISSUE 8 acceptance (tier-1 half of the paged_decode_bytes
+        bench row): the compiled pallas decode program contains no
+        gather anywhere near the per-layer stripe size; the gather
+        program materializes it."""
+        from deepspeed_tpu.inference import InferenceEngine
+        from deepspeed_tpu.utils.hlo_audit import max_gather_elems
+        cfg, params = tiny_gpt2()
+
+        def decode_hlo(pk):
+            eng = InferenceEngine(cfg, params,
+                                  dict(TINY_INF, paged_kv=pk),
+                                  dtype=jnp.float32)
+            rows = eng.num_slots + 1
+            pps = eng.paged_spec.pages_per_seq
+            args = (eng.params, eng._cache,
+                    jnp.zeros((rows,), jnp.int32),
+                    jnp.zeros((rows,), jnp.int32),
+                    jnp.zeros((rows, pps), jnp.int32),
+                    jnp.zeros((rows, 2), jnp.uint32),
+                    jnp.zeros((rows,), jnp.float32))
+            hlo = jax.jit(eng._decode_paged_impl).lower(
+                *args).compile().as_text()
+            return hlo, eng.paged_spec, rows
+
+        hlo_p, spec, rows = decode_hlo(PAGED_PALLAS)
+        hlo_g, _, _ = decode_hlo(PAGED_GATHER)
+        stripe = (rows * spec.pages_per_seq * spec.kv_heads
+                  * spec.page_size * spec.head_dim)
+        assert max_gather_elems(hlo_g) >= stripe
+        assert max_gather_elems(hlo_p) < stripe
+
+
+class TestPagedAttnConfig:
+    def test_defaults_and_validation(self):
+        from deepspeed_tpu.runtime.config import (DeepSpeedConfigError,
+                                                  get_inference_config)
+        cfg = get_inference_config({})
+        assert cfg["paged_kv"]["attn_kernel"] == "pallas"
+        assert cfg["paged_kv"]["decode_page_buckets"] == []
+        with pytest.raises(DeepSpeedConfigError, match="attn_kernel"):
+            get_inference_config(
+                {"inference": {"paged_kv": {"attn_kernel": "cuda"}}})
+        with pytest.raises(DeepSpeedConfigError,
+                           match="decode_page_buckets"):
+            get_inference_config(
+                {"inference": {"paged_kv":
+                               {"decode_page_buckets": [4, 2]}}})
+        ok = get_inference_config(
+            {"inference": {"paged_kv": {"decode_page_buckets": [2, 4],
+                                        "attn_kernel": "gather"}}})
+        assert ok["paged_kv"]["decode_page_buckets"] == [2, 4]

@@ -63,6 +63,7 @@ T_OCC = "Serve/batch_occupancy"
 T_KV_PAGES = "Serve/kv_pages_in_use"
 T_TOKENS_IN_FLIGHT = "Serve/tokens_in_flight"
 T_PREFIX_HIT = "Serve/prefix_hit_rate"
+T_DECODE_ATTN = "Serve/decode_attn_path"
 
 # host gap above this fraction of step time flags the run: the device
 # is waiting on the host often enough to cost real throughput
@@ -198,10 +199,21 @@ def summarize(path, host_gap_threshold=DEFAULT_HOST_GAP_THRESHOLD):
     pages = _vals(scalars, T_KV_PAGES)
     in_flight = _vals(scalars, T_TOKENS_IN_FLIGHT)
     prefix_hit = _vals(scalars, T_PREFIX_HIT)
+    # which decode attention ran (1.0 = pallas paged kernel, 0.0 =
+    # gather fallback); the decode_attn_path event row carries the WHY
+    attn_path = _vals(scalars, T_DECODE_ATTN)
+    attn_event = next((e for e in reversed(events)
+                       if e.get("event") == "decode_attn_path"), None)
     serving["paged_kv"] = {
         "pages_in_use_peak": max(pages) if pages else None,
         "tokens_in_flight_peak": max(in_flight) if in_flight else None,
         "prefix_hit_rate": prefix_hit[-1] if prefix_hit else None,
+        "decode_attn_path": (
+            ("pallas" if attn_path[-1] >= 0.5 else "gather")
+            if attn_path else
+            (str(attn_event.get("path")) if attn_event else None)),
+        "decode_attn_reason": (str(attn_event.get("reason"))
+                               if attn_event else None),
     }
 
     ckpt = {"saves": 0, "loads": 0, "fallbacks": 0, "save_ms": []}
@@ -372,6 +384,14 @@ def render(s):
                 f"{_fmt(pk['tokens_in_flight_peak'], '{:.0f}')} "
                 f"prefix_hit_rate="
                 f"{_fmt(pk['prefix_hit_rate'], '{:.1%}')}")
+        if pk.get("decode_attn_path"):
+            line = f"    decode_attn     : {pk['decode_attn_path']}"
+            if pk.get("decode_attn_reason"):
+                line += f" ({pk['decode_attn_reason']})"
+            if pk["decode_attn_path"] == "gather":
+                line += "  ** fallback: decode reads are stripe-wide, " \
+                        "not O(live tokens) **"
+            lines.append(line)
     lines += [
         f"  memory            : "
         f"peak={_fmt_bytes(s['memory']['peak_bytes_in_use'])} "
