@@ -51,6 +51,18 @@ Metrics (BASELINE.md rows):
   mfu_cost_model pattern) prices the mixed-length reference workload:
   value = modeled pallas KiB/decode-step, vs_baseline = stripe bytes /
   pallas bytes (ISSUE 8 acceptance: >= 2x reduction)
+- serve_trace_overhead : HARDWARE-FREE — cost of the request-granular
+  serving observability plane (inference/tracing.py): the identical
+  mixed-length continuous-batching workload runs with tracing OFF and
+  with tracing ON at the DEFAULT config (full lifecycle trail into
+  events.jsonl, per-token TBT sampling, decode-window rows at the
+  default 1/16 stride), both engines carrying the baseline event log;
+  the compiled program set and per-step dispatch counts must be
+  IDENTICAL (tracing is host-side by construction, so with equal
+  dispatches any wall delta IS host gap), steady-state recompiles 0
+  for both, greedy outputs bitwise equal; value = wall-clock overhead
+  percent (min-of-5 interleaved runs), acceptance <= 5%;
+  vs_baseline = traced tokens/s / untraced tokens/s
 - paged_decode_tokens_per_s : TPU — wall-clock decode tokens/s of the
   serving engine with the compiled Pallas paged-decode kernel at a
   TPU-legal geometry (head_dim 128), vs_baseline = pallas tokens/s /
@@ -113,6 +125,7 @@ METRICS = [
     "decode_throughput",
     "paged_kv_occupancy",
     "paged_decode_bytes",
+    "serve_trace_overhead",
     "paged_decode_tokens_per_s",
     "bert_large_samples_per_s",
     "bert_onebit_samples_per_s",
@@ -126,7 +139,7 @@ HEADLINE = "gpt2_train_mfu"
 HW_FREE = {"comm_wire_bytes_per_step", "comm_overlap_structure",
            "mfu_cost_model", "host_dispatch_overhead",
            "decode_throughput", "paged_kv_occupancy",
-           "paged_decode_bytes"}
+           "paged_decode_bytes", "serve_trace_overhead"}
 
 PARTIAL_PATH = os.environ.get(
     "BENCH_PARTIAL", "/tmp/dstpu_bench_partial.jsonl")
@@ -1257,6 +1270,115 @@ def bench_paged_decode_bytes(on_tpu, rtt):
                    "model (hardware-free)"})
 
 
+def bench_serve_trace_overhead(on_tpu, rtt):
+    """Hardware-free row: the request-granular serving observability
+    plane must be free at the dispatch level. The same mixed-length
+    continuous-batching workload runs on two engines, BOTH with the
+    crash-safe events.jsonl wired (the PR-5 aggregate telemetry is the
+    shared baseline — its line-buffered IO is the dominant telemetry
+    cost on a toy model and is not what this row prices): tracing OFF
+    (``observability.serve.enabled: false``) vs tracing ON at the
+    default config (full lifecycle trail, per-token TBT sampling,
+    ``serve_decode_window`` rows at the default 1/16 stride,
+    SLO/goodput scalars).
+
+    Pins (ISSUE 9 acceptance): the warmup program set and per-run
+    dispatch counts are IDENTICAL (tracing is host-side pure-Python by
+    construction — with equal dispatches, any wall-clock delta IS host
+    gap), ``steady_state_recompiles == 0`` for both, greedy outputs
+    bitwise equal. value = wall overhead percent of the traced engine
+    (min-of-5 interleaved runs — min, not mean, because tiny-model CPU
+    wall clocks are noise-dominated); acceptance <= 5%.
+    """
+    del on_tpu, rtt       # host-side accounting on the CPU backend
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.inference import InferenceEngine
+    from deepspeed_tpu.models.gpt2 import GPT2Config, init_gpt2_params
+
+    cfg = GPT2Config(vocab_size=256, max_position_embeddings=128,
+                     hidden_size=64, num_layers=2, num_heads=4,
+                     embd_dropout=0.0, attn_dropout=0.0,
+                     resid_dropout=0.0)
+    params = init_gpt2_params(cfg, jax.random.PRNGKey(0))
+    new_tokens = 24
+    icfg = {"max_batch_size": 4, "prompt_buckets": [8, 16],
+            "batch_buckets": [1, 4], "max_seq_len": 128,
+            "max_new_tokens": new_tokens}
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, 256, (length,)).tolist()
+               for length in (5, 8, 13, 3, 16, 7, 11, 4)]
+    tmp = tempfile.mkdtemp(prefix="dstpu_serve_trace_")
+
+    def build(traced):
+        ic = dict(icfg, events_dir=os.path.join(
+            tmp, "on" if traced else "off"))
+        eng = InferenceEngine(
+            cfg, params, ic, dtype=jnp.float32,
+            observability_config={"serve": {"enabled": traced}})
+        eng.warmup()
+        return eng
+
+    eng_off = build(False)
+    eng_on = build(True)
+    warm_off = eng_off.compile_tracker.total_compiles
+    warm_on = eng_on.compile_tracker.total_compiles
+    _beat()
+
+    def one_run(eng):
+        t0 = time.perf_counter()
+        outs = eng.generate(prompts, max_new_tokens=new_tokens,
+                            temperature=0.0)
+        return time.perf_counter() - t0, outs
+
+    walls_off, walls_on = [], []
+    outs_off = outs_on = None
+    disp0_off = eng_off.compile_tracker.total_dispatches
+    disp0_on = eng_on.compile_tracker.total_dispatches
+    for _ in range(5):
+        w, outs_off = one_run(eng_off)
+        walls_off.append(w)
+        w, outs_on = one_run(eng_on)
+        walls_on.append(w)
+        _beat()
+    disp_off = eng_off.compile_tracker.total_dispatches - disp0_off
+    disp_on = eng_on.compile_tracker.total_dispatches - disp0_on
+    gen_tokens = sum(len(o) - len(p) for o, p in zip(outs_off, prompts))
+    tps_off = gen_tokens / min(walls_off)
+    tps_on = gen_tokens / min(walls_on)
+    overhead_pct = (min(walls_on) - min(walls_off)) / min(walls_off) * 100
+    state = eng_on.debug_state()
+    eng_on.close()
+    events_path = os.path.join(tmp, "on", "events.jsonl")
+    trail_rows = sum(1 for _ in open(events_path)) \
+        if os.path.exists(events_path) else 0
+    row = _emit(
+        "serve_trace_overhead", round(overhead_pct, 2),
+        "pct_wall_overhead",
+        round(tps_on / tps_off, 3) if tps_off > 0 else 0.0,
+        {"accept_overhead_pct": 5.0,
+         "tokens_per_s_off": round(tps_off, 2),
+         "tokens_per_s_on": round(tps_on, 2),
+         "dispatches_off": disp_off, "dispatches_on": disp_on,
+         "dispatch_delta": disp_on - disp_off,
+         "warmup_programs_off": warm_off,
+         "warmup_programs_on": warm_on,
+         "steady_state_recompiles_off": eng_off.steady_state_recompiles,
+         "steady_state_recompiles_on": eng_on.steady_state_recompiles,
+         "greedy_parity": outs_on == outs_off,
+         "trail_rows": trail_rows,
+         "slo_attainment": state["slo"]["attainment"],
+         "requests_per_run": len(prompts), "new_tokens": new_tokens,
+         "backend": jax.default_backend(),
+         "source": "interleaved wall clock + CompileTracker dispatch "
+                   "accounting (hardware-free)"})
+    shutil.rmtree(tmp, ignore_errors=True)
+    return row
+
+
 def bench_paged_decode_tokens_per_s(on_tpu, rtt):
     """TPU ladder row (next hardware window): wall-clock decode
     tokens/s of the serving engine running the COMPILED Pallas
@@ -1376,6 +1498,8 @@ def run_child(metric):
         bench_paged_kv_occupancy(on_tpu, rtt)
     elif metric == "paged_decode_bytes":
         bench_paged_decode_bytes(on_tpu, rtt)
+    elif metric == "serve_trace_overhead":
+        bench_serve_trace_overhead(on_tpu, rtt)
     elif metric == "paged_decode_tokens_per_s":
         bench_paged_decode_tokens_per_s(on_tpu, rtt)
     elif metric == "bert_large_samples_per_s":

@@ -24,12 +24,13 @@ from deepspeed_tpu.inference.kv_cache import (KVCacheSpec, PageAllocator,
 from deepspeed_tpu.inference.scheduler import (FinishedRequest,
                                                PrefillBatch, Request,
                                                Scheduler)
+from deepspeed_tpu.inference.tracing import ServeTracer
 
 __all__ = [
     "InferenceEngine", "Request", "FinishedRequest", "PrefillBatch",
-    "Scheduler", "KVCacheSpec", "cache_spec_for", "init_kv_cache",
-    "kv_cache_bytes", "PagedKVSpec", "PageAllocator", "paged_spec_for",
-    "init_paged_kv_cache", "paged_kv_bytes", "pages_for", "pick_bucket",
-    "pad_prompts", "validate_buckets", "warmup_plan",
-    "qwz_distribute_params",
+    "Scheduler", "ServeTracer", "KVCacheSpec", "cache_spec_for",
+    "init_kv_cache", "kv_cache_bytes", "PagedKVSpec", "PageAllocator",
+    "paged_spec_for", "init_paged_kv_cache", "paged_kv_bytes",
+    "pages_for", "pick_bucket", "pad_prompts", "validate_buckets",
+    "warmup_plan", "qwz_distribute_params",
 ]

@@ -65,6 +65,17 @@ TPU-first:
   tokens in flight, prefix hit rate) — stream through the PR-3 monitor
   into ``events.jsonl`` (``Serve/*`` tags), rendered by
   ``tools/obs_report.py``'s serving section.
+- **Request-granular observability.** Every request carries a stamped
+  lifecycle trail (submit -> defer/admit -> prefill -> first token ->
+  sampled decode windows -> finish/evict) with a queue-wait / prefill /
+  time-between-tokens latency decomposition, SLO attainment + goodput
+  accounting against ``observability.serve.slo``, per-request Chrome
+  trace lanes, and live pool introspection via :meth:`debug_state` —
+  all host-side and sync-free (``inference/tracing.py``), so the
+  compiled program set and the zero-recompile contract are untouched
+  with tracing on. ``tools/obs_report.py --serve`` renders the SLO
+  report; the ``serve_trace_overhead`` bench row pins the no-overhead
+  claim.
 """
 
 import os
@@ -85,6 +96,7 @@ from deepspeed_tpu.inference.kv_cache import (PageAllocator, cache_spec_for,
                                               paged_spec_for, pages_for)
 from deepspeed_tpu.inference.scheduler import (FinishedRequest, Request,
                                                Scheduler)
+from deepspeed_tpu.inference.tracing import ServeTracer
 from deepspeed_tpu.models.gpt2 import (GPT2Config, gpt2_forward,
                                        gpt2_param_specs, init_gpt2_params)
 from deepspeed_tpu.models.llama import (LlamaConfig, init_llama_params,
@@ -92,7 +104,7 @@ from deepspeed_tpu.models.llama import (LlamaConfig, init_llama_params,
 from deepspeed_tpu.ops.attention.flash import NEG_INF
 from deepspeed_tpu.parallel.mesh import axis_size, build_mesh
 from deepspeed_tpu.profiling.recompile import CompileTracker
-from deepspeed_tpu.profiling.spans import trace_span
+from deepspeed_tpu.profiling.spans import ChromeTraceRecorder, trace_span
 from deepspeed_tpu.utils.logging import logger
 from deepspeed_tpu.utils.monitor import TensorBoardMonitor, _JsonlWriter
 
@@ -183,13 +195,16 @@ class InferenceEngine:
 
     def __init__(self, model_config, params, inference_config=None,
                  dtype=jnp.bfloat16, monitor: Optional[Any] = None,
-                 mesh: Optional[Any] = None):
+                 mesh: Optional[Any] = None, observability_config=None):
         self.model_config = model_config
         (self.family, self._forward, _,
          self._param_specs_fn) = _family_of(model_config)
         self.dtype = dtype
         cfg = _normalize_inference_config(inference_config)
         self.config = cfg
+        from deepspeed_tpu.runtime.config import get_observability_config
+        self.obs_config = get_observability_config(
+            {"observability": dict(observability_config or {})})
 
         self.num_slots = cfg["max_batch_size"]
         self._rows = self.num_slots + 1          # +1 scratch row
@@ -232,6 +247,37 @@ class InferenceEngine:
         else:
             self.params = jax.tree_util.tree_map(jnp.asarray, params)
 
+        # telemetry: monitor (PR-3 pattern) + crash-safe events.jsonl
+        # (size-rotated when observability.events_max_mb is set)
+        serve_obs = self.obs_config["serve"]
+        self.monitor = monitor if monitor is not None else \
+            TensorBoardMonitor(enabled=False)
+        self._log = None
+        if cfg["events_dir"]:
+            self._log = _JsonlWriter(cfg["events_dir"],
+                                     max_mb=serve_obs["events_max_mb"])
+            if getattr(self.monitor, "mirror", None) is None:
+                self.monitor.mirror = self._log
+        # per-request Chrome-trace lanes + engine phase spans land in
+        # one recorder when a chrome_trace_path is configured
+        self._recorder = None
+        self._chrome_path = self.obs_config["chrome_trace_path"] or None
+        if self._chrome_path:
+            self._recorder = ChromeTraceRecorder()
+        # the request-granular serving plane: lifecycle trail, latency
+        # decomposition histograms, SLO/goodput split — pure host code
+        # (inference/tracing.py), wired through the scheduler's hooks
+        self._tracer = ServeTracer(serve_obs, writer=self._log,
+                                   recorder=self._recorder)
+        self.compile_tracker = CompileTracker(
+            step_provider=lambda: self._steps, warn_after=0,
+            on_event=self._on_compile_event)
+        self._steps = 0
+        self._warm_compiles: Optional[int] = None
+        self._serve_secs = 0.0
+        self._state_event_every = 64       # serve_state cadence (steps)
+        self._key_cache: Dict[int, np.ndarray] = {}
+
         # ------------------------------------------------- KV cache
         pk = cfg["paged_kv"]
         self.paged = bool(pk["enabled"])
@@ -267,23 +313,8 @@ class InferenceEngine:
         self.scheduler = Scheduler(self.num_slots, cfg["prompt_buckets"],
                                    cfg["batch_buckets"], max_len,
                                    allocator=allocator,
-                                   lookahead=cfg["admit_lookahead"])
-
-        # telemetry: monitor (PR-3 pattern) + crash-safe events.jsonl
-        self.monitor = monitor if monitor is not None else \
-            TensorBoardMonitor(enabled=False)
-        self._log = None
-        if cfg["events_dir"]:
-            self._log = _JsonlWriter(cfg["events_dir"])
-            if getattr(self.monitor, "mirror", None) is None:
-                self.monitor.mirror = self._log
-        self.compile_tracker = CompileTracker(
-            step_provider=lambda: self._steps, warn_after=0,
-            on_event=self._on_compile_event)
-        self._steps = 0
-        self._warm_compiles: Optional[int] = None
-        self._serve_secs = 0.0
-        self._key_cache: Dict[int, np.ndarray] = {}
+                                   lookahead=cfg["admit_lookahead"],
+                                   tracer=self._tracer)
 
         if self.paged:
             self._prefill = self._wrap_program(
@@ -496,13 +527,69 @@ class InferenceEngine:
         with bounded-lookahead admission)."""
         return self.scheduler.submit(request)
 
+    def cancel(self, uid: int, reason: str = "evicted"
+               ) -> Optional[FinishedRequest]:
+        """Evict ``uid`` (queued or in flight): pages free immediately,
+        a ``serve_evict`` event lands in the trail, and the returned
+        FinishedRequest carries ``ttft_ms=None`` — never 0.0 — when the
+        request was evicted before its first token. None for unknown/
+        finished uids. Call between :meth:`step` calls, not inside
+        one."""
+        return self.scheduler.evict(uid, reason=reason)
+
+    def debug_state(self) -> Dict[str, Any]:
+        """Live introspection snapshot — pure host reads, zero device
+        syncs, safe to call mid-serving from a debug endpoint: page
+        pool occupancy/fragmentation + prefix-cache accounting, the
+        slot table, queue depth by prompt bucket, per-program dispatch
+        counts, and the tracer's SLO/latency histograms. Rendered by
+        ``tools/obs_report.py --serve`` from the periodic
+        ``serve_state`` event rows."""
+        sched = self.scheduler
+        slots = []
+        for sid in sched.active_slots():
+            s = sched.slots[sid]
+            slots.append({"slot": sid, "uid": s.request.uid,
+                          "position": s.position,
+                          "generated": len(s.tokens),
+                          "prefix_tokens": s.prefix_len,
+                          "pages": len(s.pages)})
+        ct = self.compile_tracker
+        programs = {n: {"dispatches": d, "compiles": ct.counts.get(n, 0)}
+                    for n, d in sorted(ct.dispatch_counts.items())}
+        pool = None
+        if self.paged and sched.allocator is not None:
+            pool = sched.allocator.debug_state()
+            used_tokens = pool["pages_in_use"] * pool["page_size"]
+            # internal fragmentation: reserved pool capacity not yet
+            # holding a live token (padding tails + reserved-but-
+            # unreached decode pages)
+            pool["tokens_in_flight"] = sched.tokens_in_flight
+            pool["internal_fragmentation"] = round(
+                1.0 - sched.tokens_in_flight / used_tokens, 4) \
+                if used_tokens else 0.0
+            pool["decode_attn_path"] = self._decode_attn_path
+        return {
+            "family": self.family,
+            "steps": self._steps,
+            "queue_depth": sched.queue_depth,
+            "queue_by_bucket": sched.queue_by_bucket(),
+            "occupancy": round(sched.occupancy, 4),
+            "slots": slots,
+            "programs": programs,
+            "steady_state_recompiles": self.steady_state_recompiles,
+            "page_pool": pool,
+            "slo": self._tracer.snapshot(),
+        }
+
     def _run_prefill(self, batch) -> np.ndarray:
         keys = np.zeros((batch.batch_bucket, 2), np.uint32)
         temps = np.zeros((batch.batch_bucket,), np.float32)
         for i, req in enumerate(batch.requests):
             keys[i] = self._key_for(req.seed)
             temps[i] = req.temperature
-        with trace_span("serve/prefill", batch=batch.batch_bucket,
+        with trace_span("serve/prefill", recorder=self._recorder,
+                        batch=batch.batch_bucket,
                         prompt=batch.prompt_bucket):
             if self.paged:
                 suffixes = [r.prompt[pl:] for r, pl in
@@ -545,13 +632,24 @@ class InferenceEngine:
         t_start = time.perf_counter()
 
         for batch in sched.admit():
+            t0 = time.perf_counter()
             first = self._run_prefill(batch)
+            prefill_ms = (time.perf_counter() - t0) * 1e3
+            for i, (sid, req) in enumerate(zip(batch.slot_ids,
+                                               batch.requests)):
+                self._tracer.on_prefill(
+                    req.uid, sid, prefill_ms, batch.prompt_bucket,
+                    batch.batch_bucket, len(batch.requests))
             finished.extend(sched.record_tokens(
                 {sid: int(first[i])
                  for i, sid in enumerate(batch.slot_ids)}))
             for ttft in sched.drain_ttfts():
                 self.monitor.write_serving_metrics(
                     ttft_ms=ttft, tokens=sched.total_tokens, flush=False)
+            for qwait in sched.drain_queue_waits():
+                self.monitor.write_serving_metrics(
+                    queue_wait_ms=qwait, tokens=sched.total_tokens,
+                    flush=False)
 
         sids, toks, poss, temps, seeds = sched.decode_state()
         if sids:
@@ -567,7 +665,8 @@ class InferenceEngine:
                 temps_a[sid] = temp
                 keys_a[sid] = self._key_for(seed)
             t0 = time.perf_counter()
-            with trace_span("serve/decode", active=len(sids)):
+            with trace_span("serve/decode", recorder=self._recorder,
+                            active=len(sids)):
                 if self.paged:
                     # clamp the dispatch's table width to the batch's
                     # live-page bucket: reads (kernel walk or gather
@@ -607,22 +706,34 @@ class InferenceEngine:
                     decode_attn_path=(
                         1.0 if self._decode_attn_path == "pallas"
                         else 0.0))
+            tracer = self._tracer
+            slo_kw = {}
+            if tracer.enabled:
+                tbts = tracer.drain_step_tbts()
+                if tbts:
+                    slo_kw["tbt_ms"] = sum(tbts) / len(tbts)
+                att = tracer.slo_attainment
+                if att is not None:
+                    slo_kw["slo_attainment"] = att
+                    slo_kw["goodput_tokens_per_s"] = (
+                        tracer.good_tokens / self._serve_secs
+                        if self._serve_secs > 0 else 0.0)
             self.monitor.write_serving_metrics(
                 token_latency_ms=tok_ms, tokens_per_sec=tps,
                 queue_depth=sched.queue_depth, batch_occupancy=occupancy,
-                tokens=sched.total_tokens, flush=False, **paged_kw)
+                tokens=sched.total_tokens, flush=False, **paged_kw,
+                **slo_kw)
         else:
             self._serve_secs += time.perf_counter() - t_start
 
-        for f in finished:
-            if self._log is not None:
-                self._log.add_event(
-                    "serve_finish", uid=f.uid, reason=f.finish_reason,
-                    new_tokens=len(f.tokens),
-                    ttft_ms=round(f.ttft_ms or 0.0, 3),
-                    latency_ms=round(f.latency_ms, 3))
+        # serve_finish / serve_evict rows are emitted by the tracer as
+        # the scheduler retires each request (sync-free host appends)
         self.monitor.flush()
         self._steps += 1
+        if self._log is not None and self._state_event_every and \
+                self._steps % self._state_event_every == 0:
+            self._log.add_event("serve_state", step=self._steps,
+                                **self.debug_state())
         return finished
 
     def run(self) -> List[FinishedRequest]:
@@ -730,7 +841,8 @@ class InferenceEngine:
                         tag: Optional[str] = None, inference_config=None,
                         dtype=jnp.bfloat16, monitor: Optional[Any] = None,
                         quantize_weights: Optional[bool] = None,
-                        verify_integrity: bool = True):
+                        verify_integrity: bool = True,
+                        observability_config=None):
         """Build a serving engine from a committed training checkpoint.
 
         Loads the ``model_states`` group ONLY (params-only mode —
@@ -782,7 +894,8 @@ class InferenceEngine:
             logger.info(f"from_checkpoint: params distributed via qwZ "
                         f"int8 (block {cfg['quantize_block']})")
         engine = cls(model_config, params, cfg, dtype=dtype,
-                     monitor=monitor, mesh=mesh)
+                     monitor=monitor, mesh=mesh,
+                     observability_config=observability_config)
         if engine._log is not None:
             engine._log.add_event(
                 "serve_load", checkpoint=chosen,
@@ -797,8 +910,19 @@ class InferenceEngine:
                                 wall_ms=round(ev.wall_ms, 3), step=ev.step)
 
     def close(self):
+        if self._log is not None:
+            # seal the run with a final pool/SLO snapshot — obs_report
+            # renders the LAST serve_state row as the pool view
+            self._log.add_event("serve_state", step=self._steps,
+                                **self.debug_state())
+        if self._chrome_path and self._recorder is not None:
+            try:
+                self._recorder.dump(self._chrome_path)
+            except Exception:
+                pass
         if getattr(self.monitor, "mirror", None) is self._log:
             self.monitor.mirror = None
         if self._log is not None:
             self._log.close()
             self._log = None
+        self._tracer.writer = None

@@ -66,6 +66,8 @@ class PageAllocator:
         # cumulative telemetry
         self.prefix_hit_tokens = 0
         self.prefix_miss_tokens = 0
+        self.prefix_hit_requests = 0     # admissions that reused pages
+        self.prefix_evictions = 0        # cache entries dropped on free
 
     # ------------------------------------------------------------ state
     @property
@@ -78,6 +80,33 @@ class PageAllocator:
 
     def refcount(self, page: int) -> int:
         return self._ref.get(page, 0)
+
+    @property
+    def prefix_entries(self) -> int:
+        """Live prefix-cache entries (pages currently matchable)."""
+        return len(self._prefix)
+
+    def debug_state(self) -> dict:
+        """Pool snapshot for live introspection (engine.debug_state()):
+        occupancy, sharing, and prefix-cache accounting — pure host
+        reads, no device touch."""
+        shared = sum(1 for c in self._ref.values() if c > 1)
+        return {
+            "num_pages": self.num_pages,
+            "page_size": self.page_size,
+            "pages_free": self.free_pages,
+            "pages_in_use": self.pages_in_use,
+            "pages_shared": shared,
+            "shared_duplicate_tokens": self.shared_duplicate_tokens,
+            "prefix_cache": {
+                "enabled": self.prefix_cache_enabled,
+                "entries": self.prefix_entries,
+                "hit_requests": self.prefix_hit_requests,
+                "hit_tokens": self.prefix_hit_tokens,
+                "miss_tokens": self.prefix_miss_tokens,
+                "evictions": self.prefix_evictions,
+            },
+        }
 
     @property
     def shared_duplicate_tokens(self) -> int:
@@ -118,6 +147,7 @@ class PageAllocator:
                 h = self._page_hash.pop(p, None)
                 if h is not None and self._prefix.get(h) == p:
                     del self._prefix[h]
+                    self.prefix_evictions += 1
                 self._page_tokens.pop(p, None)
                 self._page_parent.pop(p, None)
                 self._free.append(p)

@@ -65,13 +65,19 @@ class Request:
 
 @dataclass
 class FinishedRequest:
-    """A completed request plus its serving telemetry."""
+    """A completed request plus its serving telemetry. ``ttft_ms`` is
+    None — never 0.0 — for a request evicted before its first token;
+    ``queue_wait_ms`` is the submit -> admit wait (None when evicted
+    straight out of the queue), the first leg of the per-request
+    latency decomposition (queue_wait / prefill / TBT —
+    inference/tracing.py)."""
     uid: int
     prompt: List[int]
     tokens: List[int]            # generated tokens (EOS included if hit)
-    finish_reason: str           # "eos" | "length"
+    finish_reason: str           # "eos" | "length" | "evicted"
     ttft_ms: Optional[float]
     latency_ms: float            # submit -> finish wall time
+    queue_wait_ms: Optional[float] = None
 
 
 @dataclass
@@ -102,6 +108,7 @@ class _Slot:
     ttft_ms: Optional[float] = None
     pages: List[int] = field(default_factory=list)   # paged mode only
     prefix_len: int = 0          # tokens reused from the prefix cache
+    queue_wait_ms: float = 0.0   # submit -> admit (latency decomposition)
 
 
 class Scheduler:
@@ -115,13 +122,19 @@ class Scheduler:
     ``allocator`` (paged mode) makes admission page-aware; ``lookahead``
     bounds how many queued requests past the head are scanned for one
     that fits when the head doesn't (head-of-line fix; 0 = strict FIFO).
+
+    ``tracer`` (optional, an ``inference/tracing.py`` ServeTracer or
+    anything with its hook surface) receives the request lifecycle:
+    submit, defer (with reason), prefix hit, admit, first token,
+    per-token, finish/evict. Hooks are pure host calls — scheduling
+    stays jax-free with tracing on.
     """
 
     def __init__(self, num_slots: int, prompt_buckets: Sequence[int],
                  batch_buckets: Sequence[int], max_len: int,
                  clock=time.monotonic,
                  allocator: Optional[PageAllocator] = None,
-                 lookahead: int = 0):
+                 lookahead: int = 0, tracer=None):
         if num_slots < 1:
             raise ValueError("num_slots must be >= 1")
         if lookahead < 0:
@@ -133,11 +146,13 @@ class Scheduler:
         self._clock = clock
         self.allocator = allocator
         self.lookahead = int(lookahead)
+        self.tracer = tracer
         self.queue: List[Request] = []
         self.slots: List[Optional[_Slot]] = [None] * self.num_slots
         self._submit_time: Dict[int, float] = {}
         self.finished: List[FinishedRequest] = []
         self._new_ttfts: List[float] = []
+        self._new_queue_waits: List[float] = []
         # cumulative counters (serving telemetry)
         self.total_admitted = 0
         self.total_tokens = 0
@@ -195,7 +210,20 @@ class Scheduler:
                     f"{self.allocator.num_pages - 1} usable")
         self._submit_time[request.uid] = self._clock()
         self.queue.append(request)
+        if self.tracer is not None:
+            self.tracer.on_submit(request.uid, plen,
+                                  request.max_new_tokens)
         return request.uid
+
+    def queue_by_bucket(self) -> Dict[int, int]:
+        """Waiting requests per prompt bucket (live-pool introspection;
+        buckets are of the FULL prompt — admission may land a shorter
+        suffix bucket after a prefix hit)."""
+        out: Dict[int, int] = {}
+        for req in self.queue:
+            b = pick_bucket(len(req.prompt), self.prompt_buckets)
+            out[b] = out.get(b, 0) + 1
+        return out
 
     # ------------------------------------------------------------ admit
     def _match_prefix(self, req: Request) -> Tuple[List[int], int]:
@@ -231,6 +259,10 @@ class Scheduler:
         self.allocator.incref(shared)
         self.allocator.prefix_hit_tokens += reused
         self.allocator.prefix_miss_tokens += len(req.prompt) - reused
+        if reused:
+            self.allocator.prefix_hit_requests += 1
+            if self.tracer is not None:
+                self.tracer.on_prefix_hit(req.uid, reused, len(shared))
         pages = shared + fresh
         # publish this prompt's full pages for later (or same-batch)
         # requests sharing the prefix — content is determined by the
@@ -264,6 +296,7 @@ class Scheduler:
         """
         batches: List[PrefillBatch] = []
         free = self.free_slots()
+        tracer = self.tracer
         while free and self.queue:
             # head selection within the lookahead window
             head_idx = None
@@ -274,7 +307,16 @@ class Scheduler:
                 if res is not None:
                     head_idx, head_res = i, res
                     break
+                if tracer is not None:
+                    tracer.on_defer(req.uid, "pages")
             if head_idx is None:
+                # nothing in the window fits; whatever sits just past
+                # it wasn't even scanned — that's a lookahead defer,
+                # not a page defer (the tracer dedupes repeats)
+                if tracer is not None and \
+                        len(self.queue) > self.lookahead + 1:
+                    tracer.on_defer(
+                        self.queue[self.lookahead + 1].uid, "lookahead")
                 break
             head = self.queue[head_idx]
             head_bucket = pick_bucket(len(head.prompt) - head_res[1],
@@ -288,9 +330,13 @@ class Scheduler:
                 match = self._match_prefix(req)
                 if pick_bucket(len(req.prompt) - match[1],
                                self.prompt_buckets) != head_bucket:
+                    if tracer is not None:
+                        tracer.on_defer(req.uid, "bucket")
                     continue
                 res = self._try_reserve(req, match)
                 if res is None:
+                    if tracer is not None:
+                        tracer.on_defer(req.uid, "pages")
                     continue
                 take.append(req)
                 reserved.append(res)
@@ -300,11 +346,18 @@ class Scheduler:
             slot_ids = [free.pop(0) for _ in take]
             now = self._clock()
             for sid, req, (pages, reused) in zip(slot_ids, take, reserved):
+                t_sub = self._submit_time.pop(req.uid, now)
+                qwait = (now - t_sub) * 1e3
                 self.slots[sid] = _Slot(
                     request=req, position=len(req.prompt),
                     pending_tok=None, tokens=[],
-                    t_submit=self._submit_time.pop(req.uid, now),
-                    pages=pages, prefix_len=reused)
+                    t_submit=t_sub,
+                    pages=pages, prefix_len=reused,
+                    queue_wait_ms=qwait)
+                self._new_queue_waits.append(qwait)
+                if tracer is not None:
+                    tracer.on_admit(req.uid, sid, qwait, reused,
+                                    head_bucket, batch_bucket)
             self.total_admitted += len(take)
             batches.append(PrefillBatch(
                 slot_ids=slot_ids, requests=take,
@@ -325,6 +378,7 @@ class Scheduler:
         immediately for the next ``admit``. Returns the newly finished
         requests."""
         now = self._clock()
+        tracer = self.tracer
         done: List[FinishedRequest] = []
         for sid, tok in tokens.items():
             slot = self.slots[sid]
@@ -335,23 +389,37 @@ class Scheduler:
                 # the previous sample was written to the cache by the
                 # decode step that produced this one
                 slot.position += 1
+            req = slot.request
             if slot.ttft_ms is None:
                 slot.ttft_ms = (now - slot.t_submit) * 1e3
                 self._new_ttfts.append(slot.ttft_ms)
+                if tracer is not None:
+                    tracer.on_first_token(req.uid, slot.ttft_ms)
+            elif tracer is not None:
+                tracer.on_token(req.uid)
             slot.tokens.append(tok)
             slot.pending_tok = tok
             self.total_tokens += 1
-            req = slot.request
             hit_eos = req.eos_id is not None and tok == req.eos_id
             if hit_eos or len(slot.tokens) >= req.max_new_tokens:
-                done.append(FinishedRequest(
+                # ttft_ms can only be None here for a request whose
+                # first token never arrived — impossible on this path
+                # (a token was just recorded) but the FinishedRequest
+                # contract allows it (eviction produces it), so
+                # downstream consumers must treat None as "no first
+                # token", never as 0.0
+                fin = FinishedRequest(
                     uid=req.uid, prompt=list(req.prompt),
                     tokens=list(slot.tokens),
                     finish_reason="eos" if hit_eos else "length",
                     ttft_ms=slot.ttft_ms,
-                    latency_ms=(now - slot.t_submit) * 1e3))
+                    latency_ms=(now - slot.t_submit) * 1e3,
+                    queue_wait_ms=slot.queue_wait_ms)
+                done.append(fin)
                 self._release(slot)
                 self.slots[sid] = None
+                if tracer is not None:
+                    tracer.on_finish(fin)
         self.finished.extend(done)
         self.peak_tokens_in_flight = max(self.peak_tokens_in_flight,
                                          self.tokens_in_flight)
@@ -364,6 +432,56 @@ class Scheduler:
         out = self._new_ttfts
         self._new_ttfts = []
         return out
+
+    def drain_queue_waits(self) -> List[float]:
+        """Queue waits (submit -> admit ms) recorded since the last
+        drain — one ``Serve/queue_wait_ms`` scalar per admitted
+        request, the first leg of the latency decomposition."""
+        out = self._new_queue_waits
+        self._new_queue_waits = []
+        return out
+
+    # ---------------------------------------------------------- eviction
+    def evict(self, uid: int, reason: str = "evicted"
+              ) -> Optional[FinishedRequest]:
+        """Force ``uid`` out of the system — from the waiting queue or
+        from its live slot (pages freed, slot reusable next admit).
+        Returns the FinishedRequest (``ttft_ms`` None — NOT 0.0 — when
+        no first token was ever produced), or None for an unknown/
+        already-finished uid. Must not be called between building a
+        decode batch and recording its tokens (the engine's ``step`` is
+        atomic in that respect)."""
+        now = self._clock()
+        for i, req in enumerate(self.queue):
+            if req.uid == uid:
+                self.queue.pop(i)
+                t_sub = self._submit_time.pop(uid, now)
+                fin = FinishedRequest(
+                    uid=uid, prompt=list(req.prompt), tokens=[],
+                    finish_reason=reason, ttft_ms=None,
+                    latency_ms=(now - t_sub) * 1e3,
+                    queue_wait_ms=None)
+                self.finished.append(fin)
+                if self.tracer is not None:
+                    self.tracer.on_finish(fin, evicted=True)
+                return fin
+        for sid in self.active_slots():
+            slot = self.slots[sid]
+            if slot.request.uid != uid:
+                continue
+            fin = FinishedRequest(
+                uid=uid, prompt=list(slot.request.prompt),
+                tokens=list(slot.tokens), finish_reason=reason,
+                ttft_ms=slot.ttft_ms,
+                latency_ms=(now - slot.t_submit) * 1e3,
+                queue_wait_ms=slot.queue_wait_ms)
+            self._release(slot)
+            self.slots[sid] = None
+            self.finished.append(fin)
+            if self.tracer is not None:
+                self.tracer.on_finish(fin, evicted=True)
+            return fin
+        return None
 
     # -------------------------------------------- decode-batch assembly
     def decode_state(self):

@@ -1,0 +1,365 @@
+"""Request-granular serving observability (the serving-plane tracer).
+
+The aggregate ``Serve/*`` scalars answer "how fast is the engine";
+they cannot answer "why was THIS request slow" — queue wait? prefill
+bucket padding? page starvation behind an oversized head? That is the
+question a production serving system must answer per request, so every
+:class:`~.scheduler.Request` gets a stamped lifecycle trail written
+into the crash-safe ``events.jsonl``:
+
+    serve_submit -> [serve_defer (reason: pages | bucket | lookahead)]*
+                 -> [serve_prefix_hit] -> serve_admit -> serve_prefill
+                 -> serve_first_token -> [serve_decode_window]*
+                 -> serve_finish | serve_evict
+
+plus a latency decomposition per request (queue_wait / prefill /
+time-between-tokens), bounded-histogram percentiles (p50/p95/p99 via
+:class:`~deepspeed_tpu.utils.monitor.Histogram` — memory stays bounded
+over millions of requests), and SLO/goodput accounting: a request is
+*within SLO* when its TTFT and mean TBT beat the configured
+``observability.serve.slo`` thresholds, ``slo_attainment`` is the
+fraction of finished requests within SLO, and *goodput* counts only
+their tokens — so raw throughput and user-visible goodput are distinct
+numbers in every run report.
+
+Everything here is pure host code and sync-free by construction:
+stamps are host wall-clock (``time.perf_counter``), events are
+line-buffered file appends, and nothing imports jax — the compiled
+program set, the warmup dispatch count, and the zero-per-dispatch-sync
+contract are untouched with tracing on (pinned source-level by the
+jax-free test in tests/unit/test_inference.py and end-to-end by the
+``serve_trace_overhead`` bench row).
+
+Chrome-trace request lanes: with a recorder attached (the engine wires
+``profiling/spans.py``'s :class:`ChromeTraceRecorder` when
+``observability.chrome_trace_path`` is set), each finished request
+emits its queue_wait / prefill / decode phases onto its own lane
+(``tid`` = request uid), so Perfetto shows per-request timelines next
+to the engine's prefill/decode phase spans.
+"""
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Set
+
+from deepspeed_tpu.utils.monitor import Histogram
+
+__all__ = ["ServeTracer", "DEFER_REASONS"]
+
+#: the pinned defer vocabulary (docs/observability.md event schema):
+#: "pages"      - page reservation failed (pool starvation)
+#: "bucket"     - ride-along skipped: prompt bucket != the head's
+#: "lookahead"  - outside the bounded admission window this round
+DEFER_REASONS = ("pages", "bucket", "lookahead")
+
+
+@dataclass
+class _ReqTrace:
+    """Host-side per-request stamps (tracer clock)."""
+    uid: int
+    prompt_tokens: int = 0
+    max_new_tokens: int = 0
+    t_submit: float = 0.0
+    t_admit: Optional[float] = None
+    t_first: Optional[float] = None
+    t_last: Optional[float] = None
+    slot: Optional[int] = None
+    queue_wait_ms: Optional[float] = None     # scheduler-clock values
+    ttft_ms: Optional[float] = None
+    n_tokens: int = 0
+    tbt_sum: float = 0.0
+    tbt_max: float = 0.0
+    # decode-window sampling state (intervals tracked separately: the
+    # first window spans stride-1 TBT intervals, later ones stride)
+    window_t0: Optional[float] = None
+    window_tokens: int = 0
+    window_intervals: int = 0
+    deferred: Set[str] = field(default_factory=set)
+
+
+class ServeTracer:
+    """Lifecycle tracing + SLO/goodput accounting for the serving
+    engine.
+
+    ``cfg`` is the parsed ``observability.serve`` section
+    (``{"enabled", "slo": {"ttft_ms", "tbt_ms"}, "sample_rate"}``);
+    ``writer`` a ``_JsonlWriter``-shaped sink (or None — accounting
+    still runs for :meth:`snapshot`/``engine.debug_state()``);
+    ``recorder`` an optional Chrome-trace recorder with an
+    ``add_lane`` method. When ``enabled`` is False every hook is a
+    no-op except :meth:`on_finish`, which still emits the legacy
+    ``serve_finish``/``serve_evict`` row (the pre-tracing schema, with
+    ``ttft_ms`` null for requests evicted before their first token).
+
+    The scheduler owns the request-ms values it computes with its own
+    (injectable) clock — queue wait, TTFT, total latency ride in
+    through the hook arguments; the tracer's own clock covers only
+    what the scheduler doesn't measure: time-between-tokens and the
+    Chrome lane spans.
+    """
+
+    #: defaults when constructed without a parsed config section
+    DEFAULT_SLO_TTFT_MS = 2000.0
+    DEFAULT_SLO_TBT_MS = 200.0
+    DEFAULT_SAMPLE_RATE = 0.0625          # one window row per 16 tokens
+
+    def __init__(self, cfg: Optional[Dict[str, Any]] = None,
+                 writer=None, recorder=None, clock=time.perf_counter):
+        cfg = cfg or {}
+        slo = cfg.get("slo") or {}
+        self.enabled = bool(cfg.get("enabled", True))
+        self.slo_ttft_ms = float(slo.get("ttft_ms",
+                                         self.DEFAULT_SLO_TTFT_MS))
+        self.slo_tbt_ms = float(slo.get("tbt_ms", self.DEFAULT_SLO_TBT_MS))
+        rate = float(cfg.get("sample_rate", self.DEFAULT_SAMPLE_RATE))
+        # deterministic stride, not RNG: a window row every 1/rate
+        # tokens per request (0 disables window sampling)
+        self.window_tokens = int(round(1.0 / rate)) if rate > 0 else 0
+        self.writer = writer
+        self.recorder = recorder
+        self._clock = clock
+        self._req: Dict[int, _ReqTrace] = {}
+        self.hist = {"queue_wait_ms": Histogram(), "ttft_ms": Histogram(),
+                     "prefill_ms": Histogram(), "tbt_ms": Histogram()}
+        # SLO / goodput accounting
+        self.finished = 0
+        self.finished_in_slo = 0
+        self.evicted = 0
+        self.good_tokens = 0
+        self.finished_tokens = 0
+        self._step_tbts: List[float] = []
+
+    # ------------------------------------------------------------- sinks
+    def _event(self, kind: str, **fields) -> None:
+        if self.writer is not None:
+            self.writer.add_event(kind, **fields)
+
+    @staticmethod
+    def _r(v: Optional[float]) -> Optional[float]:
+        return round(v, 3) if v is not None else None
+
+    # ------------------------------------------------------------- hooks
+    def on_submit(self, uid: int, prompt_tokens: int,
+                  max_new_tokens: int) -> None:
+        if not self.enabled:
+            return
+        self._req[uid] = _ReqTrace(uid=uid, prompt_tokens=prompt_tokens,
+                                   max_new_tokens=max_new_tokens,
+                                   t_submit=self._clock())
+        self._event("serve_submit", uid=uid, prompt_tokens=prompt_tokens,
+                    max_new_tokens=max_new_tokens)
+
+    def on_defer(self, uid: int, reason: str) -> None:
+        """One admission pass skipped ``uid`` for ``reason``. Deduped
+        per (uid, reason) — admission rescans its window every engine
+        step, and an event per rescan would swamp the log with copies
+        of the same fact."""
+        if not self.enabled:
+            return
+        tr = self._req.get(uid)
+        if tr is None or reason in tr.deferred:
+            return
+        tr.deferred.add(reason)
+        self._event("serve_defer", uid=uid, reason=str(reason))
+
+    def on_prefix_hit(self, uid: int, tokens: int, pages: int) -> None:
+        if not self.enabled:
+            return
+        self._event("serve_prefix_hit", uid=uid, tokens=int(tokens),
+                    pages=int(pages))
+
+    def on_admit(self, uid: int, slot: int, queue_wait_ms: float,
+                 prefix_tokens: int, prompt_bucket: int,
+                 batch_bucket: int) -> None:
+        if not self.enabled:
+            return
+        tr = self._req.get(uid)
+        if tr is None:       # submitted before the tracer existed
+            tr = self._req[uid] = _ReqTrace(uid=uid,
+                                            t_submit=self._clock())
+        tr.t_admit = self._clock()
+        tr.slot = slot
+        tr.queue_wait_ms = queue_wait_ms
+        tr.deferred.clear()
+        self.hist["queue_wait_ms"].record(queue_wait_ms)
+        self._event("serve_admit", uid=uid, slot=int(slot),
+                    queue_wait_ms=self._r(queue_wait_ms),
+                    prefix_tokens=int(prefix_tokens),
+                    prompt_bucket=int(prompt_bucket),
+                    batch_bucket=int(batch_bucket))
+
+    def on_prefill(self, uid: int, slot: int, wall_ms: float,
+                   prompt_bucket: int, batch_bucket: int,
+                   rows: int) -> None:
+        """The engine ran ``uid``'s prefill dispatch (``rows`` real
+        requests shared the padded (batch_bucket, prompt_bucket)
+        program — the wall time is the batch's, amortized context for
+        this request's trail)."""
+        if not self.enabled:
+            return
+        self._event("serve_prefill", uid=uid, slot=int(slot),
+                    wall_ms=self._r(wall_ms),
+                    prompt_bucket=int(prompt_bucket),
+                    batch_bucket=int(batch_bucket), rows=int(rows))
+
+    def on_first_token(self, uid: int, ttft_ms: float) -> None:
+        if not self.enabled:
+            return
+        tr = self._req.get(uid)
+        if tr is None:
+            return
+        now = self._clock()
+        tr.t_first = tr.t_last = now
+        tr.ttft_ms = ttft_ms
+        tr.n_tokens = 1
+        tr.window_t0 = now
+        tr.window_tokens = 1
+        tr.window_intervals = 0
+        prefill_ms = (ttft_ms - tr.queue_wait_ms
+                      if tr.queue_wait_ms is not None else None)
+        self.hist["ttft_ms"].record(ttft_ms)
+        if prefill_ms is not None:
+            self.hist["prefill_ms"].record(max(prefill_ms, 0.0))
+        self._event("serve_first_token", uid=uid, ttft_ms=self._r(ttft_ms),
+                    prefill_ms=self._r(prefill_ms))
+
+    def on_token(self, uid: int) -> None:
+        """One decode token for ``uid``: a time-between-tokens sample,
+        plus the sampled ``serve_decode_window`` row at window
+        boundaries."""
+        if not self.enabled:
+            return
+        tr = self._req.get(uid)
+        if tr is None or tr.t_last is None:
+            return
+        now = self._clock()
+        tbt = (now - tr.t_last) * 1e3
+        tr.t_last = now
+        tr.n_tokens += 1
+        tr.tbt_sum += tbt
+        tr.tbt_max = max(tr.tbt_max, tbt)
+        self.hist["tbt_ms"].record(tbt)
+        self._step_tbts.append(tbt)
+        tr.window_tokens += 1
+        tr.window_intervals += 1
+        if self.window_tokens and tr.window_tokens >= self.window_tokens:
+            window_ms = (now - tr.window_t0) * 1e3
+            self._event(
+                "serve_decode_window", uid=uid, tokens=tr.window_tokens,
+                end_token=tr.n_tokens,
+                window_ms=self._r(window_ms),
+                tbt_ms=self._r(window_ms / max(tr.window_intervals, 1)))
+            tr.window_t0 = now
+            tr.window_tokens = 0
+            tr.window_intervals = 0
+
+    def on_finish(self, fin, evicted: bool = False) -> None:
+        """Terminal hook — ``fin`` is the scheduler's
+        :class:`FinishedRequest`. Emits ``serve_finish`` (or
+        ``serve_evict``), classifies the request against the SLO, and
+        draws the Chrome lane spans. ``ttft_ms`` is ``null`` (never
+        0.0) for requests evicted before their first token."""
+        kind = "serve_evict" if evicted else "serve_finish"
+        tr = self._req.pop(fin.uid, None) if self.enabled else None
+        if tr is None:
+            # tracing off (or unknown uid): the legacy row, ttft
+            # honest-null for no-first-token evictions
+            self._event(kind, uid=fin.uid, reason=fin.finish_reason,
+                        new_tokens=len(fin.tokens),
+                        ttft_ms=self._r(fin.ttft_ms),
+                        latency_ms=self._r(fin.latency_ms))
+            if self.enabled:
+                self._account(fin, evicted, tbt_mean=None)
+            return
+        tbt_mean = (tr.tbt_sum / (tr.n_tokens - 1)
+                    if tr.n_tokens > 1 else None)
+        prefill_ms = (fin.ttft_ms - tr.queue_wait_ms
+                      if fin.ttft_ms is not None
+                      and tr.queue_wait_ms is not None else None)
+        slo_ok = self._account(fin, evicted, tbt_mean)
+        self._event(kind, uid=fin.uid, reason=fin.finish_reason,
+                    new_tokens=len(fin.tokens),
+                    ttft_ms=self._r(fin.ttft_ms),
+                    latency_ms=self._r(fin.latency_ms),
+                    queue_wait_ms=self._r(tr.queue_wait_ms),
+                    prefill_ms=self._r(prefill_ms),
+                    tbt_ms=self._r(tbt_mean),
+                    tbt_ms_max=self._r(tr.tbt_max if tr.n_tokens > 1
+                                       else None),
+                    slo_ok=slo_ok)
+        self._lanes(tr)
+
+    def _account(self, fin, evicted: bool,
+                 tbt_mean: Optional[float]) -> bool:
+        """SLO classification + goodput counters. An evicted request —
+        or one whose first token never came — is by definition outside
+        SLO."""
+        self.finished += 1
+        self.finished_tokens += len(fin.tokens)
+        if evicted:
+            self.evicted += 1
+        slo_ok = (not evicted and fin.ttft_ms is not None
+                  and fin.ttft_ms <= self.slo_ttft_ms
+                  and (tbt_mean is None or tbt_mean <= self.slo_tbt_ms))
+        if slo_ok:
+            self.finished_in_slo += 1
+            self.good_tokens += len(fin.tokens)
+        return slo_ok
+
+    def _lanes(self, tr: _ReqTrace) -> None:
+        """Per-request Chrome-trace lane: queue_wait / prefill / decode
+        phase spans on lane ``tid = uid`` (drawn at finish so each
+        request costs a constant three events)."""
+        if self.recorder is None or not hasattr(self.recorder, "add_lane"):
+            return
+        now = self._clock()
+        lane = f"req {tr.uid}"
+        if tr.t_admit is not None:
+            self.recorder.add_lane(tr.uid, lane, "queue_wait",
+                                   tr.t_submit, tr.t_admit)
+            if tr.t_first is not None:
+                self.recorder.add_lane(tr.uid, lane, "prefill",
+                                       tr.t_admit, tr.t_first)
+                self.recorder.add_lane(tr.uid, lane, "decode",
+                                       tr.t_first, now,
+                                       tokens=tr.n_tokens)
+            else:
+                self.recorder.add_lane(tr.uid, lane, "prefill",
+                                       tr.t_admit, now)
+        else:
+            self.recorder.add_lane(tr.uid, lane, "queue_wait",
+                                   tr.t_submit, now)
+
+    # ------------------------------------------------------------ scalars
+    def drain_step_tbts(self) -> List[float]:
+        """TBT samples since the last drain (the engine writes their
+        mean as one ``Serve/tbt_ms`` scalar per decode dispatch)."""
+        out = self._step_tbts
+        self._step_tbts = []
+        return out
+
+    @property
+    def slo_attainment(self) -> Optional[float]:
+        if not self.finished:
+            return None
+        return self.finished_in_slo / self.finished
+
+    # ----------------------------------------------------------- reports
+    def snapshot(self) -> Dict[str, Any]:
+        """The SLO/latency block of ``engine.debug_state()`` and the
+        periodic ``serve_state`` event: bounded-histogram percentiles +
+        attainment/goodput counters (all host-side)."""
+        att = self.slo_attainment
+        return {
+            "enabled": self.enabled,
+            "slo": {"ttft_ms": self.slo_ttft_ms,
+                    "tbt_ms": self.slo_tbt_ms},
+            "finished": self.finished,
+            "evicted": self.evicted,
+            "in_slo": self.finished_in_slo,
+            "attainment": round(att, 4) if att is not None else None,
+            "good_tokens": self.good_tokens,
+            "finished_tokens": self.finished_tokens,
+            "in_flight": len(self._req),
+            "latency": {k: h.snapshot() for k, h in self.hist.items()},
+        }

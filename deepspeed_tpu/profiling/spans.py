@@ -38,6 +38,18 @@ class ChromeTraceRecorder:
         self.dropped = 0
         self._lock = threading.Lock()
         self._origin = time.perf_counter()
+        self._lanes: set = set()
+
+    def _append(self, *evs: dict) -> None:
+        """Append under the lock, then shed past ``max_events`` (oldest
+        first, count kept in ``dropped``) — the one shedding policy for
+        both the thread-span and lane paths."""
+        with self._lock:
+            self.events.extend(evs)
+            if len(self.events) > self.max_events:
+                shed = len(self.events) - self.max_events
+                del self.events[:shed]
+                self.dropped += shed
 
     def add(self, name: str, t0: float, t1: float, **extra) -> None:
         ev = {"name": name, "ph": "X", "cat": "deepspeed_tpu",
@@ -46,12 +58,41 @@ class ChromeTraceRecorder:
               "pid": os.getpid(), "tid": threading.get_ident()}
         if extra:
             ev["args"] = extra
-        with self._lock:
-            self.events.append(ev)
-            if len(self.events) > self.max_events:
-                shed = len(self.events) - self.max_events
-                del self.events[:shed]
-                self.dropped += shed
+        self._append(ev)
+
+    # the lane-id memo only suppresses duplicate thread_name metadata
+    # rows; past this many distinct lanes it resets (a re-emitted
+    # metadata row is harmless, an unbounded per-request set is a leak
+    # on a long-running serving daemon)
+    _LANES_CAP = 10_000
+
+    def add_lane(self, lane: int, lane_name: str, name: str,
+                 t0: float, t1: float, **extra) -> None:
+        """A complete event on a NAMED virtual lane (``tid = lane``)
+        instead of the calling thread — the serving tracer draws each
+        request's queue_wait/prefill/decode phases on its own
+        per-request lane (``lane`` = request uid, ``lane_name`` =
+        "req <uid>"). The first event on a lane also emits the
+        ``thread_name`` metadata row so Perfetto labels it; if the
+        bounded buffer later sheds that row, the lane falls back to
+        its numeric tid — cosmetic only."""
+        lane = int(lane)
+        ev = {"name": name, "ph": "X", "cat": "deepspeed_tpu/serve",
+              "ts": (t0 - self._origin) * 1e6,
+              "dur": max(t1 - t0, 0.0) * 1e6,
+              "pid": os.getpid(), "tid": lane}
+        if extra:
+            ev["args"] = extra
+        if lane not in self._lanes:
+            if len(self._lanes) >= self._LANES_CAP:
+                self._lanes.clear()
+            self._lanes.add(lane)
+            self._append(
+                {"name": "thread_name", "ph": "M",
+                 "pid": os.getpid(), "tid": lane,
+                 "args": {"name": lane_name}}, ev)
+        else:
+            self._append(ev)
 
     def dump(self, path: str) -> str:
         with self._lock:

@@ -467,9 +467,50 @@ def get_observability_config(param_dict):
             legacy_trace.get(C.PROFILER_NUM_STEPS,
                              C.PROFILER_NUM_STEPS_DEFAULT)),
     }
+    srv = sub.get(C.OBS_SERVE, {}) or {}
+    slo = srv.get(C.OBS_SERVE_SLO, {}) or {}
+    events_max_mb = sub.get(C.OBS_EVENTS_MAX_MB,
+                            C.OBS_EVENTS_MAX_MB_DEFAULT)
+    serve_max_mb = srv.get(C.OBS_SERVE_EVENTS_MAX_MB,
+                           C.OBS_SERVE_EVENTS_MAX_MB_DEFAULT)
+    serve = {
+        "enabled": bool(srv.get(C.OBS_SERVE_ENABLED,
+                                C.OBS_SERVE_ENABLED_DEFAULT)),
+        "slo": {
+            "ttft_ms": float(slo.get(C.OBS_SERVE_SLO_TTFT_MS,
+                                     C.OBS_SERVE_SLO_TTFT_MS_DEFAULT)),
+            "tbt_ms": float(slo.get(C.OBS_SERVE_SLO_TBT_MS,
+                                    C.OBS_SERVE_SLO_TBT_MS_DEFAULT)),
+        },
+        "sample_rate": float(srv.get(C.OBS_SERVE_SAMPLE_RATE,
+                                     C.OBS_SERVE_SAMPLE_RATE_DEFAULT)),
+        # serving events log inherits the top-level rotation cap
+        # unless overridden inside the serve section
+        "events_max_mb": float(events_max_mb if serve_max_mb is None
+                               else serve_max_mb),
+    }
+    # validated here (not only in DeepSpeedConfig) because the
+    # inference engine parses this section standalone
+    if serve["sample_rate"] < 0 or serve["sample_rate"] > 1:
+        raise DeepSpeedConfigError(
+            f"observability.serve.sample_rate must be in [0, 1], got "
+            f"{serve['sample_rate']}")
+    if serve["slo"]["ttft_ms"] <= 0 or serve["slo"]["tbt_ms"] <= 0:
+        raise DeepSpeedConfigError(
+            "observability.serve.slo thresholds must be > 0, got "
+            f"{serve['slo']}")
+    if float(events_max_mb) < 0:
+        raise DeepSpeedConfigError(
+            "observability.events_max_mb must be >= 0 (0 disables "
+            "rotation)")
+    if serve["events_max_mb"] < 0:
+        raise DeepSpeedConfigError(
+            "observability.serve.events_max_mb must be >= 0 (0 disables "
+            "rotation)")
     return {
         "enabled": sub.get(C.OBS_ENABLED, C.OBS_ENABLED_DEFAULT),
         "events_dir": sub.get(C.OBS_EVENTS_DIR, C.OBS_EVENTS_DIR_DEFAULT),
+        "events_max_mb": float(events_max_mb),
         "flops_profiler": sub.get(C.OBS_FLOPS_PROFILER,
                                   C.OBS_FLOPS_PROFILER_DEFAULT),
         "memory_watermarks": sub.get(C.OBS_MEMORY_WATERMARKS,
@@ -478,6 +519,7 @@ def get_observability_config(param_dict):
                                         C.OBS_RECOMPILE_WARN_AFTER_DEFAULT),
         "chrome_trace_path": sub.get(C.OBS_CHROME_TRACE_PATH,
                                      C.OBS_CHROME_TRACE_PATH_DEFAULT),
+        "serve": serve,
         "trace": trace,
     }
 

@@ -286,7 +286,34 @@ OBS_RECOMPILE_WARN_AFTER = "recompile_warn_after"
 OBS_RECOMPILE_WARN_AFTER_DEFAULT = 1
 OBS_CHROME_TRACE_PATH = "chrome_trace_path"
 OBS_CHROME_TRACE_PATH_DEFAULT = ""
+# size-based events.jsonl rotation (0 = off): the live file atomically
+# rolls to events.jsonl.<n> when it exceeds this many MiB, so a
+# long-running (serving) job's event log is bounded per segment;
+# tools/obs_report.py reads rotated segments back in order
+OBS_EVENTS_MAX_MB = "events_max_mb"
+OBS_EVENTS_MAX_MB_DEFAULT = 0
 OBS_TRACE = "trace"
+# request-granular serving observability (inference/tracing.py): the
+# lifecycle event trail, latency-decomposition histograms, and the
+# SLO/goodput split. Host-side and sync-free — on by default (the
+# serving engine emits nothing anyway unless inference.events_dir or a
+# monitor is wired).
+OBS_SERVE = "serve"
+OBS_SERVE_ENABLED = "enabled"
+OBS_SERVE_ENABLED_DEFAULT = True
+OBS_SERVE_SLO = "slo"
+OBS_SERVE_SLO_TTFT_MS = "ttft_ms"
+OBS_SERVE_SLO_TTFT_MS_DEFAULT = 2000.0    # time to first token budget
+OBS_SERVE_SLO_TBT_MS = "tbt_ms"
+OBS_SERVE_SLO_TBT_MS_DEFAULT = 200.0      # mean time-between-tokens budget
+# serve_decode_window sampling: one window row per request every
+# round(1/rate) tokens (deterministic stride, not RNG; 0 disables)
+OBS_SERVE_SAMPLE_RATE = "sample_rate"
+OBS_SERVE_SAMPLE_RATE_DEFAULT = 0.0625
+# per-section override of the rotation cap for the SERVING events log
+# (None = inherit the top-level observability.events_max_mb)
+OBS_SERVE_EVENTS_MAX_MB = "events_max_mb"
+OBS_SERVE_EVENTS_MAX_MB_DEFAULT = None
 
 #############################################
 # Async step pipeline (TPU-native: the host must never sit between two

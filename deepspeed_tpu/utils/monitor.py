@@ -15,13 +15,14 @@ set).
 """
 
 import json
+import math
 import os
 import socket
-from typing import Optional
+from typing import Dict, Optional
 
 from deepspeed_tpu.utils.logging import logger
 
-__all__ = ["TensorBoardMonitor", "get_summary_writer"]
+__all__ = ["TensorBoardMonitor", "get_summary_writer", "Histogram"]
 
 # serving telemetry tags (written by write_serving_metrics for the
 # inference engine; x-axis = cumulative generated tokens). Canonical
@@ -38,6 +39,92 @@ TAG_SERVE_TOKENS_IN_FLIGHT = "Serve/tokens_in_flight"  # live cache tokens
 TAG_SERVE_PREFIX_HIT = "Serve/prefix_hit_rate"      # prompt tokens reused
 TAG_SERVE_DECODE_ATTN = "Serve/decode_attn_path"    # 1 = pallas paged
 #                                                     kernel, 0 = gather
+# request-granular serving plane (ISSUE 9): latency decomposition +
+# SLO/goodput accounting (inference/tracing.py ServeTracer)
+TAG_SERVE_QUEUE_WAIT = "Serve/queue_wait_ms"        # per admitted request
+TAG_SERVE_TBT = "Serve/tbt_ms"                      # per decode dispatch
+#                                  (mean per-request time-between-tokens)
+TAG_SERVE_SLO = "Serve/slo_attainment"              # finished-in-SLO frac
+TAG_SERVE_GOODPUT = "Serve/goodput_tokens_per_s"    # within-SLO tokens/s
+
+
+class Histogram:
+    """Bounded log-bucketed latency histogram (the serving-plane
+    percentile sink).
+
+    Last-value scalars can't answer "what was p99 TTFT" without keeping
+    every sample; this keeps geometrically-spaced buckets instead —
+    memory is bounded by the value range (``O(decades x
+    bins_per_decade)`` integer counts, ~300 entries for ns..hours at
+    the default resolution), so a serving daemon can record millions of
+    requests without growing the host heap. Percentiles are
+    approximate: relative error is one bucket width
+    (``10^(1/bins_per_decade)`` — ~7.5% at the default 32/decade),
+    which is telemetry-grade, not benchmark-grade. Exact ``min``,
+    ``max``, ``count`` and ``sum`` ride along for free.
+    """
+
+    def __init__(self, bins_per_decade: int = 32, floor: float = 1e-3):
+        self.bins_per_decade = int(bins_per_decade)
+        self.floor = float(floor)       # values below land in bucket 0
+        self._buckets: Dict[int, int] = {}
+        self.count = 0
+        self.sum = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+
+    def _bucket(self, v: float) -> int:
+        if v <= self.floor:
+            return 0
+        return 1 + int(math.log10(v / self.floor) * self.bins_per_decade)
+
+    def _bucket_value(self, b: int) -> float:
+        if b == 0:
+            return self.floor
+        # geometric midpoint of the bucket's span
+        return self.floor * 10.0 ** ((b - 0.5) / self.bins_per_decade)
+
+    def record(self, v) -> None:
+        v = float(v)
+        if not math.isfinite(v):
+            return
+        b = self._bucket(v)
+        self._buckets[b] = self._buckets.get(b, 0) + 1
+        self.count += 1
+        self.sum += v
+        self.min = v if self.min is None else min(self.min, v)
+        self.max = v if self.max is None else max(self.max, v)
+
+    @property
+    def mean(self) -> Optional[float]:
+        return self.sum / self.count if self.count else None
+
+    def percentile(self, q: float) -> Optional[float]:
+        """Approximate q-quantile (q in [0, 1]); exact at the
+        extremes (q=0 -> min, q=1 -> max)."""
+        if not self.count:
+            return None
+        if q <= 0:
+            return self.min
+        if q >= 1:
+            return self.max
+        rank = q * (self.count - 1)
+        seen = 0
+        for b in sorted(self._buckets):
+            seen += self._buckets[b]
+            if seen > rank:
+                # clamp the bucket estimate into the exact bounds
+                return min(max(self._bucket_value(b), self.min), self.max)
+        return self.max
+
+    def snapshot(self) -> dict:
+        """The report-facing summary (rounded; JSON-friendly)."""
+        r = (lambda v: round(v, 3) if v is not None else None)
+        return {"count": self.count, "mean": r(self.mean),
+                "p50": r(self.percentile(0.50)),
+                "p95": r(self.percentile(0.95)),
+                "p99": r(self.percentile(0.99)),
+                "min": r(self.min), "max": r(self.max)}
 
 
 class _JsonlWriter:
@@ -52,17 +139,48 @@ class _JsonlWriter:
     Schema (pinned by tests/unit/test_monitor.py; tools/obs_report.py
     relies on it): scalar rows are ``{"tag": str, "value": float,
     "step": int}``; structured rows carry ``{"event": str, ...}``.
+
+    ``max_mb`` > 0 turns on size-based rotation: when the live file
+    exceeds the limit it is atomically renamed to
+    ``events.jsonl.<seq>`` (``os.replace`` — a crash mid-rollover
+    leaves either the old name or the new, never a torn file) and a
+    fresh ``events.jsonl`` opens, so a long serving run's event log is
+    bounded per segment instead of growing without limit.
+    ``tools/obs_report.py`` reads the rotated segments back in
+    sequence order before the live file.
     """
 
-    def __init__(self, log_dir: str):
+    def __init__(self, log_dir: str, max_mb: float = 0.0):
         os.makedirs(log_dir, exist_ok=True)
         self.path = os.path.join(log_dir, "events.jsonl")
+        self.max_bytes = int(float(max_mb or 0.0) * 2 ** 20)
+        self._seq = 1 + max(
+            (int(n.rsplit(".", 1)[1])
+             for n in os.listdir(log_dir)
+             if n.startswith("events.jsonl.")
+             and n.rsplit(".", 1)[1].isdigit()), default=0)
+        self._open()
+
+    def _open(self):
         self._f = open(self.path, "a", buffering=1)
+        self._bytes = self._f.tell()        # append mode: current size
+
+    def _write_line(self, line: str):
+        self._f.write(line)
+        self._bytes += len(line)
+        if self.max_bytes and self._bytes >= self.max_bytes:
+            self._rotate()
+
+    def _rotate(self):
+        self._f.close()
+        os.replace(self.path, f"{self.path}.{self._seq}")
+        self._seq += 1
+        self._open()
 
     def add_scalar(self, tag, value, step):
         if self._f is None:
             return
-        self._f.write(json.dumps(
+        self._write_line(json.dumps(
             {"tag": str(tag), "value": float(value), "step": int(step)})
             + "\n")
 
@@ -72,7 +190,7 @@ class _JsonlWriter:
             return
         row = {"event": str(kind)}
         row.update(fields)
-        self._f.write(json.dumps(row, default=str) + "\n")
+        self._write_line(json.dumps(row, default=str) + "\n")
 
     def flush(self):
         if self._f is not None:
@@ -221,7 +339,9 @@ class TensorBoardMonitor:
                               tokens_per_sec=None, queue_depth=None,
                               batch_occupancy=None, kv_pages_in_use=None,
                               tokens_in_flight=None, prefix_hit_rate=None,
-                              decode_attn_path=None,
+                              decode_attn_path=None, queue_wait_ms=None,
+                              tbt_ms=None, slo_attainment=None,
+                              goodput_tokens_per_s=None,
                               tokens: int = 0, flush: bool = True):
         """Serving telemetry (inference engine; TPU-native extension —
         the reference snapshot is training-only): time-to-first-token
@@ -233,10 +353,16 @@ class TensorBoardMonitor:
         the gather fallback, so a silent fallback is visible in run
         reports; the engine also logs a ``decode_attn_path`` event row
         with the reason, mirroring the comm autotuner's
-        which-exchange-compiled telemetry). The x-axis is cumulative
-        generated tokens (the serving analog of the training samples
-        axis). Tags are pinned by tests/unit/test_inference.py and
-        rendered by tools/obs_report.py's serving section."""
+        which-exchange-compiled telemetry). The request-granular plane
+        (inference/tracing.py) adds the latency decomposition and SLO
+        view: queue wait per admitted request, mean per-request
+        time-between-tokens per decode dispatch, the fraction of
+        finished requests that met the configured SLO, and the
+        within-SLO token rate — so throughput and *goodput* are
+        distinct numbers. The x-axis is cumulative generated tokens
+        (the serving analog of the training samples axis). Tags are
+        pinned by tests/unit/test_inference.py and rendered by
+        tools/obs_report.py's serving section."""
         if not self._writes():
             return
         if ttft_ms is not None:
@@ -261,6 +387,15 @@ class TensorBoardMonitor:
                               tokens)
         if decode_attn_path is not None:
             self.write_scalar(TAG_SERVE_DECODE_ATTN, decode_attn_path,
+                              tokens)
+        if queue_wait_ms is not None:
+            self.write_scalar(TAG_SERVE_QUEUE_WAIT, queue_wait_ms, tokens)
+        if tbt_ms is not None:
+            self.write_scalar(TAG_SERVE_TBT, tbt_ms, tokens)
+        if slo_attainment is not None:
+            self.write_scalar(TAG_SERVE_SLO, slo_attainment, tokens)
+        if goodput_tokens_per_s is not None:
+            self.write_scalar(TAG_SERVE_GOODPUT, goodput_tokens_per_s,
                               tokens)
         if flush:
             self.flush()

@@ -117,7 +117,7 @@ def test_host_side_scheduling_modules_stay_jax_free():
 
     import deepspeed_tpu.inference as inf
     root = pathlib.Path(inf.__file__).parent
-    for mod in ("scheduler.py", "paging.py", "buckets.py"):
+    for mod in ("scheduler.py", "paging.py", "buckets.py", "tracing.py"):
         src = (root / mod).read_text()
         for node in ast.walk(ast.parse(src)):
             if isinstance(node, ast.Import):
@@ -416,6 +416,14 @@ class TestInferenceEngine:
             obs_report.T_KV_PAGES
         assert m.TAG_SERVE_TOKENS_IN_FLIGHT == \
             prof.TAG_SERVE_TOKENS_IN_FLIGHT == obs_report.T_TOKENS_IN_FLIGHT
+        # ISSUE 9: the request-granular plane's tags (queue wait, TBT,
+        # SLO attainment, goodput) live in the same three homes
+        assert m.TAG_SERVE_QUEUE_WAIT == prof.TAG_SERVE_QUEUE_WAIT == \
+            obs_report.T_QUEUE_WAIT
+        assert m.TAG_SERVE_TBT == prof.TAG_SERVE_TBT == obs_report.T_TBT
+        assert m.TAG_SERVE_SLO == prof.TAG_SERVE_SLO == obs_report.T_SLO
+        assert m.TAG_SERVE_GOODPUT == prof.TAG_SERVE_GOODPUT == \
+            obs_report.T_GOODPUT
         assert m.TAG_SERVE_PREFIX_HIT == prof.TAG_SERVE_PREFIX_HIT == \
             obs_report.T_PREFIX_HIT
 

@@ -17,11 +17,22 @@ tests/unit/test_monitor.py) and prints the run report:
 - checkpoint events (saves / loads / fallbacks)
 - serving section (inference-engine runs): requests, TTFT p50/p95,
   per-token latency p50/p95, tokens/s, slot occupancy, queue depth
+- serving SLO section (``--serve`` renders it standalone): queue-wait /
+  TTFT / TBT p50/p95/p99, SLO attainment, goodput vs raw throughput,
+  evictions, and the page-pool / prefix-cache snapshot from the last
+  ``serve_state`` event
 - loss trajectory (first -> last)
 
 Usage::
 
-    python tools/obs_report.py <events.jsonl | dir containing it> [--json]
+    python tools/obs_report.py <events.jsonl | dir> [--json] [--serve]
+
+Rotated event logs (``observability.events_max_mb``) are read as one
+stream: ``events.jsonl.1``, ``.2``, ... in sequence order, then the
+live file. The ``--json`` output is versioned by a top-level
+``"schema"`` key (currently 2 — bumped when existing keys move or
+change meaning; additive keys don't bump it), so CI consumers can pin
+what they parse.
 
 Pure-stdlib and device-free: runnable on a laptop against a log rsync'd
 off a pod. ``summarize()`` is importable for programmatic use (the
@@ -64,6 +75,17 @@ T_KV_PAGES = "Serve/kv_pages_in_use"
 T_TOKENS_IN_FLIGHT = "Serve/tokens_in_flight"
 T_PREFIX_HIT = "Serve/prefix_hit_rate"
 T_DECODE_ATTN = "Serve/decode_attn_path"
+# request-granular serving plane (inference/tracing.py): latency
+# decomposition + SLO/goodput accounting
+T_QUEUE_WAIT = "Serve/queue_wait_ms"
+T_TBT = "Serve/tbt_ms"
+T_SLO = "Serve/slo_attainment"
+T_GOODPUT = "Serve/goodput_tokens_per_s"
+
+# --json output schema version: bumped when existing keys move or
+# change meaning (additive keys don't bump it). v2 = ISSUE 9 (serving
+# SLO section + this key itself).
+SCHEMA_VERSION = 2
 
 # host gap above this fraction of step time flags the run: the device
 # is waiting on the host often enough to cost real throughput
@@ -85,28 +107,46 @@ def find_events_file(path):
     raise FileNotFoundError(f"no events.jsonl under {path!r}")
 
 
+def segment_files(path):
+    """The event stream's files in write order: rotated segments
+    (``events.jsonl.<n>``, numeric order — the ``_JsonlWriter``
+    size-rotation scheme) first, the live file last."""
+    d = os.path.dirname(os.path.abspath(path))
+    base = os.path.basename(path)
+    segs = []
+    for name in os.listdir(d):
+        if name.startswith(base + "."):
+            suffix = name[len(base) + 1:]
+            if suffix.isdigit():
+                segs.append((int(suffix), os.path.join(d, name)))
+    return [p for _, p in sorted(segs)] + [path]
+
+
 def load_events(path):
     """(scalars_by_tag, event_rows): scalars as [(step, value)] per tag,
-    malformed lines skipped (a crash can tear the final line)."""
+    malformed lines skipped (a crash can tear the final line). Rotated
+    segments are folded in ahead of the live file, so a size-capped
+    log reads back as one ordered stream."""
     scalars = defaultdict(list)
     events = []
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                row = json.loads(line)
-            except ValueError:
-                continue
-            if "tag" in row and "value" in row:
-                try:
-                    scalars[str(row["tag"])].append(
-                        (int(row.get("step", 0)), float(row["value"])))
-                except (TypeError, ValueError):
+    for seg in segment_files(path):
+        with open(seg) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
                     continue
-            elif "event" in row:
-                events.append(row)
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    continue
+                if "tag" in row and "value" in row:
+                    try:
+                        scalars[str(row["tag"])].append(
+                            (int(row.get("step", 0)), float(row["value"])))
+                    except (TypeError, ValueError):
+                        continue
+                elif "event" in row:
+                    events.append(row)
     return dict(scalars), events
 
 
@@ -182,18 +222,48 @@ def summarize(path, host_gap_threshold=DEFAULT_HOST_GAP_THRESHOLD):
     tps = _vals(scalars, T_TPS)
     occ = _vals(scalars, T_OCC)
     qdepth = _vals(scalars, T_QDEPTH)
+    queue_wait = _vals(scalars, T_QUEUE_WAIT)
+    tbt = _vals(scalars, T_TBT)
     serve_finish = [e for e in events if e.get("event") == "serve_finish"]
+    serve_evict = [e for e in events if e.get("event") == "serve_evict"]
+    # the last serve_state event is the engine's closing
+    # debug_state() snapshot: page pool, prefix cache, SLO histograms
+    serve_state = next((e for e in reversed(events)
+                        if e.get("event") == "serve_state"), None)
+
+    def pctls(vs):
+        return {"p50": percentile(vs, 0.50), "p95": percentile(vs, 0.95),
+                "p99": percentile(vs, 0.99)}
+
+    slo_att = _last(scalars, T_SLO)
+    goodput = _last(scalars, T_GOODPUT)
+    state_slo = (serve_state or {}).get("slo") or {}
+    if slo_att is None and state_slo.get("attainment") is not None:
+        slo_att = state_slo["attainment"]
     serving = {
         "requests": len(ttft) or len(serve_finish),
+        "evictions": len(serve_evict),
         "decode_steps": len(tok_lat),
-        "ttft_ms": {"p50": percentile(ttft, 0.50),
-                    "p95": percentile(ttft, 0.95)},
-        "token_latency_ms": {"p50": percentile(tok_lat, 0.50),
-                             "p95": percentile(tok_lat, 0.95)},
+        # queue_wait/ttft rows are per admitted request (full
+        # fidelity); tbt rows are per-dispatch means of that step's
+        # per-request TBT samples (the request-exact percentiles live
+        # in the serve_state histogram snapshot)
+        "queue_wait_ms": pctls(queue_wait),
+        "ttft_ms": pctls(ttft),
+        "tbt_ms": pctls(tbt),
+        "token_latency_ms": pctls(tok_lat),
         "tokens_per_sec": {"last": tps[-1] if tps else None,
                            "best": max(tps) if tps else None},
+        "slo": {
+            "thresholds": state_slo.get("slo"),
+            "attainment": slo_att,
+            "goodput_tokens_per_s": goodput,
+            "throughput_tokens_per_s": tps[-1] if tps else None,
+        },
         "batch_occupancy_mean": (sum(occ) / len(occ)) if occ else None,
         "queue_depth_max": max(qdepth) if qdepth else None,
+        "pool": (serve_state or {}).get("page_pool"),
+        "histograms": state_slo.get("latency"),
     }
     # paged-KV view (absent on dense-cache runs: no rows, keys -> None)
     pages = _vals(scalars, T_KV_PAGES)
@@ -228,6 +298,7 @@ def summarize(path, host_gap_threshold=DEFAULT_HOST_GAP_THRESHOLD):
             ckpt["save_ms"].extend(v for _, v in rows)
 
     return {
+        "schema": SCHEMA_VERSION,
         "events_file": events_file,
         "steps": len(step_ms),
         "step_time_ms": {
@@ -360,13 +431,22 @@ def render(s):
         lines.append(line)
     sv = s.get("serving") or {}
     if sv.get("requests"):
+        evict_note = (f" evictions={sv['evictions']}"
+                      if sv.get("evictions") else "")
         lines += [
             f"  serving           : requests={sv['requests']} "
-            f"decode_steps={sv['decode_steps']} "
+            f"decode_steps={sv['decode_steps']}{evict_note} "
             f"tokens/s last={_fmt(sv['tokens_per_sec']['last'])} "
             f"best={_fmt(sv['tokens_per_sec']['best'])}",
+            f"    queue_wait_ms   : p50={_fmt(sv['queue_wait_ms']['p50'])} "
+            f"p95={_fmt(sv['queue_wait_ms']['p95'])} "
+            f"p99={_fmt(sv['queue_wait_ms']['p99'])}",
             f"    ttft_ms         : p50={_fmt(sv['ttft_ms']['p50'])} "
-            f"p95={_fmt(sv['ttft_ms']['p95'])}",
+            f"p95={_fmt(sv['ttft_ms']['p95'])} "
+            f"p99={_fmt(sv['ttft_ms']['p99'])}",
+            f"    tbt_ms          : p50={_fmt(sv['tbt_ms']['p50'])} "
+            f"p95={_fmt(sv['tbt_ms']['p95'])} "
+            f"p99={_fmt(sv['tbt_ms']['p99'])}",
             f"    token_latency_ms: "
             f"p50={_fmt(sv['token_latency_ms']['p50'])} "
             f"p95={_fmt(sv['token_latency_ms']['p95'])}",
@@ -375,6 +455,14 @@ def render(s):
             f"queue_depth_max="
             f"{_fmt(sv['queue_depth_max'], '{:.0f}')}",
         ]
+        slo = sv.get("slo") or {}
+        if slo.get("attainment") is not None:
+            lines.append(
+                f"    slo             : "
+                f"attainment={_fmt(slo['attainment'], '{:.1%}')} "
+                f"goodput={_fmt(slo['goodput_tokens_per_s'])} tok/s "
+                f"(throughput "
+                f"{_fmt(slo['throughput_tokens_per_s'])} tok/s)")
         pk = sv.get("paged_kv") or {}
         if pk.get("pages_in_use_peak") is not None:
             lines.append(
@@ -407,12 +495,85 @@ def render(s):
     return "\n".join(lines)
 
 
+def render_serve(s):
+    """The serving-plane report (``--serve``): the request-granular
+    latency/SLO view plus the live-pool snapshot — what an on-call
+    person wants first when a serving alarm fires."""
+    sv = s.get("serving") or {}
+    lines = [f"serving report: {s['events_file']}"]
+    if not sv.get("requests"):
+        lines.append("  (no serving telemetry in this log)")
+        return "\n".join(lines)
+    lines.append(
+        f"  requests          : {sv['requests']} "
+        f"(evictions={sv.get('evictions', 0)}) "
+        f"decode_steps={sv['decode_steps']}")
+
+    def pline(label, d, note=""):
+        return (f"  {label:<18}: p50={_fmt(d['p50'])} "
+                f"p95={_fmt(d['p95'])} p99={_fmt(d['p99'])} ms{note}")
+    lines += [
+        pline("queue_wait", sv["queue_wait_ms"]),
+        pline("ttft", sv["ttft_ms"]),
+        pline("tbt", sv["tbt_ms"], "  (per-dispatch means)"),
+    ]
+    slo = sv.get("slo") or {}
+    thr = slo.get("thresholds") or {}
+    if slo.get("attainment") is not None:
+        lines.append(
+            f"  slo_attainment    : {_fmt(slo['attainment'], '{:.1%}')}"
+            + (f"  (ttft<={_fmt(thr.get('ttft_ms'), '{:.0f}')} ms, "
+               f"tbt<={_fmt(thr.get('tbt_ms'), '{:.0f}')} ms)"
+               if thr else ""))
+        lines.append(
+            f"  goodput           : "
+            f"{_fmt(slo['goodput_tokens_per_s'])} tok/s within SLO "
+            f"(raw throughput "
+            f"{_fmt(slo['throughput_tokens_per_s'])} tok/s)")
+    hist = sv.get("histograms") or {}
+    tb = hist.get("tbt_ms")
+    if tb and tb.get("count"):
+        lines.append(
+            f"  tbt (per request) : p50={_fmt(tb['p50'])} "
+            f"p95={_fmt(tb['p95'])} p99={_fmt(tb['p99'])} ms "
+            f"({tb['count']} samples, histogram)")
+    pool = sv.get("pool")
+    if pool:
+        pc = pool.get("prefix_cache") or {}
+        seen = pc.get("hit_tokens", 0) + pc.get("miss_tokens", 0)
+        lines += [
+            f"  page_pool         : {pool['pages_in_use']}/"
+            f"{pool['num_pages'] - 1} pages in use "
+            f"({pool['pages_free']} free, page_size "
+            f"{pool['page_size']}, shared={pool.get('pages_shared', 0)}, "
+            f"internal_frag="
+            f"{_fmt(pool.get('internal_fragmentation'), '{:.1%}')})",
+            f"  prefix_cache      : {pc.get('entries', 0)} entries, "
+            f"{pc.get('hit_requests', 0)} hit requests, "
+            f"hit_rate={_fmt(pc.get('hit_tokens', 0) / seen if seen else None, '{:.1%}')} "
+            f"of prompt tokens, {pc.get('evictions', 0)} evictions",
+        ]
+        if pool.get("decode_attn_path") == "gather":
+            lines.append("  decode_attn       : gather  ** fallback: "
+                         "decode reads are stripe-wide, not "
+                         "O(live tokens) **")
+    occ = sv.get("batch_occupancy_mean")
+    lines.append(f"  occupancy         : mean={_fmt(occ, '{:.1%}')} "
+                 f"queue_depth_max="
+                 f"{_fmt(sv.get('queue_depth_max'), '{:.0f}')}")
+    return "\n".join(lines)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("path", help="events.jsonl file, or a directory "
                                  "containing one (searched recursively)")
     ap.add_argument("--json", action="store_true",
                     help="emit the summary as JSON instead of text")
+    ap.add_argument("--serve", action="store_true",
+                    help="render the serving-plane report (request "
+                         "percentiles, SLO attainment, goodput, pool "
+                         "snapshot) instead of the training summary")
     ap.add_argument("--host-gap-threshold", type=float,
                     default=DEFAULT_HOST_GAP_THRESHOLD,
                     help="flag the run when host-gap p50 exceeds this "
@@ -426,6 +587,8 @@ def main(argv=None):
         return 2
     if args.json:
         print(json.dumps(summary, indent=2))
+    elif args.serve:
+        print(render_serve(summary))
     else:
         print(render(summary))
     return 0
