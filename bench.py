@@ -63,6 +63,14 @@ Metrics (BASELINE.md rows):
   for both, greedy outputs bitwise equal; value = wall-clock overhead
   percent (min-of-5 interleaved runs), acceptance <= 5%;
   vs_baseline = traced tokens/s / untraced tokens/s
+- async_ckpt_stall_ms : HARDWARE-FREE — step-loop stall per global batch
+  when a checkpoint save rides every step, async (snapshot-and-return,
+  background writer commits) vs blocking, at EQUAL checkpoint size on
+  the forced 8-device CPU mesh: value = async stall ms/step (loop wall
+  minus a no-save baseline), vs_baseline = async stall / blocking stall
+  (ISSUE 10 acceptance: <= 0.20); detail pins dispatches/train_batch
+  unchanged at 1.0 for both modes and the newest async tag
+  COMMITTED+VERIFIED after the drain
 - paged_decode_tokens_per_s : TPU — wall-clock decode tokens/s of the
   serving engine with the compiled Pallas paged-decode kernel at a
   TPU-legal geometry (head_dim 128), vs_baseline = pallas tokens/s /
@@ -126,6 +134,7 @@ METRICS = [
     "paged_kv_occupancy",
     "paged_decode_bytes",
     "serve_trace_overhead",
+    "async_ckpt_stall_ms",
     "paged_decode_tokens_per_s",
     "bert_large_samples_per_s",
     "bert_onebit_samples_per_s",
@@ -139,7 +148,8 @@ HEADLINE = "gpt2_train_mfu"
 HW_FREE = {"comm_wire_bytes_per_step", "comm_overlap_structure",
            "mfu_cost_model", "host_dispatch_overhead",
            "decode_throughput", "paged_kv_occupancy",
-           "paged_decode_bytes", "serve_trace_overhead"}
+           "paged_decode_bytes", "serve_trace_overhead",
+           "async_ckpt_stall_ms"}
 
 PARTIAL_PATH = os.environ.get(
     "BENCH_PARTIAL", "/tmp/dstpu_bench_partial.jsonl")
@@ -1379,6 +1389,156 @@ def bench_serve_trace_overhead(on_tpu, rtt):
     return row
 
 
+def bench_async_ckpt_stall(on_tpu, rtt):
+    """Hardware-free row: the step-loop stall a checkpoint save costs
+    per global batch, async vs blocking, at EQUAL checkpoint size
+    (ISSUE 10). Three interleave-measured loops on the same
+    model/config/seed: no-save baseline, save-every-step blocking, and
+    save-every-step async (snapshot-and-return; the stage/commit
+    protocol runs on the background writer while the loop keeps
+    dispatching — the loop pays only the device->host snapshot).
+
+    The stall is the wall time the step loop spends BLOCKED inside
+    ``save_checkpoint`` (async: the snapshot; blocking: the whole
+    stage/commit protocol) — on TPU hardware that call is the only
+    part the device ever waits on. The CPU harness adds a second,
+    harness-only effect the row reports separately in detail: the
+    background writer's npz/CRC work shares the host cores with XLA
+    compute, so the loop-wall delta (``loop_overhead_ms``) overstates
+    what a device-bound run would see.
+
+    value = async stall ms per train_batch (mean save-call wall);
+    vs_baseline = async stall / blocking stall — acceptance <= 0.20.
+    detail pins the async-save contract: dispatches per train_batch
+    identical (1.0) in all three loops, and after the drain the newest
+    async tag verifies COMMITTED (sizes + CRC32).
+    """
+    del on_tpu, rtt      # host wall-clock accounting; no device timing
+    import shutil
+    import tempfile
+    import jax
+    import jax.numpy as jnp
+    import deepspeed_tpu
+    from deepspeed_tpu.runtime import checkpoint as _ckpt
+
+    hidden, layers, gas, steps = 512, 4, 2, 6
+    n_dev = jax.device_count()
+
+    def init_params(key):
+        p = {}
+        scale = 1.0 / np.sqrt(hidden)
+        for i in range(layers):
+            key, k = jax.random.split(key)
+            p[f"w{i}"] = jax.random.normal(
+                k, (hidden, hidden), jnp.float32) * scale
+        return p
+
+    def loss_fn(p, batch):
+        h = batch["x"]
+        for i in range(layers):
+            h = jnp.maximum(h @ p[f"w{i}"], 0.0)
+        return jnp.mean((h - batch["y"]) ** 2)
+
+    bs = 2 * n_dev
+    rng = np.random.RandomState(0)
+    window_data = [[{"x": rng.randn(bs, hidden).astype(np.float32),
+                     "y": rng.randn(bs, hidden).astype(np.float32)}
+                    for _ in range(gas)] for _ in range(steps + 1)]
+
+    def make_engine(obs_dir):
+        engine, *_ = deepspeed_tpu.initialize(
+            model=loss_fn,
+            model_parameters=init_params(jax.random.PRNGKey(0)),
+            config={
+                "train_micro_batch_size_per_gpu": 2,
+                "gradient_accumulation_steps": gas,
+                "steps_per_print": 10**9,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "observability": {"enabled": True, "events_dir": obs_dir,
+                                  "flops_profiler": False,
+                                  "memory_watermarks": False},
+            })
+        return engine
+
+    tmp = tempfile.mkdtemp(prefix="dstpu_bench_ackpt_")
+
+    def run_loop(mode):
+        """One measured loop; returns (loop_wall_s, mean save-call
+        stall ms, dispatches_per_step, engine, save_dir)."""
+        obs_dir = os.path.join(tmp, f"obs_{mode}")
+        save_dir = os.path.join(tmp, f"ckpt_{mode}")
+        engine = make_engine(obs_dir)
+        engine.train_batch(iter(window_data[0]))   # compile + settle
+        _beat()
+        tracker = engine.observability.compile_tracker
+        d0 = tracker.total_dispatches
+        stalls = []
+        t0 = time.perf_counter()
+        for s in range(steps):
+            engine.train_batch(iter(window_data[s + 1]))
+            if mode != "none":
+                t_save = time.perf_counter()
+                engine.save_checkpoint(save_dir,
+                                       async_=(mode == "async"))
+                stalls.append(time.perf_counter() - t_save)
+        wall = time.perf_counter() - t0
+        disp = (tracker.total_dispatches - d0) / steps
+        stall_ms = (sum(stalls) / len(stalls) * 1e3) if stalls else 0.0
+        return wall, stall_ms, disp, engine, save_dir
+
+    wall_base, _, disp_base, eng_base, _ = run_loop("none")
+    eng_base.close()
+    wall_block, stall_block, disp_block, eng_block, _ = run_loop("blocking")
+    eng_block.close()
+    wall_async, stall_async, disp_async, eng_async, async_dir = \
+        run_loop("async")
+    # drain OUTSIDE the timed loop: background work must still complete
+    # and commit, it just must not stall the step loop
+    t_drain = time.perf_counter()
+    eng_async.wait_pending_saves()
+    drain_ms = (time.perf_counter() - t_drain) * 1e3
+    superseded = (eng_async._ckpt_writer.superseded
+                  if eng_async._ckpt_writer else 0)
+    eng_async.close()
+
+    newest = _ckpt.candidate_tags(async_dir)
+    tag_ok, problems = (
+        _ckpt.verify_checkpoint_dir(os.path.join(async_dir, newest[0]))
+        if newest else (False, ["no committed tag"]))
+    ratio = stall_async / stall_block if stall_block > 0 else 0.0
+    row = _emit(
+        "async_ckpt_stall_ms", round(stall_async, 3), "ms_per_step",
+        round(ratio, 4),
+        {"accept_ratio": 0.20,
+         "stall_blocking_ms": round(stall_block, 3),
+         "step_ms_baseline": round(wall_base / steps * 1e3, 3),
+         # harness-only CPU contention view: loop wall minus baseline
+         # (the background writer shares the host cores with XLA here;
+         # on a device backend the step compute doesn't)
+         "loop_overhead_ms": {
+             "blocking": round(
+                 max((wall_block - wall_base) / steps * 1e3, 0.0), 3),
+             "async": round(
+                 max((wall_async - wall_base) / steps * 1e3, 0.0), 3)},
+         "dispatches_per_step": {"baseline": disp_base,
+                                 "blocking": disp_block,
+                                 "async": disp_async},
+         "dispatch_invariant": disp_base == disp_block == disp_async,
+         "drain_ms": round(drain_ms, 3),
+         "saves_superseded": superseded,
+         "newest_async_tag": newest[0] if newest else None,
+         "newest_tag_verified": bool(tag_ok),
+         "verify_problems": problems if not tag_ok else [],
+         "params_mb": round(layers * hidden * hidden * 4 / 2**20, 2),
+         "gas": gas, "steps": steps, "world": n_dev,
+         "backend": jax.default_backend(),
+         "source": "save-call wall clock (the loop's blocked time) + "
+                   "no-save loop baseline + CompileTracker dispatch "
+                   "accounting (hardware-free)"})
+    shutil.rmtree(tmp, ignore_errors=True)
+    return row
+
+
 def bench_paged_decode_tokens_per_s(on_tpu, rtt):
     """TPU ladder row (next hardware window): wall-clock decode
     tokens/s of the serving engine running the COMPILED Pallas
@@ -1500,6 +1660,8 @@ def run_child(metric):
         bench_paged_decode_bytes(on_tpu, rtt)
     elif metric == "serve_trace_overhead":
         bench_serve_trace_overhead(on_tpu, rtt)
+    elif metric == "async_ckpt_stall_ms":
+        bench_async_ckpt_stall(on_tpu, rtt)
     elif metric == "paged_decode_tokens_per_s":
         bench_paged_decode_tokens_per_s(on_tpu, rtt)
     elif metric == "bert_large_samples_per_s":

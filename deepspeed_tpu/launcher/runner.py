@@ -15,6 +15,15 @@ per host with coordinator env vars, (3) propagating ``.deepspeed_env``.
 
 Single host:  dstpu train.py --deepspeed_config ds.json
 Multi host:   dstpu --hostfile /job/hostfile train.py ...
+
+Preemption supervision (``--supervise``; ISSUE 10): the training process
+exits with the distinguished resumable code
+(``runtime/elastic.py RESUMABLE_EXIT_CODE``, 85) after a graceful
+preemption drain — the supervisor loop relaunches it with exponential
+backoff, exporting ``DSTPU_RESTART_COUNT`` so the child's telemetry can
+report how many lives it has used. Any OTHER nonzero exit is a genuine
+failure the supervisor gives up on immediately, and ``--max_restarts``
+bounds how many preemptions a run survives unattended.
 """
 
 import argparse
@@ -23,9 +32,12 @@ import json
 import os
 import subprocess
 import sys
+import time
 from collections import OrderedDict
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
+from deepspeed_tpu.runtime.elastic import (RESTART_COUNT_ENV,
+                                           RESUMABLE_EXIT_CODE)
 from deepspeed_tpu.utils.logging import logger
 
 DLTS_HOSTFILE = "/job/hostfile"
@@ -56,10 +68,56 @@ def parse_args(args=None):
                              "pdsh/openmpi/mvapich, multinode_runner.py)")
     parser.add_argument("--force_multi", action="store_true",
                         help="Treat as multi-node even for one host")
+    parser.add_argument("--supervise", action="store_true",
+                        help="Relaunch the job (with exponential backoff) "
+                             "whenever it exits with the resumable "
+                             f"preemption code {RESUMABLE_EXIT_CODE} "
+                             "(checkpoint.drain_on_preemption)")
+    parser.add_argument("--max_restarts", type=int, default=3,
+                        help="Supervisor: give up after this many "
+                             "resumable restarts (default 3)")
+    parser.add_argument("--restart_backoff", type=float, default=1.0,
+                        help="Supervisor: base backoff seconds before a "
+                             "relaunch; doubles per restart (default 1.0)")
     parser.add_argument("user_script", type=str,
                         help="User training script")
     parser.add_argument("user_args", nargs=argparse.REMAINDER)
     return parser.parse_args(args=args)
+
+
+def supervise(run_once: Callable[[int], int], max_restarts: int = 3,
+              backoff: float = 1.0, sleep: Callable[[float], None] = None
+              ) -> int:
+    """Relaunch-on-preemption loop (the launcher's elastic half).
+
+    ``run_once(restart_count)`` launches the job and returns its exit
+    code. The loop relaunches ONLY on :data:`RESUMABLE_EXIT_CODE` (a
+    graceful preemption drain — the run left a committed checkpoint and
+    asked to be resumed), sleeping ``backoff * 2**restart`` seconds
+    between lives; any other nonzero code is a genuine failure returned
+    immediately, and after ``max_restarts`` resumable exits the code is
+    returned for the operator to act on. Returns the final exit code.
+    """
+    sleep = time.sleep if sleep is None else sleep
+    restarts = 0
+    while True:
+        rc = run_once(restarts)
+        if rc != RESUMABLE_EXIT_CODE:
+            if rc != 0:
+                logger.error(f"dstpu supervisor: job failed (exit {rc}); "
+                             "not a preemption — giving up")
+            return rc
+        if restarts >= max_restarts:
+            logger.error(
+                f"dstpu supervisor: resumable exit but max_restarts="
+                f"{max_restarts} exhausted; giving up with exit {rc}")
+            return rc
+        delay = backoff * (2 ** restarts)
+        restarts += 1
+        logger.warning(
+            f"dstpu supervisor: preemption drain (exit {rc}); relaunch "
+            f"{restarts}/{max_restarts} in {delay:.1f}s")
+        sleep(delay)
 
 
 def fetch_hostfile(hostfile_path: str) -> Optional[Dict[str, int]]:
@@ -202,11 +260,22 @@ def main(args=None):
         # process and local chips are auto-discovered.
         cmd = [sys.executable, "-u", args.user_script] + args.user_args
         logger.info(f"dstpu local launch: {' '.join(cmd)}")
-        result = subprocess.Popen(cmd, env=os.environ.copy())
-        result.wait()
+
+        def run_local(restarts: int) -> int:
+            env = os.environ.copy()
+            env[RESTART_COUNT_ENV] = str(restarts)
+            proc = subprocess.Popen(cmd, env=env)
+            proc.wait()
+            return proc.returncode
+
+        if args.supervise:
+            rc = supervise(run_local, max_restarts=args.max_restarts,
+                           backoff=args.restart_backoff)
+        else:
+            rc = run_local(0)
         # propagate first failing exit code (reference runner.py:356)
-        if result.returncode != 0:
-            sys.exit(result.returncode)
+        if rc != 0:
+            sys.exit(rc)
         return
 
     active = parse_resource_filter(resource_pool, args.include, args.exclude)
@@ -231,22 +300,38 @@ def main(args=None):
             f"launcher backend '{args.launcher}' not found on PATH "
             f"(hosts: {hosts})")
 
-    procs = []
-    if args.launcher == "openmpi":
-        cmd = runner.get_cmd_all(hosts, coordinator, exports)
-        logger.info(f"dstpu mpirun launch: {' '.join(cmd[:8])} ...")
-        procs.append(subprocess.Popen(cmd))
-    else:
-        for pid, host in enumerate(hosts):
-            cmd = runner.get_cmd(host, pid, len(hosts), coordinator, exports)
-            logger.info(
-                f"dstpu launching on {host}: process {pid}/{len(hosts)}")
+    def run_wave(restarts: int) -> int:
+        """One multi-host launch wave; returns the first failing exit
+        code — RESUMABLE_EXIT_CODE wins over other nonzero codes so one
+        drained host plus N killed-mid-drain hosts still reads as a
+        preemption to the supervisor."""
+        exports[RESTART_COUNT_ENV] = str(restarts)
+        procs = []
+        if args.launcher == "openmpi":
+            cmd = runner.get_cmd_all(hosts, coordinator, exports)
+            logger.info(f"dstpu mpirun launch: {' '.join(cmd[:8])} ...")
             procs.append(subprocess.Popen(cmd))
-    exit_code = 0
-    for p in procs:
-        p.wait()
-        if p.returncode != 0 and exit_code == 0:
-            exit_code = p.returncode
+        else:
+            for pid, host in enumerate(hosts):
+                cmd = runner.get_cmd(host, pid, len(hosts), coordinator,
+                                     exports)
+                logger.info(
+                    f"dstpu launching on {host}: process {pid}/{len(hosts)}")
+                procs.append(subprocess.Popen(cmd))
+        exit_code = 0
+        for p in procs:
+            p.wait()
+            if p.returncode == RESUMABLE_EXIT_CODE:
+                exit_code = RESUMABLE_EXIT_CODE
+            elif p.returncode != 0 and exit_code == 0:
+                exit_code = p.returncode
+        return exit_code
+
+    if args.supervise:
+        exit_code = supervise(run_wave, max_restarts=args.max_restarts,
+                              backoff=args.restart_backoff)
+    else:
+        exit_code = run_wave(0)
     if exit_code != 0:
         sys.exit(exit_code)
 

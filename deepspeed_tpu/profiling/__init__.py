@@ -72,6 +72,12 @@ from deepspeed_tpu.utils.monitor import (  # noqa: E402,F401
     TAG_SERVE_QUEUE_WAIT, TAG_SERVE_SLO, TAG_SERVE_TBT,
     TAG_SERVE_TOKEN_LATENCY, TAG_SERVE_TOKENS_IN_FLIGHT, TAG_SERVE_TPS,
     TAG_SERVE_TTFT)
+# elastic / async-checkpoint plane (ISSUE 10), same canonical-home
+# arrangement (utils/monitor.py write_elastic_metrics writes them;
+# obs_report mirrors; pinned by tests/unit/test_elastic.py)
+from deepspeed_tpu.utils.monitor import (  # noqa: E402,F401
+    TAG_CKPT_PENDING, TAG_CKPT_RESTARTS, TAG_CKPT_SNAPSHOT_MS,
+    TAG_CKPT_WRITE_MS)
 
 
 class Observer:

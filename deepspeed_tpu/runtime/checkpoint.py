@@ -36,7 +36,8 @@ import json
 import os
 import re
 import shutil
-from typing import Any, Dict, List, Optional, Tuple
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -390,6 +391,176 @@ def load_params_only(ckpt_dir: str, template: Any,
         f"no model_states (sharded or single-file) in {ckpt_dir}")
 
 
+# --------------------------------------------------------------------- #
+# device -> host snapshots: the async-save boundary copy
+#
+# save_tree_sharded reads `.addressable_shards` off live jax arrays; an
+# async save cannot — the step loop keeps dispatching and the compiled
+# step DONATES the state buffers, so by the time a background writer
+# touches them they are freed (or worse, reused). snapshot_tree takes
+# an explicit host copy of exactly the replica-0 shards at the step
+# boundary (O(local shard) host memory — the same bytes a blocking
+# save_tree_sharded would have materialized anyway) into leaves that
+# duck-type the jax.Array surface save_tree_sharded consumes, so the
+# stage/commit protocol runs UNCHANGED off the snapshot.
+# --------------------------------------------------------------------- #
+
+class _SnapshotShard:
+    """One replica-0 device shard, copied to host."""
+    __slots__ = ("replica_id", "data", "index")
+
+    def __init__(self, data: np.ndarray, index):
+        self.replica_id = 0
+        self.data = data
+        self.index = index
+
+
+class _SnapshotLeaf:
+    """Host copy of one (possibly sharded) array; duck-types the subset
+    of ``jax.Array`` that ``save_tree_sharded`` reads."""
+    __slots__ = ("shape", "dtype", "addressable_shards")
+
+    def __init__(self, shape, dtype, shards):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.addressable_shards = shards
+
+
+def snapshot_tree(tree: Any) -> Any:
+    """Donation-safe device->host snapshot of a (possibly sharded)
+    pytree: same treedef, every array leaf replaced by a
+    :class:`_SnapshotLeaf` holding explicit ``np.array(..., copy=True)``
+    copies of its replica-0 shards (``np.asarray`` of a CPU-backend jax
+    array can alias the device buffer — a later donation would free the
+    memory out from under the writer). Host scalars/numpy leaves are
+    copied too (a ZeRO-Offload host optimizer mutates its buffers in
+    place between the snapshot and the background write).
+    """
+    fault.fire("ckpt.snapshot")
+
+    def snap(v):
+        if hasattr(v, "addressable_shards"):
+            shards = [_SnapshotShard(np.array(sh.data, copy=True), sh.index)
+                      for sh in v.addressable_shards if sh.replica_id == 0]
+            return _SnapshotLeaf(v.shape, v.dtype, shards)
+        if hasattr(v, "shape") or isinstance(v, (int, float, complex)):
+            return np.array(v, copy=True)
+        return v
+
+    return jax.tree_util.tree_map(snap, tree)
+
+
+class AsyncCheckpointWriter:
+    """Single background writer thread running staged commit jobs.
+
+    The collision guard the async save contract needs: at most one job
+    *runs* and at most one *waits*; submitting while one waits REPLACES
+    the waiting job's payload with the newest snapshot — reported as
+    ``"superseded"`` for a different key, ``"joined"`` for the same key
+    (same tag, fresher snapshot; writing an already-superseded older
+    snapshot would be wasted I/O and could commit out of order). Two
+    jobs can therefore never interleave their staging I/O. A job exception (including an armed ``ckpt.writer_crash``
+    InjectedCrash) is stored, not swallowed: ``raise_pending_error`` —
+    called by the engine on the next ``save_checkpoint``/``close`` —
+    re-raises it.
+    """
+
+    def __init__(self, name: str = "dstpu-ckpt-writer"):
+        self._name = name
+        self._cv = threading.Condition()
+        self._pending: Optional[Tuple[str, Callable[[], None]]] = None
+        self._running_key: Optional[str] = None
+        self._error: Optional[Tuple[str, BaseException]] = None
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+        self.completed = 0
+        self.superseded = 0
+
+    # ------------------------------------------------------------ submit
+    def submit(self, key: str, job: Callable[[], None]) -> str:
+        """Queue ``job``; returns ``"queued"``, ``"joined"`` (same key
+        already waiting) or ``"superseded"`` (replaced a waiting job)."""
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("AsyncCheckpointWriter is closed")
+            if self._pending is not None:
+                verdict = ("joined" if self._pending[0] == key
+                           else "superseded")
+                if verdict == "superseded":
+                    self.superseded += 1
+                # either way the NEWEST snapshot wins the waiting slot —
+                # a join that kept the older queued job would silently
+                # commit stale state under the caller's tag
+                self._pending = (key, job)
+                self._cv.notify_all()
+                return verdict
+            self._pending = (key, job)
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, name=self._name, daemon=True)
+                self._thread.start()
+            self._cv.notify_all()
+            return "queued"
+
+    def _run(self):
+        while True:
+            with self._cv:
+                while self._pending is None and not self._closed:
+                    self._cv.wait()
+                if self._pending is None:
+                    return
+                key, job = self._pending
+                self._pending = None
+                self._running_key = key
+            try:
+                fault.fire("ckpt.writer_crash", key=key)
+                job()
+            except BaseException as e:  # noqa: BLE001 — stored, surfaced
+                with self._cv:
+                    self._error = (key, e)
+            finally:
+                with self._cv:
+                    self._running_key = None
+                    self.completed += 1
+                    self._cv.notify_all()
+
+    # ------------------------------------------------------------- state
+    def pending_saves(self) -> int:
+        """Jobs not yet durable (waiting + running)."""
+        with self._cv:
+            return ((1 if self._pending is not None else 0)
+                    + (1 if self._running_key is not None else 0))
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Block until no job is waiting or running (the ``close()`` /
+        eval-barrier semantics). Returns False on timeout."""
+        with self._cv:
+            return self._cv.wait_for(
+                lambda: self._pending is None and self._running_key is None,
+                timeout=timeout)
+
+    def raise_pending_error(self) -> None:
+        """Re-raise (once) the last job exception, chained so the
+        traceback names the failed tag."""
+        with self._cv:
+            err, self._error = self._error, None
+        if err is not None:
+            key, exc = err
+            raise RuntimeError(
+                f"async checkpoint write of {key!r} failed") from exc
+
+    def close(self, timeout: Optional[float] = None) -> None:
+        """Drain, stop the thread. Does NOT raise the stored error —
+        callers decide (the engine raises it after releasing resources)."""
+        self.drain(timeout=timeout)
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None
+
+
 # state groups a tag directory may carry, in report order; "extras" are
 # engine-subclass files sealed via _save_checkpoint_extras (pipe layout)
 _STATE_GROUP_NAMES = ("model_states", "optim_states")
@@ -611,23 +782,60 @@ def candidate_tags(save_dir: str) -> List[str]:
     return [latest] + [t for t in tags if t != latest]
 
 
+def is_preemption_tag(ckpt_dir: str) -> bool:
+    """True when the tag was committed by the graceful preemption drain
+    (``meta.json`` carries ``preempted: true``). Detection is by meta,
+    not tag name, so operator-renamed tags keep their protection."""
+    try:
+        return bool(read_meta(ckpt_dir).get("preempted"))
+    except (OSError, json.JSONDecodeError, ValueError):
+        return False
+
+
+def newest_committed_step(save_dir: str) -> int:
+    """Step number of the newest committed step-suffixed tag, -1 when
+    none exist. The supervisor's resume sanity check
+    (``tools/verify_checkpoint.py --expect-step``) keys on this."""
+    steps = [tag_step(t) for t in list_tags(save_dir)
+             if tag_step(t) >= 0 and is_committed(os.path.join(save_dir, t))]
+    return max(steps) if steps else -1
+
+
 def gc_old_tags(save_dir: str, keep_n: int) -> List[str]:
     """Retention: delete committed *step-suffixed* tags beyond the newest
     ``keep_n``.
 
     Only automatic ``...<step>`` tags (and their ``.old`` leftovers) are
-    managed; custom-named tags (``best``) are user-owned and never GC'd,
-    nor is whatever tag ``latest`` currently points to. Uncommitted or
-    legacy dirs are never touched (they may be someone's in-flight save
-    or the only pre-durability copy); ``keep_n <= 0`` keeps everything.
+    managed; custom-named tags (``best``) are user-owned and never GC'd.
+    Two tags are protected REGARDLESS of ``keep_n`` (the fallback-load
+    safety net — deleting either races a loader that is mid-fallback to
+    it):
+
+    - whatever tag ``latest`` currently points to (the last completed
+      save as far as any resumer knows), and
+    - any committed *preemption* tag newer than ``latest`` — the drain
+      commits it and may die before repointing the pointer, and it is
+      precisely the newest state a relaunched run must resume.
+
+    Uncommitted or legacy dirs are never touched (they may be someone's
+    in-flight save or the only pre-durability copy); ``keep_n <= 0``
+    keeps everything.
     """
     if keep_n <= 0:
         return []
     latest = read_latest(save_dir)
+    lstep = tag_step(latest) if latest else -1
     managed = [t for t in list_tags(save_dir)
                if tag_step(t) >= 0
                and is_committed(os.path.join(save_dir, t))]
-    doomed = [t for t in managed[keep_n:] if t != latest]
+    doomed = []
+    for t in managed[keep_n:]:
+        if t == latest:
+            continue
+        if tag_step(t) > lstep and \
+                is_preemption_tag(os.path.join(save_dir, t)):
+            continue
+        doomed.append(t)
     for t in doomed:
         shutil.rmtree(os.path.join(save_dir, t), ignore_errors=True)
     return doomed

@@ -545,9 +545,11 @@ def get_compile_cache_config(param_dict):
 
 def get_checkpoint_config(param_dict):
     """Fault-tolerant checkpointing knobs (atomic commit + verification +
-    retention; see runtime/checkpoint.py)."""
+    retention + async snapshot saves + preemption drain/supervisor; see
+    runtime/checkpoint.py, runtime/elastic.py, docs/checkpointing.md)."""
     sub = param_dict.get(C.CHECKPOINT, {})
-    return {
+    sup = sub.get(C.CHECKPOINT_SUPERVISOR, {}) or {}
+    cfg = {
         "verify_checksums": sub.get(C.CHECKPOINT_VERIFY_CHECKSUMS,
                                     C.CHECKPOINT_VERIFY_CHECKSUMS_DEFAULT),
         "keep_n": sub.get(C.CHECKPOINT_KEEP_N, C.CHECKPOINT_KEEP_N_DEFAULT),
@@ -555,7 +557,34 @@ def get_checkpoint_config(param_dict):
                               C.CHECKPOINT_IO_RETRIES_DEFAULT),
         "io_retry_backoff": sub.get(C.CHECKPOINT_IO_RETRY_BACKOFF,
                                     C.CHECKPOINT_IO_RETRY_BACKOFF_DEFAULT),
+        "async_save": bool(sub.get(C.CHECKPOINT_ASYNC_SAVE,
+                                   C.CHECKPOINT_ASYNC_SAVE_DEFAULT)),
+        "drain_on_preemption": bool(sub.get(
+            C.CHECKPOINT_DRAIN_ON_PREEMPTION,
+            C.CHECKPOINT_DRAIN_ON_PREEMPTION_DEFAULT)),
+        "save_dir": sub.get(C.CHECKPOINT_SAVE_DIR,
+                            C.CHECKPOINT_SAVE_DIR_DEFAULT),
+        "supervisor": {
+            "max_restarts": int(sup.get(
+                C.CHECKPOINT_SUPERVISOR_MAX_RESTARTS,
+                C.CHECKPOINT_SUPERVISOR_MAX_RESTARTS_DEFAULT)),
+            "backoff": float(sup.get(
+                C.CHECKPOINT_SUPERVISOR_BACKOFF,
+                C.CHECKPOINT_SUPERVISOR_BACKOFF_DEFAULT)),
+        },
     }
+    if cfg["supervisor"]["max_restarts"] < 0:
+        raise DeepSpeedConfigError(
+            "checkpoint.supervisor.max_restarts must be >= 0, got "
+            f"{cfg['supervisor']['max_restarts']}")
+    if cfg["supervisor"]["backoff"] < 0:
+        raise DeepSpeedConfigError(
+            "checkpoint.supervisor.backoff must be >= 0, got "
+            f"{cfg['supervisor']['backoff']}")
+    if cfg["save_dir"] is not None and not isinstance(cfg["save_dir"], str):
+        raise DeepSpeedConfigError(
+            "checkpoint.save_dir must be a path string or null")
+    return cfg
 
 
 def get_inference_config(param_dict):

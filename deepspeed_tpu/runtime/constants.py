@@ -377,7 +377,18 @@ COMPILE_CACHE_MIN_COMPILE_SECS_DEFAULT = 1.0
 #   "verify_checksums": true,   # CRC32-verify files against COMMITTED
 #   "keep_n": 0,                # retention: 0 keeps all committed tags
 #   "io_retries": 3,            # transient-OSError retries per file op
-#   "io_retry_backoff": 0.05    # base seconds, doubles per attempt
+#   "io_retry_backoff": 0.05,   # base seconds, doubles per attempt
+#   "async_save": false,        # snapshot at the boundary, commit in a
+#                               # background writer (docs/checkpointing.md
+#                               # "Async snapshot saves")
+#   "drain_on_preemption": false, # SIGTERM/SIGINT -> finish window,
+#                               # commit preempt tag, exit resumable (85)
+#   "save_dir": null,           # where the preemption drain commits
+#                               # (default: last save/load dir used)
+#   "supervisor": {             # launcher relaunch-on-preemption policy
+#     "max_restarts": 3,        # give up after this many resumable exits
+#     "backoff": 1.0            # base seconds, doubles per restart
+#   }
 # }
 #############################################
 CHECKPOINT = "checkpoint"
@@ -389,6 +400,17 @@ CHECKPOINT_IO_RETRIES = "io_retries"
 CHECKPOINT_IO_RETRIES_DEFAULT = 3
 CHECKPOINT_IO_RETRY_BACKOFF = "io_retry_backoff"
 CHECKPOINT_IO_RETRY_BACKOFF_DEFAULT = 0.05
+CHECKPOINT_ASYNC_SAVE = "async_save"
+CHECKPOINT_ASYNC_SAVE_DEFAULT = False
+CHECKPOINT_DRAIN_ON_PREEMPTION = "drain_on_preemption"
+CHECKPOINT_DRAIN_ON_PREEMPTION_DEFAULT = False
+CHECKPOINT_SAVE_DIR = "save_dir"
+CHECKPOINT_SAVE_DIR_DEFAULT = None
+CHECKPOINT_SUPERVISOR = "supervisor"
+CHECKPOINT_SUPERVISOR_MAX_RESTARTS = "max_restarts"
+CHECKPOINT_SUPERVISOR_MAX_RESTARTS_DEFAULT = 3
+CHECKPOINT_SUPERVISOR_BACKOFF = "backoff"
+CHECKPOINT_SUPERVISOR_BACKOFF_DEFAULT = 1.0
 
 #############################################
 # Inference serving engine (TPU-native extension: the reference

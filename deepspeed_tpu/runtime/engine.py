@@ -41,6 +41,7 @@ from deepspeed_tpu.parallel.mesh import (axis_size, build_mesh,
                                          split_data_axis)
 from deepspeed_tpu.parallel.topology import ParallelGrid
 from deepspeed_tpu.runtime import checkpoint as ckpt
+from deepspeed_tpu.runtime import elastic
 from deepspeed_tpu.runtime import fault
 from deepspeed_tpu.runtime.config import DeepSpeedConfig
 from deepspeed_tpu.runtime.dataloader import (
@@ -497,6 +498,32 @@ class DeepSpeedEngine:
         self._ckpt_cfg = self._config.checkpoint_config
         ckpt.set_retry_policy(self._ckpt_cfg["io_retries"],
                               self._ckpt_cfg["io_retry_backoff"])
+        # elastic resilience (runtime/elastic.py; docs/checkpointing.md
+        # "Surviving TPU preemption"): env-armed fault injections so a
+        # supervisor-relaunched child can be faulted, the async-save
+        # writer slot, and the opt-in preemption guard. The guard only
+        # FLAGS a signal; the drain runs at the next train_batch
+        # boundary (_elastic_boundary) where the window has committed.
+        fault.arm_from_env()
+        self._ckpt_writer = None         # lazy AsyncCheckpointWriter
+        self._last_ckpt_dir = None       # fallback preemption save_dir
+        self._restart_count = elastic.restart_count()
+        self._elastic = None
+        if self._ckpt_cfg["drain_on_preemption"]:
+            self._elastic = elastic.PreemptionGuard()
+            if self._elastic.install():
+                log_dist(
+                    "elastic: draining on SIGTERM/SIGINT (resumable exit "
+                    f"code {elastic.RESUMABLE_EXIT_CODE})", ranks=[0])
+            else:
+                logger.warning(
+                    "elastic: drain_on_preemption set but signal handlers "
+                    "are main-thread-only; software trigger still active")
+        if self._restart_count:
+            # a supervisor relaunch: make the restart count visible on
+            # the same x-axis as everything else
+            self.monitor.write_elastic_metrics(
+                restarts=self._restart_count)
         cc = self._config.compile_cache_config
         if cc["enabled"]:
             from ..utils.platform import enable_compile_cache
@@ -2004,9 +2031,23 @@ class DeepSpeedEngine:
 
     def close(self):
         """Release engine-owned background resources: drain any
-        in-flight overlapped offload update, stop the prefetch thread,
-        flush deferred telemetry, seal the observability log."""
+        in-flight overlapped offload update AND any pending async
+        checkpoint saves (the close barrier of the async-save contract —
+        a stored writer exception is re-raised at the end, after every
+        resource is released), stop the prefetch thread, flush deferred
+        telemetry, uninstall the preemption guard, seal the
+        observability log."""
         self._offload_drain()
+        save_error = None
+        if self._ckpt_writer is not None:
+            self._ckpt_writer.close()
+            try:
+                self._ckpt_writer.raise_pending_error()
+            except Exception as e:   # surfaced below, not swallowed
+                save_error = e
+            self._ckpt_writer = None
+        if self._elastic is not None:
+            self._elastic.uninstall()
         if self._prefetcher is not None:
             self._prefetcher.close()
             self._prefetcher = None
@@ -2022,6 +2063,8 @@ class DeepSpeedEngine:
         except Exception:
             pass
         self.observability.close()
+        if save_error is not None:
+            raise save_error
 
     def _flush_monitor_atexit(self):
         """Interpreter-exit safety net for the deferred-telemetry ring
@@ -2263,6 +2306,7 @@ class DeepSpeedEngine:
             self._host_micro_step += 1
             if self.wall_clock_breakdown_enabled:
                 self.timers("step").stop()
+            self._elastic_boundary()
             return
         if self._compiled_apply is None:
             if ga > 1:
@@ -2297,6 +2341,7 @@ class DeepSpeedEngine:
             self.timers("step").stop()
             self.timers.log(["forward", "backward", "step"],
                             memory_breakdown=self._config.memory_breakdown)
+        self._elastic_boundary()
 
     # ------------------------------------------------------------------ #
     # fused path
@@ -2318,6 +2363,10 @@ class DeepSpeedEngine:
 
         self._maybe_switch_onebit_phase()
         self._maybe_profile_step()
+        # no-op unless a durability test armed it: deliver SIGTERM (or
+        # the software preemption) here and the window below must still
+        # run to completion before the boundary drain fires
+        fault.fire("elastic.sigterm_mid_window", step=self._host_global_step)
         fused = self._batch_path()
         self.tput_timer.start()
         _t_step0 = time.perf_counter()
@@ -2378,6 +2427,7 @@ class DeepSpeedEngine:
         self._check_csr_overflow()
         self._report_progress()
         self._write_monitor(mean_loss)
+        self._elastic_boundary()
         return mean_loss
 
     def last_loss(self):
@@ -2400,6 +2450,7 @@ class DeepSpeedEngine:
         window, mirroring the pipe engine's ``micro_batches``) and the
         mean loss returned."""
         self._offload_drain()
+        self._drain_saves()   # eval barrier: pending async saves land
         if self._monitor_ring:
             self._flush_monitor()   # eval is an explicit sync point
         it = normalize_eval_input(batch)
@@ -2653,14 +2704,36 @@ class DeepSpeedEngine:
     # checkpointing (reference engine.py:1329/:1173)
     # ------------------------------------------------------------------ #
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
-                        client_state: Optional[Dict] = None):
+                        client_state: Optional[Dict] = None,
+                        async_: Optional[bool] = None,
+                        preempted: bool = False):
         """Atomic-commit save: shards land in ``<tag>.tmp/``, process 0
         seals a ``COMMITTED`` marker (process_count + per-file sizes and
         CRC32s) after a multihost barrier, renames the directory to its
         final tag, then repoints ``latest`` atomically. A crash at any
         point leaves either the previous checkpoint fully intact or the
-        new one fully committed — never a half-save that resume trusts."""
-        import shutil
+        new one fully committed — never a half-save that resume trusts.
+
+        ``async_`` (default: ``checkpoint.async_save``) turns the call
+        into a snapshot-and-return: a donation-safe device->host copy of
+        the train state is taken at this step boundary (O(local shard)),
+        then the whole stage/commit protocol above runs on a single
+        background writer thread while the step loop keeps dispatching —
+        the loop stalls only for the snapshot. A save submitted while
+        one is still writing JOINS it (same tag) or SUPERSEDES the
+        still-waiting one (newer tag); two saves never interleave their
+        staging I/O. ``close()``, ``eval_batch()`` and ``load_checkpoint``
+        drain pending saves; a writer exception surfaces on the next
+        ``save_checkpoint``/``close``. Multi-process runs fall back to
+        blocking saves (the commit barriers must run on every process's
+        main thread).
+
+        ``preempted`` marks the checkpoint as committed by the graceful
+        preemption drain (``meta.preempted``); such tags are reported
+        distinctly by ``tools/verify_checkpoint.py`` and — when newer
+        than ``latest`` — are never garbage-collected.
+        """
+        self._raise_async_save_error()
         self._offload_drain()
         if self._monitor_ring:
             self._flush_monitor()   # a save is a natural sync point
@@ -2669,9 +2742,112 @@ class DeepSpeedEngine:
         # engines alive in one process
         ckpt.set_retry_policy(self._ckpt_cfg["io_retries"],
                               self._ckpt_cfg["io_retry_backoff"])
+        if async_ is None:
+            async_ = bool(self._ckpt_cfg["async_save"])
+        if async_ and jax.process_count() > 1:
+            log_dist("async_save: multi-process run — the commit barriers "
+                     "must run on every process's main thread; falling "
+                     "back to a blocking save", ranks=[0])
+            async_ = False
         t0 = time.time()
+        snap_model, snap_optim, cpu_arrays, meta = \
+            self._snapshot_train_state(client_state, preempted,
+                                       copy=async_)
         if tag is None:
-            tag = f"global_step{int(self.state.global_step)}"
+            tag = f"global_step{meta['global_step']}"
+        snapshot_ms = (time.time() - t0) * 1000.0
+        final_dir = os.path.join(save_dir, tag)
+        samples = self._host_global_step * self.train_batch_size()
+        self._last_ckpt_dir = save_dir
+        job = partial(self._write_checkpoint_job, save_dir, tag,
+                      snap_model, snap_optim, cpu_arrays, meta, samples)
+        if async_:
+            writer = self._ensure_ckpt_writer()
+            verdict = writer.submit(tag, job)
+            self.monitor.write_elastic_metrics(
+                snapshot_ms=snapshot_ms,
+                pending_saves=writer.pending_saves(), samples=samples)
+            log_dist(f"async checkpoint {final_dir}: snapshot in "
+                     f"{snapshot_ms:.0f}ms ({verdict}); commit continues "
+                     "in background", ranks=[0])
+            return final_dir
+        # a blocking save must not run its commit inline while the async
+        # writer is still staging an earlier one — same never-interleave
+        # invariant the writer enforces for its own jobs
+        self._drain_saves()
+        self.monitor.write_elastic_metrics(
+            snapshot_ms=snapshot_ms, pending_saves=0, samples=samples,
+            flush=False)
+        job()
+        return final_dir
+
+    def _snapshot_train_state(self, client_state=None, preempted=False,
+                              copy=True):
+        """The state a checkpoint carries, captured at the step boundary.
+
+        ``copy=True`` (async saves): replica-0 shard copies of the
+        model and optimizer state (donation-safe — the fused step
+        donates these buffers on the very next dispatch) plus a COPY of
+        the ZeRO-Offload host master state (the host optimizer mutates
+        its buffers in place between snapshot and background write).
+        Nothing the writer touches afterwards is ever written by the
+        step loop again.
+
+        ``copy=False`` (blocking saves): the live trees pass straight
+        through — ``save_tree_sharded`` streams their shards
+        tree-by-tree exactly as the pre-async protocol did, so a
+        blocking save's peak host memory stays max(tree), not
+        sum(trees). The ``ckpt.snapshot`` kill point fires identically
+        on both paths."""
+        if copy:
+            snap_model = ckpt.snapshot_tree(self.state.params)
+            snap_optim = ckpt.snapshot_tree(
+                {"opt_state": self.state.opt_state,
+                 "loss_scale": self.state.loss_scale})
+        else:
+            fault.fire("ckpt.snapshot")
+            snap_model = self.state.params
+            snap_optim = {"opt_state": self.state.opt_state,
+                          "loss_scale": self.state.loss_scale}
+        cpu_arrays = None
+        if self.zero_cpu_offload and jax.process_index() == 0:
+            # host-resident fp32 master + moments (reference saves the
+            # fp32 partitions in zero_pp_rank files, engine.py:1409)
+            sd = self.optimizer.state_dict()
+            cp = (lambda a: np.array(a, copy=True)) if copy else \
+                (lambda a: a)
+            cpu_arrays = {"step": cp(sd["step"])}
+            cpu_arrays.update({f"mp_{i}": cp(a)
+                               for i, a in enumerate(sd["master_params"])})
+            cpu_arrays.update({f"m_{i}": cp(a)
+                               for i, a in enumerate(sd["exp_avg"])})
+            cpu_arrays.update({f"v_{i}": cp(a)
+                               for i, a in enumerate(sd["exp_avg_sq"])})
+        meta = {
+            "global_step": int(self.state.global_step),
+            "micro_step": int(self.state.micro_step),
+            "skipped_steps": int(self.state.skipped_steps),
+            "rng": np.asarray(self.state.rng).tolist(),
+            "lr_scheduler": (self.lr_scheduler.state_dict()
+                             if self.lr_scheduler is not None and
+                             hasattr(self.lr_scheduler, "state_dict")
+                             else None),
+            "dp_world_size": self.dp_world_size,
+            "zero_stage": self.zero_stage,
+            "client_state": client_state or {},
+        }
+        if preempted:
+            meta["preempted"] = True
+        return snap_model, snap_optim, cpu_arrays, meta
+
+    def _write_checkpoint_job(self, save_dir, tag, snap_model, snap_optim,
+                              cpu_arrays, meta, samples):
+        """The stage/commit protocol, run off host snapshots — inline by
+        a blocking save, on the writer thread by an async one. The fault
+        points are identical on both paths, so the tier-1
+        kill-at-every-stage contract covers async saves for free."""
+        import shutil
+        t0 = time.time()
         final_dir = os.path.join(save_dir, tag)
         tmp_dir = final_dir + ckpt.TMP_SUFFIX
         if jax.process_index() == 0:
@@ -2684,12 +2860,9 @@ class DeepSpeedEngine:
         # sharded format: every process writes only its local device shards
         # (reference per-dp-rank zero_pp_rank_* files, engine.py:1153-1164)
         # — no host-0 gather, flat host RAM regardless of model size
-        ckpt.save_tree_sharded(tmp_dir, "model_states", self.state.params)
+        ckpt.save_tree_sharded(tmp_dir, "model_states", snap_model)
         fault.fire("ckpt.after_shard", name="model_states", dir=tmp_dir)
-        ckpt.save_tree_sharded(
-            tmp_dir, "optim_states",
-            {"opt_state": self.state.opt_state,
-             "loss_scale": self.state.loss_scale})
+        ckpt.save_tree_sharded(tmp_dir, "optim_states", snap_optim)
         fault.fire("ckpt.after_shard", name="optim_states", dir=tmp_dir)
         if jax.process_count() > 1:
             # every process's shard files must be durable before process 0
@@ -2697,33 +2870,10 @@ class DeepSpeedEngine:
             from jax.experimental import multihost_utils
             multihost_utils.sync_global_devices("ckpt_shards_written")
         if jax.process_index() == 0:
-            if self.zero_cpu_offload:
-                # host-resident fp32 master + moments (reference saves the
-                # fp32 partitions in zero_pp_rank files, engine.py:1409)
-                sd = self.optimizer.state_dict()
-                arrays = {"step": sd["step"]}
-                arrays.update({f"mp_{i}": a for i, a in
-                               enumerate(sd["master_params"])})
-                arrays.update({f"m_{i}": a for i, a in
-                               enumerate(sd["exp_avg"])})
-                arrays.update({f"v_{i}": a for i, a in
-                               enumerate(sd["exp_avg_sq"])})
+            if cpu_arrays is not None:
                 ckpt._atomic_write_bytes(
                     os.path.join(tmp_dir, "cpu_optim_states.npz"),
-                    ckpt._npz_bytes(arrays))
-            meta = {
-                "global_step": int(self.state.global_step),
-                "micro_step": int(self.state.micro_step),
-                "skipped_steps": int(self.state.skipped_steps),
-                "rng": np.asarray(self.state.rng).tolist(),
-                "lr_scheduler": (self.lr_scheduler.state_dict()
-                                 if self.lr_scheduler is not None and
-                                 hasattr(self.lr_scheduler, "state_dict")
-                                 else None),
-                "dp_world_size": self.dp_world_size,
-                "zero_stage": self.zero_stage,
-                "client_state": client_state or {},
-            }
+                    ckpt._npz_bytes(cpu_arrays))
             self._save_checkpoint_extras(tmp_dir)
             ckpt.write_meta(tmp_dir, meta)
             fault.fire("ckpt.before_marker", dir=tmp_dir)
@@ -2753,13 +2903,100 @@ class DeepSpeedEngine:
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
             multihost_utils.sync_global_devices("ckpt_committed")
-        dur_ms = (time.time() - t0) * 1000.0
+        write_ms = (time.time() - t0) * 1000.0
+        pending = (max(0, self._ckpt_writer.pending_saves() - 1)
+                   if self._ckpt_writer is not None else 0)
+        self.monitor.write_elastic_metrics(
+            write_ms=write_ms, pending_saves=pending, samples=samples,
+            flush=False)
         self.monitor.write_checkpoint_event(
-            action="save", ok=True, duration_ms=dur_ms,
-            samples=self._host_global_step * self.train_batch_size())
+            action="save", ok=True, duration_ms=write_ms, samples=samples)
         log_dist(f"saved checkpoint {final_dir} "
-                 f"(committed in {dur_ms:.0f}ms)", ranks=[0])
+                 f"(committed in {write_ms:.0f}ms)", ranks=[0])
         return final_dir
+
+    # ---------------------------------------------- async-save plumbing
+    def _ensure_ckpt_writer(self):
+        if self._ckpt_writer is None:
+            self._ckpt_writer = ckpt.AsyncCheckpointWriter()
+        return self._ckpt_writer
+
+    def _drain_saves(self):
+        """Barrier: block until every pending async save is durable
+        (``close()`` / ``eval_batch`` / ``load_checkpoint`` call it).
+        Writer errors are NOT raised here — they surface on the next
+        ``save_checkpoint``/``close`` via _raise_async_save_error."""
+        if self._ckpt_writer is not None:
+            self._ckpt_writer.drain()
+
+    def _raise_async_save_error(self):
+        if self._ckpt_writer is not None:
+            self._ckpt_writer.raise_pending_error()
+
+    def wait_pending_saves(self):
+        """Public async-save barrier: block until every pending async
+        checkpoint has committed, then surface any writer error. Call
+        before handing a save_dir to another consumer (e.g.
+        ``InferenceEngine.from_checkpoint``) mid-run; ``close()`` and
+        ``eval_batch`` already drain implicitly."""
+        self._drain_saves()
+        self._raise_async_save_error()
+
+    # ------------------------------------------------- preemption drain
+    def _elastic_boundary(self):
+        """Step-boundary preemption check — both engines call it at the
+        end of ``train_batch`` (and the facade ``step()``), i.e. only
+        once the in-flight accumulation window has fully dispatched, so
+        'finish the window, then drain' holds by construction."""
+        if self._elastic is None or not self._elastic.preempted:
+            return
+        if self.gradient_accumulation_steps > 1 and \
+                self._host_micro_step % self.gradient_accumulation_steps:
+            # facade forward/backward/step path, mid-window: accumulated
+            # grads are not part of a checkpoint — wait for the boundary
+            return
+        self._handle_preemption()
+
+    def _handle_preemption(self):
+        """Graceful drain: pending async saves finish, a
+        preemption-tagged checkpoint commits, a ``preemption`` event row
+        lands, the engine closes, and :class:`elastic.Preempted`
+        (``SystemExit`` with the resumable code) propagates so the
+        supervisor relaunches us."""
+        reason = self._elastic.reason or "signal"
+        step = int(self.global_steps)   # boundary: device value is settled
+        log_dist(f"preemption ({reason}): draining at step {step}",
+                 ranks=[0])
+        save_dir = self._ckpt_cfg["save_dir"] or self._last_ckpt_dir
+        tag = None
+        committed = False
+        if save_dir:
+            self._drain_saves()   # a new save never interleaves with one
+            tag = f"preempt_step{step}"
+            try:
+                self.save_checkpoint(save_dir, tag=tag, async_=False,
+                                     preempted=True)
+                committed = True
+            except fault.InjectedCrash:
+                raise   # durability tests kill the drain's save too
+            except Exception as e:
+                logger.warning(
+                    f"preemption drain: checkpoint failed ({e!r}); "
+                    "exiting resumable anyway — resume falls back to the "
+                    "newest committed tag")
+        else:
+            logger.warning(
+                "preemption drain: no checkpoint.save_dir configured and "
+                "no prior save/load dir — exiting without a preemption "
+                "checkpoint")
+        self.observability.event(
+            "preemption", reason=reason, step=step, tag=tag,
+            committed=committed, restarts=self._restart_count)
+        try:
+            self.close()
+        except Exception as e:
+            logger.warning(f"preemption drain: close() failed ({e!r})")
+        raise elastic.Preempted(step=step, tag=tag, reason=reason)
 
     def _save_checkpoint_extras(self, ckpt_dir: str) -> None:
         """Subclass hook: extra files written here (process 0, staging
@@ -2780,8 +3017,12 @@ class DeepSpeedEngine:
         checkpoint of progress, never the run.
         """
         self._offload_drain()
+        # loading while an async save of THIS dir is mid-commit would
+        # race the newest-first scan; the drain also orders save->load
+        self._drain_saves()
         ckpt.set_retry_policy(self._ckpt_cfg["io_retries"],
                               self._ckpt_cfg["io_retry_backoff"])
+        self._last_ckpt_dir = load_dir
         t0 = time.time()
         if verify_integrity is None:
             verify_integrity = bool(self._ckpt_cfg["verify_checksums"])
@@ -2800,6 +3041,7 @@ class DeepSpeedEngine:
             self.monitor.write_checkpoint_event(
                 action="load", ok=True,
                 duration_ms=(time.time() - t0) * 1000.0, samples=samples)
+            self._record_resume(ckpt_dir)
             return result
 
         latest = ckpt.read_latest(load_dir)
@@ -2840,10 +3082,25 @@ class DeepSpeedEngine:
             self.monitor.write_checkpoint_event(
                 action="load", ok=True,
                 duration_ms=(time.time() - t0) * 1000.0, samples=samples)
+            self._record_resume(cand_dir)
             return result
         logger.warning(f"no committed+verified checkpoint in {load_dir}; "
                        "nothing loaded")
         return None, {}
+
+    def _record_resume(self, ckpt_dir: str) -> None:
+        """One ``resume`` event row + the restart-count scalar after a
+        successful restore — together with the save side's
+        ``preemption`` row, obs_report can reconstruct the full
+        preempt -> relaunch -> resume chain of a supervised run."""
+        samples = self._host_global_step * self.train_batch_size()
+        self.observability.event(
+            "resume", step=self._host_global_step,
+            tag=os.path.basename(ckpt_dir),
+            restarts=self._restart_count,
+            preempted=ckpt.is_preemption_tag(ckpt_dir))
+        self.monitor.write_elastic_metrics(
+            restarts=self._restart_count, samples=samples)
 
     def _load_checkpoint_dir(self, ckpt_dir: str,
                              load_optimizer_states: bool = True,

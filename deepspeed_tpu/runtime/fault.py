@@ -14,27 +14,55 @@ and ``engine.save_checkpoint``):
 - ``io_write``                 : inside every atomic file write, before any
                                  bytes hit disk (arm with ``OSError`` to
                                  simulate GCS/NFS flakes; retried)
+- ``ckpt.snapshot``            : at the device->host snapshot that opens
+                                 every save — kill here and NOTHING of the
+                                 save exists on disk
 - ``ckpt.after_shard``         : after one pytree's shard files are written
                                  (ctx: ``name``) — crash-after-shard-0
 - ``ckpt.before_marker``       : all shards + meta written, COMMITTED not
 - ``ckpt.before_rename``       : COMMITTED written, tmp dir not yet renamed
 - ``ckpt.latest_tmp_written``  : ``latest.tmp`` durable, ``os.replace``
                                  not yet executed — torn-latest window
+- ``ckpt.writer_crash``        : in the async checkpoint writer thread, at
+                                 job start — a stored writer exception must
+                                 surface on the next save/close, never die
+                                 silently
+- ``elastic.sigterm_mid_window``: at the top of every ``train_batch``
+                                 window — arm a callback that delivers
+                                 SIGTERM (or triggers the software
+                                 preemption) to prove the in-flight window
+                                 still finishes before the drain
 
 ``retry_io`` is the exponential-backoff wrapper used around all checkpoint
 I/O; it retries ``OSError`` (transient filesystem flakes) but never
 ``InjectedCrash`` (a simulated process death must kill the save).
+
+Env-armed injections (``DSTPU_FAULT_ARM``): a *relaunched* process — the
+launcher supervisor's child, which no in-process test can reach — arms
+itself at engine init from the environment. Grammar (comma-separated)::
+
+    point:action[:times][@once_file]
+
+with actions ``crash`` (raise InjectedCrash), ``oserror`` (raise OSError),
+``sigterm`` (deliver a real SIGTERM to this process), ``preempt`` (flag
+the installed PreemptionGuards via ``elastic.request_preemption``).
+``@once_file`` makes the arm cross-process-one-shot: the spec only arms
+while the file exists and the first fire deletes it, so a supervisor
+relaunch with the *same* environment is not re-faulted forever.
 """
 
 import os
 import time
 import zlib
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 __all__ = [
     "InjectedCrash", "FaultInjector", "get_injector", "fire", "arm",
     "reset", "retry_io", "flip_byte", "truncate_file", "crc32_file",
+    "arm_from_env", "ENV_ARM",
 ]
+
+ENV_ARM = "DSTPU_FAULT_ARM"
 
 
 class InjectedCrash(Exception):
@@ -132,6 +160,91 @@ def retry_io(fn: Callable[[], Any], *, retries: int = 3,
                 raise
             sleep(backoff * (2 ** attempt))
             attempt += 1
+
+
+# --------------------------------------------------------------------- #
+# env-armed injections: fault a process you can only reach by env
+# --------------------------------------------------------------------- #
+
+def _env_action(name: str, point: str) -> Callable[..., None]:
+    if name == "crash":
+        def act(**ctx):
+            raise InjectedCrash(point)
+    elif name == "oserror":
+        def act(**ctx):
+            raise OSError(f"injected transient failure at {point}")
+    elif name == "sigterm":
+        def act(**ctx):
+            import signal
+            os.kill(os.getpid(), signal.SIGTERM)
+    elif name == "preempt":
+        def act(**ctx):
+            from deepspeed_tpu.runtime import elastic
+            elastic.request_preemption(f"env-armed fault at {point}")
+    else:
+        raise ValueError(
+            f"{ENV_ARM}: unknown action {name!r} (want crash | oserror "
+            f"| sigterm | preempt)")
+    return act
+
+
+# process-global one-shot latch for the engine-init call: arming is
+# per-PROCESS, not per-engine — re-arming on a second engine's init
+# would reset the fired counter and turn a `times:1` spec into
+# once-per-engine. Deliberately NOT cleared by reset().
+_ENV_ARMED = False
+
+
+def arm_from_env(env=None) -> List[str]:
+    """Arm fault points from ``DSTPU_FAULT_ARM`` (see module docstring).
+
+    Called at engine init so a supervisor-relaunched subprocess can be
+    faulted without any in-process handle on it; with ``env=None`` (the
+    engine path) it arms at most once per process. Returns the points
+    armed (empty when the variable is unset or already armed). A
+    malformed spec raises ``ValueError`` — a silently ignored fault arm
+    would make a durability test pass vacuously.
+    """
+    global _ENV_ARMED
+    if env is None:
+        if _ENV_ARMED:
+            return []
+        _ENV_ARMED = True
+    env = os.environ if env is None else env
+    raw = env.get(ENV_ARM, "").strip()
+    if not raw:
+        return []
+    armed: List[str] = []
+    for spec in raw.split(","):
+        spec = spec.strip()
+        if not spec:
+            continue
+        once_file = None
+        if "@" in spec:
+            spec, once_file = spec.split("@", 1)
+        parts = spec.split(":")
+        if len(parts) < 2:
+            raise ValueError(
+                f"{ENV_ARM}: bad spec {spec!r} (want "
+                "point:action[:times][@once_file])")
+        point, action = parts[0], parts[1]
+        times = int(parts[2]) if len(parts) > 2 else 1
+        if once_file is not None and not os.path.exists(once_file):
+            continue  # one-shot already consumed by a prior incarnation
+        act = _env_action(action, point)
+
+        def callback(_act=act, _once=once_file, **ctx):
+            if _once is not None:
+                try:
+                    os.remove(_once)
+                except OSError:
+                    pass
+            _act(**ctx)
+
+        _INJECTOR.arm(point, callback=callback,
+                      times=None if times <= 0 else times)
+        armed.append(point)
+    return armed
 
 
 # --------------------------------------------------------------------- #

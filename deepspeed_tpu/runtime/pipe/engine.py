@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deepspeed_tpu.parallel.mesh import axis_size
+from deepspeed_tpu.runtime import fault
 from deepspeed_tpu.runtime.dataloader import (DeepSpeedDataLoader,
                                               PrefetchLoader,
                                               normalize_eval_input,
@@ -204,6 +205,10 @@ class PipelineEngine(DeepSpeedEngine):
             data_iter = self._ensure_train_iter()
 
         self._maybe_profile_step()
+        # elastic passthrough: same window-then-drain contract as the
+        # base engine (runtime/elastic.py; no-op unless armed)
+        fault.fire("elastic.sigterm_mid_window",
+                   step=self._host_global_step)
         with self.observability.span("pipe/stack_batch"):
             batch = self._stack_micro_batches(data_iter)
         step_fn = self._get_compiled_micro_step()
@@ -226,6 +231,7 @@ class PipelineEngine(DeepSpeedEngine):
                 samples=self._host_global_step * self.train_batch_size())
         self._report_progress()
         self._write_monitor(loss)  # tensorboard (reference pipe :283-292)
+        self._elastic_boundary()
         return loss
 
     def eval_batch(self, data_iter) -> jnp.ndarray:
@@ -234,6 +240,7 @@ class PipelineEngine(DeepSpeedEngine):
         Accepts an iterator of micro batches or — like the base engine —
         a single batch pytree (repeated across the micro window; the
         mean loss over identical micros equals that batch's loss)."""
+        self._drain_saves()   # eval barrier: pending async saves land
         if self._monitor_ring:
             self._flush_monitor()   # eval is an explicit sync point
         if not hasattr(self, "_compiled_pipe_eval"):

@@ -46,6 +46,15 @@ TAG_SERVE_TBT = "Serve/tbt_ms"                      # per decode dispatch
 #                                  (mean per-request time-between-tokens)
 TAG_SERVE_SLO = "Serve/slo_attainment"              # finished-in-SLO frac
 TAG_SERVE_GOODPUT = "Serve/goodput_tokens_per_s"    # within-SLO tokens/s
+# elastic / async-checkpoint plane (ISSUE 10): snapshot-vs-write split
+# of every save, the async writer's backlog, and how many times the
+# supervisor has relaunched this run. Canonical home — profiling/
+# __init__.py re-exports them; tools/obs_report.py mirrors the strings
+# (pinned together by tests/unit/test_elastic.py).
+TAG_CKPT_SNAPSHOT_MS = "Checkpoint/snapshot_ms"     # device->host copy
+TAG_CKPT_WRITE_MS = "Checkpoint/write_ms"           # stage/commit protocol
+TAG_CKPT_PENDING = "Checkpoint/pending_saves"       # async writer backlog
+TAG_CKPT_RESTARTS = "Checkpoint/restarts"           # supervisor relaunches
 
 
 class Histogram:
@@ -304,6 +313,30 @@ class TensorBoardMonitor:
         self.write_scalar(f"Train/Samples/checkpoint_{action}_ok",
                           1.0 if ok else 0.0, samples)
         self.flush()
+
+    def write_elastic_metrics(self, *, snapshot_ms=None, write_ms=None,
+                              pending_saves=None, restarts=None,
+                              samples: int = 0, flush: bool = True):
+        """Elastic-resilience telemetry (ISSUE 10): the snapshot-vs-write
+        decomposition of each save (the snapshot is the only part the
+        step loop waits for under ``checkpoint.async_save``), the async
+        writer's backlog, and the supervisor restart count of this
+        incarnation — all on the samples x-axis, so a preemption storm
+        is visible right next to the loss curve. ``write_ms`` rows may
+        be emitted from the background writer thread (one line-buffered
+        write; safe under the GIL)."""
+        if not self._writes():
+            return
+        if snapshot_ms is not None:
+            self.write_scalar(TAG_CKPT_SNAPSHOT_MS, snapshot_ms, samples)
+        if write_ms is not None:
+            self.write_scalar(TAG_CKPT_WRITE_MS, write_ms, samples)
+        if pending_saves is not None:
+            self.write_scalar(TAG_CKPT_PENDING, pending_saves, samples)
+        if restarts is not None:
+            self.write_scalar(TAG_CKPT_RESTARTS, restarts, samples)
+        if flush:
+            self.flush()
 
     def write_comm_metrics(self, *, bytes_per_step=None,
                            compression_ratio=None, samples: int = 0,

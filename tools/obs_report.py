@@ -15,6 +15,9 @@ tests/unit/test_monitor.py) and prints the run report:
   threshold fraction of step time (--host-gap-threshold)
 - memory watermarks (peak / last in-use)
 - checkpoint events (saves / loads / fallbacks)
+- elastic plane: snapshot-vs-write split of async saves, writer
+  backlog, supervisor restart count, and the
+  preemption -> relaunch -> resume chain from the event rows
 - serving section (inference-engine runs): requests, TTFT p50/p95,
   per-token latency p50/p95, tokens/s, slot occupancy, queue depth
 - serving SLO section (``--serve`` renders it standalone): queue-wait /
@@ -81,6 +84,14 @@ T_QUEUE_WAIT = "Serve/queue_wait_ms"
 T_TBT = "Serve/tbt_ms"
 T_SLO = "Serve/slo_attainment"
 T_GOODPUT = "Serve/goodput_tokens_per_s"
+# elastic / async-checkpoint plane (utils/monitor.py
+# write_elastic_metrics): snapshot-vs-write decomposition of each save,
+# async writer backlog, supervisor restart count; the `preemption` /
+# `resume` event rows carry the drain / relaunch chain
+T_CKPT_SNAPSHOT = "Checkpoint/snapshot_ms"
+T_CKPT_WRITE = "Checkpoint/write_ms"
+T_CKPT_PENDING = "Checkpoint/pending_saves"
+T_CKPT_RESTARTS = "Checkpoint/restarts"
 
 # --json output schema version: bumped when existing keys move or
 # change meaning (additive keys don't bump it). v2 = ISSUE 9 (serving
@@ -297,6 +308,28 @@ def summarize(path, host_gap_threshold=DEFAULT_HOST_GAP_THRESHOLD):
         elif tag.endswith("checkpoint_save_ms"):
             ckpt["save_ms"].extend(v for _, v in rows)
 
+    # elastic plane: snapshot/write split of the saves, async backlog,
+    # preempt->relaunch->resume chain (ISSUE 10)
+    snap_ms = _vals(scalars, T_CKPT_SNAPSHOT)
+    write_ms = _vals(scalars, T_CKPT_WRITE)
+    pending = _vals(scalars, T_CKPT_PENDING)
+    preempt_events = [e for e in events if e.get("event") == "preemption"]
+    resume_events = [e for e in events if e.get("event") == "resume"]
+    elastic = {
+        "snapshot_ms_mean": (sum(snap_ms) / len(snap_ms)
+                             if snap_ms else None),
+        "write_ms_mean": (sum(write_ms) / len(write_ms)
+                          if write_ms else None),
+        "pending_saves_peak": max(pending) if pending else None,
+        "restarts": _last(scalars, T_CKPT_RESTARTS),
+        "preemptions": len(preempt_events),
+        "resumes": len(resume_events),
+        "last_preemption": ({k: preempt_events[-1].get(k)
+                             for k in ("reason", "step", "tag",
+                                       "committed")}
+                            if preempt_events else None),
+    }
+
     return {
         "schema": SCHEMA_VERSION,
         "events_file": events_file,
@@ -356,6 +389,7 @@ def summarize(path, host_gap_threshold=DEFAULT_HOST_GAP_THRESHOLD):
             "save_ms_mean": (sum(ckpt["save_ms"]) / len(ckpt["save_ms"])
                              if ckpt["save_ms"] else None),
         },
+        "elastic": elastic,
         "loss": {
             "first": loss[0] if loss else None,
             "last": loss[-1] if loss else None,
@@ -489,6 +523,27 @@ def render(s):
         f"fallbacks={s['checkpoints']['fallbacks']}"
         + (f" save_ms_mean={_fmt(s['checkpoints']['save_ms_mean'])}"
            if s['checkpoints']['save_ms_mean'] is not None else ""),
+    ]
+    el = s.get("elastic") or {}
+    if any(v not in (None, 0) for k, v in el.items()
+           if k != "last_preemption"):
+        line = (f"  elastic           : "
+                f"restarts={_fmt(el.get('restarts'), '{:.0f}', '0')} "
+                f"preemptions={el.get('preemptions', 0)} "
+                f"resumes={el.get('resumes', 0)}")
+        if el.get("snapshot_ms_mean") is not None:
+            line += (f" snapshot_ms_mean={_fmt(el['snapshot_ms_mean'])}"
+                     f" write_ms_mean={_fmt(el.get('write_ms_mean'))}"
+                     f" pending_peak="
+                     f"{_fmt(el.get('pending_saves_peak'), '{:.0f}')}")
+        lines.append(line)
+        lp = el.get("last_preemption")
+        if lp:
+            lines.append(
+                f"    last_preemption : {lp.get('reason')} at step "
+                f"{lp.get('step')} -> tag={lp.get('tag')} "
+                f"(committed={lp.get('committed')})")
+    lines += [
         f"  loss              : first={_fmt(s['loss']['first'], '{:.4f}')} "
         f"last={_fmt(s['loss']['last'], '{:.4f}')}",
     ]

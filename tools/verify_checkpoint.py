@@ -12,10 +12,15 @@ Usage::
     python tools/verify_checkpoint.py <save_dir>            # resolve latest
     python tools/verify_checkpoint.py <save_dir> --tag TAG  # one tag
     python tools/verify_checkpoint.py <save_dir>/<tag>      # tag dir direct
-    ... [--no-crc] [--all]
+    ... [--no-crc] [--all] [--expect-step N]
 
 Exit status 0 iff everything checked is committed, verified, and fully
-covered.
+covered — and, with ``--expect-step N``, the newest committed
+step-suffixed tag is at least step N (the supervisor's resume sanity
+check: a relaunch that would silently lose more progress than the
+preemption took exits nonzero here first). Preemption-tagged
+checkpoints (``meta.preempted`` — committed by the graceful drain) are
+reported distinctly.
 """
 
 import argparse
@@ -113,16 +118,26 @@ def verify_tag_dir(ckpt_dir, check_crc=True):
         if bad:
             healthy = False
     meta_path = os.path.join(ckpt_dir, "meta.json")
+    preempted = False
     if os.path.isfile(meta_path):
         with open(meta_path) as f:
             meta = json.load(f)
+        preempted = bool(meta.get("preempted"))
         print(f"  meta: global_step={meta.get('global_step')} "
               f"dp_world_size={meta.get('dp_world_size')} "
               f"zero_stage={meta.get('zero_stage')}")
+        if preempted:
+            print("  PREEMPTION checkpoint: committed by the graceful "
+                  "drain (runtime/elastic.py) — protected from retention "
+                  "GC while newer than 'latest'")
     else:
         print("  meta.json: MISSING")
         healthy = False
-    print(f"  verdict: {'COMMITTED+VERIFIED' if healthy and marker else 'OK (legacy)' if healthy else 'CORRUPT/INCOMPLETE'}")
+    verdict = ('COMMITTED+VERIFIED' if healthy and marker
+               else 'OK (legacy)' if healthy else 'CORRUPT/INCOMPLETE')
+    if preempted and healthy:
+        verdict += " (preemption)"
+    print(f"  verdict: {verdict}")
     return healthy
 
 
@@ -134,6 +149,10 @@ def main(argv=None):
                     help="verify every tag in save_dir")
     ap.add_argument("--no-crc", action="store_true",
                     help="skip checksum verification (sizes only)")
+    ap.add_argument("--expect-step", type=int, default=None, metavar="N",
+                    help="exit nonzero unless the newest committed "
+                         "step-suffixed tag is at least step N (the "
+                         "supervisor's resume sanity check)")
     args = ap.parse_args(argv)
     check_crc = not args.no_crc
 
@@ -146,7 +165,20 @@ def main(argv=None):
     if args.tag is None and not args.all and (
             os.path.isfile(os.path.join(path, ckpt.COMMIT_MARKER))
             or os.path.isfile(os.path.join(path, "meta.json"))):
-        return 0 if verify_tag_dir(path, check_crc) else 1
+        ok = verify_tag_dir(path, check_crc)
+        if ok and args.expect_step is not None:
+            # meta is authoritative (custom-named tags like 'best' carry
+            # no step in their name); the name is only a fallback
+            step = ckpt.tag_step(os.path.basename(path))
+            meta_path = os.path.join(path, "meta.json")
+            if os.path.isfile(meta_path):
+                with open(meta_path) as f:
+                    step = int(json.load(f).get("global_step", step))
+            if step < args.expect_step:
+                print(f"EXPECT-STEP FAILED: tag step {step} < expected "
+                      f"{args.expect_step}", file=sys.stderr)
+                return 1
+        return 0 if ok else 1
 
     tags = ckpt.list_tags(path)
     latest = ckpt.read_latest(path)
@@ -167,6 +199,16 @@ def main(argv=None):
     for t in targets:
         if not verify_tag_dir(os.path.join(path, t), check_crc):
             rc = 1
+    if args.expect_step is not None:
+        newest = ckpt.newest_committed_step(path)
+        if newest < args.expect_step:
+            print(f"EXPECT-STEP FAILED: newest committed tag is step "
+                  f"{newest} < expected {args.expect_step}",
+                  file=sys.stderr)
+            rc = rc or 1
+        else:
+            print(f"expect-step OK: newest committed tag is step {newest} "
+                  f">= {args.expect_step}")
     return rc
 
 
