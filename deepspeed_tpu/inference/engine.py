@@ -45,7 +45,11 @@ TPU-first:
   ``{"model": 4}``) the programs jit with GSPMD NamedShardings over a
   ``parallel/mesh.py`` mesh: params carry the families' Megatron
   column/row PartitionSpecs, the KV cache/pool shards over its kv_heads
-  dim — tensor-parallel prefill/decode over ICI.
+  dim — tensor-parallel prefill/decode over ICI. The Pallas paged-decode
+  kernel runs shard_mapped over the mesh's model axis
+  (``parallel/pallas_shard.py``) — sharded serving keeps the O(live
+  tokens) read; the compiled sharded decode program is pinned
+  gather-free in tier-1.
   :meth:`from_checkpoint` reshards committed train-mesh params onto the
   serving mesh on load (portable array redistribution: the checkpoint
   is logically indexed, ``load_params_only`` materializes straight into
@@ -372,19 +376,31 @@ class InferenceEngine:
         if requested != "pallas":
             self._decode_attn_path = "gather"
             self._decode_attn_reason = "configured"
-        elif self.mesh is not None:
-            # a pallas_call can't be auto-partitioned by GSPMD; until
-            # the kernel is shard_mapped over kv_heads, sharded serving
-            # stays on the gather path (docs/inference.md fallback
-            # matrix)
-            self._decode_attn_path = "gather"
-            self._decode_attn_reason = ("serving mesh: pallas decode "
-                                        "pending shard_map wrap")
         else:
             ok, why = paged_decode_supported(
                 self.paged_spec.page_size, self.paged_spec.head_dim,
                 dtype=self.paged_spec.dtype)
-            if ok:
+            if ok and self.mesh is not None:
+                # a pallas_call can't be auto-partitioned by GSPMD —
+                # the kernel runs shard_mapped over the mesh's model
+                # axis instead (parallel/pallas_shard), each device
+                # walking its local kv-head shard of the pool: sharded
+                # serving KEEPS the O(live tokens) read. Geometry is
+                # always legal here: __init__'s cache-sharding check
+                # already rejected any model axis that does not divide
+                # num_heads AND kv_heads (whole GQA groups per shard).
+                from deepspeed_tpu.parallel.mesh import axis_size
+                from deepspeed_tpu.parallel.pallas_shard import \
+                    head_shard_supported
+                n = axis_size(self.mesh, "model")
+                assert head_shard_supported(
+                    n, self.model_config.num_heads,
+                    self.paged_spec.kv_heads), (n, "unreachable: init "
+                                                "validates divisibility")
+                self._decode_attn_path = "pallas"
+                self._decode_attn_reason = (
+                    f"shard_map over mesh axis 'model' ({n}-way); {why}")
+            elif ok:
                 self._decode_attn_path = "pallas"
                 self._decode_attn_reason = why
             else:
@@ -398,15 +414,26 @@ class InferenceEngine:
         """jit + CompileTracker wrap; with a serving mesh, pin GSPMD
         NamedShardings (params on their TP specs, cache on the kv_heads
         split, host arrays replicated) so every dispatch hits the same
-        partitioned program."""
+        partitioned program. The mesh also rides a trace-time context
+        (``parallel/pallas_shard.pallas_kernel_mesh``) so the models'
+        Pallas kernel call sites shard_map over it instead of tripping
+        GSPMD."""
         if self.mesh is None:
             jitted = jax.jit(fn, donate_argnums=(1,))
         else:
+            from deepspeed_tpu.parallel.pallas_shard import \
+                pallas_kernel_mesh
+            mesh = self.mesh
+
+            def fn_under_mesh(*args, _fn=fn, _mesh=mesh):
+                with pallas_kernel_mesh(_mesh, "model"):
+                    return _fn(*args)
+
             repl = NamedSharding(self.mesh, P())
             cache_sh = (self._cache_sharding, self._cache_sharding)
             in_sh = (self._param_shardings, cache_sh) + \
                 (repl,) * (nargs - 2)
-            jitted = jax.jit(fn, donate_argnums=(1,),
+            jitted = jax.jit(fn_under_mesh, donate_argnums=(1,),
                              in_shardings=in_sh,
                              out_shardings=(repl, cache_sh))
         return self.compile_tracker.wrap(jitted, name)

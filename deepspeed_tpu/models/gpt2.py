@@ -546,10 +546,24 @@ def paged_decode_ctx(q, kpool, vpool, block_table, cache_position):
     :func:`deepspeed_tpu.ops.attention.paged.paged_decode_attention`
     against the (already-written) pool and restore the (B, H, 1, hd)
     context layout. One home so the kernel call contract cannot drift
-    between gpt2 and llama."""
+    between gpt2 and llama.
+
+    Under a serving mesh the engine traces its compiled programs inside
+    ``parallel/pallas_shard.pallas_kernel_mesh``; consulting that
+    context here wraps the kernel in shard_map over the mesh's head
+    axis (pools stay sharded over kv heads — the O(live tokens) read
+    survives GSPMD instead of falling back to gather)."""
     from deepspeed_tpu.ops.attention.paged import paged_decode_attention
-    out = paged_decode_attention(q[:, :, 0], kpool, vpool, block_table,
-                                 cache_position)
+    from deepspeed_tpu.parallel.pallas_shard import (current_kernel_mesh,
+                                                     sharded_paged_decode)
+    km = current_kernel_mesh()
+    if km is not None:
+        out = sharded_paged_decode(q[:, :, 0], kpool, vpool, block_table,
+                                   cache_position, mesh=km.mesh,
+                                   axis=km.axis)
+    else:
+        out = paged_decode_attention(q[:, :, 0], kpool, vpool,
+                                     block_table, cache_position)
     return out[:, :, None, :]
 
 

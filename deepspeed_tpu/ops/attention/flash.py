@@ -1,5 +1,14 @@
 """Pallas flash attention — the MXU-native core of the transformer stack.
 
+Since PR 11 :func:`flash_attention` is a DISPATCHER: the default path
+compiles the ONE mask-parameterized kernel in ``masked_flash.py`` with
+a dense/causal BlockMask (same math, same dropout hash, one code path
+with the sparse layouts — docs/attention.md). The per-path kernels in
+this module remain the numerics oracles behind
+``set_attention_options(kernel="flash")``, and this module still owns
+the shared machinery (dropout hash, streaming layout, block autotune
+table, reference oracle, once-logging).
+
 TPU-native replacement for the reference's fused CUDA attention pipeline
 (csrc/transformer/ds_transformer_cuda.cpp Forward :153: QK^T strided GEMM →
 launch_attn_softmax → PV) — but O(S) memory instead of materializing the
@@ -32,13 +41,17 @@ Falls back to a jnp reference implementation off-TPU (same math incl. the
 same hash mask, used as the numerics oracle in tests).
 """
 
+import dataclasses
 import functools
+import os
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
+
+from deepspeed_tpu.utils.logging import logger
 
 try:
     from jax.experimental.pallas import tpu as pltpu
@@ -50,12 +63,52 @@ NEG_INF = -1e30
 # single multiply-xorshift round (A/B knob BENCH_DROPOUT_HASH1=1 via
 # bench.py; same keep statistics, cheaper tile-wide VPU work)
 _HASH_FINAL_ROUNDS = 2
-_WARNED_IRREGULAR_FALLBACK = False
-# Route EVERY call through attention_reference (the XLA-fused O(S^2)
-# path): A/B knob — at short sequences (e.g. BERT seq128) XLA's batched
-# fused attention may beat the per-(b,h,row) Pallas launch grid.
-_FORCE_REFERENCE = False
-_WARNED_IRREGULAR_STREAM = False
+
+
+@dataclasses.dataclass
+class AttentionOptions:
+    """Process-wide attention-kernel selection (replaces the old
+    ``_FORCE_REFERENCE`` / ``_WARNED_*`` mutable module globals, whose
+    state leaked across tests and configs).
+
+    kernel: which implementation :func:`flash_attention` compiles —
+      ``"masked"`` (default): the unified mask-parameterized kernel
+      (``masked_flash.py``) with a dense/causal BlockMask;
+      ``"flash"``: the legacy per-path kernels in this module (kept as
+      numerics oracles);
+      ``"reference"``: the XLA-fused O(S^2) ``attention_reference``
+      path with MXU bf16 operands (A/B knob — at short sequences XLA's
+      batched fused attention may beat a Pallas launch grid). Ignored
+      (loudly, once) above STREAM_THRESHOLD where O(S^2) is not
+      meaningful.
+    """
+    kernel: str = os.environ.get("DSTPU_ATTENTION_KERNEL", "masked")
+
+    def __post_init__(self):
+        assert self.kernel in ("masked", "flash", "reference"), self.kernel
+
+
+_OPTIONS = AttentionOptions()
+
+
+def get_attention_options() -> AttentionOptions:
+    return _OPTIONS
+
+
+def set_attention_options(**kw) -> AttentionOptions:
+    """Update kernel-selection knobs; returns the PREVIOUS options so
+    callers (tests, bench A/B) can restore them."""
+    global _OPTIONS
+    old = _OPTIONS
+    _OPTIONS = dataclasses.replace(_OPTIONS, **kw)
+    return old
+
+
+# once-per-(reason, shape) which-path logging lives in utils/logging
+# (shared infrastructure); re-exported here because every attention
+# fallback logs through it and tests/benches reach it via this module
+from deepspeed_tpu.utils.logging import (_ONCE_KEYS, log_once,  # noqa
+                                         reset_once_logging)
 
 
 # --------------------------------------------------------------------- #
@@ -130,7 +183,7 @@ def attention_reference(q, k, v, mask=None, causal=False,
     mxu_bf16: keep MXU operands in the input dtype with fp32 accumulation
     (the Pallas kernels' precision) instead of the oracle's fp32 operands
     — used when this path serves as a PERFORMANCE alternative
-    (_FORCE_REFERENCE), not as the accuracy oracle."""
+    (kernel="reference"), not as the accuracy oracle."""
     if sm_scale is None:
         sm_scale = 1.0 / np.sqrt(q.shape[-1])
     if k.shape[1] != q.shape[1]:
@@ -494,17 +547,14 @@ def _use_stream(seq_q, seq_k):
     # and may exceed scoped VMEM at S>=16k — flash_attention warns)
     if seq_q % 128 != 0 or seq_k % 128 != 0:
         if max(seq_q, seq_k) >= STREAM_THRESHOLD:
-            global _WARNED_IRREGULAR_STREAM
-            if not _WARNED_IRREGULAR_STREAM:
-                _WARNED_IRREGULAR_STREAM = True
-                import warnings
-                warnings.warn(
-                    f"flash_attention: seq ({seq_q}, {seq_k}) >= "
-                    f"{STREAM_THRESHOLD} but not divisible by 128 — the "
-                    "DMA-streaming kernel needs 128-multiple sequences, "
-                    "so K/V stay VMEM-resident with small blocks (slow, "
-                    "and may fail to compile at S>=16k). Pad the "
-                    "sequence to a multiple of 128.", stacklevel=3)
+            log_once(
+                ("irregular-stream", seq_q, seq_k),
+                f"flash_attention: seq ({seq_q}, {seq_k}) >= "
+                f"{STREAM_THRESHOLD} but not divisible by 128 — the "
+                "DMA-streaming kernel needs 128-multiple sequences, "
+                "so K/V stay VMEM-resident with small blocks (slow, "
+                "and may fail to compile at S>=16k). Pad the "
+                "sequence to a multiple of 128.", warn=True)
         return False
     return max(seq_q, seq_k) >= STREAM_THRESHOLD
 
@@ -623,6 +673,42 @@ def lookup_banded_blocks(seq, fine_block, band_w=None, causal=None):
         return seq % e["bq"] == 0 and seq % e["bk"] == 0
     e = _table_lookup(m)
     return (e["bq"], e["bk"]) if e is not None else None
+
+
+def lookup_masked_blocks(seq_q, seq_k, d, stream) -> Optional[int]:
+    """Measured SQUARE walk-tile size for the unified masked kernel
+    (ops/attention/masked_flash.py), or None. Entries carry
+    kind="masked" and a single ``b`` (the CSR walk uses square tiles so
+    the mask block granularity is one number)."""
+    e = _table_lookup(
+        lambda e: e.get("kind") == "masked"
+        and e["seq_q"] == seq_q and e["seq_k"] == seq_k and e["d"] == d
+        and bool(e["stream"]) == stream
+        and seq_q % e["b"] == 0 and seq_k % e["b"] == 0)
+    return e["b"] if e is not None else None
+
+
+def pick_masked_block(seq_q, seq_k, d=None, stream=None) -> int:
+    """Walk-tile size for a dense/causal BlockMask: autotune-table hit,
+    else the measured-block heuristic with a single logged line per
+    unknown shape (the block_table.json contract)."""
+    if _FORCE_BLOCKS is not None:
+        return _FORCE_BLOCKS[0]
+    if stream is None:
+        stream = _use_stream(seq_q, seq_k)
+    if d is not None:
+        hit = lookup_masked_blocks(seq_q, seq_k, d, stream)
+        if hit is not None:
+            return hit
+        log_once(("masked-block", seq_q, seq_k, d, stream),
+                 f"masked_flash: no autotuned block for shape "
+                 f"(seq_q={seq_q}, seq_k={seq_k}, d={d}, "
+                 f"stream={stream}) — using the heuristic walk tile")
+    cap = _block_cap(max(seq_q, seq_k), stream)
+    for b in (512, 256, 128, 64, 32, 16):
+        if b <= cap and seq_q % b == 0 and seq_k % b == 0:
+            return b
+    return min(seq_q, seq_k, cap)
 
 
 def _seed_spec():
@@ -957,34 +1043,26 @@ def flash_attention(q, k, v, mask=None, causal: bool = False,
     else:
         seed = jnp.zeros((1, 1), jnp.int32)
     sq, sk = q.shape[2], k.shape[2]
-    force_ref = _FORCE_REFERENCE
+    force_ref = _OPTIONS.kernel == "reference"
     if force_ref and max(sq, sk) >= STREAM_THRESHOLD:
         # the A/B knob must never silently re-route a long-context
         # measurement onto the O(S^2) path (it would OOM or be
         # mis-attributed as the flash baseline — ADVICE r3 #2): above
         # the streaming threshold the knob is ignored, loudly
-        global _WARNED_REF_STREAM
-        if not globals().get("_WARNED_REF_STREAM"):
-            _WARNED_REF_STREAM = True
-            import warnings
-            warnings.warn(
-                f"flash_attention: _FORCE_REFERENCE ignored at seq "
-                f"({sq}, {sk}) >= {STREAM_THRESHOLD} — the O(S^2) "
-                "reference path is not meaningful (or feasible) in the "
-                "DMA-streaming regime.", stacklevel=2)
+        log_once(("ref-stream", sq, sk),
+                 f"flash_attention: kernel='reference' ignored at seq "
+                 f"({sq}, {sk}) >= {STREAM_THRESHOLD} — the O(S^2) "
+                 "reference path is not meaningful (or feasible) in the "
+                 "DMA-streaming regime.", warn=True)
         force_ref = False
     if force_reference or force_ref or sq % 16 != 0 or sk % 16 != 0:
-        if not force_reference and not _FORCE_REFERENCE \
+        if not force_reference and not force_ref \
                 and max(sq, sk) > 2048:
-            global _WARNED_IRREGULAR_FALLBACK
-            if not _WARNED_IRREGULAR_FALLBACK:
-                _WARNED_IRREGULAR_FALLBACK = True
-                import warnings
-                warnings.warn(
-                    f"flash_attention: seq ({sq}, {sk}) not divisible by "
-                    "16 — falling back to the O(S^2)-memory dense "
-                    "reference path. Pad the sequence to a multiple of "
-                    "16 to use the Pallas kernel.", stacklevel=2)
+            log_once(("irregular-fallback", sq, sk),
+                     f"flash_attention: seq ({sq}, {sk}) not divisible "
+                     "by 16 — falling back to the O(S^2)-memory dense "
+                     "reference path. Pad the sequence to a multiple of "
+                     "16 to use the Pallas kernel.", warn=True)
         return attention_reference(q, k, v, mask=mask, causal=causal,
                                    sm_scale=sm_scale,
                                    dropout_rate=dropout_rate,
@@ -1023,10 +1101,55 @@ def flash_attention(q, k, v, mask=None, causal: bool = False,
                               dropout_rng=dropout_rng,
                               interpret=interpret)
         return out[:, :, :sq, :]
+    if mask is not None:
+        assert mask.ndim == 4 and mask.shape[1] == 1 and \
+            mask.shape[2] == 1, \
+            f"flash path expects (B,1,1,Sk) additive mask, got {mask.shape}"
+    if _OPTIONS.kernel == "masked" and (not causal or sq == sk):
+        # default path (PR 11): dense and causal are mask choices of the
+        # ONE unified kernel — same math, same dropout hash, one code
+        # path with the sparse layouts. (A causal cross-attention with
+        # sq != sk has no square-block mask; it stays on the legacy
+        # kernels below.)
+        return _masked_dense_attention(q, k, v, mask, seed, causal,
+                                       float(sm_scale), interpret,
+                                       dropout_rate)
     if mask is None:
         return _flash_attention(q, k, v, seed, causal, float(sm_scale),
                                 interpret, dropout_rate)
-    assert mask.ndim == 4 and mask.shape[1] == 1 and mask.shape[2] == 1, \
-        f"flash path expects (B,1,1,Sk) additive mask, got {mask.shape}"
     return _flash_attention_masked(q, k, v, mask, seed, causal,
                                    float(sm_scale), interpret, dropout_rate)
+
+
+# dense/causal BlockMasks for the unified-kernel route, cached per
+# geometry (bounded: shapes are bucketed in practice)
+_DENSE_MASK_CACHE = {}
+_DENSE_MASK_CAP = 256
+
+
+def _dense_block_mask(sq, sk, d, causal):
+    key = (sq, sk, d, causal, _FORCE_BLOCKS)
+    bm = _DENSE_MASK_CACHE.get(key)
+    if bm is None:
+        from deepspeed_tpu.ops.attention.masked_flash import BlockMask
+        block = pick_masked_block(sq, sk, d)
+        if len(_DENSE_MASK_CACHE) >= _DENSE_MASK_CAP:
+            _DENSE_MASK_CACHE.clear()
+        bm = BlockMask.causal(sq, block) if causal else \
+            BlockMask.dense(sq, sk, block)
+        _DENSE_MASK_CACHE[key] = bm
+    return bm
+
+
+def _masked_dense_attention(q, k, v, mask, seed, causal, sm_scale,
+                            interpret, rate):
+    from deepspeed_tpu.ops.attention.masked_flash import masked_flash_call
+    sq, sk = q.shape[2], k.shape[2]
+    b = q.shape[0]
+    bm = _dense_block_mask(sq, sk, q.shape[-1], causal)
+    # no mask: a dummy kpm + has_kpm=False keeps the hot path free of
+    # an all-zero mask operand/add
+    kpm = jnp.zeros((b, 1), jnp.float32) if mask is None else \
+        mask.reshape(b, sk).astype(jnp.float32)
+    return masked_flash_call(q, k, v, kpm, seed, bm, sm_scale, interpret,
+                             rate, mask is not None)

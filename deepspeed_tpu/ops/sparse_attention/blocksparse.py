@@ -312,10 +312,23 @@ def _drop_am(kernel, n_before):
 # --------------------------------------------------------------------- #
 _FN_CACHE = {}
 
-# row-run DMA kernels (blocksparse_v2.py) for the no-attn-mask path;
-# flip off to fall back to the per-triple v1 kernels
+# unified mask-parameterized flash kernel (ops/attention/masked_flash.py,
+# PR 11): the DEFAULT for every layout without a user attention mask —
+# dense, causal, banded and BigBird are BlockMask choices of ONE kernel.
+# Flip off to reach the legacy dispatch below (banded / hybrid / v2 /
+# coarse), kept as numerics oracles and A/B baselines.
+USE_MASKED_FLASH = True
+
+# row-run DMA kernels (blocksparse_v2.py) for the no-attn-mask path
+# within the LEGACY dispatch; flip off to fall back to the per-triple v1
+# kernels. DEPRECATED AS A DISPATCH TARGET: the v1 one-program-per-
+# nonzero-block grid loses to dense flash on launch overhead (~10k
+# sequential launches at a 128-block Longformer S=8192 layout), so the
+# automatic dispatch NEVER selects it anymore — an unstreamable block
+# size now routes to the unified masked kernel instead. v1 stays
+# importable/buildable (set USE_SPLASH_V2 = False explicitly) as a test
+# oracle only.
 USE_SPLASH_V2 = True
-_WARNED_V1_BLOCK = False
 
 # banded fast path (banded.py): layouts that match the global-prefix +
 # sliding-window predicate (BSLongformer-class) skip all CSR/DMA-stream
@@ -391,9 +404,16 @@ def _pick_coarse_block(layout: np.ndarray, block: int, has_am: bool):
 
 def planned_kernel(layout, block, has_am=False, interpret=False) -> str:
     """Which kernel family _sparse_attention_fn would build for this
-    layout — diagnostic/bench reporting only: 'banded' | 'v2-coarse<N>'
-    | 'v2' | 'v1'."""
+    layout — diagnostic/bench reporting only: 'masked[-coarse<N>]'
+    (unified kernel, the default) | 'banded' | 'hybrid' | 'v2-coarse<N>'
+    | 'v2' | 'masked-fallback' | 'v1' (explicit USE_SPLASH_V2=False
+    only — retired as an automatic dispatch target)."""
     layout = np.asarray(layout)
+    if USE_MASKED_FLASH and not has_am:
+        from deepspeed_tpu.ops.attention.masked_flash import BlockMask
+        bm = BlockMask.from_layout(layout, block)
+        return (f"masked-coarse{bm.block}" if bm.block != block
+                else "masked")
     if USE_BANDED and not has_am:
         from deepspeed_tpu.ops.sparse_attention import banded as _b
         if _b.plan(layout, block, interpret) is not None:
@@ -407,6 +427,12 @@ def planned_kernel(layout, block, has_am=False, interpret=False) -> str:
     if USE_SPLASH_V2 and (interpret or block % 128 == 0
                           or coarse is not None):
         return f"v2-coarse{coarse}" if coarse else "v2"
+    if USE_SPLASH_V2:
+        # the v1-retirement route: plain layouts land on the unified
+        # kernel; a user attn mask lands on the differentiable dense
+        # reference (_build_masked_fn has_am) — report what actually
+        # runs, O(S^2) included
+        return "reference-fallback" if has_am else "masked-fallback"
     return "v1"
 
 
@@ -417,6 +443,50 @@ def _use_pallas():
         return False
 
 
+def _build_masked_fn(layout: np.ndarray, block: int, sm_scale: float,
+                     interpret: bool, has_am: bool = False):
+    """The unified masked-kernel implementation with the legacy impl
+    signature ``f(q, k, v, kpm[, am])`` (kpm pre-blocked additive
+    ``(B, nk, 1, block)``). The layout becomes a :class:`BlockMask`
+    (head-uniform layouts collapse; banded layouts coarsen to MXU-sized
+    walk tiles with the fine structure in register predicates).
+
+    ``has_am``: the unified kernel carries no streamed user-mask
+    channel, so a pre-blocked attention mask falls back to the
+    DIFFERENTIABLE dense reference — only reachable from the
+    v1-retirement branch (unstreamable block + user mask), never for
+    the plain layout path."""
+    from deepspeed_tpu.ops.attention.masked_flash import (
+        BlockMask, masked_flash_attention)
+    if has_am:
+        from deepspeed_tpu.utils.logging import log_once
+        log_once(("masked-am-reference", layout.shape, block),
+                 "block_sparse_attention: user attention mask with an "
+                 "unstreamable block size — using the O(S^2) dense "
+                 "reference (differentiable) instead of the retired v1 "
+                 "kernels.")
+
+        def fref(q, k, v, kpm, am):
+            B, _, S, _ = q.shape
+            am_flat = am.transpose(0, 2, 1, 3).reshape(S, S)
+            return block_sparse_attention_reference(
+                q, k, v, layout, sm_scale=sm_scale,
+                key_padding_mask=kpm.reshape(B, S),
+                key_padding_mask_mode="add",
+                attn_mask=am_flat, attn_mask_mode="add")
+        return fref
+
+    bm = BlockMask.from_layout(layout, block)
+
+    def fm(q, k, v, kpm):
+        B, _, S, _ = q.shape
+        return masked_flash_attention(q, k, v, bm,
+                                      key_mask=kpm.reshape(B, S),
+                                      sm_scale=sm_scale,
+                                      interpret=interpret)
+    return fm
+
+
 def _sparse_attention_fn(layout: np.ndarray, block: int, sm_scale: float,
                          has_am: bool, interpret: bool):
     """Returns f(q, k, v, kpm[, am]) -> o with a custom VJP, where q/k/v are
@@ -425,11 +495,16 @@ def _sparse_attention_fn(layout: np.ndarray, block: int, sm_scale: float,
     are closed over as static data and fed to Mosaic via scalar prefetch."""
     from deepspeed_tpu.ops.sparse_attention import banded as _banded
     key = (layout.shape, layout.tobytes(), block, float(sm_scale), has_am,
-           interpret, USE_SPLASH_V2, USE_COARSE, _FORCE_COARSE_BLOCK,
-           _COARSE_TILE_BUDGET, USE_BANDED, USE_HYBRID,
-           _banded._FORCE_BLOCKS)
+           interpret, USE_MASKED_FLASH, USE_SPLASH_V2, USE_COARSE,
+           _FORCE_COARSE_BLOCK, _COARSE_TILE_BUDGET, USE_BANDED,
+           USE_HYBRID, _banded._FORCE_BLOCKS)
     if key in _FN_CACHE:
         return _FN_CACHE[key]
+
+    if USE_MASKED_FLASH and not has_am:
+        fm = _build_masked_fn(layout, block, float(sm_scale), interpret)
+        _FN_CACHE[key] = fm
+        return fm
 
     if USE_BANDED and not has_am:
         planned = _banded.plan(layout, block, interpret)
@@ -456,19 +531,21 @@ def _sparse_attention_fn(layout: np.ndarray, block: int, sm_scale: float,
                                 or coarse_block is not None)
     if not use_v2 and USE_SPLASH_V2 and not interpret:
         # v2 wanted but the block width can't be a DMA lane dim and no
-        # coarse walk tile fits either
-        global _WARNED_V1_BLOCK
-        if not _WARNED_V1_BLOCK:
-            _WARNED_V1_BLOCK = True
-            import warnings
-            warnings.warn(
-                f"block_sparse_attention: block={block} is not a multiple "
-                "of 128, so the fast row-run (splash v2) kernels cannot "
-                "stream it by DMA on TPU, and no coarse walk tile divides "
-                "the sequence — falling back to the per-triple v1 kernels "
-                "(~row-degree x more program launches). Use 128-multiple "
-                "blocks (or 512-divisible sequences) for long-sequence "
-                "performance.", stacklevel=3)
+        # coarse walk tile fits either. The v1 per-triple kernels are
+        # RETIRED as a dispatch target (launch overhead ~row-degree x):
+        # route to the unified masked kernel, whose resident mode
+        # handles any block size, instead of silently selecting v1.
+        from deepspeed_tpu.utils.logging import log_once
+        log_once(("v1-retired", block, layout.shape),
+                 f"block_sparse_attention: block={block} cannot "
+                 "DMA-stream (not a 128 multiple) and no coarse walk "
+                 "tile divides the sequence — routing to the unified "
+                 "masked kernel (resident K/V) instead of the retired "
+                 "per-triple v1 kernels.")
+        fm = _build_masked_fn(layout, block, float(sm_scale), interpret,
+                              has_am=has_am)
+        _FN_CACHE[key] = fm
+        return fm
     if use_v2:
         # row-run kernels: one program per block row, K/V (and the
         # deduped attn-mask tiles) streamed by DMA (blocksparse_v2.py)

@@ -1,4 +1,4 @@
-"""Sparse self-attention modules on top of the Pallas block-sparse kernel.
+"""Sparse self-attention modules on top of the unified Pallas kernel.
 
 Parity targets (reference):
 - SparseSelfAttention            deepspeed/ops/sparse_attention/sparse_self_attention.py:13
@@ -8,7 +8,11 @@ Parity targets (reference):
 Where the reference caches three Triton ops per sequence length
 (sparse_self_attention.py:44 get_ops), we cache one fused differentiable
 Pallas function per (layout, seq-len) via blocksparse._sparse_attention_fn;
-layout construction itself is cached here per seq len.
+layout construction itself is cached here per seq len. Since PR 11 that
+dispatch resolves layouts to the ONE mask-parameterized flash kernel
+(``ops/attention/masked_flash.py`` — the same kernel dense training
+attention compiles); the legacy banded/v2/v1 kernels stay behind
+``blocksparse.USE_MASKED_FLASH = False`` as numerics oracles.
 
 Modules follow the repo's functional convention: configs are plain
 objects, parameters are pytrees created by ``init_*_params``, forward

@@ -59,6 +59,18 @@ class SparsityConfig:
     def make_layout(self, seq_len: int) -> np.ndarray:
         raise NotImplementedError
 
+    def make_block_mask(self, seq_len: int, walk_block=None):
+        """Resolve this config to the unified masked-flash kernel's
+        :class:`~deepspeed_tpu.ops.attention.masked_flash.BlockMask` —
+        the one object the training kernel consumes (PR 11). Head-
+        uniform layouts collapse to a single mask head; banded layouts
+        (BSLongformer-class) coarsen their walk tile automatically, the
+        fine structure riding in-register predicates. ``walk_block``
+        forces a tile size (0 = the config's own block)."""
+        from deepspeed_tpu.ops.attention.masked_flash import BlockMask
+        return BlockMask.from_layout(self.make_layout(seq_len),
+                                     self.block, walk_block=walk_block)
+
     def layout_cache_key(self):
         """Hashable identity used by SparseSelfAttention's per-seq-len op
         cache. Subclasses with extra knobs extend this tuple."""

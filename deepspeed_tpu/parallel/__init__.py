@@ -1,0 +1,3 @@
+from deepspeed_tpu.parallel.pallas_shard import (  # noqa
+    current_kernel_mesh, pallas_kernel_mesh, sharded_masked_flash,
+    sharded_paged_decode)

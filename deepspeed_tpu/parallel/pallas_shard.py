@@ -1,0 +1,169 @@
+"""shard_map wrap for Pallas kernels under a GSPMD mesh.
+
+A ``pallas_call`` cannot be auto-partitioned by GSPMD: left inside a
+jit with sharded operands, XLA either replicates the operands (wrong
+answer for a sharded cache) or fails to partition — which is why, until
+PR 11, the serving engine silently dropped the PR 8 paged-decode kernel
+for the max_len-bounded gather path the moment ``inference.mesh`` was
+set, losing the O(live tokens) win exactly at pod scale.
+
+The fix is the canonical one: wrap the kernel in ``jax.shard_map`` over
+the mesh's head axis, so each device runs the *identical* kernel on its
+local head shard — attention is embarrassingly parallel over (kv) heads,
+no collectives needed inside. This module is the one home for those
+wraps:
+
+- :func:`sharded_paged_decode` — the PR 8 decode kernel over a
+  kv-head-sharded page pool (the serving engine's mesh path; the
+  compiled program is pinned gather-free by ``hlo_audit.gather_ops``
+  in tier-1).
+- :func:`sharded_masked_flash` — the unified training kernel
+  (``ops/attention/masked_flash.py``) over head-sharded q/k/v. Requires
+  a head-uniform BlockMask (``mask.heads == 1`` — dense, causal, and
+  every propagated SparsityConfig layout): shard_map is SPMD, so
+  per-head metadata cannot differ across shards. NOTE: the in-kernel
+  dropout hash is keyed on the *local* head index, so a sharded run
+  draws a different (equally valid) keep-mask than an unsharded one.
+- :func:`pallas_kernel_mesh` / :func:`current_kernel_mesh` — a
+  trace-time context the serving engine uses to thread its mesh down to
+  the models' kernel call sites without widening every forward
+  signature: the engine traces its compiled programs under the context,
+  ``models/gpt2.paged_decode_ctx`` consults it.
+
+Head-axis legality mirrors the PR 7 cache sharding: the mesh axis must
+divide q heads AND kv heads (each shard then owns whole GQA groups, so
+group g of q head h lands on the same shard as kv head h // G).
+"""
+
+import contextlib
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deepspeed_tpu.parallel.mesh import axis_size
+
+__all__ = ["sharded_paged_decode", "sharded_masked_flash",
+           "pallas_kernel_mesh", "current_kernel_mesh", "KernelMesh",
+           "head_shard_supported"]
+
+
+class KernelMesh(NamedTuple):
+    mesh: Mesh
+    axis: str
+
+
+_ACTIVE: list = []          # stack; trace-time only
+
+
+@contextlib.contextmanager
+def pallas_kernel_mesh(mesh: Optional[Mesh], axis: str = "model"):
+    """Trace-time context: while active, mesh-aware kernel call sites
+    (``models/gpt2.paged_decode_ctx``) wrap their Pallas kernels in
+    shard_map over ``(mesh, axis)``. ``mesh=None`` (or an absent/size-1
+    axis) is a no-op, so callers can wrap unconditionally."""
+    if mesh is None or axis_size(mesh, axis) <= 1:
+        yield
+        return
+    _ACTIVE.append(KernelMesh(mesh, axis))
+    try:
+        yield
+    finally:
+        _ACTIVE.pop()
+
+
+def current_kernel_mesh() -> Optional[KernelMesh]:
+    return _ACTIVE[-1] if _ACTIVE else None
+
+
+def head_shard_supported(n: int, *head_counts) -> bool:
+    """Can a Pallas attention kernel shard over an n-way head axis for
+    these head counts? Every count must divide (whole GQA groups per
+    shard)."""
+    return all(h % n == 0 for h in head_counts)
+
+
+def sharded_paged_decode(q, kpool, vpool, block_tables, cache_position,
+                         mesh: Mesh, axis: str = "model",
+                         sm_scale: Optional[float] = None,
+                         interpret: Optional[bool] = None):
+    """PR 8 ``paged_decode_attention`` under a GSPMD mesh: q sharded
+    over heads, pools over kv heads (the engine's
+    ``P(None, None, 'model')`` cache split, per layer), block tables and
+    positions replicated. Falls through to the plain kernel when the
+    axis is absent or size 1."""
+    from deepspeed_tpu.ops.attention.paged import paged_decode_attention
+    n = axis_size(mesh, axis)
+    kernel = functools.partial(paged_decode_attention, sm_scale=sm_scale,
+                               interpret=interpret)
+    if n <= 1:
+        return kernel(q, kpool, vpool, block_tables, cache_position)
+    H, KH = q.shape[1], kpool.shape[1]
+    assert head_shard_supported(n, H, KH), (
+        f"paged decode: mesh axis {axis!r} ({n}-way) must divide "
+        f"q heads ({H}) and kv heads ({KH})")
+    f = jax.shard_map(
+        kernel, mesh=mesh,
+        in_specs=(P(None, axis), P(None, axis), P(None, axis), P(), P()),
+        out_specs=P(None, axis), check_vma=False)
+    return f(q, kpool, vpool, block_tables, cache_position)
+
+
+def sharded_masked_flash(q, k, v, mask, key_mask=None,
+                         mesh: Optional[Mesh] = None, axis: str = "model",
+                         sm_scale=None, dropout_rate: float = 0.0,
+                         dropout_rng=None,
+                         interpret: Optional[bool] = None):
+    """The unified training kernel head-sharded over ``(mesh, axis)``
+    — same signature and semantics as
+    :func:`~deepspeed_tpu.ops.attention.masked_flash.masked_flash_attention`
+    plus the mesh. Differentiable (the custom vjp transposes through
+    shard_map). Requires a head-uniform mask (``mask.heads == 1``)."""
+    import jax.numpy as jnp
+    import numpy as np
+    from deepspeed_tpu.ops.attention.flash import (_use_pallas,
+                                                   dropout_seed_from_rng)
+    from deepspeed_tpu.ops.attention.masked_flash import masked_flash_call
+    n = axis_size(mesh, axis) if mesh is not None else 1
+    b, h, _, d = q.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / np.sqrt(d)
+    if interpret is None:
+        interpret = not _use_pallas()
+    if n <= 1:
+        from deepspeed_tpu.ops.attention.masked_flash import \
+            masked_flash_attention
+        return masked_flash_attention(q, k, v, mask, key_mask=key_mask,
+                                      sm_scale=sm_scale,
+                                      dropout_rate=dropout_rate,
+                                      dropout_rng=dropout_rng,
+                                      interpret=interpret)
+    assert mask.heads == 1, (
+        "sharded_masked_flash needs a head-uniform BlockMask "
+        f"(mask.heads == 1, got {mask.heads}): shard_map is SPMD, so "
+        "per-head mask metadata cannot differ across shards")
+    assert head_shard_supported(n, h, k.shape[1]), (
+        f"mesh axis {axis!r} ({n}-way) must divide q heads ({h}) and "
+        f"kv heads ({k.shape[1]})")
+    rate = float(dropout_rate)
+    if rate > 0.0:
+        assert dropout_rng is not None
+        seed = dropout_seed_from_rng(dropout_rng)
+    else:
+        seed = jnp.zeros((1, 1), jnp.int32)
+    sk = k.shape[2]
+    has_kpm = key_mask is not None
+    kpm = jnp.zeros((b, 1), jnp.float32) if key_mask is None else \
+        key_mask.reshape(b, sk).astype(jnp.float32)
+
+    def inner(q, k, v, kpm, seed):
+        return masked_flash_call(q, k, v, kpm, seed, mask,
+                                 float(sm_scale), bool(interpret), rate,
+                                 has_kpm)
+
+    f = jax.shard_map(
+        inner, mesh=mesh,
+        in_specs=(P(None, axis), P(None, axis), P(None, axis), P(), P()),
+        out_specs=P(None, axis), check_vma=False)
+    return f(q, k, v, kpm, seed)

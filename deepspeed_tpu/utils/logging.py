@@ -53,6 +53,25 @@ def _process_index() -> int:
         return 0
 
 
+# one-line which-path logging, once per hashable key (typically a
+# (reason, *shape) tuple) — the engine's which-path-compiled convention
+# instead of per-module _WARNED_* mutable globals whose state leaks
+# across tests and configs
+_ONCE_KEYS = set()
+
+
+def log_once(key, msg: str, warn: bool = False) -> None:
+    if key in _ONCE_KEYS:
+        return
+    _ONCE_KEYS.add(key)
+    (logger.warning if warn else logger.info)(msg)
+
+
+def reset_once_logging() -> None:
+    """Test hook: forget which (reason, shape) lines were emitted."""
+    _ONCE_KEYS.clear()
+
+
 def log_dist(message: str, ranks: Optional[Iterable[int]] = None, level=logging.INFO) -> None:
     """Log ``message`` only on the listed process indices.
 

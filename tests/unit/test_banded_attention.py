@@ -33,10 +33,16 @@ def make_banded_layout(H, n, g_r, g_c, w, causal):
 
 @pytest.fixture(autouse=True)
 def _fresh_cache():
+    # this module tests the LEGACY banded dispatch, kept as a numerics
+    # oracle behind the flag since the unified masked kernel (PR 11)
+    # became the default
     bs._FN_CACHE.clear()
     old = banded._FORCE_BLOCKS
+    old_masked = bs.USE_MASKED_FLASH
+    bs.USE_MASKED_FLASH = False
     yield
     banded._FORCE_BLOCKS = old
+    bs.USE_MASKED_FLASH = old_masked
     bs._FN_CACHE.clear()
 
 

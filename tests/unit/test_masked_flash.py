@@ -1,0 +1,537 @@
+"""ONE mask-parameterized flash kernel (ops/attention/masked_flash.py)
+— ISSUE 11: dense, causal, banded and BigBird training attention are
+BlockMask choices of a single Pallas kernel.
+
+Tier-1 acceptance pins:
+- interpret-mode parity sweep (dense / causal / banded / BigBird) x GQA
+  x dropout x stream-vs-resident against the existing oracles
+  (attention_reference, block_sparse_attention_reference);
+- custom-vjp gradients vs the jnp oracle;
+- the sparse + dense dispatches route through the unified kernel by
+  default, legacy kernels stay reachable behind flags, and the v1
+  per-triple kernels are never auto-selected;
+- banded layouts coarsen their walk tile (fine structure in register
+  predicates) without changing numerics;
+- the shard_map head wrap (parallel/pallas_shard) preserves numerics
+  and gradients on a 2-way CPU mesh;
+- flash.py's old mutable warn/force globals are gone: options are a
+  dataclass knob, fallbacks log once per (reason, shape).
+
+All kernel runs are interpret-mode (CPU) — scalar prefetch, HBM refs
+and dynamic-index DMA interpret exactly, so the TPU kernel's numerics
+are testable without hardware.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.attention import flash as F
+from deepspeed_tpu.ops.attention import masked_flash as M
+from deepspeed_tpu.ops.attention.masked_flash import (BlockMask,
+                                                      masked_flash_attention,
+                                                      masked_flash_cost,
+                                                      masked_flash_reference)
+from deepspeed_tpu.ops.sparse_attention import blocksparse as bs
+from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
+    BigBirdSparsityConfig, BSLongformerSparsityConfig)
+
+S, D = 128, 16
+BLOCK = 16
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    old_stream = M._FORCE_STREAM
+    yield
+    M._FORCE_STREAM = old_stream
+    bs._FN_CACHE.clear()
+
+
+def _qkv(B=2, H=4, hkv=None, s=S, d=D, seed=0, dtype=jnp.float32):
+    rng = np.random.RandomState(seed)
+    q = jnp.asarray(rng.randn(B, H, s, d), dtype) * 0.3
+    k = jnp.asarray(rng.randn(B, hkv or H, s, d), dtype) * 0.3
+    v = jnp.asarray(rng.randn(B, hkv or H, s, d), dtype) * 0.3
+    return q, k, v
+
+
+def _mask_for(family, heads=4, s=S, block=BLOCK):
+    if family == "dense":
+        return BlockMask.dense(s, s, block)
+    if family == "causal":
+        return BlockMask.causal(s, block)
+    if family == "banded":
+        cfg = BSLongformerSparsityConfig(num_heads=heads, block=block,
+                                         num_sliding_window_blocks=3)
+        return BlockMask.from_layout(cfg.make_layout(s), block)
+    if family == "bigbird":
+        cfg = BigBirdSparsityConfig(num_heads=heads, block=block,
+                                    num_random_blocks=1,
+                                    num_sliding_window_blocks=3,
+                                    num_global_blocks=1)
+        return BlockMask.from_layout(cfg.make_layout(s), block)
+    raise AssertionError(family)
+
+
+# --------------------------------------------------------------------- #
+# the new jnp oracle is tied to the EXISTING oracles first
+# --------------------------------------------------------------------- #
+class TestReferenceTies:
+    def test_dense_and_causal_match_attention_reference(self):
+        q, k, v = _qkv()
+        for family, causal in (("dense", False), ("causal", True)):
+            got = masked_flash_reference(q, k, v, _mask_for(family))
+            want = F.attention_reference(q, k, v, causal=causal)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       atol=2e-6)
+
+    @pytest.mark.parametrize("family", ["banded", "bigbird"])
+    def test_layouts_match_blocksparse_reference(self, family):
+        q, k, v = _qkv()
+        cfg_cls = (BSLongformerSparsityConfig if family == "banded"
+                   else BigBirdSparsityConfig)
+        cfg = (cfg_cls(num_heads=4, block=BLOCK,
+                       num_sliding_window_blocks=3) if family == "banded"
+               else cfg_cls(num_heads=4, block=BLOCK, num_random_blocks=1,
+                            num_sliding_window_blocks=3,
+                            num_global_blocks=1))
+        layout = cfg.make_layout(S)
+        got = masked_flash_reference(
+            q, k, v, BlockMask.from_layout(layout, BLOCK),
+            sm_scale=D ** -0.5)
+        want = bs.block_sparse_attention_reference(q, k, v, layout,
+                                                   sm_scale=D ** -0.5)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-6)
+
+
+# --------------------------------------------------------------------- #
+# ISSUE 11 acceptance: the parity sweep
+# --------------------------------------------------------------------- #
+class TestKernelParity:
+    @pytest.mark.parametrize("stream", [False, True])
+    @pytest.mark.parametrize("family",
+                             ["dense", "causal", "banded", "bigbird"])
+    def test_parity_sweep(self, family, stream):
+        """dense/causal/banded/BigBird x GQA x dropout x
+        stream-vs-resident, all against the oracle."""
+        M._FORCE_STREAM = stream
+        mask = _mask_for(family)
+        rng_key = jax.random.PRNGKey(5)
+        seed = F.dropout_seed_from_rng(rng_key).reshape(())
+        for hkv in (4, 2):
+            for rate in (0.0, 0.25):
+                q, k, v = _qkv(hkv=hkv, seed=hkv)
+                got = masked_flash_attention(
+                    q, k, v, mask, dropout_rate=rate,
+                    dropout_rng=rng_key if rate else None,
+                    interpret=True)
+                want = masked_flash_reference(
+                    q, k, v, mask, dropout_rate=rate,
+                    dropout_seed=seed if rate else None)
+                np.testing.assert_allclose(
+                    np.asarray(got), np.asarray(want), atol=5e-5,
+                    err_msg=f"{family} stream={stream} hkv={hkv} "
+                            f"rate={rate}")
+
+    def test_stream_and_resident_agree_exactly(self):
+        mask = _mask_for("causal")
+        q, k, v = _qkv()
+        M._FORCE_STREAM = True
+        o_s = masked_flash_attention(q, k, v, mask, interpret=True)
+        M._FORCE_STREAM = False
+        o_r = masked_flash_attention(q, k, v, mask, interpret=True)
+        np.testing.assert_array_equal(np.asarray(o_s), np.asarray(o_r))
+
+    def test_key_mask_parity(self):
+        q, k, v = _qkv(seed=3)
+        kpm = np.zeros((2, S), np.float32)
+        kpm[:, 100:] = -1e9
+        mask = _mask_for("banded")
+        got = masked_flash_attention(q, k, v, mask,
+                                     key_mask=jnp.asarray(kpm),
+                                     interpret=True)
+        want = masked_flash_reference(q, k, v, mask,
+                                      key_mask=jnp.asarray(kpm))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=5e-5)
+
+    def test_bf16(self):
+        q, k, v = _qkv(dtype=jnp.bfloat16, seed=6)
+        mask = _mask_for("banded")
+        got = masked_flash_attention(q, k, v, mask, interpret=True)
+        want = masked_flash_reference(q, k, v, mask)
+        assert got.dtype == jnp.bfloat16
+        np.testing.assert_allclose(np.asarray(got, np.float32),
+                                   np.asarray(want, np.float32),
+                                   atol=3e-2)
+
+    def test_empty_rows_zero_output(self):
+        """Rows whose block-row has no active tile produce exact-zero
+        output (blocksparse oracle semantics)."""
+        active = np.ones((1, S // BLOCK, S // BLOCK), bool)
+        active[0, 2] = False
+        mask = BlockMask(active, np.zeros_like(active, np.uint8), BLOCK,
+                         S, S)
+        q, k, v = _qkv()
+        out = masked_flash_attention(q, k, v, mask, interpret=True)
+        rows = np.asarray(out)[:, :, 2 * BLOCK:3 * BLOCK]
+        assert np.all(rows == 0.0)
+        want = masked_flash_reference(q, k, v, mask)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   atol=5e-5)
+
+    def test_per_head_layout_supported(self):
+        cfg = BigBirdSparsityConfig(num_heads=4, block=BLOCK,
+                                    different_layout_per_head=True,
+                                    num_random_blocks=1,
+                                    num_sliding_window_blocks=3,
+                                    num_global_blocks=1)
+        layout = cfg.make_layout(S)
+        mask = BlockMask.from_layout(layout, BLOCK)
+        assert mask.heads == 4                    # no collapse
+        q, k, v = _qkv()
+        got = masked_flash_attention(q, k, v, mask, sm_scale=D ** -0.5,
+                                     interpret=True)
+        want = bs.block_sparse_attention_reference(q, k, v, layout,
+                                                   sm_scale=D ** -0.5)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=5e-5)
+
+
+# --------------------------------------------------------------------- #
+# ISSUE 11 acceptance: custom-vjp gradients vs the jnp oracle
+# --------------------------------------------------------------------- #
+class TestGradients:
+    @pytest.mark.parametrize("family",
+                             ["dense", "causal", "banded", "bigbird"])
+    def test_grads_match_oracle(self, family):
+        mask = _mask_for(family)
+        q, k, v = _qkv(seed=9)
+
+        def f_m(q, k, v):
+            return jnp.sum(masked_flash_attention(
+                q, k, v, mask, interpret=True) ** 2)
+
+        def f_r(q, k, v):
+            return jnp.sum(masked_flash_reference(q, k, v, mask) ** 2)
+
+        gm = jax.grad(f_m, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(f_r, argnums=(0, 1, 2))(q, k, v)
+        for a, b, n in zip(gm, gr, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-4, rtol=1e-3,
+                                       err_msg=f"{family} d{n}")
+
+    @pytest.mark.parametrize("stream", [False, True])
+    def test_gqa_dropout_grads(self, stream):
+        """fwd/bwd dropout-mask consistency under GQA in both K/V
+        paths: the backward kernels must regenerate the identical hash
+        bits."""
+        M._FORCE_STREAM = stream
+        mask = _mask_for("causal")
+        q, k, v = _qkv(hkv=2, seed=4)
+        rng = jax.random.PRNGKey(21)
+        seed = F.dropout_seed_from_rng(rng).reshape(())
+
+        def f_m(q, k, v):
+            return jnp.sum(masked_flash_attention(
+                q, k, v, mask, dropout_rate=0.2, dropout_rng=rng,
+                interpret=True) ** 2)
+
+        def f_r(q, k, v):
+            return jnp.sum(masked_flash_reference(
+                q, k, v, mask, dropout_rate=0.2,
+                dropout_seed=seed) ** 2)
+
+        gm = jax.grad(f_m, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(f_r, argnums=(0, 1, 2))(q, k, v)
+        for a, b, n in zip(gm, gr, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-3, rtol=2e-3,
+                                       err_msg=f"d{n}")
+
+    def test_key_mask_cotangent_is_zero(self):
+        q, k, v = _qkv()
+        kpm = jnp.zeros((2, S), jnp.float32)
+        mask = _mask_for("dense")
+        g = jax.grad(lambda m: jnp.sum(masked_flash_attention(
+            q, k, v, mask, key_mask=m, interpret=True)))(kpm)
+        assert float(jnp.abs(g).max()) == 0.0
+
+
+# --------------------------------------------------------------------- #
+# banded coarsening: big walk tiles, fine structure in registers
+# --------------------------------------------------------------------- #
+class TestCoarsening:
+    def _longformer(self, s=2048, fb=128):
+        cfg = BSLongformerSparsityConfig(num_heads=2, block=fb,
+                                         num_sliding_window_blocks=3)
+        return cfg.make_layout(s), s, fb
+
+    def test_banded_layout_coarsens(self):
+        layout, s, fb = self._longformer()
+        mask = BlockMask.from_layout(layout, fb)
+        assert mask.block > fb, mask.describe()
+        assert mask.band is not None and mask.has_partials
+        # the expansion must reproduce the layout's fine bits exactly
+        dense = mask.dense_additive()
+        want = bs.layout_additive_mask(layout, fb)[:1]
+        np.testing.assert_array_equal(dense == 0.0, want == 0.0)
+
+    def test_coarse_matches_fine_and_oracle(self):
+        layout, s, fb = self._longformer()
+        q, k, v = _qkv(B=1, H=2, s=s, seed=2)
+        coarse = BlockMask.from_layout(layout, fb)
+        fine = BlockMask.from_layout(layout, fb, walk_block=0)
+        assert fine.block == fb and coarse.block > fb
+        o_c = masked_flash_attention(q, k, v, coarse,
+                                     sm_scale=D ** -0.5, interpret=True)
+        o_f = masked_flash_attention(q, k, v, fine, sm_scale=D ** -0.5,
+                                     interpret=True)
+        want = bs.block_sparse_attention_reference(q, k, v, layout,
+                                                   sm_scale=D ** -0.5)
+        np.testing.assert_allclose(np.asarray(o_c), np.asarray(want),
+                                   atol=1e-4, rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(o_f), np.asarray(want),
+                                   atol=1e-4, rtol=1e-4)
+
+    def test_causal_banded_clip(self):
+        """A causally-clipped band (unidirectional Longformer-class
+        realized bits) coarsens with the clip folded into the register
+        predicate."""
+        n = 16
+        idx = np.arange(n)
+        rb, cb = idx[:, None], idx[None, :]
+        pred = ((rb < 1) | (cb < 1) | (np.abs(rb - cb) <= 1)) & (cb <= rb)
+        layout = np.broadcast_to(pred.astype(np.int32),
+                                 (2, n, n)).copy()
+        fb = 128
+        s = n * fb
+        mask = BlockMask.from_layout(layout, fb)
+        assert mask.block > fb and mask.band is not None
+        assert mask.band[-1] is True              # clip folded in
+        q, k, v = _qkv(B=1, H=2, s=s, seed=8)
+        got = masked_flash_attention(q, k, v, mask, sm_scale=D ** -0.5,
+                                     interpret=True)
+        want = bs.block_sparse_attention_reference(q, k, v, layout,
+                                                   sm_scale=D ** -0.5)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-4, rtol=1e-4)
+
+    def test_bigbird_declines_coarsening(self):
+        mask = _mask_for("bigbird")
+        assert mask.block == BLOCK and mask.band is None
+
+    def test_sparsity_config_resolves_to_block_mask(self):
+        cfg = BSLongformerSparsityConfig(num_heads=2, block=128,
+                                         num_sliding_window_blocks=3)
+        mask = cfg.make_block_mask(2048)
+        assert isinstance(mask, BlockMask) and mask.heads == 1
+        assert mask.block > 128                    # coarsened
+        assert cfg.make_block_mask(2048, walk_block=0).block == 128
+
+
+# --------------------------------------------------------------------- #
+# dispatch: ONE kernel serves every path; v1 retired
+# --------------------------------------------------------------------- #
+class TestDispatch:
+    def test_sparse_dispatch_defaults_to_masked(self):
+        cfg = BSLongformerSparsityConfig(num_heads=2, block=32,
+                                         num_sliding_window_blocks=3)
+        L = cfg.make_layout(512)
+        assert bs.planned_kernel(L, 32, interpret=True).startswith(
+            "masked")
+        q, k, v = _qkv(B=1, H=2, s=512, seed=1)
+        got = bs.block_sparse_attention(q, k, v, L)
+        want = bs.block_sparse_attention_reference(q, k, v, L)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=5e-5, rtol=5e-5)
+
+    def test_legacy_flag_restores_old_dispatch(self):
+        cfg = BSLongformerSparsityConfig(num_heads=2, block=32,
+                                         num_sliding_window_blocks=3)
+        L = cfg.make_layout(512)
+        old = bs.USE_MASKED_FLASH
+        try:
+            bs.USE_MASKED_FLASH = False
+            assert bs.planned_kernel(L, 32, interpret=True) == "banded"
+        finally:
+            bs.USE_MASKED_FLASH = old
+
+    def test_v1_never_auto_selected(self):
+        """ISSUE 11 satellite: the per-triple v1 kernels are retired as
+        a dispatch target — even the historical silent-fallback case
+        (compiled mode, unstreamable block, no coarse tile) resolves to
+        the masked kernel; only an explicit USE_SPLASH_V2=False (test
+        oracle use) reaches v1."""
+        layout = np.ones((1, 5, 5), np.int32)      # block 96, S=480:
+        assert bs.planned_kernel(layout, 96, interpret=False) \
+            .startswith("masked")
+        old_m, old_v2 = bs.USE_MASKED_FLASH, bs.USE_SPLASH_V2
+        try:
+            bs.USE_MASKED_FLASH = False
+            # 96 % 128 != 0 and no coarse tile divides 480 -> the old
+            # code picked v1 here; now it must route to masked
+            assert bs.planned_kernel(layout, 96, interpret=False) == \
+                "masked-fallback"
+            f = bs._sparse_attention_fn(layout, 96, 0.125, has_am=False,
+                                        interpret=False)
+            assert f is not None
+            bs.USE_SPLASH_V2 = False               # explicit oracle use
+            bs._FN_CACHE.clear()
+            assert bs.planned_kernel(layout, 96, interpret=False) == "v1"
+        finally:
+            bs.USE_MASKED_FLASH, bs.USE_SPLASH_V2 = old_m, old_v2
+            bs._FN_CACHE.clear()
+
+    def test_flash_attention_routes_masked_by_default(self):
+        assert F.get_attention_options().kernel == "masked"
+        q, k, v = _qkv(seed=12)
+        o = F.flash_attention(q, k, v, causal=True, interpret=True)
+        want = F.attention_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(want),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_kernel_knob_switches_paths(self):
+        q, k, v = _qkv(seed=13)
+        old = F.set_attention_options(kernel="flash")
+        try:
+            o_legacy = F.flash_attention(q, k, v, causal=True,
+                                         interpret=True)
+        finally:
+            F._OPTIONS = old
+        o_masked = F.flash_attention(q, k, v, causal=True,
+                                     interpret=True)
+        np.testing.assert_allclose(np.asarray(o_legacy),
+                                   np.asarray(o_masked), atol=2e-5)
+
+    def test_bad_kernel_name_rejected(self):
+        with pytest.raises(AssertionError):
+            F.set_attention_options(kernel="cuda")
+        assert F.get_attention_options().kernel == "masked"
+
+
+# --------------------------------------------------------------------- #
+# satellite: module-global hygiene — options + once-logging
+# --------------------------------------------------------------------- #
+class TestOnceLogging:
+    def test_log_once_per_shape_reason(self):
+        F.reset_once_logging()
+        F.log_once(("x", 128), "m1")
+        F.log_once(("x", 128), "m1")
+        F.log_once(("x", 256), "m2")
+        assert len(F._ONCE_KEYS) == 2
+        F.reset_once_logging()
+        assert not F._ONCE_KEYS
+
+    def test_unknown_masked_block_logs_single_line(self):
+        F.reset_once_logging()
+        b1 = F.pick_masked_block(192, 192, 48)
+        b2 = F.pick_masked_block(192, 192, 48)
+        assert b1 == b2 and 192 % b1 == 0
+        keys = [k for k in F._ONCE_KEYS if k[0] == "masked-block"]
+        assert len(keys) == 1
+
+    def test_no_mutable_warn_globals_remain(self):
+        for name in ("_FORCE_REFERENCE", "_WARNED_IRREGULAR_FALLBACK",
+                     "_WARNED_IRREGULAR_STREAM", "_WARNED_REF_STREAM"):
+            assert not hasattr(F, name), name
+
+    def test_reference_knob(self):
+        q, k, v = _qkv(seed=14)
+        old = F.set_attention_options(kernel="reference")
+        try:
+            o = F.flash_attention(q, k, v, causal=True, interpret=True)
+            want = F.attention_reference(q, k, v, causal=True,
+                                         mxu_bf16=True)
+            np.testing.assert_array_equal(np.asarray(o),
+                                          np.asarray(want))
+        finally:
+            F._OPTIONS = old
+
+
+# --------------------------------------------------------------------- #
+# shard_map head wrap (parallel/pallas_shard)
+# --------------------------------------------------------------------- #
+class TestShardedMaskedFlash:
+    def _mesh(self):
+        from deepspeed_tpu.parallel.mesh import build_mesh
+        return build_mesh({"model": 2})
+
+    def test_sharded_parity_and_grads(self):
+        from deepspeed_tpu.parallel.pallas_shard import \
+            sharded_masked_flash
+        mesh = self._mesh()
+        mask = _mask_for("banded")
+        q, k, v = _qkv(seed=15)
+
+        def f_sh(q, k, v):
+            return jnp.sum(sharded_masked_flash(
+                q, k, v, mask, mesh=mesh, interpret=True) ** 2)
+
+        def f_ref(q, k, v):
+            return jnp.sum(masked_flash_reference(q, k, v, mask) ** 2)
+
+        o = sharded_masked_flash(q, k, v, mask, mesh=mesh,
+                                 interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(o), np.asarray(masked_flash_reference(q, k, v,
+                                                             mask)),
+            atol=5e-5)
+        gs = jax.grad(f_sh, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b, n in zip(gs, gr, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-4, rtol=1e-3,
+                                       err_msg=f"d{n}")
+
+    def test_sharded_gqa_under_jit(self):
+        from deepspeed_tpu.parallel.pallas_shard import \
+            sharded_masked_flash
+        mesh = self._mesh()
+        mask = _mask_for("causal")
+        q, k, v = _qkv(hkv=2, seed=16)
+        f = jax.jit(lambda q, k, v: sharded_masked_flash(
+            q, k, v, mask, mesh=mesh, interpret=True))
+        np.testing.assert_allclose(
+            np.asarray(f(q, k, v)),
+            np.asarray(masked_flash_reference(q, k, v, mask)),
+            atol=5e-5)
+
+    def test_per_head_mask_rejected(self):
+        from deepspeed_tpu.parallel.pallas_shard import \
+            sharded_masked_flash
+        mesh = self._mesh()
+        active = np.ones((4, S // BLOCK, S // BLOCK), bool)
+        active[1, 0, 0] = False                    # heads differ
+        mask = BlockMask(active, np.zeros_like(active, np.uint8),
+                         BLOCK, S, S)
+        q, k, v = _qkv()
+        with pytest.raises(AssertionError, match="head-uniform"):
+            sharded_masked_flash(q, k, v, mask, mesh=mesh,
+                                 interpret=True)
+
+
+# --------------------------------------------------------------------- #
+# cost model (the masked_flash_flops_bytes bench row's engine)
+# --------------------------------------------------------------------- #
+class TestCostModel:
+    def test_work_proportional_to_nonzero_blocks(self):
+        dense = _mask_for("dense")
+        bird = _mask_for("bigbird")
+        cd = masked_flash_cost(dense, batch=1, heads=4, head_dim=64)
+        cb = masked_flash_cost(bird, batch=1, heads=4, head_dim=64)
+        # FLOPs scale exactly with items at equal block size
+        assert cd["flops"] / cb["flops"] == pytest.approx(
+            cd["items"] / cb["items"])
+        assert cb["bytes"] < cd["bytes"]
+
+    def test_item_counts_match_csr(self):
+        mask = _mask_for("bigbird")
+        offs, cnts, cols, kinds = mask.csr()
+        assert int(cnts.sum()) == mask.nnz == len(cols)
+        coffs, ccnts, crows, ckinds = mask.csc()
+        assert int(ccnts.sum()) == mask.nnz

@@ -576,7 +576,11 @@ class TestComposableSparseOps:
 # --------------------------------------------------------------------- #
 def _run_coarse_case(S, fine_block, coarse, with_am, with_kpm, seed=11):
     """Run block_sparse_attention with _FORCE_COARSE_BLOCK=coarse (0 =
-    off) and return (o, (dq, dk, dv))."""
+    off) and return (o, (dq, dk, dv)). Pins the LEGACY dispatch:
+    _FORCE_COARSE_BLOCK only exists on the v2 coarse walk, which the
+    unified masked kernel (the PR 11 default) would otherwise
+    short-circuit — these tests guard the oracle path the legacy bench
+    row still measures."""
     from deepspeed_tpu.ops.sparse_attention import blocksparse as bs
     B, H, D = 1, 2, 16
     cfg = BSLongformerSparsityConfig(num_heads=H, block=fine_block)
@@ -594,7 +598,9 @@ def _run_coarse_case(S, fine_block, coarse, with_am, with_kpm, seed=11):
         kw["key_padding_mask_mode"] = "add"
 
     old = bs._FORCE_COARSE_BLOCK
+    old_masked = bs.USE_MASKED_FLASH
     bs._FORCE_COARSE_BLOCK = coarse
+    bs.USE_MASKED_FLASH = False
     bs._FN_CACHE.clear()
     try:
         def loss(q, k, v):
@@ -605,6 +611,7 @@ def _run_coarse_case(S, fine_block, coarse, with_am, with_kpm, seed=11):
         return o, g
     finally:
         bs._FORCE_COARSE_BLOCK = old
+        bs.USE_MASKED_FLASH = old_masked
         bs._FN_CACHE.clear()
 
 
@@ -638,12 +645,15 @@ def test_coarse_walk_matches_dense_oracle():
     layout = cfg.make_layout(S)
     q, k, v = _rand_qkv(B, H, S, D, seed=3)
     old = bs._FORCE_COARSE_BLOCK
+    old_masked = bs.USE_MASKED_FLASH
     bs._FORCE_COARSE_BLOCK = 256
+    bs.USE_MASKED_FLASH = False          # the legacy coarse walk under test
     bs._FN_CACHE.clear()
     try:
         o = block_sparse_attention(q, k, v, layout)
     finally:
         bs._FORCE_COARSE_BLOCK = old
+        bs.USE_MASKED_FLASH = old_masked
         bs._FN_CACHE.clear()
     ref = block_sparse_attention_reference(q, k, v, layout)
     np.testing.assert_allclose(np.asarray(o), np.asarray(ref),

@@ -518,14 +518,24 @@ def test_shipped_block_table_resolves(monkeypatch):
     path = os.path.join(os.path.dirname(F.__file__), "block_table.json")
     entries = json.load(open(path))
     assert entries, "shipped block table is empty?"
+    kinds_seen = set()
     for e in entries:
-        if e.get("kind", "flash") != "flash":
-            continue
+        kind = e.get("kind", "flash")
+        kinds_seen.add(kind)
         monkeypatch.setattr(F, "_device_kind",
                             lambda dk=e.get("device_kind"): dk)
-        got = F._pick_blocks(e["seq_q"], e["seq_k"], e["d"],
-                             gqa=e.get("gqa", 1))
-        assert got == (e["bq"], e["bk"]), (e, got)
+        if kind == "flash":
+            got = F._pick_blocks(e["seq_q"], e["seq_k"], e["d"],
+                                 gqa=e.get("gqa", 1))
+            assert got == (e["bq"], e["bk"]), (e, got)
+        elif kind == "masked":
+            got = F.lookup_masked_blocks(e["seq_q"], e["seq_k"], e["d"],
+                                         bool(e["stream"]))
+            assert got == e["b"], (e, got)
+            assert F.pick_masked_block(e["seq_q"], e["seq_k"], e["d"],
+                                       stream=bool(e["stream"])) == e["b"]
+    # the unified-kernel entries must ship alongside the flash ones
+    assert "masked" in kinds_seen, sorted(kinds_seen)
 
 
 def test_block_table_lookup_and_fallback():

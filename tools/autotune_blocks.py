@@ -6,9 +6,13 @@ algorithm search at engine construction, this offline harness times
 kernel block combinations per shape class on the REAL chip and writes
 the winners to ``deepspeed_tpu/ops/attention/block_table.json``,
 consulted at trace time by ``flash._pick_blocks`` (kind="flash": keys
-seq_q/seq_k/d/stream/gqa) and ``flash.lookup_banded_blocks``
-(kind="banded": keys seq/fine_block/band_w/causal for the banded sparse
-walk). Unknown shapes keep the hand-measured heuristics.
+seq_q/seq_k/d/stream/gqa), ``flash.lookup_masked_blocks``
+(kind="masked": keys seq_q/seq_k/d/stream, one square ``b`` — the
+unified mask-parameterized kernel's dense/causal walk tile, PR 11) and
+``flash.lookup_banded_blocks`` (kind="banded": keys
+seq/fine_block/band_w/causal for the legacy banded sparse walk).
+Unknown shapes keep the hand-measured heuristics (one logged line per
+shape for the masked kernel).
 
 Every entry is stamped with the measuring chip's ``device_kind``; the
 lookups only consume same-device entries (legacy unstamped entries act
@@ -131,6 +135,10 @@ def time_combo(sq, sk, d, bq, bk, rtt, iters=None, heads=None, gqa=1):
     # never ~0 — when the floor is unreachable.
     from deepspeed_tpu.utils.benchtime import scan_grad_seconds
 
+    # kind="flash" entries feed the LEGACY per-path kernels — pin them
+    # for the measurement (the default dispatch is the masked kernel,
+    # which sweeps separately through time_masked_combo)
+    old_opts = F.set_attention_options(kernel="flash")
     F._FORCE_BLOCKS = (bq, bk)
     try:
         sec, _n = scan_grad_seconds(grad_fn, (q, k, v), rtt, start_len=n,
@@ -139,6 +147,46 @@ def time_combo(sq, sk, d, bq, bk, rtt, iters=None, heads=None, gqa=1):
         return sec * 8.0 / (batch * h)
     finally:
         F._FORCE_BLOCKS = None
+        F._OPTIONS = old_opts
+
+
+def time_masked_combo(sq, sk, d, b, rtt, iters=None, gqa=1):
+    """One dense/causal grad eval through the UNIFIED masked kernel at
+    a forced square walk tile ``b`` (kind="masked" table entries)."""
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.ops.attention import flash as F
+    from deepspeed_tpu.utils.benchtime import scan_grad_seconds
+
+    batch, h, n = _shape_plan(max(sq, sk))
+    if iters is not None:
+        n = iters
+    h = max(h, gqa)
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(jax.random.fold_in(key, 0), (batch, h, sq, d),
+                          jnp.bfloat16)
+    k, v = (jax.random.normal(jax.random.fold_in(key, i),
+                              (batch, h // gqa, sk, d), jnp.bfloat16)
+            for i in (1, 2))
+
+    def loss(q, k, v):
+        return jnp.sum(F.flash_attention(q, k, v, causal=True)
+                       .astype(jnp.float32))
+
+    # pin the unified kernel (a DSTPU_ATTENTION_KERNEL A/B export must
+    # not abort the sweep — time_combo pins "flash" the same way)
+    old_opts = F.set_attention_options(kernel="masked")
+    F._FORCE_BLOCKS = (b, b)
+    F._DENSE_MASK_CACHE.clear()
+    try:
+        sec, _n = scan_grad_seconds(jax.grad(loss, argnums=(0, 1, 2)),
+                                    (q, k, v), rtt, start_len=n,
+                                    max_len=n * 4096)
+        return sec * 8.0 / (batch * h)
+    finally:
+        F._FORCE_BLOCKS = None
+        F._OPTIONS = old_opts
+        F._DENSE_MASK_CACHE.clear()
 
 
 def time_banded_combo(S, fb, win, bq, bk, rtt, iters=None):
@@ -199,6 +247,9 @@ def _entry_key(r):
     if r.get("kind") == "banded":
         shape = ("banded", r["seq"], r["fine_block"], r.get("band_w"),
                  bool(r.get("causal", False)))
+    elif r.get("kind") == "masked":
+        shape = ("masked", r["seq_q"], r["seq_k"], r["d"],
+                 bool(r.get("stream")))
     else:
         shape = ("flash", r["seq_q"], r["seq_k"], r["d"],
                  bool(r.get("stream")), r.get("gqa", 1))
@@ -243,6 +294,12 @@ def _covered(existing, key_wo_device, device_kind):
         try:
             k = _entry_key(r)
         except KeyError:
+            continue
+        if "ms" not in r:
+            # seeded/unmeasured placeholder (e.g. the masked entries
+            # shipped from the flash square winners): it serves lookups
+            # as a fallback but must never stop the sweep from actually
+            # MEASURING the shape
             continue
         if k[:-1] == key_wo_device and k[-1] in (device_kind, None):
             return True
@@ -342,7 +399,42 @@ def main():
         # tunnel drop costs only the in-flight shape
         _merge_write(args.out, rows, backend[0], device_kind)
 
-    # ---- flash shape classes ----
+    # ---- masked (unified-kernel) dense/causal shape classes: the
+    # DEFAULT training path sweeps before the legacy flash oracle ----
+    for sq, sk, d, gqa in FLASH_SHAPES:
+        stream = F._use_stream(sq, sk)
+        key_wo = ("masked", sq, sk, d, stream)
+        if gqa != 1:
+            continue          # the masked table is GQA-agnostic (square
+            # walk tiles; kv delivery is the same row select)
+        if not args.force and _covered(existing, key_wo, device_kind):
+            print(f"# masked ({sq},{sk},{d}) already covered - skip")
+            continue
+        results = {}
+        for b in CANDIDATES:
+            if sq % b or sk % b or (stream and b % 128):
+                continue
+            try:
+                dt = time_masked_combo(sq, sk, d, b, rtt,
+                                       iters=args.iters)
+                results[b] = dt
+                print(f"masked S=({sq},{sk}) d={d} stream={stream} "
+                      f"b={b}: {dt*1e3:.2f} ms", flush=True)
+            except Exception as e:
+                print(f"masked S=({sq},{sk}) d={d} b={b}: "
+                      f"FAILED {type(e).__name__}", flush=True)
+            last_beat[0] = time.monotonic()
+        if not results:
+            continue
+        b, dt = min(results.items(), key=lambda kv: kv[1])
+        print(f"--> best masked ({sq},{sk},{d}): b={b} "
+              f"{dt*1e3:.2f} ms", flush=True)
+        rows.append({"kind": "masked", "seq_q": sq, "seq_k": sk, "d": d,
+                     "stream": stream, "b": b, "ms": round(dt * 1e3, 3),
+                     "backend": backend[0], "device_kind": device_kind})
+        _merge_write(args.out, rows, backend[0], device_kind)
+
+    # ---- flash shape classes (legacy oracle kernels) ----
     for sq, sk, d, gqa in FLASH_SHAPES:
         stream = F._use_stream(sq, sk)
         key_wo = ("flash", sq, sk, d, stream, gqa)
