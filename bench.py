@@ -85,11 +85,33 @@ Metrics (BASELINE.md rows):
   (ISSUE 10 acceptance: <= 0.20); detail pins dispatches/train_batch
   unchanged at 1.0 for both modes and the newest async tag
   COMMITTED+VERIFIED after the drain
+- spec_decode_accepted_per_dispatch : HARDWARE-FREE — speculative
+  multi-token decoding on the paged pool (ISSUE 13): a repetitive
+  workload (prompts the host-side n-gram drafter can actually predict)
+  runs spec OFF vs spec ON at the same config/seed; value = verified-
+  and-kept tokens emitted per decode-phase dispatch with speculation
+  (acceptance >= 2.0), vs_baseline = spec dispatches / baseline
+  dispatches (< 1.0 — fewer device round-trips for the same tokens);
+  pins greedy outputs bitwise equal and 0 steady-state recompiles for
+  both engines
+- disagg_dispatch_structure : HARDWARE-FREE — the disaggregated
+  prefill/decode step discipline as pure dispatch ordering: a workload
+  submitted in waves (so prefill and decode phases mix within single
+  steps) must show every decode/verify dispatch preceding every
+  prefill dispatch of its step; value = decode_first_fraction
+  (acceptance == 1.0), pins greedy parity vs the interleaved engine,
+  0 recompiles, and TTFT queue/prefill/handoff decomposition in the
+  trail
 - paged_decode_tokens_per_s : TPU — wall-clock decode tokens/s of the
   serving engine with the compiled Pallas paged-decode kernel at a
   TPU-legal geometry (head_dim 128), vs_baseline = pallas tokens/s /
   the gather-fallback engine's at identical config; pins
   0 steady-state recompiles for both (next hardware window)
+- disagg_ttft_p95 : TPU — p95 TTFT of the disaggregated engine
+  (decode-first step order, handoff queue between the phases) vs the
+  interleaved engine under the same open-loop load; on a non-TPU
+  backend it is a functional pin, not a perf number (next hardware
+  window)
 - bert_large_samples_per_s : BERT-large fused-layer training @ seq 128
   (reference: 272 samples/s on 1x V100, fastest-bert post :38-40)
 - bert_onebit_samples_per_s : BERT + 1-bit Adam in the compression
@@ -150,7 +172,10 @@ METRICS = [
     "masked_flash_flops_bytes",
     "serve_trace_overhead",
     "async_ckpt_stall_ms",
+    "spec_decode_accepted_per_dispatch",
+    "disagg_dispatch_structure",
     "paged_decode_tokens_per_s",
+    "disagg_ttft_p95",
     "bert_large_samples_per_s",
     "bert_onebit_samples_per_s",
     "sparse_attention_speedup_s8k",
@@ -165,7 +190,9 @@ HW_FREE = {"comm_wire_bytes_per_step", "comm_overlap_structure",
            "mfu_cost_model", "host_dispatch_overhead",
            "decode_throughput", "paged_kv_occupancy",
            "paged_decode_bytes", "masked_flash_flops_bytes",
-           "serve_trace_overhead", "async_ckpt_stall_ms"}
+           "serve_trace_overhead", "async_ckpt_stall_ms",
+           "spec_decode_accepted_per_dispatch",
+           "disagg_dispatch_structure"}
 
 PARTIAL_PATH = os.environ.get(
     "BENCH_PARTIAL", "/tmp/dstpu_bench_partial.jsonl")
@@ -1834,6 +1861,231 @@ def bench_paged_decode_tokens_per_s(on_tpu, rtt):
                    "decode"})
 
 
+def bench_spec_decode_accepted_per_dispatch(on_tpu, rtt):
+    """Hardware-free row: speculative multi-token decoding on the paged
+    pool (ISSUE 13). The host-side n-gram drafter proposes k tokens per
+    in-flight request; ONE seq-(k+1) verify dispatch through the paged
+    path scores them all, and only verified-greedy-matching tokens are
+    kept. On a repetitive workload (greedy decode of a tiny model falls
+    into a cycle, which prompt-lookup drafting then predicts) the value
+    is verified-and-kept tokens emitted per decode-phase device
+    dispatch — the device round-trips actually saved.
+
+    Pins (ISSUE 13 acceptance): value >= 2.0; greedy outputs bitwise
+    equal to the non-speculative engine at the same config/seed;
+    ``steady_state_recompiles == 0`` for BOTH engines (the verify
+    program set is fixed at warmup); vs_baseline = spec decode-phase
+    dispatches / baseline decode dispatches (< 1.0 — same tokens, fewer
+    dispatches).
+    """
+    del on_tpu, rtt      # host accounting + CPU backend by design
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.inference import InferenceEngine
+    from deepspeed_tpu.models.gpt2 import GPT2Config, init_gpt2_params
+
+    cfg = GPT2Config(vocab_size=61, max_position_embeddings=128,
+                     hidden_size=32, num_layers=2, num_heads=4,
+                     embd_dropout=0.0, attn_dropout=0.0,
+                     resid_dropout=0.0)
+    params = init_gpt2_params(cfg, jax.random.PRNGKey(3))
+    new_tokens = 24
+    icfg = {"max_batch_size": 4, "prompt_buckets": [8, 16],
+            "batch_buckets": [1, 4], "max_seq_len": 128,
+            "max_new_tokens": new_tokens}
+    # period-3 / period-4 repeated patterns: the n-gram drafter's bread
+    # and butter, and short enough that greedy decode cycles quickly
+    prompts = [[5, 6, 7] * 4, [9, 10, 11, 12] * 3, [1, 2] * 5,
+               [20, 21, 22] * 4]
+
+    def serve(spec_on):
+        ic = dict(icfg)
+        if spec_on:
+            ic["spec_decode"] = {"enabled": True, "k": 4}
+        eng = InferenceEngine(cfg, params, ic, dtype=jnp.float32)
+        eng.warmup()
+        _beat()
+        d0 = dict(eng.compile_tracker.dispatch_counts)
+        outs = eng.generate(prompts, max_new_tokens=new_tokens,
+                            temperature=0.0)
+        disp = {n: c - d0.get(n, 0)
+                for n, c in eng.compile_tracker.dispatch_counts.items()}
+        state = eng.debug_state()
+        rc = eng.steady_state_recompiles
+        eng.close()
+        gen = sum(len(o) - len(p) for o, p in zip(outs, prompts))
+        return outs, gen, disp, state, rc
+
+    outs_off, gen_off, disp_off, _, rc_off = serve(False)
+    outs_on, gen_on, disp_on, state_on, rc_on = serve(True)
+    _beat()
+    phase_off = disp_off.get("decode", 0)
+    phase_on = disp_on.get("verify", 0) + disp_on.get("decode", 0)
+    per_dispatch = gen_on / phase_on if phase_on else 0.0
+    spec = state_on["slo"]["spec"]
+    return _emit(
+        "spec_decode_accepted_per_dispatch", round(per_dispatch, 3),
+        "kept_tokens_per_dispatch",
+        round(phase_on / phase_off, 3) if phase_off else 0.0,
+        {"accept_min": 2.0,
+         "greedy_parity": bool(outs_on == outs_off),
+         "steady_state_recompiles": {"off": rc_off, "on": rc_on},
+         "decode_dispatches_off": phase_off,
+         "verify_dispatches_on": disp_on.get("verify", 0),
+         "fallback_decode_dispatches_on": disp_on.get("decode", 0),
+         "drafted": spec["proposed"], "accepted": spec["accepted"],
+         "accept_rate": spec["accept_rate"],
+         "generated_tokens": gen_on,
+         "baseline_tokens": gen_off,
+         "backend": jax.default_backend(),
+         "source": "CompileTracker dispatch accounting, spec on/off "
+                   "(hardware-free)"})
+
+
+def bench_disagg_dispatch_structure(on_tpu, rtt):
+    """Hardware-free row: the disaggregated serving step discipline as
+    pure dispatch ordering. Requests are submitted in waves while
+    earlier ones still decode, so single engine steps mix the decode
+    phase (handoff claims + decode/verify dispatch) with the prefill
+    phase. The structural guarantee — no decode dispatch ever waits
+    behind a prefill dispatch — is then checkable without a clock:
+    within every step of the dispatch trace, all decode-phase ordinals
+    precede all prefill ordinals.
+
+    Pins (ISSUE 13 acceptance): value = decode_first_fraction over
+    steps that mixed both phases, acceptance == 1.0, and the trace must
+    actually contain mixed steps; greedy outputs bitwise equal to the
+    interleaved (non-disagg) engine; 0 steady-state recompiles; every
+    handoff claimed (queue drains).
+    """
+    del on_tpu, rtt
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.inference import InferenceEngine, Request
+    from deepspeed_tpu.models.gpt2 import GPT2Config, init_gpt2_params
+
+    cfg = GPT2Config(vocab_size=61, max_position_embeddings=128,
+                     hidden_size=32, num_layers=2, num_heads=4,
+                     embd_dropout=0.0, attn_dropout=0.0,
+                     resid_dropout=0.0)
+    params = init_gpt2_params(cfg, jax.random.PRNGKey(3))
+    new_tokens = 12
+    icfg = {"max_batch_size": 3, "prompt_buckets": [8, 16],
+            "batch_buckets": [1, 2], "max_seq_len": 64,
+            "max_new_tokens": new_tokens}
+    rng = np.random.RandomState(7)
+    waves = [[rng.randint(1, 61, (l,)).tolist() for l in lens]
+             for lens in ((5, 9, 3), (12, 4), (7, 15, 6))]
+
+    def serve(disagg_on):
+        ic = dict(icfg)
+        if disagg_on:
+            ic["disagg"] = {"enabled": True}
+        eng = InferenceEngine(cfg, params, ic, dtype=jnp.float32)
+        eng.warmup()
+        _beat()
+        done = {}
+        pending = list(waves)
+        uid2prompt = {}
+        while pending or not eng.scheduler.idle():
+            if pending:
+                # next wave lands while the previous one still decodes:
+                # the admitting step runs prefill AND decode phases
+                for p in pending.pop(0):
+                    uid = eng.submit(Request(
+                        prompt=p, max_new_tokens=new_tokens,
+                        temperature=0.0, seed=0))
+                    uid2prompt[uid] = tuple(p)
+            for f in eng.step():
+                done[uid2prompt[f.uid]] = f.tokens
+        state = eng.debug_state()
+        rc = eng.steady_state_recompiles
+        eng.close()
+        return done, state, rc
+
+    base_done, _, base_rc = serve(False)
+    dis_done, dis_state, dis_rc = serve(True)
+    _beat()
+    dg = dis_state["disagg"]
+    frac = dg["decode_first_fraction"]
+    return _emit(
+        "disagg_dispatch_structure",
+        round(frac, 4) if frac is not None else -1.0,
+        "decode_first_fraction", 1.0 if dis_done == base_done else 0.0,
+        {"accept_fraction": 1.0,
+         "mixed_steps_traced": frac is not None,
+         "greedy_parity": bool(dis_done == base_done),
+         "steady_state_recompiles": {"interleaved": base_rc,
+                                     "disagg": dis_rc},
+         "handoffs": dg["queue"]["handoffs"],
+         "handoff_queue_drained": dg["queue"]["depth"] == 0,
+         "requeues": dg["queue"]["requeues"],
+         "requests": sum(len(w) for w in waves),
+         "backend": jax.default_backend(),
+         "source": "DispatchTrace step ordering, disagg vs interleaved "
+                   "(hardware-free)"})
+
+
+def bench_disagg_ttft_p95(on_tpu, rtt):
+    """TPU ladder row (next hardware window): p95 TTFT of the
+    disaggregated engine — decode-first step order with the handoff
+    queue between the phases — vs the interleaved engine under the same
+    load. On hardware the interleaved engine stalls every in-flight
+    request's next token behind each prefill dispatch; disaggregation
+    converts that stall into bounded handoff queue time. On a non-TPU
+    backend the row is a functional pin (parity + decomposition), not a
+    perf number.
+    """
+    del rtt
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.inference import InferenceEngine
+    from deepspeed_tpu.models.gpt2 import GPT2Config, init_gpt2_params
+
+    cfg = GPT2Config(vocab_size=256, max_position_embeddings=512,
+                     hidden_size=512 if on_tpu else 64,
+                     num_layers=2, num_heads=4,
+                     embd_dropout=0.0, attn_dropout=0.0,
+                     resid_dropout=0.0)
+    params = init_gpt2_params(cfg, jax.random.PRNGKey(0))
+    new_tokens = 32
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, 256, (l,)).tolist()
+               for l in (5, 8, 13, 3, 16, 7, 11, 4, 9, 14, 6, 12)]
+    icfg = {"max_batch_size": 4, "prompt_buckets": [16],
+            "batch_buckets": [4], "max_seq_len": 256,
+            "max_new_tokens": new_tokens}
+
+    def serve(disagg_on):
+        ic = dict(icfg)
+        if disagg_on:
+            ic["disagg"] = {"enabled": True}
+        eng = InferenceEngine(cfg, params, ic, dtype=dtype)
+        eng.warmup()
+        _beat()
+        outs = eng.generate(prompts, max_new_tokens=new_tokens,
+                            temperature=0.0)
+        p95 = eng._tracer.hist["ttft_ms"].percentile(0.95)
+        rc = eng.steady_state_recompiles
+        eng.close()
+        return outs, p95 or 0.0, rc
+
+    outs_i, p95_i, rc_i = serve(False)
+    outs_d, p95_d, rc_d = serve(True)
+    _beat()
+    return _emit(
+        "disagg_ttft_p95", round(p95_d, 3), "ms",
+        round(p95_i / p95_d, 3) if p95_d > 0 else 0.0,
+        {"interleaved_p95_ms": round(p95_i, 3),
+         "greedy_parity": bool(outs_d == outs_i),
+         "steady_state_recompiles": {"interleaved": rc_i, "disagg": rc_d},
+         "requests": len(prompts), "new_tokens": new_tokens,
+         "backend": jax.default_backend(),
+         "functional_pin_only": jax.default_backend() != "tpu",
+         "source": "tracer TTFT histogram, disagg vs interleaved"})
+
+
 # ------------------------------------------------------------- child mode
 
 
@@ -1906,8 +2158,14 @@ def run_child(metric):
         bench_serve_trace_overhead(on_tpu, rtt)
     elif metric == "async_ckpt_stall_ms":
         bench_async_ckpt_stall(on_tpu, rtt)
+    elif metric == "spec_decode_accepted_per_dispatch":
+        bench_spec_decode_accepted_per_dispatch(on_tpu, rtt)
+    elif metric == "disagg_dispatch_structure":
+        bench_disagg_dispatch_structure(on_tpu, rtt)
     elif metric == "paged_decode_tokens_per_s":
         bench_paged_decode_tokens_per_s(on_tpu, rtt)
+    elif metric == "disagg_ttft_p95":
+        bench_disagg_ttft_p95(on_tpu, rtt)
     elif metric == "bert_large_samples_per_s":
         bench_bert_large(on_tpu, rtt)
     elif metric == "bert_onebit_samples_per_s":

@@ -13,6 +13,10 @@ See ``deepspeed_tpu/inference/engine.py`` and ``docs/inference.md``.
 
 from deepspeed_tpu.inference.buckets import (pad_prompts, pick_bucket,
                                              validate_buckets, warmup_plan)
+from deepspeed_tpu.inference.disagg import (DispatchTrace, HandoffQueue,
+                                            HandoffRecord, price_handoff)
+from deepspeed_tpu.inference.draft import (CallableDrafter, NGramDrafter,
+                                           make_drafter)
 from deepspeed_tpu.inference.engine import (InferenceEngine,
                                             qwz_distribute_params)
 from deepspeed_tpu.inference.kv_cache import (KVCacheSpec, PageAllocator,
@@ -32,5 +36,7 @@ __all__ = [
     "init_kv_cache", "kv_cache_bytes", "PagedKVSpec", "PageAllocator",
     "paged_spec_for", "init_paged_kv_cache", "paged_kv_bytes",
     "pages_for", "pick_bucket", "pad_prompts", "validate_buckets",
-    "warmup_plan", "qwz_distribute_params",
+    "warmup_plan", "qwz_distribute_params", "NGramDrafter",
+    "CallableDrafter", "make_drafter", "HandoffQueue", "HandoffRecord",
+    "DispatchTrace", "price_handoff",
 ]

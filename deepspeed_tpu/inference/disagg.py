@@ -1,0 +1,209 @@
+"""Host-side bookkeeping for disaggregated prefill/decode serving.
+
+Heavy-traffic serving splits into two phases with opposite resource
+profiles: prefill is compute-bound (one big batched matmul pass over
+the prompt), decode is bandwidth-bound (one token per request per
+dispatch, reads dominated by KV traffic). Interleaving them in one
+loop makes every prefill dispatch stall every in-flight request's next
+token. Disaggregation runs them as separate worker loops — a *prefill
+worker* fed by the admission window and a *decode worker* that owns
+token generation — connected by a **page handoff**: a completed
+prefill's KV state transfers to the decode loop by moving block-table
+ownership.
+
+Two handoff modes (the engine picks per config):
+
+- **shared pool** (same mesh): the pages already live where decode
+  reads them — the handoff is a zero-copy host bookkeeping move
+  (this module), exactly like a refcount transfer. Cost: queue time
+  only.
+- **separate pools** (optionally separate meshes): only the LIVE pages
+  (``ceil(prompt / page_size)`` — never the full reservation) are
+  exported from the prefill pool, shipped to the decode mesh, and
+  scattered into the decode pool (the jit half lives in
+  ``inference/engine.py``). The wire cost is priced per hop by the
+  PR 6 ``LinkModel`` (:func:`price_handoff` duck-types it, so this
+  module stays import-clean).
+
+This module is the pure host-side half — the handoff queue, transfer
+records, wire pricing, and the dispatch interleaving trace that pins
+"no decode dispatch waits behind a prefill dispatch" (the decode phase
+of every engine step runs FIRST). Nothing here imports jax (pinned
+source-level by tests/unit/test_inference.py, like scheduler/paging/
+buckets/draft): handoff POLICY is unit-testable in microseconds and
+cannot perturb the compiled program set.
+"""
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["HandoffRecord", "HandoffQueue", "HandoffStats",
+           "DispatchTrace", "price_handoff"]
+
+
+@dataclass
+class HandoffRecord:
+    """One completed prefill awaiting decode-side adoption.
+
+    ``first_token`` is the token the prefill dispatch sampled — it is
+    NOT released to the request until the decode worker claims the
+    handoff (TTFT honestly includes handoff wait). ``live_pages`` is
+    the page count actually holding prompt K/V (what a cross-pool
+    transfer must move); the slot's full reservation never travels.
+    """
+    uid: int
+    slot: int
+    first_token: int
+    live_pages: int
+    prompt_tokens: int
+    t_ready: float
+    attempts: int = 0
+
+
+class HandoffQueue:
+    """FIFO of completed prefills between the worker loops.
+
+    The decode worker drains it at the START of its phase; a claim can
+    fail (decode pool can't reserve the request's lifetime pages yet)
+    and the record is then re-queued — decode-side memory pressure
+    backpressures the handoff, never the prefill loop. Counters feed
+    ``engine.debug_state()`` and the ``serve_handoff`` trail rows.
+    """
+
+    def __init__(self, clock=time.perf_counter):
+        self._clock = clock
+        self._q: List[HandoffRecord] = []
+        self.total_handoffs = 0       # claims completed
+        self.total_requeues = 0       # claims bounced (pool pressure)
+        self.total_dropped = 0        # records voided by eviction
+        self.peak_depth = 0
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    def push(self, rec: HandoffRecord) -> None:
+        self._q.append(rec)
+        self.peak_depth = max(self.peak_depth, len(self._q))
+
+    def drain(self) -> List[HandoffRecord]:
+        """Take every waiting record (the decode phase claims them in
+        arrival order; unclaimable ones come back via :meth:`requeue`)."""
+        out, self._q = self._q, []
+        return out
+
+    def requeue(self, rec: HandoffRecord) -> None:
+        """Put a record back at the FRONT (its arrival order survives a
+        bounced claim — the retry next step precedes newer handoffs)."""
+        rec.attempts += 1
+        self._q.insert(0, rec)
+        self.total_requeues += 1
+
+    def claimed(self, rec: HandoffRecord) -> float:
+        """Account one completed claim; returns the record's total
+        queue wait in ms."""
+        self.total_handoffs += 1
+        return (self._clock() - rec.t_ready) * 1e3
+
+    def dropped(self, rec: HandoffRecord) -> None:
+        """The request was evicted while its handoff waited — the
+        record is void (its pages were already freed by the
+        scheduler's eviction path)."""
+        self.total_dropped += 1
+
+    def debug_state(self) -> Dict[str, int]:
+        return {"depth": len(self._q), "peak_depth": self.peak_depth,
+                "handoffs": self.total_handoffs,
+                "requeues": self.total_requeues,
+                "dropped": self.total_dropped}
+
+
+def price_handoff(n_pages: int, page_bytes: int, link,
+                  axis: str = "inter", hops: int = 1) -> float:
+    """Modeled wire cost (ms) of moving ``n_pages`` pages across
+    ``hops`` links, priced by a ``runtime/comm_autotune.LinkModel``
+    (duck-typed: anything with ``bytes_per_us(axis)`` /
+    ``latency_us(axis)``). Same-pool handoffs cost 0 — no bytes move.
+    The priced figure rides the ``serve_handoff`` event row next to
+    the measured wall time, so a handoff that costs more than the
+    model predicts is visible per request."""
+    if n_pages <= 0 or hops <= 0:
+        return 0.0
+    bytes_moved = float(n_pages) * float(page_bytes)
+    us = hops * (link.latency_us(axis)
+                 + bytes_moved / link.bytes_per_us(axis))
+    return us / 1e3
+
+
+class DispatchTrace:
+    """The interleaving trace of device dispatches under disaggregated
+    serving: (step, kind) per dispatch, kind in {"decode", "verify",
+    "prefill", "handoff"}. The structural serving guarantee — no decode
+    dispatch ever waits behind a prefill dispatch — is checkable as
+    pure ordering: within every step, all decode/verify ordinals
+    precede all prefill ordinals (the engine's disagg step runs its
+    decode phase first). Bounded (ring of ``cap`` entries) so a serving
+    daemon can leave it on."""
+
+    DECODE_KINDS = ("decode", "verify", "handoff")
+
+    def __init__(self, cap: int = 4096):
+        self.cap = int(cap)
+        self._rows: List[Tuple[int, str]] = []
+        self.total = 0
+
+    def record(self, step: int, kind: str) -> None:
+        self._rows.append((int(step), str(kind)))
+        self.total += 1
+        if len(self._rows) > self.cap:
+            del self._rows[:len(self._rows) - self.cap]
+
+    def rows(self) -> List[Tuple[int, str]]:
+        return list(self._rows)
+
+    def decode_first_fraction(self) -> Optional[float]:
+        """Fraction of traced steps where every decode-phase dispatch
+        precedes every prefill dispatch of the same step (1.0 = the
+        never-blocked-behind-prefill pin holds; None = no step mixed
+        both phases, nothing to measure)."""
+        by_step: Dict[int, List[str]] = {}
+        for step, kind in self._rows:
+            by_step.setdefault(step, []).append(kind)
+        mixed = ok = 0
+        for kinds in by_step.values():
+            if "prefill" not in kinds or not any(
+                    k in self.DECODE_KINDS for k in kinds):
+                continue
+            mixed += 1
+            first_prefill = kinds.index("prefill")
+            if all(k == "prefill" for k in kinds[first_prefill:]):
+                ok += 1
+        return (ok / mixed) if mixed else None
+
+
+@dataclass
+class HandoffStats:
+    """Rolling same-process aggregates for ``debug_state()`` (the
+    event rows carry per-request detail; this is the cheap live
+    view)."""
+    count: int = 0
+    queue_ms_sum: float = 0.0
+    transfer_ms_sum: float = 0.0
+    bytes_moved: int = 0
+    pages_moved: int = 0
+
+    def record(self, queue_ms: float, transfer_ms: float,
+               pages: int, nbytes: int) -> None:
+        self.count += 1
+        self.queue_ms_sum += queue_ms
+        self.transfer_ms_sum += transfer_ms
+        self.pages_moved += pages
+        self.bytes_moved += nbytes
+
+    def snapshot(self) -> Dict[str, float]:
+        n = max(self.count, 1)
+        return {"handoffs": self.count,
+                "queue_ms_mean": round(self.queue_ms_sum / n, 3),
+                "transfer_ms_mean": round(self.transfer_ms_sum / n, 3),
+                "pages_moved": self.pages_moved,
+                "bytes_moved": self.bytes_moved}

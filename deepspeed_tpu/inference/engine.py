@@ -93,6 +93,10 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from deepspeed_tpu.inference.buckets import (pad_prompts, pick_bucket,
                                              warmup_plan)
+from deepspeed_tpu.inference.disagg import (DispatchTrace, HandoffQueue,
+                                            HandoffRecord, HandoffStats,
+                                            price_handoff)
+from deepspeed_tpu.inference.draft import make_drafter
 from deepspeed_tpu.inference.kv_cache import (PageAllocator, cache_spec_for,
                                               init_kv_cache,
                                               init_paged_kv_cache,
@@ -199,7 +203,8 @@ class InferenceEngine:
 
     def __init__(self, model_config, params, inference_config=None,
                  dtype=jnp.bfloat16, monitor: Optional[Any] = None,
-                 mesh: Optional[Any] = None, observability_config=None):
+                 mesh: Optional[Any] = None, observability_config=None,
+                 draft_fn=None):
         self.model_config = model_config
         (self.family, self._forward, _,
          self._param_specs_fn) = _family_of(model_config)
@@ -251,6 +256,65 @@ class InferenceEngine:
         else:
             self.params = jax.tree_util.tree_map(jnp.asarray, params)
 
+        # -------------------- disaggregation + speculative decoding
+        sd = cfg["spec_decode"]
+        dg = cfg["disagg"]
+        self.spec = bool(sd["enabled"])
+        self._spec_k = int(sd["k"]) if self.spec else 0
+        self._verify_widths = ()
+        self._drafter = None
+        if self.spec:
+            # one compiled verify program per width; default = a single
+            # seq-(k+1) program (config validation keeps widths >= 2 —
+            # width 1 IS the plain decode program)
+            widths = tuple(int(w) for w in sd["verify_widths"]) or \
+                (self._spec_k + 1,)
+            self._verify_widths = tuple(sorted(set(widths)))
+            self._drafter = make_drafter(sd, draft_fn)
+        self.disagg = bool(dg["enabled"])
+        self._decode_mesh_axes = (dict(dg["decode_mesh"]["axes"])
+                                  if self.disagg else {})
+        sep = dg["separate_pools"]
+        if sep is None:
+            # a distinct decode mesh forces distinct pools (pages must
+            # physically move); same-mesh disagg defaults to the
+            # zero-copy shared-pool handoff
+            sep = bool(self._decode_mesh_axes)
+        self._separate_pools = bool(self.disagg and sep)
+        # decode-side placement: identical to the prefill side unless
+        # disagg.decode_mesh carves the decode workers their own mesh
+        self._mesh_decode = self.mesh
+        self._param_shardings_decode = self._param_shardings
+        self._cache_sharding_decode = self._cache_sharding
+        self.params_decode = self.params
+        if self._decode_mesh_axes:
+            self._mesh_decode = build_mesh(self._decode_mesh_axes)
+            tp = axis_size(self._mesh_decode, "model")
+            kv_heads = getattr(model_config, "kv_heads", None) or \
+                model_config.num_heads
+            if model_config.num_heads % tp or kv_heads % tp:
+                raise ValueError(
+                    f"inference.disagg.decode_mesh model axis ({tp}) "
+                    f"must divide num_heads ({model_config.num_heads}) "
+                    f"and kv_heads ({kv_heads})")
+            self._param_shardings_decode = _param_shardings(
+                self._mesh_decode, self._param_specs_fn, model_config,
+                self.params)
+            self._cache_sharding_decode = NamedSharding(
+                self._mesh_decode, P(None, None, "model"))
+            # the decode workers' own weight copy (the priced reshard
+            # moves only KV pages per request — weights ship once)
+            self.params_decode = jax.tree_util.tree_map(
+                lambda x, s: jax.device_put(x, s),
+                self.params, self._param_shardings_decode)
+        self._handoff_q = HandoffQueue() if self.disagg else None
+        self._handoff_stats = HandoffStats() if self.disagg else None
+        self._dispatch_trace = DispatchTrace() if self.disagg else None
+        self._link = None
+        if self._separate_pools:
+            from deepspeed_tpu.runtime.comm_autotune import LinkModel
+            self._link = LinkModel()
+
         # telemetry: monitor (PR-3 pattern) + crash-safe events.jsonl
         # (size-rotated when observability.events_max_mb is set)
         serve_obs = self.obs_config["serve"]
@@ -289,6 +353,10 @@ class InferenceEngine:
         self._decode_attn_path = None          # "pallas" | "gather" (paged)
         self._decode_attn_reason = None
         self._decode_page_buckets = ()
+        admit_allocator = None
+        self.paged_spec_prefill = None
+        self._cache_prefill = None
+        self._page_bytes = 0
         if self.paged:
             ps = pk["page_size"]
             # auto pool: the dense-equivalent worst case (+ null page) —
@@ -303,6 +371,24 @@ class InferenceEngine:
             allocator = PageAllocator(num_pages, ps,
                                       prefix_cache=pk["prefix_cache"])
             cache_bytes = paged_kv_bytes(self.paged_spec)
+            self._page_bytes = cache_bytes // num_pages
+            if self._separate_pools:
+                # the prefill workers' own pool: prompts only (decode
+                # lifetime is reserved from the main pool at handoff
+                # claim), sized for num_slots worst-case prompts unless
+                # pinned by disagg.prefill_pages. The prefix cache
+                # lives HERE — sharing is a prefill-side concern and
+                # ends at the handoff (the migrated copy is private)
+                max_prompt = max(cfg["prompt_buckets"])
+                ppages = dg["prefill_pages"] or (
+                    self.num_slots * pages_for(max_prompt, ps) + 1)
+                self.paged_spec_prefill = paged_spec_for(
+                    model_config, ppages, ps, max_prompt, dtype=dtype)
+                self._cache_prefill = init_paged_kv_cache(
+                    self.paged_spec_prefill)
+                admit_allocator = PageAllocator(
+                    ppages, ps, prefix_cache=pk["prefix_cache"])
+                cache_bytes += paged_kv_bytes(self.paged_spec_prefill)
             self._resolve_decode_attn(pk)
         else:
             self.paged_spec = None
@@ -310,21 +396,50 @@ class InferenceEngine:
                                              max_len, dtype=dtype)
             self._cache = init_kv_cache(self.cache_spec)
             cache_bytes = kv_cache_bytes(self.cache_spec)
-        if self._cache_sharding is not None:
+        # pages_per_seq of the pool the PREFILL program scatters into
+        self._prefill_pps = (self.paged_spec_prefill.pages_per_seq
+                             if self._separate_pools else
+                             self.paged_spec.pages_per_seq) \
+            if self.paged else 0
+        # the width of one handoff migration (pad-0 rows land in the
+        # null page): every live prompt page fits, shape stays static
+        self._handoff_width = (self.paged_spec_prefill.pages_per_seq
+                               if self._separate_pools else 0)
+        if self._cache_sharding_decode is not None:
             self._cache = tuple(
-                jax.device_put(c, self._cache_sharding)
+                jax.device_put(c, self._cache_sharding_decode)
                 for c in self._cache)
+        if self._cache_prefill is not None and \
+                self._cache_sharding is not None:
+            self._cache_prefill = tuple(
+                jax.device_put(c, self._cache_sharding)
+                for c in self._cache_prefill)
         self.scheduler = Scheduler(self.num_slots, cfg["prompt_buckets"],
                                    cfg["batch_buckets"], max_len,
                                    allocator=allocator,
                                    lookahead=cfg["admit_lookahead"],
-                                   tracer=self._tracer)
+                                   tracer=self._tracer,
+                                   admit_allocator=admit_allocator,
+                                   drafter=self._drafter,
+                                   spec_k=self._spec_k)
 
         if self.paged:
             self._prefill = self._wrap_program(
                 self._prefill_paged_impl, 8, "prefill")
             self._decode = self._wrap_program(
-                self._decode_paged_impl, 7, "decode")
+                self._decode_paged_impl, 7, "decode",
+                mesh=self._mesh_decode,
+                param_shardings=self._param_shardings_decode,
+                cache_sharding=self._cache_sharding_decode)
+            self._verify = None
+            if self.spec:
+                self._verify = self._wrap_program(
+                    self._verify_paged_impl, 7, "verify",
+                    mesh=self._mesh_decode,
+                    param_shardings=self._param_shardings_decode,
+                    cache_sharding=self._cache_sharding_decode)
+            if self._separate_pools:
+                self._wrap_handoff_programs()
             geom = (f"paged KV cache: {self.paged_spec.num_pages} pages "
                     f"x {self.paged_spec.page_size} tokens "
                     f"({cache_bytes / 2**20:.1f} MiB), prefix cache "
@@ -352,6 +467,16 @@ class InferenceEngine:
                     f"{cache_bytes / 2**20:.1f} MiB")
         mesh_note = (f", mesh {dict(self.mesh.shape)}"
                      if self.mesh is not None else "")
+        if self.spec:
+            mesh_note += (f", spec_decode k={self._spec_k} "
+                          f"verify_widths={list(self._verify_widths)} "
+                          f"({type(self._drafter).__name__})")
+        if self.disagg:
+            pool_note = "separate pools" if self._separate_pools \
+                else "shared pool"
+            if self._decode_mesh_axes:
+                pool_note += f", decode mesh {self._decode_mesh_axes}"
+            mesh_note += f", disagg ({pool_note})"
         logger.info(
             f"inference engine: {self.family}, {self.num_slots} slots, "
             f"max_len {max_len}, prompt buckets {cfg['prompt_buckets']}, "
@@ -410,33 +535,75 @@ class InferenceEngine:
         widths = [int(b) for b in pk["decode_page_buckets"] if b < pps]
         self._decode_page_buckets = tuple(widths) + (pps,)
 
-    def _wrap_program(self, fn, nargs: int, name: str):
+    def _wrap_program(self, fn, nargs: int, name: str, mesh="__self__",
+                      param_shardings=None, cache_sharding=None):
         """jit + CompileTracker wrap; with a serving mesh, pin GSPMD
         NamedShardings (params on their TP specs, cache on the kv_heads
         split, host arrays replicated) so every dispatch hits the same
         partitioned program. The mesh also rides a trace-time context
         (``parallel/pallas_shard.pallas_kernel_mesh``) so the models'
         Pallas kernel call sites shard_map over it instead of tripping
-        GSPMD."""
-        if self.mesh is None:
+        GSPMD. Disaggregated serving wraps the decode-side programs
+        against the DECODE mesh/shardings — pass them explicitly; the
+        defaults are the prefill side's."""
+        if mesh == "__self__":
+            mesh = self.mesh
+            param_shardings = self._param_shardings
+            cache_sharding = self._cache_sharding
+        if mesh is None:
             jitted = jax.jit(fn, donate_argnums=(1,))
         else:
             from deepspeed_tpu.parallel.pallas_shard import \
                 pallas_kernel_mesh
-            mesh = self.mesh
 
             def fn_under_mesh(*args, _fn=fn, _mesh=mesh):
                 with pallas_kernel_mesh(_mesh, "model"):
                     return _fn(*args)
 
-            repl = NamedSharding(self.mesh, P())
-            cache_sh = (self._cache_sharding, self._cache_sharding)
-            in_sh = (self._param_shardings, cache_sh) + \
+            repl = NamedSharding(mesh, P())
+            cache_sh = (cache_sharding, cache_sharding)
+            in_sh = (param_shardings, cache_sh) + \
                 (repl,) * (nargs - 2)
             jitted = jax.jit(fn_under_mesh, donate_argnums=(1,),
                              in_shardings=in_sh,
                              out_shardings=(repl, cache_sh))
         return self.compile_tracker.wrap(jitted, name)
+
+    def _wrap_handoff_programs(self):
+        """The two cross-pool page-migration programs (separate-pools
+        disaggregation only): ``handoff_export`` gathers the live
+        prompt pages out of the prefill pool (no donation — the pool
+        keeps serving other slots), ``handoff_import`` scatters the
+        slab into the decode pool (pool donated: migration allocates
+        nothing steady-state). Fixed index width
+        (``self._handoff_width``, pad index 0) keeps both in the
+        warmup-compiled program set. Between them the slab crosses
+        meshes by ``device_put`` when ``disagg.decode_mesh`` differs —
+        the priced hop."""
+        if self.mesh is None:
+            ex = jax.jit(self._export_pages_impl)
+        else:
+            cs = self._cache_sharding
+            slab_sh = NamedSharding(self.mesh, P(None, None, "model"))
+            repl = NamedSharding(self.mesh, P())
+            ex = jax.jit(self._export_pages_impl,
+                         in_shardings=((cs, cs), repl),
+                         out_shardings=(slab_sh, slab_sh))
+        self._export = self.compile_tracker.wrap(ex, "handoff_export")
+        self._slab_sharding_decode = None
+        if self._mesh_decode is None:
+            im = jax.jit(self._import_pages_impl, donate_argnums=(0,))
+        else:
+            cs = self._cache_sharding_decode
+            slab_sh = NamedSharding(self._mesh_decode,
+                                    P(None, None, "model"))
+            self._slab_sharding_decode = slab_sh
+            repl = NamedSharding(self._mesh_decode, P())
+            im = jax.jit(self._import_pages_impl, donate_argnums=(0,),
+                         in_shardings=((cs, cs), (slab_sh, slab_sh),
+                                       repl),
+                         out_shardings=(cs, cs))
+        self._import = self.compile_tracker.wrap(im, "handoff_import")
 
     # -------------------------------------------------- compiled programs
     def _sample_tokens(self, logits, keys, temps):
@@ -534,6 +701,54 @@ class InferenceEngine:
         nxt = self._sample_tokens(logits[:, 0], step_keys, temps)
         return nxt, cache
 
+    def _verify_paged_impl(self, params, cache, toks, positions, tables,
+                           keys, temps):
+        """One speculative VERIFY dispatch: ``toks[i] = [pending,
+        d_1..d_{v-1}]`` — each row's pending token plus its draft
+        proposals (zero-padded) — runs as a seq-``v`` pass through the
+        SAME paged cached forward as decode, writing all ``v``
+        positions and producing ``v`` next-token samples per row:
+        ``out[i, j]`` is what sequential decode would have sampled
+        after position ``positions[i] + j`` (per-position keys continue
+        the exact ``fold_in(key, position + 1)`` chain, so acceptance
+        is bitwise-faithful for greedy AND sampled rows). The host
+        accepts the longest prefix of drafts matching ``out`` and rolls
+        the rest back by pure position bookkeeping: rejected positions'
+        K/V sit beyond the causal cache mask and are overwritten by
+        later contiguous writes before any query can attend them — no
+        cache edit, no extra dispatch. Tables ride at FULL width (one
+        program per verify width, not per width x page bucket)."""
+        B, V = toks.shape
+        logits, cache = self._forward(
+            params, self.model_config, toks, dtype=self.dtype,
+            kv_cache=cache, cache_position=positions,
+            block_tables=tables,
+            paged_attn_kernel=self._decode_attn_path)
+        offs = positions[:, None] + 1 + \
+            jnp.arange(V, dtype=jnp.int32)[None, :]
+        vkeys = jax.vmap(lambda k, o: jax.vmap(
+            lambda oo: jax.random.fold_in(k, oo))(o))(keys, offs)
+        out = self._sample_tokens(logits.reshape(B * V, -1),
+                                  vkeys.reshape(B * V, 2),
+                                  jnp.repeat(temps, V))
+        return out.reshape(B, V), cache
+
+    def _export_pages_impl(self, cache, idx):
+        """Gather ``idx``'s rows (live prompt pages) out of the prefill
+        pool into a contiguous slab — the unit that crosses the
+        prefill->decode link. No donation: the pool keeps serving."""
+        k, v = cache
+        return k[:, idx], v[:, idx]
+
+    def _import_pages_impl(self, cache, slab, idx):
+        """Scatter a handoff slab into the decode pool at ``idx``
+        (pad index 0 rows land in the null page — garbage by design).
+        The pool is donated: steady-state migration allocates
+        nothing."""
+        k, v = cache
+        sk, sv = slab
+        return k.at[:, idx].set(sk), v.at[:, idx].set(sv)
+
     # ----------------------------------------------------------- serving
     # seeds are caller-supplied, so the memo must be bounded: a serving
     # daemon taking per-request random seeds would otherwise grow it one
@@ -596,7 +811,7 @@ class InferenceEngine:
                 1.0 - sched.tokens_in_flight / used_tokens, 4) \
                 if used_tokens else 0.0
             pool["decode_attn_path"] = self._decode_attn_path
-        return {
+        state = {
             "family": self.family,
             "steps": self._steps,
             "queue_depth": sched.queue_depth,
@@ -608,6 +823,23 @@ class InferenceEngine:
             "page_pool": pool,
             "slo": self._tracer.snapshot(),
         }
+        if self.spec:
+            state["spec_decode"] = {
+                "k": self._spec_k,
+                "verify_widths": list(self._verify_widths),
+                "drafter": type(self._drafter).__name__,
+            }
+        if self.disagg:
+            dff = self._dispatch_trace.decode_first_fraction()
+            dg = {"separate_pools": self._separate_pools,
+                  "queue": self._handoff_q.debug_state(),
+                  "handoff": self._handoff_stats.snapshot(),
+                  "decode_first_fraction": (round(dff, 4)
+                                            if dff is not None else None)}
+            if self._separate_pools:
+                dg["prefill_pool"] = sched.admit_allocator.debug_state()
+            state["disagg"] = dg
+        return state
 
     def _run_prefill(self, batch) -> np.ndarray:
         keys = np.zeros((batch.batch_bucket, 2), np.uint32)
@@ -625,17 +857,23 @@ class InferenceEngine:
                                            batch.batch_bucket)
                 positions = np.zeros((batch.batch_bucket,), np.int32)
                 tables = np.zeros(
-                    (batch.batch_bucket, self.paged_spec.pages_per_seq),
-                    np.int32)
+                    (batch.batch_bucket, self._prefill_pps), np.int32)
                 for i, (pl, pages) in enumerate(
                         zip(batch.prefix_lens, batch.page_tables)):
                     positions[i] = pl
                     tables[i, :len(pages)] = pages
-                first, self._cache = self._prefill(
-                    self.params, self._cache, jnp.asarray(ids),
-                    jnp.asarray(lengths), jnp.asarray(positions),
-                    jnp.asarray(tables), jnp.asarray(keys),
-                    jnp.asarray(temps))
+                if self._separate_pools:
+                    first, self._cache_prefill = self._prefill(
+                        self.params, self._cache_prefill,
+                        jnp.asarray(ids), jnp.asarray(lengths),
+                        jnp.asarray(positions), jnp.asarray(tables),
+                        jnp.asarray(keys), jnp.asarray(temps))
+                else:
+                    first, self._cache = self._prefill(
+                        self.params, self._cache, jnp.asarray(ids),
+                        jnp.asarray(lengths), jnp.asarray(positions),
+                        jnp.asarray(tables), jnp.asarray(keys),
+                        jnp.asarray(temps))
             else:
                 ids, lengths = pad_prompts(
                     [r.prompt for r in batch.requests],
@@ -649,49 +887,202 @@ class InferenceEngine:
                     jnp.asarray(keys), jnp.asarray(temps))
             return np.asarray(first)
 
-    def step(self) -> List[FinishedRequest]:
-        """One serving iteration: admit waiting requests into free slots
-        (bucketed prefill, first token sampled), then advance every
-        in-flight sequence one decode step. Returns requests that
-        finished this iteration."""
+    def _drain_request_metrics(self):
+        """Per-admitted-request scalar writes (TTFT / queue wait)
+        pulled off the scheduler's drain queues."""
         sched = self.scheduler
-        finished: List[FinishedRequest] = []
-        t_start = time.perf_counter()
+        for ttft in sched.drain_ttfts():
+            self.monitor.write_serving_metrics(
+                ttft_ms=ttft, tokens=sched.total_tokens, flush=False)
+        for qwait in sched.drain_queue_waits():
+            self.monitor.write_serving_metrics(
+                queue_wait_ms=qwait, tokens=sched.total_tokens,
+                flush=False)
 
+    def _prefill_phase(self, finished: List[FinishedRequest]) -> None:
+        """Admission + bucketed prefill dispatches (the prefill worker
+        loop). Non-disagg: each first token releases to its request
+        immediately. Disagg: it parks in the handoff queue instead —
+        the DECODE phase claims it, so TTFT honestly includes the
+        handoff wait."""
+        sched = self.scheduler
+        t0 = time.perf_counter()
         for batch in sched.admit():
-            t0 = time.perf_counter()
+            t_p = time.perf_counter()
             first = self._run_prefill(batch)
-            prefill_ms = (time.perf_counter() - t0) * 1e3
-            for i, (sid, req) in enumerate(zip(batch.slot_ids,
-                                               batch.requests)):
+            prefill_ms = (time.perf_counter() - t_p) * 1e3
+            if self._dispatch_trace is not None:
+                self._dispatch_trace.record(self._steps, "prefill")
+            for sid, req in zip(batch.slot_ids, batch.requests):
                 self._tracer.on_prefill(
                     req.uid, sid, prefill_ms, batch.prompt_bucket,
                     batch.batch_bucket, len(batch.requests))
-            finished.extend(sched.record_tokens(
-                {sid: int(first[i])
-                 for i, sid in enumerate(batch.slot_ids)}))
-            for ttft in sched.drain_ttfts():
-                self.monitor.write_serving_metrics(
-                    ttft_ms=ttft, tokens=sched.total_tokens, flush=False)
-            for qwait in sched.drain_queue_waits():
-                self.monitor.write_serving_metrics(
-                    queue_wait_ms=qwait, tokens=sched.total_tokens,
-                    flush=False)
+            if self.disagg:
+                now = time.perf_counter()
+                ps = self.paged_spec.page_size
+                for i, (sid, req) in enumerate(zip(batch.slot_ids,
+                                                   batch.requests)):
+                    self._handoff_q.push(HandoffRecord(
+                        uid=req.uid, slot=sid,
+                        first_token=int(first[i]),
+                        live_pages=pages_for(len(req.prompt), ps),
+                        prompt_tokens=len(req.prompt), t_ready=now))
+            else:
+                finished.extend(sched.record_tokens(
+                    {sid: int(first[i])
+                     for i, sid in enumerate(batch.slot_ids)}))
+            self._drain_request_metrics()
+        self._serve_secs += time.perf_counter() - t0
 
+    def _claim_phase(self, finished: List[FinishedRequest]) -> None:
+        """Disagg decode-worker intake: claim completed prefills off
+        the handoff queue, transferring page OWNERSHIP to the decode
+        loop — a zero-copy host bookkeeping move on a shared pool, or
+        an export -> link -> import migration of only the live prompt
+        pages (never the full reservation) across separate pools /
+        meshes, priced by the LinkModel next to the measured wall
+        time. A claim the decode pool can't fund yet bounces back
+        (requeue + "handoff" defer): decode-side memory pressure
+        backpressures the handoff, never the prefill loop. Each claim
+        releases the request's first token."""
+        sched = self.scheduler
+        q = self._handoff_q
+        tracer = self._tracer
+        t0 = time.perf_counter()
+        for rec in q.drain():
+            slot = sched.slots[rec.slot]
+            if slot is None or slot.request.uid != rec.uid:
+                q.dropped(rec)     # evicted while the handoff waited
+                continue
+            transfer_ms = 0.0
+            priced = 0.0
+            pages = nbytes = 0
+            mode = "shared_pool"
+            if self._separate_pools:
+                req = slot.request
+                need = pages_for(len(req.prompt) + req.max_new_tokens,
+                                 self.paged_spec.page_size)
+                new_pages = sched.allocator.alloc(need)
+                if new_pages is None:
+                    q.requeue(rec)
+                    tracer.on_defer(rec.uid, "handoff")
+                    continue
+                cross = self._mesh_decode is not self.mesh
+                mode = "migrate_mesh" if cross else "migrate"
+                t_m = time.perf_counter()
+                src = np.zeros((self._handoff_width,), np.int32)
+                dst = np.zeros((self._handoff_width,), np.int32)
+                live = slot.pages[:rec.live_pages]
+                src[:len(live)] = live
+                dst[:len(live)] = new_pages[:len(live)]
+                slab = self._export(self._cache_prefill,
+                                    jnp.asarray(src))
+                if cross and self._slab_sharding_decode is not None:
+                    slab = tuple(
+                        jax.device_put(s, self._slab_sharding_decode)
+                        for s in slab)
+                self._cache = self._import(self._cache, slab,
+                                           jnp.asarray(dst))
+                # one host sync per CLAIM (once per request, never per
+                # dispatch): the measured wall time must cover the
+                # device copy it reports
+                jax.block_until_ready(self._cache[0])
+                transfer_ms = (time.perf_counter() - t_m) * 1e3
+                pages = len(live)
+                nbytes = pages * self._page_bytes
+                priced = price_handoff(
+                    pages, self._page_bytes, self._link,
+                    axis="inter" if cross else "intra")
+                sched.adopt_pages(rec.slot, new_pages)
+                if self._dispatch_trace is not None:
+                    self._dispatch_trace.record(self._steps, "handoff")
+            queue_ms = q.claimed(rec)
+            tracer.on_handoff(rec.uid, queue_ms, transfer_ms, pages,
+                              nbytes, mode, priced)
+            self._handoff_stats.record(queue_ms, transfer_ms, pages,
+                                       nbytes)
+            self.monitor.write_serving_metrics(
+                handoff_ms=queue_ms + transfer_ms,
+                tokens=sched.total_tokens, flush=False)
+            finished.extend(sched.record_tokens(
+                {rec.slot: rec.first_token}))
+            self._drain_request_metrics()
+        self._serve_secs += time.perf_counter() - t0
+
+    def _decode_phase(self, finished: List[FinishedRequest]) -> bool:
+        """Advance every in-flight sequence: a plain one-token decode
+        dispatch, or — with speculation and live draft proposals — ONE
+        seq-``v`` verify dispatch that emits ``accepted + 1`` tokens
+        per row. Returns whether anything dispatched."""
+        sched = self.scheduler
         sids, toks, poss, temps, seeds = sched.decode_state()
-        if sids:
-            occupancy = len(sids) / self.num_slots
-            toks_a = np.zeros((self._rows,), np.int32)
-            poss_a = np.zeros((self._rows,), np.int32)
-            temps_a = np.zeros((self._rows,), np.float32)
-            keys_a = np.zeros((self._rows, 2), np.uint32)
-            for sid, tok, pos, temp, seed in zip(sids, toks, poss, temps,
-                                                 seeds):
-                toks_a[sid] = tok
-                poss_a[sid] = pos
-                temps_a[sid] = temp
-                keys_a[sid] = self._key_for(seed)
-            t0 = time.perf_counter()
+        if not sids:
+            return False
+        t0 = time.perf_counter()
+        occupancy = len(sids) / self.num_slots
+        toks_a = np.zeros((self._rows,), np.int32)
+        poss_a = np.zeros((self._rows,), np.int32)
+        temps_a = np.zeros((self._rows,), np.float32)
+        keys_a = np.zeros((self._rows, 2), np.uint32)
+        for sid, tok, pos, temp, seed in zip(sids, toks, poss, temps,
+                                             seeds):
+            toks_a[sid] = tok
+            poss_a[sid] = pos
+            temps_a[sid] = temp
+            keys_a[sid] = self._key_for(seed)
+        props: Dict[int, List[int]] = {}
+        if self.spec and self.paged:
+            props = sched.draft_proposals(
+                cap=max(self._verify_widths) - 1)
+        spec_kw = {}
+        runs: Dict[int, List[int]] = {}
+        draft_stats = None
+        t_d = time.perf_counter()
+        if props:
+            dmax = max(len(p) for p in props.values())
+            v = pick_bucket(dmax + 1, self._verify_widths)
+            vt = np.zeros((self._rows, v), np.int32)
+            vt[:, 0] = toks_a
+            for sid, p in props.items():
+                vt[sid, 1:1 + len(p)] = p
+            # verify tables ride at FULL width: one compiled program
+            # per verify width, not per width x page bucket
+            tables = sched.block_table_rows(
+                self._rows, self.paged_spec.pages_per_seq)
+            with trace_span("serve/verify", recorder=self._recorder,
+                            active=len(sids), width=v):
+                out, self._cache = self._verify(
+                    self.params_decode, self._cache, jnp.asarray(vt),
+                    jnp.asarray(poss_a), jnp.asarray(tables),
+                    jnp.asarray(keys_a), jnp.asarray(temps_a))
+                # host sync: the scheduler needs the token values
+                out = np.asarray(out)
+            if self._dispatch_trace is not None:
+                self._dispatch_trace.record(self._steps, "verify")
+            draft_stats = {}
+            proposed_total = accepted_total = 0
+            for sid in sids:
+                p = props.get(sid)
+                if not p:
+                    # rode the verify program with zero drafts — a
+                    # draft stall, traced once per request
+                    runs[sid] = [int(out[sid, 0])]
+                    tracer_uid = sched.slots[sid].request.uid
+                    self._tracer.on_defer(tracer_uid, "draft_stall")
+                    continue
+                m = 0
+                while m < len(p) and p[m] == int(out[sid, m]):
+                    m += 1
+                runs[sid] = [int(t) for t in out[sid, :m + 1]]
+                draft_stats[sid] = (len(p), m)
+                self._tracer.on_spec(
+                    sched.slots[sid].request.uid, len(p), m)
+                proposed_total += len(p)
+                accepted_total += m
+            if proposed_total:
+                spec_kw["spec_accept_rate"] = (accepted_total
+                                               / proposed_total)
+        else:
             with trace_span("serve/decode", recorder=self._recorder,
                             active=len(sids)):
                 if self.paged:
@@ -705,53 +1096,82 @@ class InferenceEngine:
                         self._decode_page_buckets)
                     tables = sched.block_table_rows(self._rows, width)
                     nxt, self._cache = self._decode(
-                        self.params, self._cache, jnp.asarray(toks_a),
-                        jnp.asarray(poss_a), jnp.asarray(tables),
-                        jnp.asarray(keys_a), jnp.asarray(temps_a))
+                        self.params_decode, self._cache,
+                        jnp.asarray(toks_a), jnp.asarray(poss_a),
+                        jnp.asarray(tables), jnp.asarray(keys_a),
+                        jnp.asarray(temps_a))
                 else:
                     nxt, self._cache = self._decode(
-                        self.params, self._cache, jnp.asarray(toks_a),
-                        jnp.asarray(poss_a), jnp.asarray(keys_a),
-                        jnp.asarray(temps_a))
+                        self.params_decode, self._cache,
+                        jnp.asarray(toks_a), jnp.asarray(poss_a),
+                        jnp.asarray(keys_a), jnp.asarray(temps_a))
                 # host sync: the scheduler needs the token values
                 nxt = np.asarray(nxt)
-            tok_ms = (time.perf_counter() - t0) * 1e3
-            finished.extend(sched.record_tokens(
-                {sid: int(nxt[sid]) for sid in sids}))
-            self._serve_secs += time.perf_counter() - t_start
-            tps = (sched.total_tokens / self._serve_secs
-                   if self._serve_secs > 0 else 0.0)
-            paged_kw = {}
-            if self.paged:
-                alloc = sched.allocator
-                seen = alloc.prefix_hit_tokens + alloc.prefix_miss_tokens
-                paged_kw = dict(
-                    kv_pages_in_use=alloc.pages_in_use,
-                    tokens_in_flight=sched.tokens_in_flight,
-                    prefix_hit_rate=(alloc.prefix_hit_tokens / seen
-                                     if seen else 0.0),
-                    decode_attn_path=(
-                        1.0 if self._decode_attn_path == "pallas"
-                        else 0.0))
-            tracer = self._tracer
-            slo_kw = {}
-            if tracer.enabled:
-                tbts = tracer.drain_step_tbts()
-                if tbts:
-                    slo_kw["tbt_ms"] = sum(tbts) / len(tbts)
-                att = tracer.slo_attainment
-                if att is not None:
-                    slo_kw["slo_attainment"] = att
-                    slo_kw["goodput_tokens_per_s"] = (
-                        tracer.good_tokens / self._serve_secs
-                        if self._serve_secs > 0 else 0.0)
-            self.monitor.write_serving_metrics(
-                token_latency_ms=tok_ms, tokens_per_sec=tps,
-                queue_depth=sched.queue_depth, batch_occupancy=occupancy,
-                tokens=sched.total_tokens, flush=False, **paged_kw,
-                **slo_kw)
+            if self._dispatch_trace is not None:
+                self._dispatch_trace.record(self._steps, "decode")
+            runs = {sid: [int(nxt[sid])] for sid in sids}
+            if self.spec:
+                # speculation on, drafter had nothing anywhere: the
+                # whole dispatch fell back to plain decode
+                for sid in sids:
+                    self._tracer.on_defer(
+                        sched.slots[sid].request.uid, "draft_stall")
+        tok_ms = (time.perf_counter() - t_d) * 1e3
+        finished.extend(sched.record_token_runs(runs, draft_stats))
+        self._serve_secs += time.perf_counter() - t0
+        tps = (sched.total_tokens / self._serve_secs
+               if self._serve_secs > 0 else 0.0)
+        paged_kw = {}
+        if self.paged:
+            alloc = sched.allocator
+            hit_alloc = sched.admit_allocator
+            seen = (hit_alloc.prefix_hit_tokens
+                    + hit_alloc.prefix_miss_tokens)
+            paged_kw = dict(
+                kv_pages_in_use=alloc.pages_in_use,
+                tokens_in_flight=sched.tokens_in_flight,
+                prefix_hit_rate=(hit_alloc.prefix_hit_tokens / seen
+                                 if seen else 0.0),
+                decode_attn_path=(
+                    1.0 if self._decode_attn_path == "pallas"
+                    else 0.0))
+        tracer = self._tracer
+        slo_kw = {}
+        if tracer.enabled:
+            tbts = tracer.drain_step_tbts()
+            if tbts:
+                slo_kw["tbt_ms"] = sum(tbts) / len(tbts)
+            att = tracer.slo_attainment
+            if att is not None:
+                slo_kw["slo_attainment"] = att
+                slo_kw["goodput_tokens_per_s"] = (
+                    tracer.good_tokens / self._serve_secs
+                    if self._serve_secs > 0 else 0.0)
+        self.monitor.write_serving_metrics(
+            token_latency_ms=tok_ms, tokens_per_sec=tps,
+            queue_depth=sched.queue_depth, batch_occupancy=occupancy,
+            tokens=sched.total_tokens, flush=False, **paged_kw,
+            **slo_kw, **spec_kw)
+        return True
+
+    def step(self) -> List[FinishedRequest]:
+        """One serving iteration. Default: admit waiting requests into
+        free slots (bucketed prefill, first token released), then
+        advance every in-flight sequence one decode (or speculative
+        verify) dispatch. Disaggregated (``inference.disagg``): the
+        DECODE phase runs FIRST — handoff claims, then the decode/
+        verify dispatch — and the prefill phase runs after it, so no
+        decode dispatch ever waits behind a prefill dispatch
+        (structural; pinned by the dispatch trace). Returns requests
+        that finished this iteration."""
+        finished: List[FinishedRequest] = []
+        if self.disagg:
+            self._claim_phase(finished)
+            self._decode_phase(finished)
+            self._prefill_phase(finished)
         else:
-            self._serve_secs += time.perf_counter() - t_start
+            self._prefill_phase(finished)
+            self._decode_phase(finished)
 
         # serve_finish / serve_evict rows are emitted by the tracer as
         # the scheduler retires each request (sync-free host appends)
@@ -814,13 +1234,19 @@ class InferenceEngine:
             keys = np.zeros((bb, 2), np.uint32)
             temps = np.zeros((bb,), np.float32)
             if self.paged:
-                first, self._cache = self._prefill(
-                    self.params, self._cache, jnp.asarray(ids),
-                    jnp.asarray(lengths),
-                    jnp.zeros((bb,), jnp.int32),
-                    jnp.zeros((bb, self.paged_spec.pages_per_seq),
-                              jnp.int32),
-                    jnp.asarray(keys), jnp.asarray(temps))
+                ztab = jnp.zeros((bb, self._prefill_pps), jnp.int32)
+                if self._separate_pools:
+                    first, self._cache_prefill = self._prefill(
+                        self.params, self._cache_prefill,
+                        jnp.asarray(ids), jnp.asarray(lengths),
+                        jnp.zeros((bb,), jnp.int32), ztab,
+                        jnp.asarray(keys), jnp.asarray(temps))
+                else:
+                    first, self._cache = self._prefill(
+                        self.params, self._cache, jnp.asarray(ids),
+                        jnp.asarray(lengths),
+                        jnp.zeros((bb,), jnp.int32), ztab,
+                        jnp.asarray(keys), jnp.asarray(temps))
             else:
                 slots = np.full((bb,), self._scratch, np.int32)
                 first, self._cache = self._prefill(
@@ -830,15 +1256,39 @@ class InferenceEngine:
         if self.paged:
             for w in self._decode_page_buckets:
                 nxt, self._cache = self._decode(
-                    self.params, self._cache,
+                    self.params_decode, self._cache,
                     jnp.zeros((self._rows,), jnp.int32),
                     jnp.zeros((self._rows,), jnp.int32),
                     jnp.zeros((self._rows, w), jnp.int32),
                     jnp.zeros((self._rows, 2), jnp.uint32),
                     jnp.zeros((self._rows,), jnp.float32))
+            if self.spec:
+                # one verify program per width — tables always ride at
+                # full pps, so widths x 1 (not widths x page buckets)
+                for v in self._verify_widths:
+                    nxt2, self._cache = self._verify(
+                        self.params_decode, self._cache,
+                        jnp.zeros((self._rows, v), jnp.int32),
+                        jnp.zeros((self._rows,), jnp.int32),
+                        jnp.zeros(
+                            (self._rows, self.paged_spec.pages_per_seq),
+                            jnp.int32),
+                        jnp.zeros((self._rows, 2), jnp.uint32),
+                        jnp.zeros((self._rows,), jnp.float32))
+                    nxt = nxt2[:, 0]
+            if self._separate_pools:
+                # warm both handoff programs against the null page so
+                # the first real claim doesn't compile on the clock
+                idx = jnp.zeros((self._handoff_width,), jnp.int32)
+                slab = self._export(self._cache_prefill, idx)
+                if self._slab_sharding_decode is not None:
+                    slab = tuple(
+                        jax.device_put(s, self._slab_sharding_decode)
+                        for s in slab)
+                self._cache = self._import(self._cache, slab, idx)
         else:
             nxt, self._cache = self._decode(
-                self.params, self._cache,
+                self.params_decode, self._cache,
                 jnp.zeros((self._rows,), jnp.int32),
                 jnp.zeros((self._rows,), jnp.int32),
                 jnp.zeros((self._rows, 2), jnp.uint32),
@@ -850,7 +1300,9 @@ class InferenceEngine:
                                 programs=self._warm_compiles,
                                 batch_buckets=self.config["batch_buckets"],
                                 prompt_buckets=self.config["prompt_buckets"],
-                                paged=self.paged)
+                                paged=self.paged,
+                                verify_widths=list(self._verify_widths),
+                                disagg=self.disagg)
         return self._warm_compiles
 
     @property
@@ -869,7 +1321,7 @@ class InferenceEngine:
                         dtype=jnp.bfloat16, monitor: Optional[Any] = None,
                         quantize_weights: Optional[bool] = None,
                         verify_integrity: bool = True,
-                        observability_config=None):
+                        observability_config=None, draft_fn=None):
         """Build a serving engine from a committed training checkpoint.
 
         Loads the ``model_states`` group ONLY (params-only mode —
@@ -922,7 +1374,8 @@ class InferenceEngine:
                         f"int8 (block {cfg['quantize_block']})")
         engine = cls(model_config, params, cfg, dtype=dtype,
                      monitor=monitor, mesh=mesh,
-                     observability_config=observability_config)
+                     observability_config=observability_config,
+                     draft_fn=draft_fn)
         if engine._log is not None:
             engine._log.add_event(
                 "serve_load", checkpoint=chosen,

@@ -78,6 +78,16 @@ class FinishedRequest:
     ttft_ms: Optional[float]
     latency_ms: float            # submit -> finish wall time
     queue_wait_ms: Optional[float] = None
+    # per-request decode rate (kept tokens / total latency; None when
+    # no token or no measurable latency) and the speculative-decoding
+    # ledger: every PROPOSED draft token the verify dispatches saw for
+    # this request vs how many were ACCEPTED (kept). ``tokens`` only
+    # ever contains verified-and-kept tokens — rolled-back drafts are
+    # never recorded, so goodput accounting stays honest by
+    # construction (inference/tracing.py).
+    tokens_per_s: Optional[float] = None
+    draft_proposed: int = 0
+    draft_accepted: int = 0
 
 
 @dataclass
@@ -109,6 +119,13 @@ class _Slot:
     pages: List[int] = field(default_factory=list)   # paged mode only
     prefix_len: int = 0          # tokens reused from the prefix cache
     queue_wait_ms: float = 0.0   # submit -> admit (latency decomposition)
+    # which allocator owns ``pages``: admission reserves from the admit
+    # allocator ("admit" — the prefill pool under disaggregated
+    # separate-pools serving, else the main pool); a claimed handoff
+    # re-homes the slot onto the main pool via ``adopt_pages``
+    pool: str = "admit"
+    draft_proposed: int = 0      # speculative-decoding ledger
+    draft_accepted: int = 0
 
 
 class Scheduler:
@@ -134,7 +151,9 @@ class Scheduler:
                  batch_buckets: Sequence[int], max_len: int,
                  clock=time.monotonic,
                  allocator: Optional[PageAllocator] = None,
-                 lookahead: int = 0, tracer=None):
+                 lookahead: int = 0, tracer=None,
+                 admit_allocator: Optional[PageAllocator] = None,
+                 drafter=None, spec_k: int = 0):
         if num_slots < 1:
             raise ValueError("num_slots must be >= 1")
         if lookahead < 0:
@@ -145,6 +164,19 @@ class Scheduler:
         self.max_len = int(max_len)
         self._clock = clock
         self.allocator = allocator
+        # disaggregated separate-pools mode: admission reserves PROMPT
+        # pages from its own (prefill) pool; the decode-lifetime
+        # reservation moves to handoff claim (``adopt_pages``). Default
+        # — one pool — keeps the whole-lifetime up-front reservation.
+        self.admit_allocator = (admit_allocator if admit_allocator
+                                is not None else allocator)
+        self._separate_pools = (self.admit_allocator is not None and
+                                self.admit_allocator is not allocator)
+        # speculative decoding: a host-side drafter (inference/draft.py
+        # surface: ``propose(history, k) -> tokens``) proposing up to
+        # ``spec_k`` tokens per slot per decode dispatch
+        self.drafter = drafter
+        self.spec_k = int(spec_k)
         self.lookahead = int(lookahead)
         self.tracer = tracer
         self.queue: List[Request] = []
@@ -180,8 +212,10 @@ class Scheduler:
         allocator's refcounts (only prefix sharing raises a refcount
         above 1); dense slots never share."""
         n = sum(s.position for s in self.slots if s is not None)
-        if self.allocator is not None:
-            n -= self.allocator.shared_duplicate_tokens
+        # prefix sharing lives in the admission-side allocator (the
+        # prefill pool under separate-pools disaggregation)
+        if self.admit_allocator is not None:
+            n -= self.admit_allocator.shared_duplicate_tokens
         return n
 
     def idle(self) -> bool:
@@ -208,6 +242,12 @@ class Scheduler:
                 raise ValueError(
                     f"request needs {total} pages but the pool has "
                     f"{self.allocator.num_pages - 1} usable")
+        if self._separate_pools:
+            ppages = pages_for(plen, self.admit_allocator.page_size)
+            if ppages > self.admit_allocator.num_pages - 1:
+                raise ValueError(
+                    f"prompt needs {ppages} pages but the prefill pool "
+                    f"has {self.admit_allocator.num_pages - 1} usable")
         self._submit_time[request.uid] = self._clock()
         self.queue.append(request)
         if self.tracer is not None:
@@ -230,10 +270,10 @@ class Scheduler:
         """Cached prefix pages reusable by ``req`` — capped one token
         short of the full prompt: the last prompt token must run through
         prefill to produce the first-token logits."""
-        if self.allocator is None:
+        if self.admit_allocator is None:
             return [], 0
-        shared, reused = self.allocator.match_prefix(req.prompt)
-        ps = self.allocator.page_size
+        shared, reused = self.admit_allocator.match_prefix(req.prompt)
+        ps = self.admit_allocator.page_size
         cap = (len(req.prompt) - 1) // ps
         shared = shared[:cap]
         return shared, len(shared) * ps
@@ -247,20 +287,26 @@ class Scheduler:
         taken) when the pool can't supply them. ``match`` reuses a
         just-computed ``_match_prefix`` result (admission's bucket
         pre-check) instead of re-hashing the prompt."""
-        if self.allocator is None:
+        alloc = self.admit_allocator
+        if alloc is None:
             return [], 0
         shared, reused = match if match is not None else \
             self._match_prefix(req)
-        total = pages_for(len(req.prompt) + req.max_new_tokens,
-                          self.allocator.page_size)
-        fresh = self.allocator.alloc(total - len(shared))
+        # separate-pools disaggregation: prefill only ever writes the
+        # PROMPT's K/V, so admission reserves just that — the decode
+        # lifetime (prompt + max_new) is reserved from the main pool
+        # when the handoff is claimed (adopt_pages)
+        tokens = len(req.prompt) if self._separate_pools else \
+            len(req.prompt) + req.max_new_tokens
+        total = pages_for(tokens, alloc.page_size)
+        fresh = alloc.alloc(total - len(shared))
         if fresh is None:
             return None
-        self.allocator.incref(shared)
-        self.allocator.prefix_hit_tokens += reused
-        self.allocator.prefix_miss_tokens += len(req.prompt) - reused
+        alloc.incref(shared)
+        alloc.prefix_hit_tokens += reused
+        alloc.prefix_miss_tokens += len(req.prompt) - reused
         if reused:
-            self.allocator.prefix_hit_requests += 1
+            alloc.prefix_hit_requests += 1
             if self.tracer is not None:
                 self.tracer.on_prefix_hit(req.uid, reused, len(shared))
         pages = shared + fresh
@@ -268,13 +314,29 @@ class Scheduler:
         # requests sharing the prefix — content is determined by the
         # prompt alone, and every reader's gather runs after this
         # request's prefill scatter (same or later dispatch)
-        self.allocator.register_prefix(req.prompt, pages)
+        alloc.register_prefix(req.prompt, pages)
         return pages, reused
 
     def _release(self, slot: _Slot):
-        if self.allocator is not None and slot.pages:
-            self.allocator.free(slot.pages)
+        alloc = self.admit_allocator if slot.pool == "admit" else \
+            self.allocator
+        if alloc is not None and slot.pages:
+            alloc.free(slot.pages)
             slot.pages = []
+
+    def adopt_pages(self, sid: int, pages: List[int]) -> None:
+        """Re-home slot ``sid`` onto the MAIN (decode) pool: its
+        admission-side pages (the prefill pool's, under separate-pools
+        disaggregation) free immediately and ``pages`` — already
+        allocated from ``self.allocator`` by the engine's handoff
+        claim, content already migrated — become the slot's block
+        table."""
+        slot = self.slots[sid]
+        if slot is None:
+            raise KeyError(f"slot {sid} is not active")
+        self._release(slot)
+        slot.pages = list(pages)
+        slot.pool = "main"
 
     def admit(self) -> List[PrefillBatch]:
         """Assign waiting requests to free slots, grouped into bucketed
@@ -377,44 +439,82 @@ class Scheduler:
         max_new_tokens) are evicted; their slots (and pages) free
         immediately for the next ``admit``. Returns the newly finished
         requests."""
+        return self.record_token_runs(
+            {sid: [tok] for sid, tok in tokens.items()})
+
+    def record_token_runs(self, runs: Dict[int, Sequence[int]],
+                          draft_stats: Optional[
+                              Dict[int, Tuple[int, int]]] = None
+                          ) -> List[FinishedRequest]:
+        """Record a RUN of kept tokens per slot — one token from a
+        plain decode/prefill dispatch, or ``m + 1`` from a speculative
+        verify dispatch that accepted ``m`` draft tokens (the accepted
+        drafts plus the dispatch's fresh bonus sample). Every token in
+        a run advances position by one: each was written to the cache
+        by the dispatch that produced it, except the LAST, which
+        becomes the new pending token — exactly the single-token
+        invariant, iterated. A mid-run EOS (or max_new) finishes the
+        request and DISCARDS the run's remainder: tokens past a stop
+        are never emitted, counted, or written back.
+
+        ``draft_stats`` (``{slot_id: (proposed, accepted)}``) settles
+        the speculative ledger for the dispatch that produced the runs
+        — rejected (rolled-back) drafts thus exist only in these
+        counters, never in ``total_tokens``/goodput."""
         now = self._clock()
         tracer = self.tracer
         done: List[FinishedRequest] = []
-        for sid, tok in tokens.items():
+        for sid, run in runs.items():
             slot = self.slots[sid]
             if slot is None:
                 raise KeyError(f"slot {sid} is not active")
-            tok = int(tok)
-            if slot.pending_tok is not None:
-                # the previous sample was written to the cache by the
-                # decode step that produced this one
-                slot.position += 1
             req = slot.request
-            if slot.ttft_ms is None:
-                slot.ttft_ms = (now - slot.t_submit) * 1e3
-                self._new_ttfts.append(slot.ttft_ms)
-                if tracer is not None:
-                    tracer.on_first_token(req.uid, slot.ttft_ms)
-            elif tracer is not None:
-                tracer.on_token(req.uid)
-            slot.tokens.append(tok)
-            slot.pending_tok = tok
-            self.total_tokens += 1
-            hit_eos = req.eos_id is not None and tok == req.eos_id
-            if hit_eos or len(slot.tokens) >= req.max_new_tokens:
-                # ttft_ms can only be None here for a request whose
-                # first token never arrived — impossible on this path
-                # (a token was just recorded) but the FinishedRequest
-                # contract allows it (eviction produces it), so
-                # downstream consumers must treat None as "no first
-                # token", never as 0.0
-                fin = FinishedRequest(
-                    uid=req.uid, prompt=list(req.prompt),
-                    tokens=list(slot.tokens),
-                    finish_reason="eos" if hit_eos else "length",
-                    ttft_ms=slot.ttft_ms,
-                    latency_ms=(now - slot.t_submit) * 1e3,
-                    queue_wait_ms=slot.queue_wait_ms)
+            if draft_stats is not None and sid in draft_stats:
+                proposed, accepted = draft_stats[sid]
+                slot.draft_proposed += int(proposed)
+                slot.draft_accepted += int(accepted)
+                if tracer is not None and proposed:
+                    tracer.on_spec(req.uid, int(proposed), int(accepted))
+            fin = None
+            for tok in run:
+                tok = int(tok)
+                if slot.pending_tok is not None:
+                    # the previous sample was written to the cache by
+                    # the dispatch that produced this one
+                    slot.position += 1
+                if slot.ttft_ms is None:
+                    slot.ttft_ms = (now - slot.t_submit) * 1e3
+                    self._new_ttfts.append(slot.ttft_ms)
+                    if tracer is not None:
+                        tracer.on_first_token(req.uid, slot.ttft_ms)
+                elif tracer is not None:
+                    tracer.on_token(req.uid)
+                slot.tokens.append(tok)
+                slot.pending_tok = tok
+                self.total_tokens += 1
+                hit_eos = req.eos_id is not None and tok == req.eos_id
+                if hit_eos or len(slot.tokens) >= req.max_new_tokens:
+                    # ttft_ms can only be None here for a request whose
+                    # first token never arrived — impossible on this
+                    # path (a token was just recorded) but the
+                    # FinishedRequest contract allows it (eviction
+                    # produces it), so downstream consumers must treat
+                    # None as "no first token", never as 0.0
+                    latency_ms = (now - slot.t_submit) * 1e3
+                    fin = FinishedRequest(
+                        uid=req.uid, prompt=list(req.prompt),
+                        tokens=list(slot.tokens),
+                        finish_reason="eos" if hit_eos else "length",
+                        ttft_ms=slot.ttft_ms,
+                        latency_ms=latency_ms,
+                        queue_wait_ms=slot.queue_wait_ms,
+                        tokens_per_s=(len(slot.tokens) * 1e3 /
+                                      latency_ms if latency_ms > 0
+                                      else None),
+                        draft_proposed=slot.draft_proposed,
+                        draft_accepted=slot.draft_accepted)
+                    break
+            if fin is not None:
                 done.append(fin)
                 self._release(slot)
                 self.slots[sid] = None
@@ -424,6 +524,40 @@ class Scheduler:
         self.peak_tokens_in_flight = max(self.peak_tokens_in_flight,
                                          self.tokens_in_flight)
         return done
+
+    def draft_proposals(self, cap: Optional[int] = None
+                        ) -> Dict[int, List[int]]:
+        """Host-side speculation for the next decode dispatch: for
+        every slot mid-decode, ask the drafter for up to
+        ``min(spec_k, cap, tokens left before max_new)`` continuation
+        tokens of the slot's full history (prompt + kept tokens — the
+        pending token is history too: it is what the verify dispatch
+        writes first). Slots the drafter has nothing for are simply
+        absent — they ride the verify dispatch as plain one-token
+        decode rows (a draft stall, not an error)."""
+        out: Dict[int, List[int]] = {}
+        if self.drafter is None or self.spec_k < 1:
+            return out
+        for sid in self.active_slots():
+            slot = self.slots[sid]
+            if slot.pending_tok is None:
+                continue
+            # the run a verify dispatch may emit is (accepted + 1)
+            # tokens; cap proposals so even full acceptance cannot
+            # overshoot max_new_tokens
+            k_row = min(self.spec_k,
+                        slot.request.max_new_tokens
+                        - len(slot.tokens) - 1)
+            if cap is not None:
+                k_row = min(k_row, cap)
+            if k_row < 1:
+                continue
+            history = list(slot.request.prompt) + slot.tokens
+            props = [int(t) for t in
+                     self.drafter.propose(history, k_row)][:k_row]
+            if props:
+                out[sid] = props
+        return out
 
     def drain_ttfts(self) -> List[float]:
         """TTFTs recorded since the last drain (telemetry pull — the
@@ -469,12 +603,18 @@ class Scheduler:
             slot = self.slots[sid]
             if slot.request.uid != uid:
                 continue
+            latency_ms = (now - slot.t_submit) * 1e3
             fin = FinishedRequest(
                 uid=uid, prompt=list(slot.request.prompt),
                 tokens=list(slot.tokens), finish_reason=reason,
                 ttft_ms=slot.ttft_ms,
-                latency_ms=(now - slot.t_submit) * 1e3,
-                queue_wait_ms=slot.queue_wait_ms)
+                latency_ms=latency_ms,
+                queue_wait_ms=slot.queue_wait_ms,
+                tokens_per_s=(len(slot.tokens) * 1e3 / latency_ms
+                              if slot.tokens and latency_ms > 0
+                              else None),
+                draft_proposed=slot.draft_proposed,
+                draft_accepted=slot.draft_accepted)
             self._release(slot)
             self.slots[sid] = None
             self.finished.append(fin)
@@ -509,10 +649,17 @@ class Scheduler:
         NARROWER than a slot's full reservation (the engine's
         live-page-bucketed decode width): the tail entries dropped are
         reserved-but-unreached pages this step can neither write nor
-        read, so the clamp is exact."""
+        read, so the clamp is exact. Slots with no pending token
+        (admitted but not yet claimed by the decode worker, under
+        disaggregation) keep all-null rows: their pages — possibly a
+        DIFFERENT pool's, or shared prefix pages — must never receive
+        the dispatch's garbage row writes."""
         out = np.zeros((rows, pages_per_seq), np.int32)
         for sid in self.active_slots():
-            pages = self.slots[sid].pages[:pages_per_seq]
+            slot = self.slots[sid]
+            if slot.pending_tok is None:
+                continue
+            pages = slot.pages[:pages_per_seq]
             out[sid, :len(pages)] = pages
         return out
 
@@ -520,11 +667,14 @@ class Scheduler:
         """Widest live page count across active slots for ONE decode
         step: slot at ``position`` writes its pending token at
         ``position`` and attends positions ``<= position`` —
-        ``position // page_size + 1`` pages. The engine buckets this up
-        to a compiled decode width (never below 1: an idle table still
-        needs its null column)."""
+        ``position // page_size + 1`` pages (slots parked awaiting a
+        disagg handoff claim count too: the width clamp is a dispatch
+        bucket, and a spuriously wide table is merely unclamped, never
+        wrong). The engine buckets this up to a compiled decode width
+        (never below 1: an idle table still needs its null column)."""
         if self.allocator is None:
             return 1
         ps = self.allocator.page_size
         return max((s.position // ps + 1
-                    for s in self.slots if s is not None), default=1)
+                    for s in self.slots if s is not None),
+                   default=1)

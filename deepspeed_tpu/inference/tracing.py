@@ -7,10 +7,24 @@ question a production serving system must answer per request, so every
 :class:`~.scheduler.Request` gets a stamped lifecycle trail written
 into the crash-safe ``events.jsonl``:
 
-    serve_submit -> [serve_defer (reason: pages | bucket | lookahead)]*
+    serve_submit -> [serve_defer (reason: pages | bucket | lookahead
+                                        | handoff | draft_stall)]*
                  -> [serve_prefix_hit] -> serve_admit -> serve_prefill
-                 -> serve_first_token -> [serve_decode_window]*
+                 -> [serve_handoff] -> serve_first_token
+                 -> [serve_decode_window | serve_spec_window]*
                  -> serve_finish | serve_evict
+
+Disaggregated serving (ISSUE 13) adds the ``serve_handoff`` row — the
+prefill->decode page-ownership transfer, with queue wait, measured
+transfer wall time, and the LinkModel-priced wire cost side by side —
+and splits TTFT into queue_wait / prefill / handoff / first_decode
+legs on the ``serve_first_token`` row. Speculative decoding adds
+sampled ``serve_spec_window`` rows (proposed vs accepted draft tokens
+per window) plus per-request draft counters on the finish row.
+Goodput stays honest by construction: only verified-and-KEPT tokens
+ever reach ``on_token``/``on_finish`` (the scheduler never records a
+rolled-back draft), so ``Serve/goodput_tokens_per_s`` cannot be
+inflated by speculation.
 
 plus a latency decomposition per request (queue_wait / prefill /
 time-between-tokens), bounded-histogram percentiles (p50/p95/p99 via
@@ -47,10 +61,13 @@ from deepspeed_tpu.utils.monitor import Histogram
 __all__ = ["ServeTracer", "DEFER_REASONS"]
 
 #: the pinned defer vocabulary (docs/observability.md event schema):
-#: "pages"      - page reservation failed (pool starvation)
-#: "bucket"     - ride-along skipped: prompt bucket != the head's
-#: "lookahead"  - outside the bounded admission window this round
-DEFER_REASONS = ("pages", "bucket", "lookahead")
+#: "pages"       - page reservation failed (pool starvation)
+#: "bucket"      - ride-along skipped: prompt bucket != the head's
+#: "lookahead"   - outside the bounded admission window this round
+#: "handoff"     - disagg: decode-pool claim bounced, handoff requeued
+#: "draft_stall" - speculation: drafter proposed nothing this dispatch
+#:                 (the slot rode the verify program with 0 drafts)
+DEFER_REASONS = ("pages", "bucket", "lookahead", "handoff", "draft_stall")
 
 
 @dataclass
@@ -75,6 +92,15 @@ class _ReqTrace:
     window_tokens: int = 0
     window_intervals: int = 0
     deferred: Set[str] = field(default_factory=set)
+    # disagg: prefill->decode handoff leg of TTFT (queue + transfer)
+    handoff_ms: Optional[float] = None
+    # speculation: per-request draft accounting + window sampling
+    spec_proposed: int = 0
+    spec_accepted: int = 0
+    spec_dispatches: int = 0
+    spec_window_proposed: int = 0
+    spec_window_accepted: int = 0
+    spec_window_dispatches: int = 0
 
 
 class ServeTracer:
@@ -120,7 +146,9 @@ class ServeTracer:
         self._clock = clock
         self._req: Dict[int, _ReqTrace] = {}
         self.hist = {"queue_wait_ms": Histogram(), "ttft_ms": Histogram(),
-                     "prefill_ms": Histogram(), "tbt_ms": Histogram()}
+                     "prefill_ms": Histogram(), "tbt_ms": Histogram(),
+                     "handoff_ms": Histogram(),
+                     "spec_accept_rate": Histogram()}
         # SLO / goodput accounting
         self.finished = 0
         self.finished_in_slo = 0
@@ -128,6 +156,12 @@ class ServeTracer:
         self.good_tokens = 0
         self.finished_tokens = 0
         self._step_tbts: List[float] = []
+        # global speculation / disagg counters (engine scalar writes +
+        # debug_state; per-request detail rides the event rows)
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.spec_dispatches = 0
+        self.handoffs = 0
 
     # ------------------------------------------------------------- sinks
     def _event(self, kind: str, **fields) -> None:
@@ -202,6 +236,66 @@ class ServeTracer:
                     prompt_bucket=int(prompt_bucket),
                     batch_bucket=int(batch_bucket), rows=int(rows))
 
+    def on_handoff(self, uid: int, queue_ms: float, transfer_ms: float,
+                   pages: int, bytes_moved: int, mode: str,
+                   priced_ms: Optional[float] = None) -> None:
+        """Disagg only: ``uid``'s prefill->decode page handoff was
+        claimed. ``queue_ms`` is the wait in the handoff queue,
+        ``transfer_ms`` the measured page-migration wall time (0 for a
+        shared-pool bookkeeping move), ``priced_ms`` the LinkModel's
+        prediction for the same bytes — measured and modeled ride the
+        row side by side. Called BEFORE the claim releases the first
+        token, so :meth:`on_first_token` can subtract the handoff leg
+        out of prefill time."""
+        if not self.enabled:
+            return
+        tr = self._req.get(uid)
+        total = max(queue_ms, 0.0) + max(transfer_ms, 0.0)
+        if tr is not None:
+            tr.handoff_ms = total
+        self.handoffs += 1
+        self.hist["handoff_ms"].record(total)
+        self._event("serve_handoff", uid=uid, mode=str(mode),
+                    queue_ms=self._r(queue_ms),
+                    transfer_ms=self._r(transfer_ms),
+                    handoff_ms=self._r(total),
+                    priced_ms=self._r(priced_ms),
+                    pages=int(pages), bytes_moved=int(bytes_moved))
+
+    def on_spec(self, uid: int, proposed: int, accepted: int) -> None:
+        """One verify dispatch's draft outcome for ``uid``: ``proposed``
+        draft tokens went in, ``accepted`` survived verification (the
+        scheduler only ever records the kept ones — this hook is pure
+        accounting, it does not touch token state). Emits a sampled
+        ``serve_spec_window`` row on the decode-window stride."""
+        if not self.enabled or proposed <= 0:
+            return
+        self.spec_proposed += proposed
+        self.spec_accepted += accepted
+        self.spec_dispatches += 1
+        self.hist["spec_accept_rate"].record(accepted / proposed)
+        tr = self._req.get(uid)
+        if tr is None:
+            return
+        tr.spec_proposed += proposed
+        tr.spec_accepted += accepted
+        tr.spec_dispatches += 1
+        tr.spec_window_proposed += proposed
+        tr.spec_window_accepted += accepted
+        tr.spec_window_dispatches += 1
+        if (self.window_tokens
+                and tr.spec_window_proposed >= self.window_tokens):
+            self._event(
+                "serve_spec_window", uid=uid,
+                proposed=tr.spec_window_proposed,
+                accepted=tr.spec_window_accepted,
+                dispatches=tr.spec_window_dispatches,
+                accept_rate=self._r(tr.spec_window_accepted
+                                    / tr.spec_window_proposed))
+            tr.spec_window_proposed = 0
+            tr.spec_window_accepted = 0
+            tr.spec_window_dispatches = 0
+
     def on_first_token(self, uid: int, ttft_ms: float) -> None:
         if not self.enabled:
             return
@@ -215,13 +309,17 @@ class ServeTracer:
         tr.window_t0 = now
         tr.window_tokens = 1
         tr.window_intervals = 0
-        prefill_ms = (ttft_ms - tr.queue_wait_ms
+        # TTFT decomposition: queue_wait + prefill (+ handoff under
+        # disagg; the handoff leg is 0/absent otherwise, so the legacy
+        # two-way split is the same number)
+        prefill_ms = (ttft_ms - tr.queue_wait_ms - (tr.handoff_ms or 0.0)
                       if tr.queue_wait_ms is not None else None)
         self.hist["ttft_ms"].record(ttft_ms)
         if prefill_ms is not None:
             self.hist["prefill_ms"].record(max(prefill_ms, 0.0))
         self._event("serve_first_token", uid=uid, ttft_ms=self._r(ttft_ms),
-                    prefill_ms=self._r(prefill_ms))
+                    prefill_ms=self._r(prefill_ms),
+                    handoff_ms=self._r(tr.handoff_ms))
 
     def on_token(self, uid: int) -> None:
         """One decode token for ``uid``: a time-between-tokens sample,
@@ -274,6 +372,7 @@ class ServeTracer:
         tbt_mean = (tr.tbt_sum / (tr.n_tokens - 1)
                     if tr.n_tokens > 1 else None)
         prefill_ms = (fin.ttft_ms - tr.queue_wait_ms
+                      - (tr.handoff_ms or 0.0)
                       if fin.ttft_ms is not None
                       and tr.queue_wait_ms is not None else None)
         slo_ok = self._account(fin, evicted, tbt_mean)
@@ -283,10 +382,13 @@ class ServeTracer:
                     latency_ms=self._r(fin.latency_ms),
                     queue_wait_ms=self._r(tr.queue_wait_ms),
                     prefill_ms=self._r(prefill_ms),
+                    handoff_ms=self._r(tr.handoff_ms),
                     tbt_ms=self._r(tbt_mean),
                     tbt_ms_max=self._r(tr.tbt_max if tr.n_tokens > 1
                                        else None),
-                    slo_ok=slo_ok)
+                    slo_ok=slo_ok,
+                    draft_proposed=tr.spec_proposed,
+                    draft_accepted=tr.spec_accepted)
         self._lanes(tr)
 
     def _account(self, fin, evicted: bool,
@@ -344,6 +446,14 @@ class ServeTracer:
             return None
         return self.finished_in_slo / self.finished
 
+    @property
+    def spec_accept_rate(self) -> Optional[float]:
+        """Lifetime accepted/proposed draft ratio (None before the
+        first verify dispatch with live drafts)."""
+        if not self.spec_proposed:
+            return None
+        return self.spec_accepted / self.spec_proposed
+
     # ----------------------------------------------------------- reports
     def snapshot(self) -> Dict[str, Any]:
         """The SLO/latency block of ``engine.debug_state()`` and the
@@ -361,5 +471,12 @@ class ServeTracer:
             "good_tokens": self.good_tokens,
             "finished_tokens": self.finished_tokens,
             "in_flight": len(self._req),
+            "spec": {"proposed": self.spec_proposed,
+                     "accepted": self.spec_accepted,
+                     "dispatches": self.spec_dispatches,
+                     "accept_rate": (round(self.spec_accept_rate, 4)
+                                     if self.spec_accept_rate is not None
+                                     else None)},
+            "handoffs": self.handoffs,
             "latency": {k: h.snapshot() for k, h in self.hist.items()},
         }

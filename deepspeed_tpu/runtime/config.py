@@ -636,6 +636,32 @@ def get_inference_config(param_dict):
     }
     mesh_sub = sub.get(C.INF_MESH, {}) or {}
     cfg["mesh"] = {"axes": dict(mesh_sub.get(C.INF_MESH_AXES, {}) or {})}
+    sd = sub.get(C.INF_SPEC_DECODE, {}) or {}
+    cfg["spec_decode"] = {
+        "enabled": bool(sd.get(C.INF_SPEC_ENABLED,
+                               C.INF_SPEC_ENABLED_DEFAULT)),
+        "k": int(sd.get(C.INF_SPEC_K, C.INF_SPEC_K_DEFAULT)),
+        "method": str(sd.get(C.INF_SPEC_METHOD,
+                             C.INF_SPEC_METHOD_DEFAULT)),
+        "ngram_min": int(sd.get(C.INF_SPEC_NGRAM_MIN,
+                                C.INF_SPEC_NGRAM_MIN_DEFAULT)),
+        "ngram_max": int(sd.get(C.INF_SPEC_NGRAM_MAX,
+                                C.INF_SPEC_NGRAM_MAX_DEFAULT)),
+        "verify_widths": list(sd.get(C.INF_SPEC_VERIFY_WIDTHS,
+                                     C.INF_SPEC_VERIFY_WIDTHS_DEFAULT)),
+    }
+    dg = sub.get(C.INF_DISAGG, {}) or {}
+    dg_mesh = dg.get(C.INF_DISAGG_DECODE_MESH, {}) or {}
+    cfg["disagg"] = {
+        "enabled": bool(dg.get(C.INF_DISAGG_ENABLED,
+                               C.INF_DISAGG_ENABLED_DEFAULT)),
+        "separate_pools": dg.get(C.INF_DISAGG_SEPARATE_POOLS,
+                                 C.INF_DISAGG_SEPARATE_POOLS_DEFAULT),
+        "prefill_pages": int(dg.get(C.INF_DISAGG_PREFILL_PAGES,
+                                    C.INF_DISAGG_PREFILL_PAGES_DEFAULT)),
+        "decode_mesh": {"axes": dict(
+            dg_mesh.get(C.INF_MESH_AXES, {}) or {})},
+    }
     try:
         cfg["prompt_buckets"] = list(validate_buckets(
             cfg["prompt_buckets"], "inference.prompt_buckets"))
@@ -685,18 +711,67 @@ def get_inference_config(param_dict):
                 "inference.paged_kv.decode_page_buckets"))
         except ValueError as e:
             raise DeepSpeedConfigError(str(e))
-    for name, size in cfg["mesh"]["axes"].items():
-        if name != "model":
-            # the serving programs shard params/cache over the 'model'
-            # axis only today; an unknown axis would otherwise surface
-            # as an opaque jax resource error deep in engine init
+    for where, axes in (("inference.mesh", cfg["mesh"]["axes"]),
+                        ("inference.disagg.decode_mesh",
+                         cfg["disagg"]["decode_mesh"]["axes"])):
+        for name, size in axes.items():
+            if name != "model":
+                # the serving programs shard params/cache over the
+                # 'model' axis only today; an unknown axis would
+                # otherwise surface as an opaque jax resource error
+                # deep in engine init
+                raise DeepSpeedConfigError(
+                    f"{where}.axes supports only the 'model' "
+                    f"(tensor-parallel) axis, got {name!r}")
+            if not isinstance(size, int) or size < 1:
+                raise DeepSpeedConfigError(
+                    f"{where}.axes entries must be positive ints, "
+                    f"got {name}={size!r}")
+    sdc = cfg["spec_decode"]
+    if sdc["enabled"] and not pkc["enabled"]:
+        raise DeepSpeedConfigError(
+            "inference.spec_decode requires paged_kv.enabled (rollback "
+            "is a block-table/position edit on the page pool)")
+    if sdc["k"] < 1 or sdc["k"] >= cfg["max_seq_len"]:
+        raise DeepSpeedConfigError(
+            f"inference.spec_decode.k must be in [1, max_seq_len), got "
+            f"{sdc['k']}")
+    if sdc["method"] not in ("ngram", "callable"):
+        raise DeepSpeedConfigError(
+            f"inference.spec_decode.method must be 'ngram' or "
+            f"'callable', got {sdc['method']!r}")
+    if sdc["ngram_min"] < 1 or sdc["ngram_max"] < sdc["ngram_min"]:
+        raise DeepSpeedConfigError(
+            "inference.spec_decode: 1 <= ngram_min <= ngram_max "
+            f"required, got [{sdc['ngram_min']}, {sdc['ngram_max']}]")
+    if sdc["verify_widths"]:
+        try:
+            sdc["verify_widths"] = list(validate_buckets(
+                sdc["verify_widths"],
+                "inference.spec_decode.verify_widths"))
+        except ValueError as e:
+            raise DeepSpeedConfigError(str(e))
+        if min(sdc["verify_widths"]) < 2:
+            # width 1 IS the plain decode program; a verify program
+            # only exists to check >= 1 draft token in one dispatch
             raise DeepSpeedConfigError(
-                f"inference.mesh.axes supports only the 'model' "
-                f"(tensor-parallel) axis, got {name!r}")
-        if not isinstance(size, int) or size < 1:
-            raise DeepSpeedConfigError(
-                f"inference.mesh.axes entries must be positive ints, "
-                f"got {name}={size!r}")
+                "inference.spec_decode.verify_widths entries must be "
+                ">= 2 (width 1 is the plain decode program)")
+    dgc = cfg["disagg"]
+    if dgc["enabled"] and not pkc["enabled"]:
+        raise DeepSpeedConfigError(
+            "inference.disagg requires paged_kv.enabled (the handoff "
+            "transfers page ownership between worker loops)")
+    if dgc["separate_pools"] is not None:
+        dgc["separate_pools"] = bool(dgc["separate_pools"])
+    if dgc["prefill_pages"] < 0 or dgc["prefill_pages"] == 1:
+        raise DeepSpeedConfigError(
+            f"inference.disagg.prefill_pages must be 0 (auto) or >= 2, "
+            f"got {dgc['prefill_pages']}")
+    if dgc["decode_mesh"]["axes"] and not dgc["enabled"]:
+        raise DeepSpeedConfigError(
+            "inference.disagg.decode_mesh.axes set but disagg.enabled "
+            "is false")
     return cfg
 
 

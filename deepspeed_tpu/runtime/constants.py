@@ -456,6 +456,27 @@ CHECKPOINT_SUPERVISOR_BACKOFF_DEFAULT = 1.0
 #   "mesh": {                     # serving mesh (GSPMD NamedShardings)
 #     "axes": {}                  # e.g. {"model": 4}: tensor-parallel
 #                                 # prefill/decode over ICI
+#   },
+#   "spec_decode": {              # speculative multi-token decoding
+#     "enabled": false,           # requires paged_kv.enabled
+#     "k": 4,                     # max draft tokens proposed/dispatch
+#     "method": "ngram",          # "ngram" (prompt-lookup; host-side,
+#                                 # no second model) | "callable"
+#                                 # (engine-injected small draft model)
+#     "ngram_min": 1,             # shortest suffix match tried
+#     "ngram_max": 3,             # longest suffix match tried first
+#     "verify_widths": []         # compiled verify seq widths;
+#                                 # [] = one program at k + 1
+#   },
+#   "disagg": {                   # disaggregated prefill/decode workers
+#     "enabled": false,           # requires paged_kv.enabled
+#     "separate_pools": null,     # null = auto (true iff decode_mesh
+#                                 # axes set); true forces a prefill
+#                                 # pool + priced page handoff
+#     "prefill_pages": 0,         # prefill pool size; 0 = auto
+#     "decode_mesh": {            # decode worker's own mesh (else the
+#       "axes": {}                # decode loop shares inference.mesh)
+#     }
 #   }
 # }
 #############################################
@@ -499,6 +520,27 @@ INF_PAGED_DECODE_PAGE_BUCKETS = "decode_page_buckets"
 INF_PAGED_DECODE_PAGE_BUCKETS_DEFAULT = ()  # () = one full-width program
 INF_MESH = "mesh"
 INF_MESH_AXES = "axes"
+INF_SPEC_DECODE = "spec_decode"
+INF_SPEC_ENABLED = "enabled"
+INF_SPEC_ENABLED_DEFAULT = False
+INF_SPEC_K = "k"
+INF_SPEC_K_DEFAULT = 4
+INF_SPEC_METHOD = "method"
+INF_SPEC_METHOD_DEFAULT = "ngram"
+INF_SPEC_NGRAM_MIN = "ngram_min"
+INF_SPEC_NGRAM_MIN_DEFAULT = 1
+INF_SPEC_NGRAM_MAX = "ngram_max"
+INF_SPEC_NGRAM_MAX_DEFAULT = 3
+INF_SPEC_VERIFY_WIDTHS = "verify_widths"
+INF_SPEC_VERIFY_WIDTHS_DEFAULT = ()  # () = one program at k + 1
+INF_DISAGG = "disagg"
+INF_DISAGG_ENABLED = "enabled"
+INF_DISAGG_ENABLED_DEFAULT = False
+INF_DISAGG_SEPARATE_POOLS = "separate_pools"
+INF_DISAGG_SEPARATE_POOLS_DEFAULT = None  # auto: decode_mesh axes set
+INF_DISAGG_PREFILL_PAGES = "prefill_pages"
+INF_DISAGG_PREFILL_PAGES_DEFAULT = 0     # 0 = auto
+INF_DISAGG_DECODE_MESH = "decode_mesh"
 
 TENSORBOARD = "tensorboard"
 TENSORBOARD_ENABLED = "enabled"

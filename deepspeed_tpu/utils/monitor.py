@@ -46,6 +46,12 @@ TAG_SERVE_TBT = "Serve/tbt_ms"                      # per decode dispatch
 #                                  (mean per-request time-between-tokens)
 TAG_SERVE_SLO = "Serve/slo_attainment"              # finished-in-SLO frac
 TAG_SERVE_GOODPUT = "Serve/goodput_tokens_per_s"    # within-SLO tokens/s
+# disagg + speculative decoding plane (ISSUE 13): draft acceptance per
+# verify dispatch and the prefill->decode handoff leg of TTFT
+TAG_SERVE_SPEC_ACCEPT = "Serve/spec_accept_rate"    # accepted/proposed
+#                                                     per verify dispatch
+TAG_SERVE_HANDOFF = "Serve/handoff_ms"              # per claimed handoff
+#                                                     (queue + transfer)
 # elastic / async-checkpoint plane (ISSUE 10): snapshot-vs-write split
 # of every save, the async writer's backlog, and how many times the
 # supervisor has relaunched this run. Canonical home — profiling/
@@ -375,6 +381,7 @@ class TensorBoardMonitor:
                               decode_attn_path=None, queue_wait_ms=None,
                               tbt_ms=None, slo_attainment=None,
                               goodput_tokens_per_s=None,
+                              spec_accept_rate=None, handoff_ms=None,
                               tokens: int = 0, flush: bool = True):
         """Serving telemetry (inference engine; TPU-native extension —
         the reference snapshot is training-only): time-to-first-token
@@ -430,6 +437,11 @@ class TensorBoardMonitor:
         if goodput_tokens_per_s is not None:
             self.write_scalar(TAG_SERVE_GOODPUT, goodput_tokens_per_s,
                               tokens)
+        if spec_accept_rate is not None:
+            self.write_scalar(TAG_SERVE_SPEC_ACCEPT, spec_accept_rate,
+                              tokens)
+        if handoff_ms is not None:
+            self.write_scalar(TAG_SERVE_HANDOFF, handoff_ms, tokens)
         if flush:
             self.flush()
 

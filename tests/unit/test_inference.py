@@ -117,7 +117,8 @@ def test_host_side_scheduling_modules_stay_jax_free():
 
     import deepspeed_tpu.inference as inf
     root = pathlib.Path(inf.__file__).parent
-    for mod in ("scheduler.py", "paging.py", "buckets.py", "tracing.py"):
+    for mod in ("scheduler.py", "paging.py", "buckets.py", "tracing.py",
+                "draft.py", "disagg.py"):
         src = (root / mod).read_text()
         for node in ast.walk(ast.parse(src)):
             if isinstance(node, ast.Import):
@@ -426,6 +427,11 @@ class TestInferenceEngine:
             obs_report.T_GOODPUT
         assert m.TAG_SERVE_PREFIX_HIT == prof.TAG_SERVE_PREFIX_HIT == \
             obs_report.T_PREFIX_HIT
+        # ISSUE 13: speculation + disaggregation scalars
+        assert m.TAG_SERVE_SPEC_ACCEPT == prof.TAG_SERVE_SPEC_ACCEPT == \
+            obs_report.T_SPEC_ACCEPT == "Serve/spec_accept_rate"
+        assert m.TAG_SERVE_HANDOFF == prof.TAG_SERVE_HANDOFF == \
+            obs_report.T_HANDOFF == "Serve/handoff_ms"
 
     def test_rejects_unservable_config(self):
         from deepspeed_tpu.inference import InferenceEngine

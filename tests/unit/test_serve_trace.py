@@ -67,6 +67,10 @@ TRAIL_SCHEMA = {
     "serve_prefill": {"uid", "slot", "wall_ms", "prompt_bucket",
                       "batch_bucket", "rows"},
     "serve_first_token": {"uid", "ttft_ms", "prefill_ms"},
+    "serve_handoff": {"uid", "mode", "queue_ms", "transfer_ms",
+                      "handoff_ms", "pages", "bytes_moved"},
+    "serve_spec_window": {"uid", "proposed", "accepted", "dispatches",
+                          "accept_rate"},
     "serve_decode_window": {"uid", "tokens", "end_token", "window_ms",
                             "tbt_ms"},
     "serve_finish": {"uid", "reason", "new_tokens", "ttft_ms",

@@ -84,6 +84,10 @@ T_QUEUE_WAIT = "Serve/queue_wait_ms"
 T_TBT = "Serve/tbt_ms"
 T_SLO = "Serve/slo_attainment"
 T_GOODPUT = "Serve/goodput_tokens_per_s"
+# disagg + speculative decoding plane (ISSUE 13): draft acceptance per
+# verify dispatch, prefill->decode handoff leg of TTFT
+T_SPEC_ACCEPT = "Serve/spec_accept_rate"
+T_HANDOFF = "Serve/handoff_ms"
 # elastic / async-checkpoint plane (utils/monitor.py
 # write_elastic_metrics): snapshot-vs-write decomposition of each save,
 # async writer backlog, supervisor restart count; the `preemption` /
@@ -295,6 +299,47 @@ def summarize(path, host_gap_threshold=DEFAULT_HOST_GAP_THRESHOLD):
             (str(attn_event.get("path")) if attn_event else None)),
         "decode_attn_reason": (str(attn_event.get("reason"))
                                if attn_event else None),
+    }
+    # disagg + speculation view (ISSUE 13; absent -> counts 0, keys
+    # None). Accept-rate percentiles come from the per-verify-dispatch
+    # scalar rows; the serve_state "spec" block carries the lifetime
+    # accepted/proposed counters for mean-accepted-per-dispatch.
+    spec_rows = _vals(scalars, T_SPEC_ACCEPT)
+    handoff_rows = _vals(scalars, T_HANDOFF)
+    handoff_events = [e for e in events
+                      if e.get("event") == "serve_handoff"]
+    spec_windows = [e for e in events
+                    if e.get("event") == "serve_spec_window"]
+    state_spec = ((serve_state or {}).get("spec")
+                  or state_slo.get("spec") or {})
+    spec_disp = state_spec.get("dispatches") or len(spec_rows)
+    accepted = state_spec.get("accepted")
+    serving["speculation"] = {
+        "dispatches": spec_disp,
+        "proposed": state_spec.get("proposed"),
+        "accepted": accepted,
+        "accept_rate": {"p50": percentile(spec_rows, 0.50),
+                        "p95": percentile(spec_rows, 0.95),
+                        "lifetime": state_spec.get("accept_rate")},
+        # accepted drafts per verify dispatch; +1 target token always
+        # rides on top, so tokens/dispatch = this + 1
+        "accepted_per_dispatch": (accepted / spec_disp
+                                  if accepted is not None and spec_disp
+                                  else None),
+        "window_rows": len(spec_windows),
+    }
+    if not handoff_rows:       # scalar plane absent: use the event rows
+        handoff_rows = [float(e["handoff_ms"]) for e in handoff_events
+                        if e.get("handoff_ms") is not None]
+    serving["disagg"] = {
+        "handoffs": ((serve_state or {}).get("handoffs")
+                     or state_slo.get("handoffs")
+                     or len(handoff_events) or len(handoff_rows)),
+        "handoff_ms": {"p50": percentile(handoff_rows, 0.50),
+                       "p95": percentile(handoff_rows, 0.95)},
+        "requeues": sum(1 for e in events
+                        if e.get("event") == "serve_defer"
+                        and e.get("reason") == "handoff"),
     }
 
     ckpt = {"saves": 0, "loads": 0, "fallbacks": 0, "save_ms": []}
@@ -616,6 +661,24 @@ def render_serve(s):
     lines.append(f"  occupancy         : mean={_fmt(occ, '{:.1%}')} "
                  f"queue_depth_max="
                  f"{_fmt(sv.get('queue_depth_max'), '{:.0f}')}")
+    spec = sv.get("speculation") or {}
+    if spec.get("dispatches"):
+        ar = spec.get("accept_rate") or {}
+        lines.append(
+            f"  speculation       : "
+            f"{_fmt(spec.get('accepted_per_dispatch'), '{:.2f}')} "
+            f"accepted drafts/dispatch over {spec['dispatches']} verify "
+            f"dispatches (accept_rate p50="
+            f"{_fmt(ar.get('p50'), '{:.1%}')} "
+            f"p95={_fmt(ar.get('p95'), '{:.1%}')}, "
+            f"lifetime={_fmt(ar.get('lifetime'), '{:.1%}')})")
+    dg = sv.get("disagg") or {}
+    if dg.get("handoffs"):
+        hm = dg.get("handoff_ms") or {}
+        lines.append(
+            f"  disagg_handoff    : {dg['handoffs']} handoffs, "
+            f"p50={_fmt(hm.get('p50'))} p95={_fmt(hm.get('p95'))} ms, "
+            f"requeues={dg.get('requeues', 0)}")
     return "\n".join(lines)
 
 
