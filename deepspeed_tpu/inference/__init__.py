@@ -19,6 +19,7 @@ from deepspeed_tpu.inference.draft import (CallableDrafter, NGramDrafter,
                                            make_drafter)
 from deepspeed_tpu.inference.engine import (InferenceEngine,
                                             qwz_distribute_params)
+from deepspeed_tpu.inference.fleet import FleetRouter, ReplicaHandle
 from deepspeed_tpu.inference.kv_cache import (KVCacheSpec, PageAllocator,
                                               PagedKVSpec, cache_spec_for,
                                               init_kv_cache,
@@ -38,5 +39,5 @@ __all__ = [
     "pages_for", "pick_bucket", "pad_prompts", "validate_buckets",
     "warmup_plan", "qwz_distribute_params", "NGramDrafter",
     "CallableDrafter", "make_drafter", "HandoffQueue", "HandoffRecord",
-    "DispatchTrace", "price_handoff",
+    "DispatchTrace", "price_handoff", "FleetRouter", "ReplicaHandle",
 ]

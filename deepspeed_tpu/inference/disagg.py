@@ -111,6 +111,18 @@ class HandoffQueue:
         scheduler's eviction path)."""
         self.total_dropped += 1
 
+    def pop(self, uid: int) -> Optional[HandoffRecord]:
+        """Remove and return the queued record for ``uid`` (None if no
+        record waits). Cancellation uses this: a record left in the
+        queue after its slot is evicted would sit as a phantom entry
+        until the next claim drain — or forever, if the eviction made
+        the scheduler idle and the serving loop exits."""
+        for i, rec in enumerate(self._q):
+            if rec.uid == uid:
+                del self._q[i]
+                return rec
+        return None
+
     def debug_state(self) -> Dict[str, int]:
         return {"depth": len(self._q), "peak_depth": self.peak_depth,
                 "handoffs": self.total_handoffs,

@@ -141,6 +141,29 @@ def _normalize_inference_config(inference_config) -> Dict[str, Any]:
         {"inference": dict(inference_config or {})})
 
 
+def _resolve_committed_tag(ckptlib, load_dir: str, tag: Optional[str],
+                           verify_integrity: bool) -> str:
+    """The one committed-tag pre-flight all serving loads share
+    (``from_checkpoint``, ``swap_params``, and — via
+    ``tools/verify_checkpoint.py --serve-ready`` — the supervisor that
+    pushes swaps): newest committed tag wins when ``tag`` is None,
+    corrupt/uncommitted/model-states-less tags are skipped with a
+    warning, and a tag that survives is loadable by definition."""
+    candidates = [tag] if tag is not None else \
+        ckptlib.candidate_tags(load_dir)
+    for t in candidates:
+        d = os.path.join(load_dir, t)
+        ok, problems = ckptlib.verify_checkpoint_dir(
+            d, check_crc=verify_integrity)
+        if ok and ckptlib.state_groups(d)["model_states"]:
+            return d
+        logger.warning(f"serving checkpoint pre-flight: skipping {d}: "
+                       f"{problems or 'no model_states group'}")
+    raise FileNotFoundError(
+        f"no loadable committed checkpoint with model_states "
+        f"under {load_dir} (tag={tag!r})")
+
+
 def _serving_mesh(cfg, mesh=None):
     """The serving mesh from ``inference.mesh.axes`` (or an injected
     one); None for single-device serving."""
@@ -422,6 +445,14 @@ class InferenceEngine:
                                    admit_allocator=admit_allocator,
                                    drafter=self._drafter,
                                    spec_k=self._spec_k)
+        # serving-weights version stamp: "initial" for constructor
+        # params; from_checkpoint / swap_params overwrite it with the
+        # checkpoint tag. The ordinal counts committed swaps (the
+        # Serve/weight_version scalar — tags are strings, scalars
+        # aren't).
+        self._weight_version = "initial"
+        self._weight_ordinal = 0
+        self.scheduler.weight_version = self._weight_version
 
         if self.paged:
             self._prefill = self._wrap_program(
@@ -777,7 +808,125 @@ class InferenceEngine:
         request was evicted before its first token. None for unknown/
         finished uids. Call between :meth:`step` calls, not inside
         one."""
+        # disagg: a prefill-complete request can be waiting in the
+        # handoff queue — pop its record NOW, before the slot eviction
+        # below. Left queued it would sit as a phantom entry (depth
+        # stays wrong, `dropped` never counted), and if this eviction
+        # makes the scheduler idle, the serving loop exits with the
+        # stale record still holding the queue — no later claim drain
+        # ever voids it. The slot's page reservation itself is released
+        # by ``scheduler.evict`` (``_release`` frees from whichever
+        # pool owns the slot's pages).
+        if self._handoff_q is not None:
+            rec = self._handoff_q.pop(uid)
+            if rec is not None:
+                self._handoff_q.dropped(rec)
         return self.scheduler.evict(uid, reason=reason)
+
+    # ------------------------------------------------- live weight swap
+    @property
+    def weight_version(self) -> str:
+        """The checkpoint tag currently serving ("initial" for
+        constructor-supplied params) — stamped onto every
+        FinishedRequest."""
+        return self._weight_version
+
+    @property
+    def weight_ordinal(self) -> int:
+        """Committed swap count (the ``Serve/weight_version`` scalar:
+        0 = the weights the engine started with)."""
+        return self._weight_ordinal
+
+    def swap_params(self, load_dir: str, tag: Optional[str] = None,
+                    verify_integrity: bool = True) -> str:
+        """Push a newly committed checkpoint tag into the RUNNING
+        engine — the live half of the train->serve loop.
+
+        Loads the tag's ``model_states`` group through
+        ``load_params_only`` with the engine's live params as the
+        template, so every new leaf materializes with the OLD leaf's
+        dtype and sharding: the compiled program set keys on
+        aval+sharding, both are unchanged, and steady-state serving
+        continues with zero recompiles. The swap is atomic-or-rollback:
+        nothing is assigned until the whole tree has loaded, so any
+        failure (bad tag, I/O error, injected ``serve.swap_load``
+        fault) leaves the engine serving the old weights untouched.
+
+        Call between :meth:`step` calls (same contract as
+        :meth:`cancel`); in-flight requests switch weights at their
+        next dispatch — their KV prefix stays valid (same model
+        geometry), which is the standard live-upgrade semantic.
+        Returns the new version stamp (the tag name)."""
+        from deepspeed_tpu.runtime import checkpoint as ckptlib
+        from deepspeed_tpu.runtime import fault
+        t0 = time.perf_counter()
+        try:
+            chosen = _resolve_committed_tag(ckptlib, load_dir, tag,
+                                            verify_integrity)
+            version = os.path.basename(chosen)
+            fault.fire("serve.swap_load", path=chosen, version=version)
+            new_params = ckptlib.load_params_only(
+                chosen, self.params, self._param_shardings)
+        except BaseException as e:
+            if self._log is not None:
+                self._log.add_event(
+                    "fleet_swap", ok=False, tag=tag,
+                    load_dir=str(load_dir),
+                    error=str(e) or type(e).__name__,
+                    weight_version=self._weight_version,
+                    weight_ordinal=self._weight_ordinal)
+            logger.warning(
+                f"swap_params: load failed ({e!r}); still serving "
+                f"weight_version={self._weight_version}")
+            raise
+        if self.mesh is None:
+            # single-device serving: construction built params with
+            # ``jnp.asarray`` (UNcommitted); the loader returns
+            # committed arrays, and jit specializes on committedness —
+            # the host round-trip restores the constructor's placement
+            # so the warm program set keys hit (zero recompiles)
+            new_params = jax.tree_util.tree_map(
+                lambda x: jnp.asarray(np.asarray(x)), new_params)
+        # commit — from here on every dispatch sees the new weights
+        alias = self.params_decode is self.params
+        self.params = new_params
+        if alias:
+            self.params_decode = new_params
+        else:
+            # disagg decode mesh: re-ship the decode workers' copy onto
+            # their own shardings (weights move once per swap, exactly
+            # like construction)
+            self.params_decode = jax.tree_util.tree_map(
+                lambda x, s: jax.device_put(x, s),
+                new_params, self._param_shardings_decode)
+        self._weight_version = version
+        self._weight_ordinal += 1
+        self.scheduler.weight_version = version
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        if self._log is not None:
+            self._log.add_event(
+                "fleet_swap", ok=True, checkpoint=chosen,
+                weight_version=version,
+                weight_ordinal=self._weight_ordinal,
+                wall_ms=round(wall_ms, 3))
+        self.monitor.write_serving_metrics(
+            weight_version=self._weight_ordinal,
+            tokens=self.scheduler.total_tokens)
+        logger.info(f"swap_params: now serving {version} "
+                    f"(ordinal {self._weight_ordinal}, "
+                    f"{wall_ms:.1f} ms, zero recompiles by construction)")
+        return version
+
+    def set_speculation(self, on: bool) -> bool:
+        """Degrade rung of the fleet shed ladder: toggle speculative
+        decoding without touching the compiled program set (the plain
+        one-token decode program is part of the warmed set, so turning
+        drafting off never recompiles). Returns False — and does
+        nothing — on an engine built without spec_decode."""
+        if not self.spec:
+            return False
+        self.scheduler.spec_k = self._spec_k if on else 0
+        return True
 
     def debug_state(self) -> Dict[str, Any]:
         """Live introspection snapshot — pure host reads, zero device
@@ -822,6 +971,8 @@ class InferenceEngine:
             "steady_state_recompiles": self.steady_state_recompiles,
             "page_pool": pool,
             "slo": self._tracer.snapshot(),
+            "weight_version": self._weight_version,
+            "weight_ordinal": self._weight_ordinal,
         }
         if self.spec:
             state["spec_decode"] = {
@@ -1339,22 +1490,8 @@ class InferenceEngine:
         (:func:`qwz_distribute_params`)."""
         from deepspeed_tpu.runtime import checkpoint as ckptlib
         cfg = _normalize_inference_config(inference_config)
-        candidates = [tag] if tag is not None else \
-            ckptlib.candidate_tags(load_dir)
-        chosen = None
-        for t in candidates:
-            d = os.path.join(load_dir, t)
-            ok, problems = ckptlib.verify_checkpoint_dir(
-                d, check_crc=verify_integrity)
-            if ok and ckptlib.state_groups(d)["model_states"]:
-                chosen = d
-                break
-            logger.warning(f"from_checkpoint: skipping {d}: "
-                           f"{problems or 'no model_states group'}")
-        if chosen is None:
-            raise FileNotFoundError(
-                f"no loadable committed checkpoint with model_states "
-                f"under {load_dir} (tag={tag!r})")
+        chosen = _resolve_committed_tag(ckptlib, load_dir, tag,
+                                        verify_integrity)
         _, _, init_fn, specs_fn = _family_of(model_config)
         template = jax.eval_shape(
             lambda k: init_fn(model_config, k), jax.random.PRNGKey(0))
@@ -1376,6 +1513,8 @@ class InferenceEngine:
                      monitor=monitor, mesh=mesh,
                      observability_config=observability_config,
                      draft_fn=draft_fn)
+        engine._weight_version = os.path.basename(chosen)
+        engine.scheduler.weight_version = engine._weight_version
         if engine._log is not None:
             engine._log.add_event(
                 "serve_load", checkpoint=chosen,

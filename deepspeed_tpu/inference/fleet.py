@@ -1,0 +1,515 @@
+"""The serving fleet: a multi-replica router over in-process engines.
+
+One engine is one failure domain: a single SIGTERM, a hot queue, or a
+weight push takes the whole service down. :class:`FleetRouter` fronts
+N :class:`~.engine.InferenceEngine` replicas (built by the caller —
+this module never constructs device state) and keeps the service
+answering, correctly and within SLO, while individual replicas are
+preempted, overloaded, or being upgraded. Three legs:
+
+**Routing + SLO shedding.** Requests route ``least_loaded`` (queue
+depth + active slots) or ``prefix_affinity`` (the replica whose prefix
+cache already covers the most prompt tokens, ties broken least-loaded)
+off each engine's host-side introspection — no device syncs. Admission
+is SLO-aware: when the fleet's p95 TTFT (the PR 9 goodput histograms)
+breaches ``slo_shed.ttft_budget_ms``, the shed ladder engages —
+*goodput, not throughput, is the objective*:
+
+    rung 1 (p95 > budget)          reject requests below the
+                                   ``shed_below_priority`` tier
+                                   ("shed_slo" — a synthesized
+                                   zero-token response, never a drop)
+    rung 2 (p95 > budget x factor) additionally cap admitted requests'
+                                   max_new_tokens ("degrade_max_new")
+                                   and switch speculation off fleet-wide
+                                   ("degrade_spec_off" — the plain
+                                   decode program is already warm, so
+                                   the ladder never recompiles)
+
+Every shed decision lands in the serve trail (``fleet_shed`` rows)
+with a reason from the pinned :data:`~.tracing.SHED_REASONS`
+vocabulary.
+
+**Replica drain.** Each replica carries a
+:class:`~deepspeed_tpu.runtime.elastic.PreemptionGuard`; a SIGTERM (or
+software ``request_preemption``, or :meth:`FleetRouter.drain`) flips it
+and the router reacts at the next step: the replica stops receiving
+work, its queued (not-yet-admitted) requests are cancelled with reason
+"drain" and resubmitted — same ``Request`` objects, same uids, same
+per-request seeds — to surviving replicas, where the prefix cache
+re-prefills them; in-flight requests finish where they are. Greedy
+outputs are bitwise unchanged because sampling is per-request-seeded
+and batch-composition-independent. When its last slot empties the
+replica retires (``fleet_drain`` rows bracket the episode).
+
+**Live weight swap.** :meth:`swap_weights` pushes a committed
+checkpoint tag into every running replica between dispatches via
+``engine.swap_params`` (``load_params_only`` into the existing serving
+shardings — zero recompiles, fixed program set, atomic-or-rollback per
+replica). Every ``FinishedRequest`` carries the ``weight_version``
+that produced it.
+
+This module is jax-free (pinned source-level next to scheduler/
+paging/disagg by tests/unit/test_inference.py): it orchestrates
+engines purely through their host-side surface, so routing policy is
+unit-testable in microseconds and cannot perturb any compiled program.
+"""
+
+import time
+from dataclasses import dataclass, field, replace
+from typing import Any, Dict, List, Optional, Sequence
+
+from deepspeed_tpu.inference.scheduler import FinishedRequest, Request
+from deepspeed_tpu.inference.tracing import SHED_REASONS  # noqa: F401
+from deepspeed_tpu.runtime import fault
+from deepspeed_tpu.runtime.elastic import PreemptionGuard
+from deepspeed_tpu.utils.logging import logger
+
+__all__ = ["FleetRouter", "ReplicaHandle"]
+
+#: replica lifecycle (one-way): live -> draining -> retired
+LIVE, DRAINING, RETIRED = "live", "draining", "retired"
+
+
+def _normalize_fleet_config(fleet_config) -> Dict[str, Any]:
+    """Run a raw ``inference.fleet`` section through the real config
+    parser (defaults + DeepSpeedConfigError validation — one grammar,
+    no router-private dialect). ``runtime/config.py`` is jax-free."""
+    from deepspeed_tpu.runtime.config import get_inference_config
+    return get_inference_config(
+        {"inference": {"fleet": dict(fleet_config or {})}})["fleet"]
+
+
+@dataclass
+class ReplicaHandle:
+    """The router's per-replica bookkeeping around one engine."""
+    idx: int
+    engine: Any
+    guard: PreemptionGuard
+    status: str = LIVE
+    drain_reason: Optional[str] = None
+    dispatch_faults: int = 0     # serve.dispatch injections survived
+    routed: int = 0              # requests this replica received
+
+    # ------------------------------------------------- host-side reads
+    def load(self) -> int:
+        """Routing load metric: waiting + in-flight requests."""
+        sched = self.engine.scheduler
+        return sched.queue_depth + len(sched.active_slots())
+
+    def prefix_tokens(self, prompt: Sequence[int]) -> int:
+        """Prompt tokens this replica's prefix cache already holds."""
+        alloc = getattr(self.engine.scheduler, "admit_allocator", None)
+        if alloc is None or not hasattr(alloc, "match_prefix"):
+            return 0
+        _pages, tokens = alloc.match_prefix(list(prompt))
+        return int(tokens)
+
+    def handoff_depth(self) -> int:
+        q = getattr(self.engine, "_handoff_q", None)
+        return len(q) if q is not None else 0
+
+    def idle(self) -> bool:
+        return self.engine.scheduler.idle() and self.handoff_depth() == 0
+
+    def snapshot(self) -> Dict[str, Any]:
+        """One row of the ``fleet_state`` event / ``debug_state()``."""
+        sched = self.engine.scheduler
+        alloc = getattr(sched, "allocator", None)
+        return {
+            "replica": self.idx,
+            "status": self.status,
+            "queue_depth": sched.queue_depth,
+            "active_slots": len(sched.active_slots()),
+            "occupancy": round(sched.occupancy, 4),
+            "pages_in_use": (alloc.pages_in_use if alloc is not None
+                             else None),
+            "weight_version": getattr(self.engine, "weight_version",
+                                      None),
+            "weight_ordinal": getattr(self.engine, "weight_ordinal", 0),
+            "steady_state_recompiles": getattr(
+                self.engine, "steady_state_recompiles", None),
+            "routed": self.routed,
+            "dispatch_faults": self.dispatch_faults,
+            "drain_reason": self.drain_reason,
+        }
+
+
+class FleetRouter:
+    """Route requests across N in-process engine replicas; shed by
+    SLO, drain through preemptions, swap weights live.
+
+    ``engines`` are already-warmed :class:`~.engine.InferenceEngine`
+    instances (duck-typed: anything with the engine's host surface —
+    ``submit/step/cancel/scheduler/swap_params/set_speculation``).
+    ``fleet_config`` is a raw ``inference.fleet`` dict (normalized and
+    validated through ``runtime/config.py``). Telemetry reuses the
+    first engine's monitor and events.jsonl writer unless overridden —
+    the fleet trail interleaves with the per-request serve trail, one
+    timeline per run.
+
+    Drive it like an engine: ``submit`` then ``run`` (or ``step`` in a
+    serving loop). ``run`` returns exactly one :class:`FinishedRequest`
+    per submitted uid — shed requests get a synthesized zero-token
+    response (``finish_reason`` from the pinned shed vocabulary), never
+    a dropped uid.
+    """
+
+    #: fleet_state event / scalar cadence (router steps)
+    _STATE_EVERY = 16
+
+    def __init__(self, engines: Sequence[Any], fleet_config=None,
+                 monitor=None, writer=None,
+                 install_signal_handlers: bool = False,
+                 clock=time.perf_counter):
+        if not engines:
+            raise ValueError("FleetRouter needs at least one engine")
+        self.cfg = _normalize_fleet_config(fleet_config)
+        self._clock = clock
+        self.replicas = [ReplicaHandle(i, e, PreemptionGuard())
+                         for i, e in enumerate(engines)]
+        if install_signal_handlers:
+            # chain-installed: a real SIGTERM reaches the last guard —
+            # ONE replica drains, the fleet keeps serving (the process-
+            # level analog of a preempted pod). Software triggers
+            # (drain()/request_preemption) don't need handlers.
+            for r in self.replicas:
+                r.guard.install()
+        self.monitor = monitor if monitor is not None else \
+            getattr(engines[0], "monitor", None)
+        self._log = writer if writer is not None else \
+            getattr(engines[0], "_log", None)
+        # env-armed serve-plane faults (DSTPU_FAULT_ARM) — latched
+        # no-op when another component already armed this process
+        fault.arm_from_env()
+        self._steps = 0
+        self._pending: List[FinishedRequest] = []
+        # ladder + ledger
+        self.total_submitted = 0
+        self.total_shed = 0
+        self.shed_by_reason: Dict[str, int] = {}
+        self.shed_by_priority: Dict[int, int] = {}
+        self.total_degraded = 0
+        self.total_redistributed = 0
+        self.total_reroutes = 0
+        self._spec_degraded = False
+        sh = self.cfg["slo_shed"]
+        self._budget_ms = sh["ttft_budget_ms"]
+        if self._budget_ms is None:
+            # fall back to the serve SLO the tracers already enforce
+            tr = getattr(engines[0], "_tracer", None)
+            self._budget_ms = float(getattr(tr, "slo_ttft_ms", 2000.0))
+        logger.info(
+            f"fleet router: {len(self.replicas)} replicas, "
+            f"routing={self.cfg['routing']}, slo_shed="
+            f"{'on' if sh['enabled'] else 'off'} "
+            f"(p95 TTFT budget {self._budget_ms:.0f} ms)")
+
+    # ---------------------------------------------------------- events
+    def _event(self, kind: str, **fields) -> None:
+        if self._log is not None:
+            self._log.add_event(kind, **fields)
+
+    # ------------------------------------------------------ shed ladder
+    def _ttft_stats(self):
+        """Aggregate (samples, worst p95) over serving replicas — the
+        goodput histograms the tracers already keep."""
+        count, p95 = 0, None
+        for r in self.replicas:
+            if r.status == RETIRED:
+                continue
+            tr = getattr(r.engine, "_tracer", None)
+            if tr is None:
+                continue
+            h = tr.hist.get("ttft_ms")
+            if h is None or not h.count:
+                continue
+            count += h.count
+            v = h.percentile(0.95)
+            if v is not None:
+                p95 = v if p95 is None else max(p95, v)
+        return count, p95
+
+    def shed_level(self) -> int:
+        """0 = healthy, 1 = shed rung (reject low tiers), 2 = degrade
+        rung (cap max_new + speculation off)."""
+        sh = self.cfg["slo_shed"]
+        if not sh["enabled"]:
+            return 0
+        count, p95 = self._ttft_stats()
+        if p95 is None or count < sh["min_samples"]:
+            return 0
+        if p95 > self._budget_ms * sh["degrade_factor"]:
+            return 2
+        if p95 > self._budget_ms:
+            return 1
+        return 0
+
+    def _shed(self, req: Request, reason: str,
+              **extra) -> FinishedRequest:
+        """Synthesize the rejection response: the client gets exactly
+        one FinishedRequest per uid — a shed is a (zero-token) answer,
+        never a dropped request."""
+        prio = getattr(req, "priority", 0)
+        self.total_shed += 1
+        self.shed_by_reason[reason] = \
+            self.shed_by_reason.get(reason, 0) + 1
+        self.shed_by_priority[prio] = \
+            self.shed_by_priority.get(prio, 0) + 1
+        self._event("fleet_shed", uid=req.uid, reason=reason,
+                    priority=prio, **extra)
+        fin = FinishedRequest(uid=req.uid, prompt=list(req.prompt),
+                              tokens=[], finish_reason=reason,
+                              ttft_ms=None, latency_ms=0.0)
+        self._pending.append(fin)
+        return fin
+
+    def _apply_spec_degrade(self, level: int) -> None:
+        want = level >= 2
+        if want == self._spec_degraded:
+            return
+        changed = 0
+        for r in self.replicas:
+            if r.status == RETIRED:
+                continue
+            if getattr(r.engine, "set_speculation",
+                       lambda on: False)(not want):
+                changed += 1
+        self._spec_degraded = want
+        if changed:
+            self._event("fleet_shed", reason="degrade_spec_off",
+                        enabled=want, replicas=changed)
+
+    # ---------------------------------------------------------- routing
+    def _ranked(self, req: Optional[Request]) -> List[ReplicaHandle]:
+        """Live replicas, best dispatch target first."""
+        live = [r for r in self.replicas if r.status == LIVE]
+        if self.cfg["routing"] == "prefix_affinity" and req is not None:
+            return sorted(live, key=lambda r: (-r.prefix_tokens(
+                req.prompt), r.load(), r.idx))
+        return sorted(live, key=lambda r: (r.load(), r.idx))
+
+    def _dispatch(self, req: Request) -> Optional[ReplicaHandle]:
+        """Hand ``req`` to the best live replica; a transient
+        ``serve.dispatch`` fault reroutes to the next-best instead of
+        dropping. None = no replica accepted (caller sheds)."""
+        for r in self._ranked(req):
+            try:
+                fault.fire("serve.dispatch", replica=r.idx, uid=req.uid)
+                r.engine.submit(req)
+            except (fault.InjectedCrash, OSError) as e:
+                r.dispatch_faults += 1
+                self.total_reroutes += 1
+                logger.warning(f"fleet dispatch fault on replica "
+                               f"{r.idx} (uid {req.uid}): {e!r}; "
+                               f"rerouting")
+                continue
+            r.routed += 1
+            return r
+        return None
+
+    # ----------------------------------------------------------- submit
+    def submit(self, request: Request) -> int:
+        """Admit (or shed) one request; returns its uid either way —
+        the response arrives through :meth:`step`/:meth:`run`."""
+        self.total_submitted += 1
+        prio = getattr(request, "priority", 0)
+        level = self.shed_level()
+        self._apply_spec_degrade(level)
+        sh = self.cfg["slo_shed"]
+        if level >= 1 and prio < sh["shed_below_priority"]:
+            _count, p95 = self._ttft_stats()
+            self._shed(request, "shed_slo", p95_ttft_ms=p95,
+                       budget_ms=self._budget_ms, level=level)
+            return request.uid
+        if level >= 2 and sh["degrade_max_new"] > 0 and \
+                request.max_new_tokens > sh["degrade_max_new"]:
+            # replace() preserves uid/seed — only the budget shrinks
+            request = replace(request,
+                              max_new_tokens=sh["degrade_max_new"])
+            self.total_degraded += 1
+            self._event("fleet_shed", uid=request.uid,
+                        reason="degrade_max_new", priority=prio,
+                        max_new_tokens=request.max_new_tokens)
+        if self._dispatch(request) is None:
+            self._shed(request, "shed_capacity",
+                       live=[r.idx for r in self.replicas
+                             if r.status == LIVE])
+        return request.uid
+
+    # ------------------------------------------------------------ drain
+    def drain(self, replica: int, reason: str = "manual") -> None:
+        """Software-preempt one replica (the SIGTERM-equivalent). The
+        actual drain runs at the next :meth:`step`."""
+        self.replicas[replica].guard.trigger(reason)
+
+    def _begin_drain(self, r: ReplicaHandle) -> None:
+        r.status = DRAINING
+        r.drain_reason = r.guard.reason or "preempted"
+        survivors = [s for s in self.replicas if s.status == LIVE]
+        queued = list(r.engine.scheduler.queue)
+        in_flight = len(r.engine.scheduler.active_slots())
+        self._event("fleet_drain", phase="begin", replica=r.idx,
+                    reason=r.drain_reason, queued=len(queued),
+                    in_flight=in_flight,
+                    survivors=[s.idx for s in survivors])
+        logger.info(
+            f"fleet drain: replica {r.idx} ({r.drain_reason}) — "
+            f"{in_flight} in flight finish here, {len(queued)} queued "
+            f"redistribute over {len(survivors)} survivors")
+        if not survivors or not queued:
+            # nobody to redistribute to (the replica simply finishes
+            # everything it holds), or nothing waiting
+            return
+        for req in queued:
+            # the cancel's serve_evict row (reason "drain") is drain
+            # bookkeeping, not the client's answer — _collect drops it;
+            # the SAME Request object (uid, seed, budget) goes to a
+            # survivor, whose prefix cache re-prefills it
+            r.engine.cancel(req.uid, reason="drain")
+            self.total_redistributed += 1
+            if self._dispatch(req) is None:
+                self._shed(req, "shed_capacity", drained_from=r.idx)
+
+    # ------------------------------------------------------------- step
+    def _collect(self, fins: List[FinishedRequest]
+                 ) -> List[FinishedRequest]:
+        return [f for f in fins if f.finish_reason != "drain"]
+
+    def step(self) -> List[FinishedRequest]:
+        """One fleet scheduling round: react to preemptions, advance
+        every serving replica one engine step, retire empty drains.
+        Returns the requests that finished (shed responses included)."""
+        out: List[FinishedRequest] = []
+        out.extend(self._pending)
+        self._pending = []
+        for r in self.replicas:
+            if r.status == RETIRED:
+                continue
+            try:
+                # the per-replica preemption probe: a raised injection
+                # preempts THIS replica (the env grammar's targeted
+                # form); the "preempt" action instead flags installed
+                # guards, exactly like a real SIGTERM
+                fault.fire("serve.replica_preempt", replica=r.idx)
+            except (fault.InjectedCrash, OSError) as e:
+                r.guard.trigger(f"fault:{type(e).__name__}")
+            if r.status == LIVE and r.guard.preempted:
+                self._begin_drain(r)
+        for r in self.replicas:
+            if r.status == RETIRED:
+                continue
+            if not r.idle():
+                out.extend(self._collect(r.engine.step()))
+            if r.status == DRAINING and r.idle():
+                r.status = RETIRED
+                self._event("fleet_drain", phase="complete",
+                            replica=r.idx, reason=r.drain_reason)
+                logger.info(f"fleet drain: replica {r.idx} retired")
+        self._apply_spec_degrade(self.shed_level())
+        self._steps += 1
+        if self._steps % self._STATE_EVERY == 0:
+            self._write_telemetry()
+        return out
+
+    def idle(self) -> bool:
+        return not self._pending and all(
+            r.status == RETIRED or r.idle() for r in self.replicas)
+
+    def run(self) -> List[FinishedRequest]:
+        """Serve until every admitted request has answered (the fleet
+        analog of ``engine.run``; responses in completion order)."""
+        out: List[FinishedRequest] = []
+        while not self.idle():
+            out.extend(self.step())
+        out.extend(self._pending)
+        self._pending = []
+        self._write_telemetry()
+        return out
+
+    # ------------------------------------------------ live weight swap
+    def swap_weights(self, load_dir: str, tag: Optional[str] = None
+                     ) -> Dict[int, Optional[str]]:
+        """Push a committed checkpoint tag into every serving replica
+        between dispatches. Per replica atomic-or-rollback: a failed
+        load (bad tag, I/O flake, injected ``serve.swap_load``) leaves
+        THAT replica serving its old weights and still live — the
+        result maps replica -> new version (None = rolled back)."""
+        verify = self.cfg["swap"]["verify_integrity"]
+        results: Dict[int, Optional[str]] = {}
+        for r in self.replicas:
+            if r.status == RETIRED:
+                continue
+            try:
+                results[r.idx] = r.engine.swap_params(
+                    load_dir, tag=tag, verify_integrity=verify)
+            except Exception as e:
+                results[r.idx] = None
+                logger.warning(
+                    f"fleet swap: replica {r.idx} rolled back "
+                    f"({e!r}); still serving "
+                    f"{getattr(r.engine, 'weight_version', '?')}")
+        self._event("fleet_swap_push", load_dir=str(load_dir), tag=tag,
+                    versions={str(k): v for k, v in results.items()},
+                    rolled_back=[k for k, v in results.items()
+                                 if v is None])
+        return results
+
+    # -------------------------------------------------------- telemetry
+    @property
+    def shed_rate(self) -> float:
+        return (self.total_shed / self.total_submitted
+                if self.total_submitted else 0.0)
+
+    def fleet_queue_depth(self) -> int:
+        return sum(r.engine.scheduler.queue_depth for r in self.replicas
+                   if r.status != RETIRED)
+
+    def debug_state(self) -> Dict[str, Any]:
+        """Host-only fleet introspection (mirrors the periodic
+        ``fleet_state`` event row obs_report renders)."""
+        count, p95 = self._ttft_stats()
+        return {
+            "routing": self.cfg["routing"],
+            "steps": self._steps,
+            "replicas": [r.snapshot() for r in self.replicas],
+            "fleet_queue_depth": self.fleet_queue_depth(),
+            "submitted": self.total_submitted,
+            "shed": {"total": self.total_shed,
+                     "rate": round(self.shed_rate, 4),
+                     "by_reason": dict(self.shed_by_reason),
+                     "by_priority": {str(k): v for k, v in
+                                     self.shed_by_priority.items()},
+                     "degraded": self.total_degraded,
+                     "spec_degraded": self._spec_degraded,
+                     "level": self.shed_level()},
+            "slo": {"p95_ttft_ms": p95, "samples": count,
+                    "budget_ms": self._budget_ms},
+            "redistributed": self.total_redistributed,
+            "reroutes": self.total_reroutes,
+        }
+
+    def _write_telemetry(self) -> None:
+        self._event("fleet_state", step=self._steps,
+                    **self.debug_state())
+        if self.monitor is None or not hasattr(
+                self.monitor, "write_serving_metrics"):
+            return
+        tokens = sum(r.engine.scheduler.total_tokens
+                     for r in self.replicas)
+        self.monitor.write_serving_metrics(
+            shed_rate=self.shed_rate,
+            fleet_queue_depth=self.fleet_queue_depth(),
+            tokens=tokens)
+
+    # ---------------------------------------------------------- cleanup
+    def close(self) -> None:
+        """Uninstall guards and close every engine (final ``fleet_state``
+        first, so the run report sees the fleet's last shape)."""
+        self._write_telemetry()
+        for r in self.replicas:
+            r.guard.uninstall()
+            close = getattr(r.engine, "close", None)
+            if close is not None:
+                close()
+        self._log = None

@@ -53,6 +53,11 @@ class Request:
     temperature: float = 0.0
     seed: int = 0
     eos_id: Optional[int] = None
+    # admission tier for the fleet router's SLO shed ladder (higher =
+    # more important; 0 is the first tier rejected under load). The
+    # scheduler itself stays FIFO — priority is routing policy, not
+    # slot policy (inference/fleet.py).
+    priority: int = 0
     uid: int = field(default_factory=lambda: next(_uid_counter))
 
     def __post_init__(self):
@@ -88,6 +93,10 @@ class FinishedRequest:
     tokens_per_s: Optional[float] = None
     draft_proposed: int = 0
     draft_accepted: int = 0
+    # which serving weights produced ``tokens`` — the engine stamps its
+    # current checkpoint tag (or "initial") so a live weight swap is
+    # attributable per response (inference/fleet.py swap protocol)
+    weight_version: Optional[str] = None
 
 
 @dataclass
@@ -189,6 +198,10 @@ class Scheduler:
         self.total_admitted = 0
         self.total_tokens = 0
         self.peak_tokens_in_flight = 0
+        # stamped onto every FinishedRequest; the engine sets it at
+        # construction / from_checkpoint / swap_params so a live weight
+        # swap is attributable per response
+        self.weight_version: Optional[str] = None
 
     # ------------------------------------------------------------ state
     def free_slots(self) -> List[int]:
@@ -512,7 +525,8 @@ class Scheduler:
                                       latency_ms if latency_ms > 0
                                       else None),
                         draft_proposed=slot.draft_proposed,
-                        draft_accepted=slot.draft_accepted)
+                        draft_accepted=slot.draft_accepted,
+                        weight_version=self.weight_version)
                     break
             if fin is not None:
                 done.append(fin)
@@ -594,7 +608,8 @@ class Scheduler:
                     uid=uid, prompt=list(req.prompt), tokens=[],
                     finish_reason=reason, ttft_ms=None,
                     latency_ms=(now - t_sub) * 1e3,
-                    queue_wait_ms=None)
+                    queue_wait_ms=None,
+                    weight_version=self.weight_version)
                 self.finished.append(fin)
                 if self.tracer is not None:
                     self.tracer.on_finish(fin, evicted=True)
@@ -614,7 +629,8 @@ class Scheduler:
                               if slot.tokens and latency_ms > 0
                               else None),
                 draft_proposed=slot.draft_proposed,
-                draft_accepted=slot.draft_accepted)
+                draft_accepted=slot.draft_accepted,
+                weight_version=self.weight_version)
             self._release(slot)
             self.slots[sid] = None
             self.finished.append(fin)

@@ -14,6 +14,14 @@ into the crash-safe ``events.jsonl``:
                  -> [serve_decode_window | serve_spec_window]*
                  -> serve_finish | serve_evict
 
+The fleet router (ISSUE 14, inference/fleet.py) adds fleet-plane rows
+in the same trail — ``fleet_shed`` (a request rejected or degraded by
+the SLO shed ladder, reason from :data:`SHED_REASONS`), ``fleet_drain``
+(a replica stopped admitting and its queue was redistributed; the
+rerouted requests' scheduler-side evictions ride ``serve_evict`` with
+reason "drain"), ``fleet_swap`` (a live weight push, tag + ok/rollback),
+and periodic ``fleet_state`` snapshots.
+
 Disaggregated serving (ISSUE 13) adds the ``serve_handoff`` row — the
 prefill->decode page-ownership transfer, with queue wait, measured
 transfer wall time, and the LinkModel-priced wire cost side by side —
@@ -58,7 +66,7 @@ from typing import Any, Dict, List, Optional, Set
 
 from deepspeed_tpu.utils.monitor import Histogram
 
-__all__ = ["ServeTracer", "DEFER_REASONS"]
+__all__ = ["ServeTracer", "DEFER_REASONS", "SHED_REASONS"]
 
 #: the pinned defer vocabulary (docs/observability.md event schema):
 #: "pages"       - page reservation failed (pool starvation)
@@ -68,6 +76,24 @@ __all__ = ["ServeTracer", "DEFER_REASONS"]
 #: "draft_stall" - speculation: drafter proposed nothing this dispatch
 #:                 (the slot rode the verify program with 0 drafts)
 DEFER_REASONS = ("pages", "bucket", "lookahead", "handoff", "draft_stall")
+
+#: the pinned fleet shed/degrade vocabulary (``fleet_shed`` rows and
+#: drain-path ``serve_evict`` rows — docs/serving-fleet.md):
+#: "shed_slo"        - rejected: fleet p95 TTFT breached the budget and
+#:                     the request's priority tier is below the floor
+#: "shed_capacity"   - rejected: no live replica can ever serve it
+#:                     (fleet draining/retired, not a transient defer)
+#: "degrade_max_new" - admitted, but max_new_tokens capped by the shed
+#:                     ladder's degrade rung
+#: "degrade_spec_off"- fleet-wide: speculation switched off under
+#:                     sustained SLO breach (plain decode programs are
+#:                     already warm — zero recompiles)
+#: "drain"           - requeued off a draining replica and resubmitted
+#:                     to a survivor (the client still gets exactly one
+#:                     response; the drain-side eviction row is
+#:                     bookkeeping, not an answer)
+SHED_REASONS = ("shed_slo", "shed_capacity", "degrade_max_new",
+                "degrade_spec_off", "drain")
 
 
 @dataclass

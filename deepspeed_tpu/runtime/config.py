@@ -662,6 +662,40 @@ def get_inference_config(param_dict):
         "decode_mesh": {"axes": dict(
             dg_mesh.get(C.INF_MESH_AXES, {}) or {})},
     }
+    fl = sub.get(C.INF_FLEET, {}) or {}
+    shed = fl.get(C.INF_FLEET_SLO_SHED, {}) or {}
+    swap = fl.get(C.INF_FLEET_SWAP, {}) or {}
+    budget = shed.get(C.INF_FLEET_SHED_TTFT_BUDGET_MS,
+                      C.INF_FLEET_SHED_TTFT_BUDGET_MS_DEFAULT)
+    cfg["fleet"] = {
+        "replicas": int(fl.get(C.INF_FLEET_REPLICAS,
+                               C.INF_FLEET_REPLICAS_DEFAULT)),
+        "routing": str(fl.get(C.INF_FLEET_ROUTING,
+                              C.INF_FLEET_ROUTING_DEFAULT)),
+        "slo_shed": {
+            "enabled": bool(shed.get(C.INF_FLEET_SHED_ENABLED,
+                                     C.INF_FLEET_SHED_ENABLED_DEFAULT)),
+            "ttft_budget_ms": (float(budget) if budget is not None
+                               else None),
+            "min_samples": int(shed.get(
+                C.INF_FLEET_SHED_MIN_SAMPLES,
+                C.INF_FLEET_SHED_MIN_SAMPLES_DEFAULT)),
+            "shed_below_priority": int(shed.get(
+                C.INF_FLEET_SHED_BELOW_PRIORITY,
+                C.INF_FLEET_SHED_BELOW_PRIORITY_DEFAULT)),
+            "degrade_factor": float(shed.get(
+                C.INF_FLEET_SHED_DEGRADE_FACTOR,
+                C.INF_FLEET_SHED_DEGRADE_FACTOR_DEFAULT)),
+            "degrade_max_new": int(shed.get(
+                C.INF_FLEET_SHED_DEGRADE_MAX_NEW,
+                C.INF_FLEET_SHED_DEGRADE_MAX_NEW_DEFAULT)),
+        },
+        "swap": {
+            "verify_integrity": bool(swap.get(
+                C.INF_FLEET_SWAP_VERIFY_INTEGRITY,
+                C.INF_FLEET_SWAP_VERIFY_INTEGRITY_DEFAULT)),
+        },
+    }
     try:
         cfg["prompt_buckets"] = list(validate_buckets(
             cfg["prompt_buckets"], "inference.prompt_buckets"))
@@ -772,6 +806,31 @@ def get_inference_config(param_dict):
         raise DeepSpeedConfigError(
             "inference.disagg.decode_mesh.axes set but disagg.enabled "
             "is false")
+    flc = cfg["fleet"]
+    if flc["replicas"] < 1:
+        raise DeepSpeedConfigError(
+            f"inference.fleet.replicas must be >= 1, got "
+            f"{flc['replicas']}")
+    if flc["routing"] not in C.INF_FLEET_ROUTING_CHOICES:
+        raise DeepSpeedConfigError(
+            f"inference.fleet.routing must be one of "
+            f"{list(C.INF_FLEET_ROUTING_CHOICES)}, got "
+            f"{flc['routing']!r}")
+    shc = flc["slo_shed"]
+    if shc["ttft_budget_ms"] is not None and shc["ttft_budget_ms"] <= 0:
+        raise DeepSpeedConfigError(
+            f"inference.fleet.slo_shed.ttft_budget_ms must be > 0 (or "
+            f"null for the serve SLO), got {shc['ttft_budget_ms']}")
+    if shc["min_samples"] < 1 or shc["shed_below_priority"] < 0 or \
+            shc["degrade_max_new"] < 0:
+        raise DeepSpeedConfigError(
+            "inference.fleet.slo_shed: min_samples >= 1, "
+            "shed_below_priority >= 0 and degrade_max_new >= 0 required")
+    if shc["degrade_factor"] < 1.0:
+        raise DeepSpeedConfigError(
+            f"inference.fleet.slo_shed.degrade_factor must be >= 1.0 "
+            f"(the degrade rung engages above the shed rung), got "
+            f"{shc['degrade_factor']}")
     return cfg
 
 

@@ -477,6 +477,28 @@ CHECKPOINT_SUPERVISOR_BACKOFF_DEFAULT = 1.0
 #     "decode_mesh": {            # decode worker's own mesh (else the
 #       "axes": {}                # decode loop shares inference.mesh)
 #     }
+#   },
+#   "fleet": {                    # multi-replica router (inference/
+#                                 # fleet.py FleetRouter)
+#     "replicas": 1,              # in-process engine replicas fronted
+#     "routing": "least_loaded",  # | "prefix_affinity" (route to the
+#                                 # replica whose prefix cache covers
+#                                 # the most prompt tokens)
+#     "slo_shed": {               # SLO-driven admission (goodput > raw
+#                                 # throughput)
+#       "enabled": false,
+#       "ttft_budget_ms": null,   # p95 TTFT budget; null = the
+#                                 # observability.serve.slo.ttft_ms SLO
+#       "min_samples": 8,         # TTFTs before the ladder may engage
+#       "shed_below_priority": 1, # rung 1: reject requests with
+#                                 # priority < this while p95 breaches
+#       "degrade_factor": 2.0,    # rung 2 at budget x factor: cap
+#                                 # max_new + switch speculation off
+#       "degrade_max_new": 32     # the rung-2 max_new cap (0 = no cap)
+#     },
+#     "swap": {                   # live weight swap (engine.swap_params)
+#       "verify_integrity": true  # CRC-verify the tag before pushing
+#     }
 #   }
 # }
 #############################################
@@ -541,6 +563,28 @@ INF_DISAGG_SEPARATE_POOLS_DEFAULT = None  # auto: decode_mesh axes set
 INF_DISAGG_PREFILL_PAGES = "prefill_pages"
 INF_DISAGG_PREFILL_PAGES_DEFAULT = 0     # 0 = auto
 INF_DISAGG_DECODE_MESH = "decode_mesh"
+INF_FLEET = "fleet"
+INF_FLEET_REPLICAS = "replicas"
+INF_FLEET_REPLICAS_DEFAULT = 1
+INF_FLEET_ROUTING = "routing"
+INF_FLEET_ROUTING_DEFAULT = "least_loaded"
+INF_FLEET_ROUTING_CHOICES = ("least_loaded", "prefix_affinity")
+INF_FLEET_SLO_SHED = "slo_shed"
+INF_FLEET_SHED_ENABLED = "enabled"
+INF_FLEET_SHED_ENABLED_DEFAULT = False
+INF_FLEET_SHED_TTFT_BUDGET_MS = "ttft_budget_ms"
+INF_FLEET_SHED_TTFT_BUDGET_MS_DEFAULT = None  # None = serve SLO ttft_ms
+INF_FLEET_SHED_MIN_SAMPLES = "min_samples"
+INF_FLEET_SHED_MIN_SAMPLES_DEFAULT = 8
+INF_FLEET_SHED_BELOW_PRIORITY = "shed_below_priority"
+INF_FLEET_SHED_BELOW_PRIORITY_DEFAULT = 1
+INF_FLEET_SHED_DEGRADE_FACTOR = "degrade_factor"
+INF_FLEET_SHED_DEGRADE_FACTOR_DEFAULT = 2.0
+INF_FLEET_SHED_DEGRADE_MAX_NEW = "degrade_max_new"
+INF_FLEET_SHED_DEGRADE_MAX_NEW_DEFAULT = 32  # 0 = no cap
+INF_FLEET_SWAP = "swap"
+INF_FLEET_SWAP_VERIFY_INTEGRITY = "verify_integrity"
+INF_FLEET_SWAP_VERIFY_INTEGRITY_DEFAULT = True
 
 TENSORBOARD = "tensorboard"
 TENSORBOARD_ENABLED = "enabled"

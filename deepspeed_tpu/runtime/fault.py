@@ -33,6 +33,28 @@ and ``engine.save_checkpoint``):
                                  preemption) to prove the in-flight window
                                  still finishes before the drain
 
+Serve-plane points (ISSUE 14 — ``inference/engine.py`` and
+``inference/fleet.py``; the fleet tests arm them through the same env
+grammar):
+
+- ``serve.swap_load``          : in ``engine.swap_params``, after the tag
+                                 pre-flight and BEFORE the params load —
+                                 arm ``oserror``/``crash`` to prove a
+                                 failed mid-swap load leaves the replica
+                                 serving the OLD weights (swap is
+                                 atomic-or-rollback, never half-loaded)
+- ``serve.replica_preempt``    : once per live replica per router step
+                                 (ctx: ``replica``) — a raised injection
+                                 preempts THAT replica (drain +
+                                 redistribute); the ``preempt`` action
+                                 instead flags every installed
+                                 PreemptionGuard, same as a real SIGTERM
+- ``serve.dispatch``           : in the router's dispatch of one request
+                                 to its chosen replica (ctx: ``replica``,
+                                 ``uid``) — a transient failure here must
+                                 reroute the request to the next-best
+                                 replica, never drop it
+
 ``retry_io`` is the exponential-backoff wrapper used around all checkpoint
 I/O; it retries ``OSError`` (transient filesystem flakes) but never
 ``InjectedCrash`` (a simulated process death must kill the save).

@@ -52,6 +52,12 @@ TAG_SERVE_SPEC_ACCEPT = "Serve/spec_accept_rate"    # accepted/proposed
 #                                                     per verify dispatch
 TAG_SERVE_HANDOFF = "Serve/handoff_ms"              # per claimed handoff
 #                                                     (queue + transfer)
+# fleet plane (ISSUE 14): the multi-replica router's shed ladder,
+# aggregate queue, and live-weight-swap stamp (inference/fleet.py)
+TAG_SERVE_SHED_RATE = "Serve/shed_rate"             # shed / submitted
+TAG_SERVE_FLEET_QDEPTH = "Serve/fleet_queue_depth"  # sum of replica queues
+TAG_SERVE_WEIGHT_VERSION = "Serve/weight_version"   # committed swap
+#                                                     ordinal (0 = boot)
 # elastic / async-checkpoint plane (ISSUE 10): snapshot-vs-write split
 # of every save, the async writer's backlog, and how many times the
 # supervisor has relaunched this run. Canonical home — profiling/
@@ -382,6 +388,8 @@ class TensorBoardMonitor:
                               tbt_ms=None, slo_attainment=None,
                               goodput_tokens_per_s=None,
                               spec_accept_rate=None, handoff_ms=None,
+                              shed_rate=None, fleet_queue_depth=None,
+                              weight_version=None,
                               tokens: int = 0, flush: bool = True):
         """Serving telemetry (inference engine; TPU-native extension —
         the reference snapshot is training-only): time-to-first-token
@@ -442,6 +450,14 @@ class TensorBoardMonitor:
                               tokens)
         if handoff_ms is not None:
             self.write_scalar(TAG_SERVE_HANDOFF, handoff_ms, tokens)
+        if shed_rate is not None:
+            self.write_scalar(TAG_SERVE_SHED_RATE, shed_rate, tokens)
+        if fleet_queue_depth is not None:
+            self.write_scalar(TAG_SERVE_FLEET_QDEPTH, fleet_queue_depth,
+                              tokens)
+        if weight_version is not None:
+            self.write_scalar(TAG_SERVE_WEIGHT_VERSION, weight_version,
+                              tokens)
         if flush:
             self.flush()
 

@@ -88,6 +88,11 @@ T_GOODPUT = "Serve/goodput_tokens_per_s"
 # verify dispatch, prefill->decode handoff leg of TTFT
 T_SPEC_ACCEPT = "Serve/spec_accept_rate"
 T_HANDOFF = "Serve/handoff_ms"
+# fleet plane (inference/fleet.py FleetRouter): SLO-shed rate, waiting
+# work across replicas, the serving weight ordinal (bumped per swap)
+T_SHED_RATE = "Serve/shed_rate"
+T_FLEET_QDEPTH = "Serve/fleet_queue_depth"
+T_WEIGHT_VERSION = "Serve/weight_version"
 # elastic / async-checkpoint plane (utils/monitor.py
 # write_elastic_metrics): snapshot-vs-write decomposition of each save,
 # async writer backlog, supervisor restart count; the `preemption` /
@@ -341,6 +346,64 @@ def summarize(path, host_gap_threshold=DEFAULT_HOST_GAP_THRESHOLD):
                         if e.get("event") == "serve_defer"
                         and e.get("reason") == "handoff"),
     }
+
+    # fleet view (multi-replica router; absent on single-engine runs:
+    # None). The last fleet_state row is the router's closing
+    # debug_state() — per-replica occupancy/status/weight version —
+    # and the fleet_shed / fleet_drain / fleet_swap(_push) rows carry
+    # the shed ledger and the swap/drain timeline.
+    fleet_state = next((e for e in reversed(events)
+                        if e.get("event") == "fleet_state"), None)
+    shed_rows = [e for e in events if e.get("event") == "fleet_shed"]
+    drain_rows = [e for e in events if e.get("event") == "fleet_drain"]
+    swap_rows = [e for e in events
+                 if e.get("event") in ("fleet_swap", "fleet_swap_push")]
+    if fleet_state is not None or shed_rows or drain_rows or swap_rows:
+        shed_by_reason = defaultdict(int)
+        for e in shed_rows:
+            shed_by_reason[str(e.get("reason", "?"))] += 1
+        fs_shed = (fleet_state or {}).get("shed") or {}
+        timeline = []
+        for e in drain_rows:
+            timeline.append({"kind": "drain", "phase": e.get("phase"),
+                             "replica": e.get("replica"),
+                             "reason": e.get("reason"),
+                             "queued": e.get("queued"),
+                             "in_flight": e.get("in_flight")})
+        for e in swap_rows:
+            timeline.append({
+                "kind": "swap",
+                "version": (e.get("weight_version") or e.get("tag")),
+                "ok": e.get("ok"),
+                "rolled_back": e.get("rolled_back"),
+            })
+        serving["fleet"] = {
+            "replicas": (fleet_state or {}).get("replicas"),
+            "routing": (fleet_state or {}).get("routing"),
+            "submitted": (fleet_state or {}).get("submitted"),
+            "shed": {
+                "total": fs_shed.get("total", len(
+                    [e for e in shed_rows
+                     if e.get("reason") in ("shed_slo",
+                                            "shed_capacity")])),
+                "rate": (fs_shed.get("rate")
+                         if fs_shed.get("rate") is not None
+                         else _last(scalars, T_SHED_RATE)),
+                "by_reason": (fs_shed.get("by_reason")
+                              or dict(shed_by_reason)),
+                "by_priority": fs_shed.get("by_priority"),
+            },
+            "redistributed": (fleet_state or {}).get("redistributed"),
+            "reroutes": (fleet_state or {}).get("reroutes"),
+            "slo": (fleet_state or {}).get("slo"),
+            "queue_depth_peak": (max(_vals(scalars, T_FLEET_QDEPTH))
+                                 if _vals(scalars, T_FLEET_QDEPTH)
+                                 else None),
+            "weight_ordinal_last": _last(scalars, T_WEIGHT_VERSION),
+            "timeline": timeline,
+        }
+    else:
+        serving["fleet"] = None
 
     ckpt = {"saves": 0, "loads": 0, "fallbacks": 0, "save_ms": []}
     for tag, rows in scalars.items():
@@ -679,6 +742,62 @@ def render_serve(s):
             f"  disagg_handoff    : {dg['handoffs']} handoffs, "
             f"p50={_fmt(hm.get('p50'))} p95={_fmt(hm.get('p95'))} ms, "
             f"requeues={dg.get('requeues', 0)}")
+    fl = sv.get("fleet")
+    if fl:
+        shed = fl.get("shed") or {}
+        line = (f"  fleet             : routing={fl.get('routing')} "
+                f"submitted={_fmt(fl.get('submitted'), '{:.0f}')} "
+                f"shed={shed.get('total', 0)} "
+                f"(rate {_fmt(shed.get('rate'), '{:.1%}')}) "
+                f"redistributed={_fmt(fl.get('redistributed'), '{:.0f}')} "
+                f"reroutes={_fmt(fl.get('reroutes'), '{:.0f}')}")
+        lines.append(line)
+        slo = fl.get("slo") or {}
+        if slo.get("budget_ms") is not None:
+            lines.append(
+                f"    slo_shed        : p95_ttft="
+                f"{_fmt(slo.get('p95_ttft_ms'))} ms vs budget "
+                f"{_fmt(slo.get('budget_ms'), '{:.0f}')} ms "
+                f"({_fmt(slo.get('samples'), '{:.0f}')} samples)")
+        by_reason = shed.get("by_reason") or {}
+        if by_reason:
+            parts = ", ".join(f"{k}={v}"
+                              for k, v in sorted(by_reason.items()))
+            lines.append(f"    shed_by_reason  : {parts}")
+        by_prio = shed.get("by_priority") or {}
+        if by_prio:
+            parts = ", ".join(f"tier{k}={v}"
+                              for k, v in sorted(by_prio.items()))
+            lines.append(f"    shed_by_tier    : {parts}")
+        for r in fl.get("replicas") or []:
+            lines.append(
+                f"    replica {r.get('replica')}       : "
+                f"{r.get('status'):<8} "
+                f"occ={_fmt(r.get('occupancy'), '{:.1%}')} "
+                f"q={r.get('queue_depth')} routed={r.get('routed')} "
+                f"weights={r.get('weight_version')} "
+                f"recompiles={r.get('steady_state_recompiles')}"
+                + (f" drain={r.get('drain_reason')}"
+                   if r.get("drain_reason") else ""))
+        for t in fl.get("timeline") or []:
+            if t["kind"] == "drain":
+                lines.append(
+                    f"    drain           : replica {t.get('replica')} "
+                    f"{t.get('phase')} ({t.get('reason')}"
+                    + (f", queued={t.get('queued')} "
+                       f"in_flight={t.get('in_flight')}"
+                       if t.get("phase") == "begin" else "") + ")")
+            else:
+                if t.get("rolled_back") is not None:
+                    ver = t.get("version")
+                    lines.append(
+                        f"    swap_push       : tag={ver} "
+                        f"rolled_back={t['rolled_back']}")
+                else:
+                    lines.append(
+                        f"    swap            : "
+                        f"-> {t.get('version')} "
+                        f"(ok={t.get('ok')})")
     return "\n".join(lines)
 
 

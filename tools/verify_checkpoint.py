@@ -12,7 +12,7 @@ Usage::
     python tools/verify_checkpoint.py <save_dir>            # resolve latest
     python tools/verify_checkpoint.py <save_dir> --tag TAG  # one tag
     python tools/verify_checkpoint.py <save_dir>/<tag>      # tag dir direct
-    ... [--no-crc] [--all] [--expect-step N]
+    ... [--no-crc] [--all] [--expect-step N] [--serve-ready]
 
 Exit status 0 iff everything checked is committed, verified, and fully
 covered — and, with ``--expect-step N``, the newest committed
@@ -56,8 +56,13 @@ def _leaf_coverage(ckpt_dir, name):
     return rows
 
 
-def verify_tag_dir(ckpt_dir, check_crc=True):
-    """Print a report for one tag dir; return True iff healthy."""
+def verify_tag_dir(ckpt_dir, check_crc=True, require_optim=True):
+    """Print a report for one tag dir; return True iff healthy.
+
+    ``require_optim=False`` (the ``--serve-ready`` preflight) accepts
+    params-only tags: a weight push loads model_states and nothing
+    else, so a missing optimizer group is by design there, not a gap.
+    """
     print(f"== {ckpt_dir}")
     healthy = True
     marker = ckpt.read_commit_marker(ckpt_dir)
@@ -101,7 +106,8 @@ def verify_tag_dir(ckpt_dir, check_crc=True):
                 print(f"  {name}: legacy single-file format")
             else:
                 print(f"  {name}: MISSING")
-                healthy = False
+                if name == "model_states" or require_optim:
+                    healthy = False
             continue
         except (json.JSONDecodeError, KeyError, ValueError, OSError) as e:
             # a torn/corrupt manifest is exactly what this tool exists to
@@ -153,6 +159,11 @@ def main(argv=None):
                     help="exit nonzero unless the newest committed "
                          "step-suffixed tag is at least step N (the "
                          "supervisor's resume sanity check)")
+    ap.add_argument("--serve-ready", action="store_true",
+                    help="exit nonzero unless every verified tag also "
+                         "carries a model_states group — the fleet "
+                         "swap-weights preflight (engine.swap_params / "
+                         "FleetRouter.swap_weights load params-only)")
     args = ap.parse_args(argv)
     check_crc = not args.no_crc
 
@@ -161,11 +172,25 @@ def main(argv=None):
         print(f"error: {path} is not a directory", file=sys.stderr)
         return 2
 
+    def check_serve_ready(tag_dir):
+        """--serve-ready: a swap target must carry model_states (the
+        only group the params-only serving loader reads)."""
+        if ckpt.state_groups(tag_dir)["model_states"]:
+            print(f"  serve-ready OK: {tag_dir} carries model_states")
+            return True
+        print(f"SERVE-READY FAILED: {tag_dir} has no model_states "
+              "group — swap_params would find nothing to load",
+              file=sys.stderr)
+        return False
+
     # a tag dir directly (has a marker/meta and no nested tags)
     if args.tag is None and not args.all and (
             os.path.isfile(os.path.join(path, ckpt.COMMIT_MARKER))
             or os.path.isfile(os.path.join(path, "meta.json"))):
-        ok = verify_tag_dir(path, check_crc)
+        ok = verify_tag_dir(path, check_crc,
+                            require_optim=not args.serve_ready)
+        if ok and args.serve_ready:
+            ok = check_serve_ready(path)
         if ok and args.expect_step is not None:
             # meta is authoritative (custom-named tags like 'best' carry
             # no step in their name); the name is only a fallback
@@ -197,7 +222,11 @@ def main(argv=None):
                   "loadable tag")
     rc = 0
     for t in targets:
-        if not verify_tag_dir(os.path.join(path, t), check_crc):
+        d = os.path.join(path, t)
+        if not verify_tag_dir(d, check_crc,
+                              require_optim=not args.serve_ready):
+            rc = 1
+        elif args.serve_ready and not check_serve_ready(d):
             rc = 1
     if args.expect_step is not None:
         newest = ckpt.newest_committed_step(path)
