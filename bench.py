@@ -171,6 +171,7 @@ METRICS = [
     "paged_decode_bytes",
     "masked_flash_flops_bytes",
     "serve_trace_overhead",
+    "health_overhead",
     "async_ckpt_stall_ms",
     "spec_decode_accepted_per_dispatch",
     "disagg_dispatch_structure",
@@ -191,7 +192,8 @@ HW_FREE = {"comm_wire_bytes_per_step", "comm_overlap_structure",
            "mfu_cost_model", "host_dispatch_overhead",
            "decode_throughput", "paged_kv_occupancy",
            "paged_decode_bytes", "masked_flash_flops_bytes",
-           "serve_trace_overhead", "async_ckpt_stall_ms",
+           "serve_trace_overhead", "health_overhead",
+           "async_ckpt_stall_ms",
            "spec_decode_accepted_per_dispatch",
            "disagg_dispatch_structure", "fleet_drain_goodput"}
 
@@ -253,10 +255,26 @@ def _apply_platform_override(jax):
 # long remote compiles inside the scan-timing protocol beat this so a
 # slow-but-alive tunnel is not mistaken for a dead one.
 _BEAT = [time.monotonic()]
+# health-plane black box (utils/health.py FlightRecorder), armed by
+# run_child: every _beat() lands a ring row, and the child watchdog
+# dumps the ring + all-thread stacks to _flight_path() on a stall so
+# the parent can salvage a postmortem instead of an empty tail
+_FLIGHT = [None]
 
 
 def _beat():
     _BEAT[0] = time.monotonic()
+    if _FLIGHT[0] is not None:
+        _FLIGHT[0].record({"event": "bench_beat",
+                           "t_mono": round(time.monotonic(), 3)})
+
+
+def _flight_path(metric):
+    """Where the child's black box lands — deterministic per metric so
+    the parent knows where to look after a kill. Control knob: excluded
+    from the source digest (see _git_head's control set)."""
+    return os.environ.get("BENCH_FLIGHT_PATH",
+                          f"/tmp/dstpu_bench_flight_{metric}.json")
 
 
 def _rtt():
@@ -1651,6 +1669,107 @@ def bench_serve_trace_overhead(on_tpu, rtt):
     return row
 
 
+def bench_health_overhead(on_tpu, rtt):
+    """Hardware-free row: the health plane (flight-recorder mirror tap,
+    live stall watchdog, numeric detectors) must be free at the
+    dispatch level. The same mixed-length continuous-batching workload
+    runs on two engines: health fully OFF vs fully ON (ring tap +
+    armed watchdog at a timeout the run never hits + all detectors at
+    defaults).
+
+    Pins (ISSUE 15 acceptance): per-run dispatch counts IDENTICAL
+    (the plane is host-side pure-Python by construction — with equal
+    dispatches, any wall delta IS host gap), ``steady_state_recompiles
+    == 0`` for both, greedy outputs bitwise equal, zero health alerts
+    on the healthy run. value = wall overhead percent of the enabled
+    engine (min-of-5 interleaved runs); acceptance <= 2%.
+    """
+    del on_tpu, rtt       # host-side accounting on the CPU backend
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.inference import InferenceEngine
+    from deepspeed_tpu.models.gpt2 import GPT2Config, init_gpt2_params
+
+    cfg = GPT2Config(vocab_size=256, max_position_embeddings=128,
+                     hidden_size=64, num_layers=2, num_heads=4,
+                     embd_dropout=0.0, attn_dropout=0.0,
+                     resid_dropout=0.0)
+    params = init_gpt2_params(cfg, jax.random.PRNGKey(0))
+    new_tokens = 24
+    icfg = {"max_batch_size": 4, "prompt_buckets": [8, 16],
+            "batch_buckets": [1, 4], "max_seq_len": 128,
+            "max_new_tokens": new_tokens}
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, 256, (length,)).tolist()
+               for length in (5, 8, 13, 3, 16, 7, 11, 4)]
+    tmp = tempfile.mkdtemp(prefix="dstpu_health_ovh_")
+
+    def build(on):
+        ic = dict(icfg, events_dir=os.path.join(
+            tmp, "on" if on else "off"))
+        # watchdog armed at a timeout the healthy run never trips, so
+        # the beat path itself is part of what this row prices
+        health = {"enabled": on, "stall_timeout_s": 120.0,
+                  "on_stall": "warn"}
+        eng = InferenceEngine(
+            cfg, params, ic, dtype=jnp.float32,
+            observability_config={"health": health})
+        eng.warmup()
+        return eng
+
+    eng_off = build(False)
+    eng_on = build(True)
+    _beat()
+
+    def one_run(eng):
+        t0 = time.perf_counter()
+        outs = eng.generate(prompts, max_new_tokens=new_tokens,
+                            temperature=0.0)
+        return time.perf_counter() - t0, outs
+
+    walls_off, walls_on = [], []
+    outs_off = outs_on = None
+    disp0_off = eng_off.compile_tracker.total_dispatches
+    disp0_on = eng_on.compile_tracker.total_dispatches
+    for _ in range(5):
+        w, outs_off = one_run(eng_off)
+        walls_off.append(w)
+        w, outs_on = one_run(eng_on)
+        walls_on.append(w)
+        _beat()
+    disp_off = eng_off.compile_tracker.total_dispatches - disp0_off
+    disp_on = eng_on.compile_tracker.total_dispatches - disp0_on
+    gen_tokens = sum(len(o) - len(p) for o, p in zip(outs_off, prompts))
+    tps_off = gen_tokens / min(walls_off)
+    tps_on = gen_tokens / min(walls_on)
+    overhead_pct = (min(walls_on) - min(walls_off)) / min(walls_off) * 100
+    alerts_on = eng_on.health.alerts_total
+    eng_on.close()
+    eng_off.close()
+    row = _emit(
+        "health_overhead", round(overhead_pct, 2),
+        "pct_wall_overhead",
+        round(tps_on / tps_off, 3) if tps_off > 0 else 0.0,
+        {"accept_overhead_pct": 2.0,
+         "tokens_per_s_off": round(tps_off, 2),
+         "tokens_per_s_on": round(tps_on, 2),
+         "dispatches_off": disp_off, "dispatches_on": disp_on,
+         "dispatch_delta": disp_on - disp_off,
+         "steady_state_recompiles_off": eng_off.steady_state_recompiles,
+         "steady_state_recompiles_on": eng_on.steady_state_recompiles,
+         "greedy_parity": outs_on == outs_off,
+         "health_alerts_on": alerts_on,
+         "requests_per_run": len(prompts), "new_tokens": new_tokens,
+         "backend": jax.default_backend(),
+         "source": "interleaved wall clock + CompileTracker dispatch "
+                   "accounting (hardware-free)"})
+    shutil.rmtree(tmp, ignore_errors=True)
+    return row
+
+
 def bench_async_ckpt_stall(on_tpu, rtt):
     """Hardware-free row: the step-loop stall a checkpoint save costs
     per global batch, async vs blocking, at EQUAL checkpoint size
@@ -2188,20 +2307,34 @@ def run_child(metric):
     subprocess timeout is the backstop if even this thread is starved).
     """
     _beat()
+    flight = _flight_path(metric)
 
     def _watchdog():
         while True:
             time.sleep(30)
             if time.monotonic() - _BEAT[0] > STALL_TIMEOUT:
+                rec = _FLIGHT[0]
+                if rec is not None:   # black box first, then the row
+                    rec.dump("bench_stall", extra={"stall": {
+                        "metric": metric, "phase": "bench_metric",
+                        "timeout_s": STALL_TIMEOUT}}, stacks=True)
                 _emit(metric, 0.0, "error", 0.0,
                       {"error": "device_unreachable: no benchmark "
                                 f"progress for {STALL_TIMEOUT}s "
-                                "(tunnel down?)", "skipped": True})
+                                "(tunnel down?)", "skipped": True,
+                       "stall_detected": {"phase": "bench_metric",
+                                          "flight": flight}})
                 os._exit(2)
 
     threading.Thread(target=_watchdog, daemon=True).start()
     import jax
     _apply_platform_override(jax)
+    # arm the flight recorder AFTER the watchdog thread exists (the
+    # package import below is itself inside the protected window — a
+    # dead tunnel can wedge any first device touch)
+    from deepspeed_tpu.utils.health import FlightRecorder
+    _FLIGHT[0] = FlightRecorder(flight, ring_events=128)
+    _FLIGHT[0].record({"event": "bench_start", "metric": metric})
     # persistent compile cache: children share compiled executables, so a
     # retried/resumed ladder only pays each remote compile once
     from deepspeed_tpu.utils.platform import enable_compile_cache
@@ -2246,6 +2379,8 @@ def run_child(metric):
         bench_masked_flash_flops_bytes(on_tpu, rtt)
     elif metric == "serve_trace_overhead":
         bench_serve_trace_overhead(on_tpu, rtt)
+    elif metric == "health_overhead":
+        bench_health_overhead(on_tpu, rtt)
     elif metric == "async_ckpt_stall_ms":
         bench_async_ckpt_stall(on_tpu, rtt)
     elif metric == "spec_decode_accepted_per_dispatch":
@@ -2320,7 +2455,7 @@ def _git_head():
         control = {"BENCH_PARTIAL", "BENCH_METRIC_TIMEOUT",
                    "BENCH_METRIC_RETRIES", "BENCH_NO_RESUME",
                    "BENCH_STALL_TIMEOUT", "BENCH_HW_FREE_TIMEOUT",
-                   "BENCH_TIME_BUDGET"}
+                   "BENCH_TIME_BUDGET", "BENCH_FLIGHT_PATH"}
         for k in sorted(os.environ):
             if k.startswith("BENCH_") and k not in control:
                 h.update(f"{k}={os.environ[k]}".encode())
@@ -2453,6 +2588,39 @@ def _last_metric_row(stdout, metric):
     return row if row is not None else err_row
 
 
+# Postmortems salvaged from stalled children, keyed by metric. A side
+# table (not a third return value) because the (row, err) contract of
+# _run_metric_subprocess is pinned by the ladder tests.
+_STALL_POSTMORTEMS = {}
+
+
+def _salvage_stall(metric, flight, err_row=None):
+    """Fold a stalled child's black box into _STALL_POSTMORTEMS so the
+    parent's error row carries the postmortem (which phase went silent,
+    how much pre-stall telemetry survived) instead of a bare timeout."""
+    post = {}
+    if err_row is not None:
+        sd = (err_row.get("detail") or {}).get("stall_detected")
+        if sd:
+            post["stall_detected"] = sd
+    try:
+        with open(flight) as f:
+            payload = json.load(f)
+        post["flight"] = {
+            "path": flight,
+            "trigger": payload.get("trigger"),
+            "rows": len(payload.get("rows", [])),
+            "stall": payload.get("stall"),
+            "threads": len(payload.get("stacks", [])),
+        }
+    except FileNotFoundError:
+        pass   # child died before the ring armed; nothing to attach
+    except Exception:
+        post["flight"] = {"path": flight, "error": "unreadable"}
+    if post:
+        _STALL_POSTMORTEMS[metric] = post
+
+
 def _run_metric_subprocess(metric):
     """(row, err): parse the child's last JSON row; err string on failure.
 
@@ -2468,15 +2636,23 @@ def _run_metric_subprocess(metric):
     salvaged instead of discarded (the r02–r05 "one hang zeroed the
     revision" fix)."""
     cmd = [sys.executable, os.path.abspath(__file__), "--metric", metric]
-    env = None
     timeout = HW_FREE_TIMEOUT if metric in HW_FREE else METRIC_TIMEOUT
     rem = _remaining_budget()
     if rem is not None:
         timeout = max(min(timeout, int(rem) - 10), 30)
+    # every child gets a deterministic flight-recorder path so a stalled
+    # child's black box can be salvaged even after a hard kill; a stale
+    # file from an earlier run must not masquerade as this run's dump
+    flight = _flight_path(metric)
+    env = dict(os.environ)
+    env["BENCH_FLIGHT_PATH"] = flight
+    try:
+        os.remove(flight)
+    except OSError:
+        pass
     if metric in HW_FREE:
         # hardware-free audits run on a virtual 8-device CPU mesh in
         # their own child — deterministic, tunnel-independent
-        env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
         env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
                             " --xla_force_host_platform_device_count=8")
@@ -2496,12 +2672,15 @@ def _run_metric_subprocess(metric):
                 f"child exceeded {timeout}s after the row landed "
                 "(teardown hang); measurement kept")
             return row, None
+        _salvage_stall(metric, flight, err_row=row)
         return None, f"metric subprocess exceeded {timeout}s (killed)"
     row = _last_metric_row(r.stdout, metric)
     if row is None:
+        _salvage_stall(metric, flight)
         tail = (r.stderr or r.stdout or "").strip().splitlines()[-3:]
         return None, f"child rc={r.returncode}, no row; tail={' | '.join(tail)}"
     if row.get("unit") == "error":
+        _salvage_stall(metric, flight, err_row=row)
         return None, str(row.get("detail", {}).get("error", "child error row"))
     if r.returncode != 0:
         # value row streamed, then the child died (in-child watchdog
@@ -2621,6 +2800,11 @@ def main():
     def error_row(metric):
         detail = failed_detail.get(
             metric, {"error": failed.get(metric, "unknown failure")})
+        post = _STALL_POSTMORTEMS.get(metric)
+        if post:
+            # the salvaged black box rides the error row: which phase
+            # went silent + how much pre-stall telemetry survived
+            detail = dict(detail, stalled=post)
         _emit(metric, 0.0, "error", 0.0, detail)
 
     for metric in METRICS:

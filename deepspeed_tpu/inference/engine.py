@@ -363,6 +363,14 @@ class InferenceEngine:
         self.compile_tracker = CompileTracker(
             step_provider=lambda: self._steps, warn_after=0,
             on_event=self._on_compile_event)
+        # postmortem health plane (utils/health.py): flight ring over
+        # the mirror, stall watchdog fed per-phase beats (prefill/
+        # decode/handoff_claim) — serve-side black box, host-only
+        from deepspeed_tpu.utils.health import HealthPlane
+        self.health = HealthPlane(
+            self.obs_config.get("health"), monitor=self.monitor,
+            rank=0, component="serve",
+            events_dir=cfg["events_dir"] or None)
         self._steps = 0
         self._warm_compiles: Optional[int] = None
         self._serve_secs = 0.0
@@ -1057,6 +1065,7 @@ class InferenceEngine:
         the DECODE phase claims it, so TTFT honestly includes the
         handoff wait."""
         sched = self.scheduler
+        self.health.heartbeat("prefill")
         t0 = time.perf_counter()
         for batch in sched.admit():
             t_p = time.perf_counter()
@@ -1099,6 +1108,7 @@ class InferenceEngine:
         sched = self.scheduler
         q = self._handoff_q
         tracer = self._tracer
+        self.health.heartbeat("handoff_claim")
         t0 = time.perf_counter()
         for rec in q.drain():
             slot = sched.slots[rec.slot]
@@ -1166,6 +1176,7 @@ class InferenceEngine:
         seq-``v`` verify dispatch that emits ``accepted + 1`` tokens
         per row. Returns whether anything dispatched."""
         sched = self.scheduler
+        self.health.heartbeat("decode")
         sids, toks, poss, temps, seeds = sched.decode_state()
         if not sids:
             return False
@@ -1529,6 +1540,9 @@ class InferenceEngine:
                                 wall_ms=round(ev.wall_ms, 3), step=ev.step)
 
     def close(self):
+        # health first: untapping restores the raw mirror so the
+        # identity check below still clears our own writer
+        self.health.close()
         if self._log is not None:
             # seal the run with a final pool/SLO snapshot — obs_report
             # renders the LAST serve_state row as the pool view

@@ -182,6 +182,10 @@ class FleetRouter:
         # env-armed serve-plane faults (DSTPU_FAULT_ARM) — latched
         # no-op when another component already armed this process
         fault.arm_from_env()
+        # health plane: the router beats the FIRST replica's watchdog
+        # once per scheduling round (duck-typed like monitor/_log — a
+        # fleet of stubs without one simply has no fleet heartbeat)
+        self.health = getattr(engines[0], "health", None)
         self._steps = 0
         self._pending: List[FinishedRequest] = []
         # ladder + ledger
@@ -383,6 +387,8 @@ class FleetRouter:
         out: List[FinishedRequest] = []
         out.extend(self._pending)
         self._pending = []
+        if self.health is not None:
+            self.health.heartbeat("fleet_step")
         for r in self.replicas:
             if r.status == RETIRED:
                 continue

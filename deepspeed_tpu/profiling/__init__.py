@@ -79,6 +79,11 @@ from deepspeed_tpu.utils.monitor import (  # noqa: E402,F401
 from deepspeed_tpu.utils.monitor import (  # noqa: E402,F401
     TAG_CKPT_PENDING, TAG_CKPT_RESTARTS, TAG_CKPT_SNAPSHOT_MS,
     TAG_CKPT_WRITE_MS)
+# health plane (ISSUE 15), same canonical-home arrangement (utils/
+# health.py writes it via the monitor; obs_report mirrors; pinned by
+# tests/unit/test_health.py)
+from deepspeed_tpu.utils.monitor import (  # noqa: E402,F401
+    TAG_HEALTH_ALERTS)
 
 
 class Observer:

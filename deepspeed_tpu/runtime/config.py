@@ -507,6 +507,71 @@ def get_observability_config(param_dict):
         raise DeepSpeedConfigError(
             "observability.serve.events_max_mb must be >= 0 (0 disables "
             "rotation)")
+    hl = sub.get(C.OBS_HEALTH, {}) or {}
+    det = hl.get(C.OBS_HEALTH_DETECTORS, {}) or {}
+    health = {
+        "enabled": bool(hl.get(C.OBS_HEALTH_ENABLED,
+                               C.OBS_HEALTH_ENABLED_DEFAULT)),
+        "ring_events": int(hl.get(C.OBS_HEALTH_RING_EVENTS,
+                                  C.OBS_HEALTH_RING_EVENTS_DEFAULT)),
+        "stall_timeout_s": float(hl.get(
+            C.OBS_HEALTH_STALL_TIMEOUT_S,
+            C.OBS_HEALTH_STALL_TIMEOUT_S_DEFAULT)),
+        "on_stall": str(hl.get(C.OBS_HEALTH_ON_STALL,
+                               C.OBS_HEALTH_ON_STALL_DEFAULT)),
+        "flight_path": str(hl.get(C.OBS_HEALTH_FLIGHT_PATH,
+                                  C.OBS_HEALTH_FLIGHT_PATH_DEFAULT)),
+        "detectors": {
+            "enabled": bool(det.get(C.OBS_HEALTH_DET_ENABLED,
+                                    C.OBS_HEALTH_DET_ENABLED_DEFAULT)),
+            "nonfinite_streak": int(det.get(
+                C.OBS_HEALTH_DET_NONFINITE_STREAK,
+                C.OBS_HEALTH_DET_NONFINITE_STREAK_DEFAULT)),
+            "spike_zscore": float(det.get(
+                C.OBS_HEALTH_DET_SPIKE_ZSCORE,
+                C.OBS_HEALTH_DET_SPIKE_ZSCORE_DEFAULT)),
+            "spike_window": int(det.get(
+                C.OBS_HEALTH_DET_SPIKE_WINDOW,
+                C.OBS_HEALTH_DET_SPIKE_WINDOW_DEFAULT)),
+            "grad_norm_max": float(det.get(
+                C.OBS_HEALTH_DET_GRAD_NORM_MAX,
+                C.OBS_HEALTH_DET_GRAD_NORM_MAX_DEFAULT)),
+            "scale_collapse_below": float(det.get(
+                C.OBS_HEALTH_DET_SCALE_COLLAPSE_BELOW,
+                C.OBS_HEALTH_DET_SCALE_COLLAPSE_BELOW_DEFAULT)),
+            "recompile_storm_count": int(det.get(
+                C.OBS_HEALTH_DET_RECOMPILE_STORM_COUNT,
+                C.OBS_HEALTH_DET_RECOMPILE_STORM_COUNT_DEFAULT)),
+            "recompile_storm_window": int(det.get(
+                C.OBS_HEALTH_DET_RECOMPILE_STORM_WINDOW,
+                C.OBS_HEALTH_DET_RECOMPILE_STORM_WINDOW_DEFAULT)),
+        },
+    }
+    # validated here for the same standalone-parse reason as serve
+    if health["ring_events"] < 1:
+        raise DeepSpeedConfigError(
+            "observability.health.ring_events must be >= 1, got "
+            f"{health['ring_events']}")
+    if health["stall_timeout_s"] < 0:
+        raise DeepSpeedConfigError(
+            "observability.health.stall_timeout_s must be >= 0 (0 "
+            f"disables the watchdog), got {health['stall_timeout_s']}")
+    if health["on_stall"] not in ("warn", "exit"):
+        raise DeepSpeedConfigError(
+            "observability.health.on_stall must be 'warn' or 'exit', "
+            f"got {health['on_stall']!r}")
+    _det = health["detectors"]
+    if _det["nonfinite_streak"] < 1 or _det["spike_window"] < 2 or \
+            _det["recompile_storm_count"] < 1 or \
+            _det["recompile_storm_window"] < 1:
+        raise DeepSpeedConfigError(
+            "observability.health.detectors window/streak/count knobs "
+            f"must be positive, got {_det}")
+    if _det["spike_zscore"] <= 0 or _det["grad_norm_max"] <= 0 or \
+            _det["scale_collapse_below"] <= 0:
+        raise DeepSpeedConfigError(
+            "observability.health.detectors thresholds must be > 0, "
+            f"got {_det}")
     return {
         "enabled": sub.get(C.OBS_ENABLED, C.OBS_ENABLED_DEFAULT),
         "events_dir": sub.get(C.OBS_EVENTS_DIR, C.OBS_EVENTS_DIR_DEFAULT),
@@ -520,6 +585,7 @@ def get_observability_config(param_dict):
         "chrome_trace_path": sub.get(C.OBS_CHROME_TRACE_PATH,
                                      C.OBS_CHROME_TRACE_PATH_DEFAULT),
         "serve": serve,
+        "health": health,
         "trace": trace,
     }
 

@@ -314,6 +314,56 @@ OBS_SERVE_SAMPLE_RATE_DEFAULT = 0.0625
 # (None = inherit the top-level observability.events_max_mb)
 OBS_SERVE_EVENTS_MAX_MB = "events_max_mb"
 OBS_SERVE_EVENTS_MAX_MB_DEFAULT = None
+# postmortem health plane (deepspeed_tpu/utils/health.py): flight
+# recorder ring, stall watchdog, numeric anomaly detectors. Entirely
+# host-side; enabling it is pinned to leave losses/params/outputs
+# bitwise identical (tests/unit/test_health.py).
+#
+# "health": {
+#   "enabled": false,
+#   "ring_events": 256,        # flight-ring rows kept in memory
+#   "stall_timeout_s": 0.0,    # 0 disables the watchdog thread
+#   "on_stall": "warn",        # or "exit" (code 87, see health.py)
+#   "flight_path": "",         # "" = <events_dir>/flight.json
+#   "detectors": {
+#     "enabled": true,
+#     "nonfinite_streak": 3,        # NaN/inf losses in a row -> alert
+#     "spike_zscore": 6.0,          # rolling z-score spike threshold
+#     "spike_window": 32,           # rolling window (steps)
+#     "grad_norm_max": 1.0e4,       # grad-norm explosion ceiling
+#     "scale_collapse_below": 2.0,  # dynamic loss-scale floor
+#     "recompile_storm_count": 3,   # compiles within ...
+#     "recompile_storm_window": 16  # ... this many steps -> alert
+#   }
+# }
+OBS_HEALTH = "health"
+OBS_HEALTH_ENABLED = "enabled"
+OBS_HEALTH_ENABLED_DEFAULT = False
+OBS_HEALTH_RING_EVENTS = "ring_events"
+OBS_HEALTH_RING_EVENTS_DEFAULT = 256
+OBS_HEALTH_STALL_TIMEOUT_S = "stall_timeout_s"
+OBS_HEALTH_STALL_TIMEOUT_S_DEFAULT = 0.0
+OBS_HEALTH_ON_STALL = "on_stall"
+OBS_HEALTH_ON_STALL_DEFAULT = "warn"
+OBS_HEALTH_FLIGHT_PATH = "flight_path"
+OBS_HEALTH_FLIGHT_PATH_DEFAULT = ""
+OBS_HEALTH_DETECTORS = "detectors"
+OBS_HEALTH_DET_ENABLED = "enabled"
+OBS_HEALTH_DET_ENABLED_DEFAULT = True
+OBS_HEALTH_DET_NONFINITE_STREAK = "nonfinite_streak"
+OBS_HEALTH_DET_NONFINITE_STREAK_DEFAULT = 3
+OBS_HEALTH_DET_SPIKE_ZSCORE = "spike_zscore"
+OBS_HEALTH_DET_SPIKE_ZSCORE_DEFAULT = 6.0
+OBS_HEALTH_DET_SPIKE_WINDOW = "spike_window"
+OBS_HEALTH_DET_SPIKE_WINDOW_DEFAULT = 32
+OBS_HEALTH_DET_GRAD_NORM_MAX = "grad_norm_max"
+OBS_HEALTH_DET_GRAD_NORM_MAX_DEFAULT = 1.0e4
+OBS_HEALTH_DET_SCALE_COLLAPSE_BELOW = "scale_collapse_below"
+OBS_HEALTH_DET_SCALE_COLLAPSE_BELOW_DEFAULT = 2.0
+OBS_HEALTH_DET_RECOMPILE_STORM_COUNT = "recompile_storm_count"
+OBS_HEALTH_DET_RECOMPILE_STORM_COUNT_DEFAULT = 3
+OBS_HEALTH_DET_RECOMPILE_STORM_WINDOW = "recompile_storm_window"
+OBS_HEALTH_DET_RECOMPILE_STORM_WINDOW_DEFAULT = 16
 
 #############################################
 # Async step pipeline (TPU-native: the host must never sit between two

@@ -493,6 +493,18 @@ class DeepSpeedEngine:
             num_devices=len(jax.devices()))
         self.observability.set_step_provider(
             lambda: self._host_global_step)
+        # postmortem health plane ('observability.health' section):
+        # flight-recorder ring tapping the monitor mirror, stall
+        # watchdog fed heartbeats at dispatch boundaries, numeric
+        # anomaly detectors over the deferred-telemetry flush values
+        # (utils/health.py — host-side only, pinned zero-perturbation)
+        from ..utils.health import HealthPlane
+        self.health = HealthPlane(
+            self._config.observability_config.get("health"),
+            monitor=self.monitor, rank=jax.process_index(),
+            component="train",
+            events_dir=self._config.observability_config.get(
+                "events_dir"))
         # fault-tolerant checkpointing knobs ('checkpoint' config section):
         # CRC verification on load, retention, transient-I/O retry policy
         self._ckpt_cfg = self._config.checkpoint_config
@@ -2062,6 +2074,10 @@ class DeepSpeedEngine:
             atexit.unregister(self._atexit_flush_hook)
         except Exception:
             pass
+        # health BEFORE observability: untapping the mirror restores
+        # the Observer's own writer so its close-time identity check
+        # (mirror is self._log) still clears it
+        self.health.close()
         self.observability.close()
         if save_error is not None:
             raise save_error
@@ -2367,6 +2383,11 @@ class DeepSpeedEngine:
         # the software preemption) here and the window below must still
         # run to completion before the boundary drain fires
         fault.fire("elastic.sigterm_mid_window", step=self._host_global_step)
+        # health-plane liveness beat, then the armed-stall point: the
+        # `stall` action wedges the loop HERE, past the beat, so the
+        # watchdog observes a genuinely silent train_batch phase
+        self.health.heartbeat("train_batch")
+        fault.fire("health.stall", step=self._host_global_step)
         fused = self._batch_path()
         self.tput_timer.start()
         _t_step0 = time.perf_counter()
@@ -2662,12 +2683,31 @@ class DeepSpeedEngine:
         skip_offset = self._host_global_step - int(self.state.global_step)
         for rec in ring:
             lr_step = max(rec["host_step"] - skip_offset, 0)
+            loss_val = (float(rec["loss"]) if rec["loss"] is not None
+                        else None)
+            # armed-fault poison (health.nan_loss): corrupt THIS record's
+            # telemetry value to NaN — params and the returned device
+            # loss are untouched; the detector below must catch it
+            try:
+                fault.fire("health.nan_loss", step=rec["host_step"])
+            except fault.InjectedCrash:
+                if loss_val is not None:
+                    loss_val = float("nan")
+            scale_val = (float(rec["scale"])
+                         if rec.get("scale") is not None else scale)
+            # numeric health detectors read the SAME host floats the
+            # monitor writes — this flush barrier already materialized
+            # them, so the feed adds no device sync
+            self.health.observe_loss(loss_val, rec["host_step"])
+            # a collapse needs a DYNAMIC scale: fp32 / static-scale
+            # runs hold a constant (often 1.0) that must not alert
+            if self.dynamic_loss_scale():
+                self.health.observe_loss_scale(scale_val,
+                                               rec["host_step"])
             self.monitor.write_train_metrics(
-                loss=(float(rec["loss"]) if rec["loss"] is not None
-                      else None),
+                loss=loss_val,
                 lr=float(self._lr_at(lr_step)),
-                loss_scale=(float(rec["scale"])
-                            if rec.get("scale") is not None else scale),
+                loss_scale=scale_val,
                 samples=rec["samples"], flush=False)
             # step time only from boundary flushes: an out-of-band
             # flush (eval/save/last_loss — arbitrary idle or mere host
@@ -2683,6 +2723,10 @@ class DeepSpeedEngine:
                         "Train/Samples/samples_per_sec",
                         self.train_batch_size() / (step_ms / 1e3),
                         rec["samples"])
+        tracker = self.observability.compile_tracker
+        if tracker is not None:
+            self.health.observe_recompiles(tracker.total_compiles,
+                                           self._host_global_step)
         self.observability.write_mfu(
             avg_ms, ring[-1]["samples"],
             micro_steps_per_step=(1 if self._use_fused_batch
@@ -2904,6 +2948,11 @@ class DeepSpeedEngine:
             from jax.experimental import multihost_utils
             multihost_utils.sync_global_devices("ckpt_committed")
         write_ms = (time.time() - t0) * 1000.0
+        # liveness beat from the commit tail (thread-safe: the watchdog
+        # timestamp is a plain assignment, and this runs on the async
+        # writer thread for async saves) — a long blocking save must
+        # not read as a stalled train loop
+        self.health.heartbeat("checkpoint_commit")
         pending = (max(0, self._ckpt_writer.pending_saves() - 1)
                    if self._ckpt_writer is not None else 0)
         self.monitor.write_elastic_metrics(
@@ -2992,6 +3041,10 @@ class DeepSpeedEngine:
         self.observability.event(
             "preemption", reason=reason, step=step, tag=tag,
             committed=committed, restarts=self._restart_count)
+        # black-box dump before close tears the telemetry down: the
+        # relaunched incarnation (or a human) reads flight.json to see
+        # the final pre-drain ring
+        self.health.dump("drain", reason=reason, step=step, tag=tag)
         try:
             self.close()
         except Exception as e:

@@ -55,6 +55,24 @@ grammar):
                                  reroute the request to the next-best
                                  replica, never drop it
 
+Health-plane points (ISSUE 15 — ``utils/health.py`` watchdog and
+detectors; process-boundary-testable like the supervisor tests):
+
+- ``health.stall``             : at the top of every ``train_batch``
+                                 window, right after the heartbeat — arm
+                                 the ``stall`` env action (or a sleeping
+                                 callback) to wedge the step loop past
+                                 ``stall_timeout_s`` and prove the
+                                 watchdog dumps flight.json + stacks and
+                                 emits ``stall_detected``
+- ``health.nan_loss``          : at the monitor-flush barrier where each
+                                 deferred loss is materialized host-side
+                                 (ctx: ``step``) — arm ``crash`` and the
+                                 engine poisons THAT loss value to NaN
+                                 (telemetry only, params untouched) to
+                                 prove the nonfinite-streak detector
+                                 emits its pinned ``health`` row
+
 ``retry_io`` is the exponential-backoff wrapper used around all checkpoint
 I/O; it retries ``OSError`` (transient filesystem flakes) but never
 ``InjectedCrash`` (a simulated process death must kill the save).
@@ -67,7 +85,9 @@ itself at engine init from the environment. Grammar (comma-separated)::
 
 with actions ``crash`` (raise InjectedCrash), ``oserror`` (raise OSError),
 ``sigterm`` (deliver a real SIGTERM to this process), ``preempt`` (flag
-the installed PreemptionGuards via ``elastic.request_preemption``).
+the installed PreemptionGuards via ``elastic.request_preemption``), and
+``stall`` (sleep ``DSTPU_FAULT_STALL_S`` seconds — default 30 — inside
+the fault point, wedging the caller past the health watchdog's timeout).
 ``@once_file`` makes the arm cross-process-one-shot: the spec only arms
 while the file exists and the first fire deletes it, so a supervisor
 relaunch with the *same* environment is not re-faulted forever.
@@ -203,10 +223,16 @@ def _env_action(name: str, point: str) -> Callable[..., None]:
         def act(**ctx):
             from deepspeed_tpu.runtime import elastic
             elastic.request_preemption(f"env-armed fault at {point}")
+    elif name == "stall":
+        def act(**ctx):
+            # wedge the CALLER (not a side thread): the health
+            # watchdog must observe a genuinely silent step loop
+            time.sleep(float(os.environ.get("DSTPU_FAULT_STALL_S",
+                                            "30")))
     else:
         raise ValueError(
             f"{ENV_ARM}: unknown action {name!r} (want crash | oserror "
-            f"| sigterm | preempt)")
+            f"| sigterm | preempt | stall)")
     return act
 
 

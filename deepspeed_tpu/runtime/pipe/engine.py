@@ -209,6 +209,10 @@ class PipelineEngine(DeepSpeedEngine):
         # base engine (runtime/elastic.py; no-op unless armed)
         fault.fire("elastic.sigterm_mid_window",
                    step=self._host_global_step)
+        # health passthrough: same beat-then-armed-stall order as the
+        # base engine's train_batch
+        self.health.heartbeat("train_batch")
+        fault.fire("health.stall", step=self._host_global_step)
         with self.observability.span("pipe/stack_batch"):
             batch = self._stack_micro_batches(data_iter)
         step_fn = self._get_compiled_micro_step()

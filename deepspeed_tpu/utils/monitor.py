@@ -67,6 +67,12 @@ TAG_CKPT_SNAPSHOT_MS = "Checkpoint/snapshot_ms"     # device->host copy
 TAG_CKPT_WRITE_MS = "Checkpoint/write_ms"           # stage/commit protocol
 TAG_CKPT_PENDING = "Checkpoint/pending_saves"       # async writer backlog
 TAG_CKPT_RESTARTS = "Checkpoint/restarts"           # supervisor relaunches
+# health plane (ISSUE 15): cumulative numeric-anomaly alert count from
+# utils/health.py's detectors (nan_loss / loss_spike / ... — the pinned
+# HEALTH_REASONS vocabulary rides in the per-alert "health" event rows).
+# Canonical home — profiling/__init__.py re-exports it; tools/
+# obs_report.py mirrors the string (pinned by tests/unit/test_health.py).
+TAG_HEALTH_ALERTS = "Health/alerts"                 # cumulative alerts
 
 
 class Histogram:
@@ -463,8 +469,14 @@ class TensorBoardMonitor:
 
     def write_timer_values(self, timer_values: dict, samples: int = 0):
         """Per-timer milliseconds (engine.py:950-974 pattern)."""
+        if not self._writes():
+            return
         for name, ms in timer_values.items():
             self.write_scalar(f"Train/Samples/{name}", ms, samples)
+        # same contract as every other write_* method: without the
+        # flush, timer telemetry buffered in the writer is lost on
+        # crash/preemption
+        self.flush()
 
     def flush(self):
         if self.writer is not None:

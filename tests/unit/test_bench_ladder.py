@@ -208,6 +208,100 @@ def test_hw_free_rows_land_even_with_dead_tunnel(monkeypatch, capsys,
     assert "freebie" in bench._load_partial("src-TEST")
 
 
+# ------------------------------------------------- stalled-child postmortem
+
+
+def test_stalled_child_black_box_is_salvaged(monkeypatch, tmp_path):
+    """ISSUE 15: a child whose stall watchdog fired dumps its flight
+    ring before os._exit(2) and names the stall in its error row; the
+    parent folds BOTH into _STALL_POSTMORTEMS keyed by metric."""
+    flight = str(tmp_path / "flight.json")
+    monkeypatch.setenv("BENCH_FLIGHT_PATH", flight)
+    monkeypatch.setattr(bench, "_STALL_POSTMORTEMS", {})
+    err = {"metric": "m", "value": 0.0, "unit": "error",
+           "vs_baseline": 0.0,
+           "detail": {"error": "device_unreachable: no benchmark "
+                               "progress for 300s (tunnel down?)",
+                      "skipped": True,
+                      "stall_detected": {"phase": "bench_metric",
+                                         "flight": flight}}}
+
+    def fake_run(cmd, **kw):
+        # the "child": dumps its black box, then streams the error row
+        with open(flight, "w") as f:
+            json.dump({"trigger": "bench_stall",
+                       "rows": [{"event": "bench_start", "metric": "m"},
+                                {"event": "bench_beat", "t_mono": 1.0}],
+                       "stall": {"metric": "m", "phase": "bench_metric",
+                                 "timeout_s": 300},
+                       "stacks": {"MainThread (1)": ["wedged here"]}}, f)
+
+        class R:
+            stdout = json.dumps(err) + "\n"
+            stderr, returncode = "", 2
+        return R()
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    got, errmsg = bench._run_metric_subprocess("m")
+    assert got is None and "device_unreachable" in errmsg
+    post = bench._STALL_POSTMORTEMS["m"]
+    assert post["stall_detected"]["phase"] == "bench_metric"
+    assert post["flight"]["trigger"] == "bench_stall"
+    assert post["flight"]["rows"] == 2       # pre-stall ring survived
+    assert post["flight"]["stall"]["phase"] == "bench_metric"
+    assert post["flight"]["threads"] == 1
+    # a stale flight file is REMOVED before the next launch — it must
+    # never masquerade as a fresh dump
+    seen = []
+
+    def fake_run2(cmd, **kw):
+        seen.append(bench.os.path.exists(flight))
+
+        class R:
+            stdout = json.dumps(_row("m", 1.0)) + "\n"
+            stderr, returncode = "", 0
+        return R()
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run2)
+    got, errmsg = bench._run_metric_subprocess("m")
+    assert got is not None and seen == [False]
+
+
+def test_error_row_carries_stall_postmortem(monkeypatch, capsys,
+                                            tmp_path):
+    """main()'s explicit error row for a stalled metric includes the
+    salvaged postmortem under detail.stalled."""
+    monkeypatch.setattr(bench, "PARTIAL_PATH", str(tmp_path / "p.jsonl"))
+    monkeypatch.setattr(bench, "METRICS", ["stuck"])
+    monkeypatch.setattr(bench, "HW_FREE", {"stuck"})
+    monkeypatch.setattr(bench, "HEADLINE", "stuck")
+    monkeypatch.setattr(bench, "_T_START", time.monotonic())
+    monkeypatch.setattr(bench.sys, "argv", ["bench.py"])
+    monkeypatch.setattr(bench, "_git_head", lambda: "src-TEST")
+    post = {"stall_detected": {"phase": "bench_metric", "flight": "/f"},
+            "flight": {"path": "/f", "trigger": "bench_stall",
+                       "rows": 7, "stall": None, "threads": 3}}
+    monkeypatch.setattr(bench, "_STALL_POSTMORTEMS", {"stuck": post})
+    monkeypatch.setattr(bench, "_run_metric_subprocess",
+                        lambda m: (None, "metric subprocess exceeded "
+                                         "300s (killed)"))
+    bench.main()
+    rows = [json.loads(l) for l in capsys.readouterr().out.splitlines()
+            if l.strip().startswith("{")]
+    row = rows[-1]
+    assert row["metric"] == "stuck" and row["unit"] == "error"
+    assert row["detail"]["stalled"] == post
+    assert row["detail"]["stalled"]["flight"]["rows"] == 7
+
+
+def test_health_overhead_is_in_the_ladder():
+    assert "health_overhead" in bench.METRICS
+    assert "health_overhead" in bench.HW_FREE
+    # hardware-free: runs before the tunnel probe, in canonical order
+    assert (bench.METRICS.index("health_overhead")
+            < bench.METRICS.index("bert_large_samples_per_s"))
+
+
 # ------------------------------------------------------------- comm row
 
 

@@ -100,6 +100,41 @@ def test_comm_metrics_flushed(tmp_path, monkeypatch):
     assert tags["Train/Samples/comm_compression_ratio"] == 2.0
 
 
+def test_timer_values_flushed_and_gated(tmp_path, monkeypatch):
+    """write_timer_values had BOTH halves of the write_* contract
+    missing: no _writes() early-out (it crashed a disabled monitor on
+    the f-string write path) and no trailing flush (timer telemetry
+    buffered in the writer died with the process). Regression-pin
+    both."""
+    import deepspeed_tpu.utils.monitor as mon
+
+    class CountingWriter(_JsonlWriter):
+        flushes = 0
+
+        def flush(self):
+            CountingWriter.flushes += 1
+            super().flush()
+
+    CountingWriter.flushes = 0
+    monkeypatch.setattr(mon, "_make_writer",
+                        lambda log_dir: CountingWriter(log_dir))
+    m = TensorBoardMonitor(enabled=True, output_path=str(tmp_path),
+                           job_name="job")
+    m.write_timer_values({"forward_microstep": 12.5, "backward": 30.0},
+                         samples=64)
+    assert CountingWriter.flushes >= 1
+    m.close()
+    lines = [json.loads(l) for l in
+             open(os.path.join(tmp_path, "job", "events.jsonl"))]
+    tags = {l["tag"]: (l["value"], l["step"]) for l in lines}
+    assert tags["Train/Samples/forward_microstep"] == (12.5, 64)
+    assert tags["Train/Samples/backward"] == (30.0, 64)
+    # disabled monitor (no mirror): clean no-op, nothing written
+    off = TensorBoardMonitor(enabled=False)
+    off.write_timer_values({"forward": 1.0}, samples=1)
+    off.close()
+
+
 def test_monitor_mirror_receives_all_scalars(tmp_path):
     """The observability layer attaches a JSONL mirror: every monitor
     scalar (train metrics, checkpoint events, comm bytes) lands there

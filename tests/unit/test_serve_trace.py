@@ -702,7 +702,7 @@ class TestChromeLanes:
 
 
 # --------------------------------------------------------------------- #
-# obs_report: --serve, schema v2, engine-driven rotation
+# obs_report: --serve, versioned schema, engine-driven rotation
 # --------------------------------------------------------------------- #
 class TestServeReport:
     @pytest.fixture(scope="class")
@@ -727,7 +727,7 @@ class TestServeReport:
         assert segs, "0.002 MiB cap must rotate on this run"
         obs_report = _load_tool("obs_report")
         s = obs_report.summarize(report_run)
-        assert s["schema"] == 2
+        assert s["schema"] == 3     # v3 (ISSUE 15) keeps every v2 key
         sv = s["serving"]
         # early rows (warmup, first admits) live in rotated segments;
         # losing them would undercount requests
@@ -759,7 +759,7 @@ class TestServeReport:
         assert "serving report" in out and "goodput" in out
         assert obs_report.main([report_run, "--json"]) == 0
         payload = json.loads(capsys.readouterr().out)
-        assert payload["schema"] == 2
+        assert payload["schema"] == 3
         assert payload["serving"]["slo"]["attainment"] == 1.0
 
 

@@ -24,16 +24,26 @@ tests/unit/test_monitor.py) and prints the run report:
   TTFT / TBT p50/p95/p99, SLO attainment, goodput vs raw throughput,
   evictions, and the page-pool / prefix-cache snapshot from the last
   ``serve_state`` event
+- health section (``--health`` renders the postmortem standalone):
+  numeric-anomaly alerts by pinned reason, the watchdog's stall
+  diagnosis (last phase + flight.json location), black-box dump trail
 - loss trajectory (first -> last)
+
+``--diff RUN_A RUN_B`` compares two runs metric-by-metric (step-time
+p50/p95, samples/s, MFU, goodput, recompiles, health alerts/stalls)
+with threshold-based REGRESSED / IMPROVED / OK verdicts and exits
+nonzero on any regression — the bench-trajectory regression gate.
 
 Usage::
 
     python tools/obs_report.py <events.jsonl | dir> [--json] [--serve]
+                               [--health]
+    python tools/obs_report.py --diff RUN_A RUN_B [--json]
 
 Rotated event logs (``observability.events_max_mb``) are read as one
 stream: ``events.jsonl.1``, ``.2``, ... in sequence order, then the
 live file. The ``--json`` output is versioned by a top-level
-``"schema"`` key (currently 2 — bumped when existing keys move or
+``"schema"`` key (currently 3 — bumped when existing keys move or
 change meaning; additive keys don't bump it), so CI consumers can pin
 what they parse.
 
@@ -101,11 +111,17 @@ T_CKPT_SNAPSHOT = "Checkpoint/snapshot_ms"
 T_CKPT_WRITE = "Checkpoint/write_ms"
 T_CKPT_PENDING = "Checkpoint/pending_saves"
 T_CKPT_RESTARTS = "Checkpoint/restarts"
+# health plane (utils/health.py): cumulative anomaly-alert counter; the
+# `health` / `stall_detected` / `flight_dump` event rows carry the
+# per-alert reason (pinned HEALTH_REASONS), the watchdog postmortem,
+# and the black-box dump locations
+T_HEALTH_ALERTS = "Health/alerts"
 
 # --json output schema version: bumped when existing keys move or
 # change meaning (additive keys don't bump it). v2 = ISSUE 9 (serving
-# SLO section + this key itself).
-SCHEMA_VERSION = 2
+# SLO section + this key itself); v3 = ISSUE 15 (health + diff
+# sections — every v2 key is unchanged).
+SCHEMA_VERSION = 3
 
 # host gap above this fraction of step time flags the run: the device
 # is waiting on the host often enough to cost real throughput
@@ -423,6 +439,34 @@ def summarize(path, host_gap_threshold=DEFAULT_HOST_GAP_THRESHOLD):
     pending = _vals(scalars, T_CKPT_PENDING)
     preempt_events = [e for e in events if e.get("event") == "preemption"]
     resume_events = [e for e in events if e.get("event") == "resume"]
+
+    # health plane (utils/health.py): the numeric-anomaly alert rows,
+    # the watchdog's stall postmortems, and the black-box dump trail
+    health_rows = [e for e in events if e.get("event") == "health"]
+    stall_rows = [e for e in events
+                  if e.get("event") == "stall_detected"]
+    dump_rows = [e for e in events if e.get("event") == "flight_dump"]
+    by_reason = defaultdict(int)
+    for e in health_rows:
+        by_reason[str(e.get("reason", "?"))] += 1
+    alerts_scalar = _last(scalars, T_HEALTH_ALERTS)
+    last_stall = stall_rows[-1] if stall_rows else None
+    health = {
+        "alerts": (int(alerts_scalar) if alerts_scalar is not None
+                   else len(health_rows)),
+        "by_reason": dict(by_reason),
+        "rows": [{k: e.get(k) for k in ("reason", "step", "component")}
+                 for e in health_rows],
+        "stalls": len(stall_rows),
+        "last_stall": ({k: last_stall.get(k)
+                        for k in ("phase", "silent_s", "timeout_s",
+                                  "component", "flight")}
+                       if last_stall else None),
+        "flight_dumps": [{k: e.get(k)
+                          for k in ("trigger", "flight", "component")}
+                         for e in dump_rows],
+    }
+
     elastic = {
         "snapshot_ms_mean": (sum(snap_ms) / len(snap_ms)
                              if snap_ms else None),
@@ -498,6 +542,7 @@ def summarize(path, host_gap_threshold=DEFAULT_HOST_GAP_THRESHOLD):
                              if ckpt["save_ms"] else None),
         },
         "elastic": elastic,
+        "health": health,
         "loss": {
             "first": loss[0] if loss else None,
             "last": loss[-1] if loss else None,
@@ -651,6 +696,15 @@ def render(s):
                 f"    last_preemption : {lp.get('reason')} at step "
                 f"{lp.get('step')} -> tag={lp.get('tag')} "
                 f"(committed={lp.get('committed')})")
+    hl = s.get("health") or {}
+    if hl.get("alerts") or hl.get("stalls"):
+        parts = ", ".join(f"{k}={v}" for k, v in
+                          sorted((hl.get("by_reason") or {}).items()))
+        lines.append(
+            f"  health            : alerts={hl.get('alerts', 0)} "
+            f"stalls={hl.get('stalls', 0)}"
+            + (f" ({parts})" if parts else "")
+            + "  ** see --health for the postmortem **")
     lines += [
         f"  loss              : first={_fmt(s['loss']['first'], '{:.4f}')} "
         f"last={_fmt(s['loss']['last'], '{:.4f}')}",
@@ -801,21 +855,178 @@ def render_serve(s):
     return "\n".join(lines)
 
 
+def render_health(s):
+    """The health-plane postmortem (``--health``): anomaly alerts by
+    pinned reason, the watchdog's stall diagnosis (phase + flight.json
+    location), and the black-box dump trail — what you read FIRST when
+    a run died or wedged."""
+    hl = s.get("health") or {}
+    lines = [f"health report: {s['events_file']}"]
+    if not (hl.get("alerts") or hl.get("stalls")
+            or hl.get("flight_dumps")):
+        lines.append("  (no health events in this log — clean run, or "
+                     "observability.health not enabled)")
+        return "\n".join(lines)
+    lines.append(f"  alerts            : {hl.get('alerts', 0)}")
+    for reason, n in sorted((hl.get("by_reason") or {}).items()):
+        lines.append(f"    - {reason}: {n}")
+    for row in hl.get("rows") or []:
+        lines.append(
+            f"    alert           : {row.get('reason')} at step "
+            f"{row.get('step')} ({row.get('component')})")
+    lines.append(f"  stalls            : {hl.get('stalls', 0)}")
+    ls = hl.get("last_stall")
+    if ls:
+        lines.append(
+            f"    last_stall      : phase={ls.get('phase')} "
+            f"silent={_fmt(ls.get('silent_s'), '{:.1f}')}s "
+            f"(timeout {_fmt(ls.get('timeout_s'), '{:.1f}')}s, "
+            f"{ls.get('component')})")
+        if ls.get("flight"):
+            lines.append(f"    flight          : {ls['flight']}")
+    for d in hl.get("flight_dumps") or []:
+        lines.append(
+            f"  flight_dump       : trigger={d.get('trigger')} -> "
+            f"{d.get('flight')} ({d.get('component')})")
+    return "\n".join(lines)
+
+
+# ------------------------------------------------------------------- #
+# cross-run regression diffing (--diff RUN_A RUN_B)
+# ------------------------------------------------------------------- #
+
+# (name, extractor, direction, relative threshold). Directions:
+# "lower"  = lower is better (latency)   — regressed when B/A - 1 > thr
+# "higher" = higher is better (rate)     — regressed when 1 - B/A > thr
+# "counter"= should not grow (failures)  — regressed on ANY increase
+# p95 gets a looser threshold than p50: the tail is noisier by nature.
+DIFF_METRICS = (
+    ("step_time_ms_p50", lambda s: s["step_time_ms"]["p50"],
+     "lower", 0.10),
+    ("step_time_ms_p95", lambda s: s["step_time_ms"]["p95"],
+     "lower", 0.15),
+    ("samples_per_sec_best", lambda s: s["samples_per_sec"]["best"],
+     "higher", 0.10),
+    ("mfu_best", lambda s: s["mfu"]["best"], "higher", 0.10),
+    ("goodput_tokens_per_s",
+     lambda s: ((s.get("serving") or {}).get("slo")
+                or {}).get("goodput_tokens_per_s"), "higher", 0.10),
+    ("recompiles", lambda s: s["recompiles"]["count"], "counter", 0.0),
+    ("health_alerts",
+     lambda s: (s.get("health") or {}).get("alerts", 0), "counter",
+     0.0),
+    ("stalls", lambda s: (s.get("health") or {}).get("stalls", 0),
+     "counter", 0.0),
+)
+
+
+def diff_runs(path_a, path_b):
+    """Compare two runs' event logs metric-by-metric; A is the
+    baseline, B the candidate. Returns the versioned diff dict
+    (``render_diff`` turns it into text; any REGRESSED metric makes
+    the CLI exit nonzero — the bench-trajectory regression gate)."""
+    sa = summarize(path_a)
+    sb = summarize(path_b)
+    metrics = []
+    regressed = []
+    for name, extract, direction, thr in DIFF_METRICS:
+        a, b = extract(sa), extract(sb)
+        entry = {"metric": name, "a": a, "b": b,
+                 "direction": direction, "threshold": thr,
+                 "rel_change": None, "verdict": "OK"}
+        if a is None or b is None:
+            entry["verdict"] = "N/A" if a is None and b is None \
+                else "OK"   # one-sided metric (e.g. no serving plane)
+            metrics.append(entry)
+            continue
+        a, b = float(a), float(b)
+        if direction == "counter":
+            if b > a:
+                entry["verdict"] = "REGRESSED"
+            elif b < a:
+                entry["verdict"] = "IMPROVED"
+        else:
+            rel = (b - a) / a if a else (0.0 if b == a else None)
+            entry["rel_change"] = rel
+            if rel is None:
+                entry["verdict"] = "N/A"
+            elif direction == "lower":
+                if rel > thr:
+                    entry["verdict"] = "REGRESSED"
+                elif rel < -thr:
+                    entry["verdict"] = "IMPROVED"
+            else:   # higher is better
+                if rel < -thr:
+                    entry["verdict"] = "REGRESSED"
+                elif rel > thr:
+                    entry["verdict"] = "IMPROVED"
+        if entry["verdict"] == "REGRESSED":
+            regressed.append(name)
+        metrics.append(entry)
+    return {
+        "schema": SCHEMA_VERSION,
+        "run_a": sa["events_file"],
+        "run_b": sb["events_file"],
+        "metrics": metrics,
+        "regressed": regressed,
+        "verdict": "REGRESSED" if regressed else "OK",
+    }
+
+
+def render_diff(d):
+    lines = [
+        f"run diff: A={d['run_a']}",
+        f"          B={d['run_b']}",
+    ]
+    for m in d["metrics"]:
+        rel = (f" ({m['rel_change']:+.1%})"
+               if m.get("rel_change") is not None else "")
+        lines.append(
+            f"  {m['metric']:<22}: A={_fmt(m['a'], '{:.4g}')} "
+            f"B={_fmt(m['b'], '{:.4g}')}{rel}  {m['verdict']}")
+    if d["regressed"]:
+        lines.append(
+            f"verdict: REGRESSED ({', '.join(d['regressed'])})")
+    else:
+        lines.append("verdict: OK")
+    return "\n".join(lines)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("path", help="events.jsonl file, or a directory "
-                                 "containing one (searched recursively)")
+    ap.add_argument("path", nargs="?",
+                    help="events.jsonl file, or a directory "
+                         "containing one (searched recursively)")
     ap.add_argument("--json", action="store_true",
-                    help="emit the summary as JSON instead of text")
+                    help="emit the summary (or diff) as JSON instead "
+                         "of text")
     ap.add_argument("--serve", action="store_true",
                     help="render the serving-plane report (request "
                          "percentiles, SLO attainment, goodput, pool "
                          "snapshot) instead of the training summary")
+    ap.add_argument("--health", action="store_true",
+                    help="render the health-plane postmortem (anomaly "
+                         "alerts, stall diagnosis, flight-recorder "
+                         "dumps) instead of the training summary")
+    ap.add_argument("--diff", nargs=2, metavar=("RUN_A", "RUN_B"),
+                    help="compare two runs' event logs (A = baseline, "
+                         "B = candidate); exits 1 when any metric "
+                         "REGRESSED past its threshold")
     ap.add_argument("--host-gap-threshold", type=float,
                     default=DEFAULT_HOST_GAP_THRESHOLD,
                     help="flag the run when host-gap p50 exceeds this "
                          "fraction of step-time p50 (default %(default)s)")
     args = ap.parse_args(argv)
+    if args.diff:
+        try:
+            d = diff_runs(args.diff[0], args.diff[1])
+        except FileNotFoundError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        print(json.dumps(d, indent=2) if args.json else render_diff(d))
+        return 1 if d["regressed"] else 0
+    if not args.path:
+        ap.error("path is required unless --diff is given")
     try:
         summary = summarize(args.path,
                             host_gap_threshold=args.host_gap_threshold)
@@ -826,6 +1037,8 @@ def main(argv=None):
         print(json.dumps(summary, indent=2))
     elif args.serve:
         print(render_serve(summary))
+    elif args.health:
+        print(render_health(summary))
     else:
         print(render(summary))
     return 0
