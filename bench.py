@@ -176,6 +176,7 @@ METRICS = [
     "spec_decode_accepted_per_dispatch",
     "disagg_dispatch_structure",
     "fleet_drain_goodput",
+    "fleet_migration_goodput",
     "paged_decode_tokens_per_s",
     "disagg_ttft_p95",
     "bert_large_samples_per_s",
@@ -195,7 +196,8 @@ HW_FREE = {"comm_wire_bytes_per_step", "comm_overlap_structure",
            "serve_trace_overhead", "health_overhead",
            "async_ckpt_stall_ms",
            "spec_decode_accepted_per_dispatch",
-           "disagg_dispatch_structure", "fleet_drain_goodput"}
+           "disagg_dispatch_structure", "fleet_drain_goodput",
+           "fleet_migration_goodput"}
 
 PARTIAL_PATH = os.environ.get(
     "BENCH_PARTIAL", "/tmp/dstpu_bench_partial.jsonl")
@@ -2235,6 +2237,102 @@ def bench_fleet_drain_goodput(on_tpu, rtt):
                    "vs undisturbed (hardware-free)"})
 
 
+def bench_fleet_migration_goodput(on_tpu, rtt):
+    """Hardware-free row: serve through a replica KILL with live
+    KV-page migration (ISSUE 16). The same mixed-length greedy
+    workload runs twice over a 3-replica FleetRouter of
+    migration-warmed engines — once undisturbed, once with replica 0
+    yanked mid-run, its in-flight requests' live pages exported and
+    imported into survivors (decode resumes at the same
+    cache_position, no re-prefill). Pins: zero dropped responses,
+    greedy outputs bitwise identical with and without the kill, at
+    least one live migration actually happened, zero steady-state
+    recompiles on the survivors (import runs through the
+    warmup-compiled programs), and goodput holds >= 0.90 of the
+    undisturbed run — migration moves pages, not re-decodes tokens.
+    """
+    del on_tpu, rtt
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.inference import (FleetRouter, InferenceEngine,
+                                         Request)
+    from deepspeed_tpu.models.gpt2 import GPT2Config, init_gpt2_params
+
+    cfg = GPT2Config(vocab_size=61, max_position_embeddings=64,
+                     hidden_size=32, num_layers=2, num_heads=4,
+                     embd_dropout=0.0, attn_dropout=0.0,
+                     resid_dropout=0.0)
+    params = init_gpt2_params(cfg, jax.random.PRNGKey(3))
+    new_tokens = 16
+    icfg = {"max_batch_size": 2, "prompt_buckets": [8, 16],
+            "batch_buckets": [1, 2], "max_seq_len": 48,
+            "max_new_tokens": new_tokens}
+    rng = np.random.RandomState(13)
+    # 4 requests over 3 replicas x 2 slots: the survivors hold free
+    # decode slots at kill time — an import needs one (a full target
+    # falls back to redistribute-and-re-decode, which is the OTHER
+    # row's regime)
+    prompts = [rng.randint(1, 61, (l,)).tolist()
+               for l in (5, 9, 3, 12)]
+
+    def serve(do_kill):
+        engines = []
+        for _ in range(3):
+            eng = InferenceEngine(cfg, params, dict(icfg),
+                                  dtype=jnp.float32)
+            eng.warmup()
+            eng.warm_migration()
+            _beat()
+            engines.append(eng)
+        router = FleetRouter(engines)
+        uids = [router.submit(Request(prompt=p,
+                                      max_new_tokens=new_tokens,
+                                      temperature=0.0, seed=0))
+                for p in prompts]
+        t0 = time.perf_counter()
+        fins = router.step()
+        fins.extend(router.step())   # decode underway fleet-wide
+        if do_kill:
+            router.drain(0, reason="kill")
+        fins.extend(router.run())
+        wall = time.perf_counter() - t0
+        tokens = sum(len(f.tokens) for f in fins)
+        by_uid = {f.uid: f.tokens for f in fins}
+        outs = [by_uid.get(u) for u in uids]
+        # survivors only: the killed replica's programs are gone with it
+        rc = [e.steady_state_recompiles for e in engines[1:]]
+        migrated = router.total_migrated
+        mig_bytes = router.migration_bytes
+        router.close()
+        return (outs, tokens / wall if wall > 0 else 0.0,
+                rc, migrated, mig_bytes)
+
+    base_out, base_gp, base_rc, _, _ = serve(False)
+    kill_out, kill_gp, kill_rc, migrated, mig_bytes = serve(True)
+    _beat()
+    dropped = base_out.count(None) + kill_out.count(None)
+    parity = base_out == kill_out
+    ratio = kill_gp / base_gp if base_gp > 0 else 0.0
+    ok = parity and dropped == 0 and migrated >= 1 \
+        and all(r == 0 for r in base_rc + kill_rc) and ratio >= 0.90
+    return _emit(
+        "fleet_migration_goodput", round(ratio, 4),
+        "killed/undisturbed_goodput_ratio", 1.0 if ok else 0.0,
+        {"undisturbed_tokens_per_s": round(base_gp, 2),
+         "killed_tokens_per_s": round(kill_gp, 2),
+         "dropped_responses": dropped,
+         "greedy_parity": parity,
+         "live_migrations": migrated,
+         "migration_bytes": mig_bytes,
+         "steady_state_recompiles": {"undisturbed": base_rc,
+                                     "killed": kill_rc},
+         "requests": len(prompts), "replicas": 3,
+         "backend": jax.default_backend(),
+         "source": "FleetRouter 3 migration-warmed replicas, kill "
+                   "replica 0 mid-decode, live KV pages migrate to "
+                   "survivors vs undisturbed (hardware-free)"})
+
+
 def bench_disagg_ttft_p95(on_tpu, rtt):
     """TPU ladder row (next hardware window): p95 TTFT of the
     disaggregated engine — decode-first step order with the handoff
@@ -2389,6 +2487,8 @@ def run_child(metric):
         bench_disagg_dispatch_structure(on_tpu, rtt)
     elif metric == "fleet_drain_goodput":
         bench_fleet_drain_goodput(on_tpu, rtt)
+    elif metric == "fleet_migration_goodput":
+        bench_fleet_migration_goodput(on_tpu, rtt)
     elif metric == "paged_decode_tokens_per_s":
         bench_paged_decode_tokens_per_s(on_tpu, rtt)
     elif metric == "disagg_ttft_p95":

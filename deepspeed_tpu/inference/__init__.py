@@ -14,12 +14,16 @@ See ``deepspeed_tpu/inference/engine.py`` and ``docs/inference.md``.
 from deepspeed_tpu.inference.buckets import (pad_prompts, pick_bucket,
                                              validate_buckets, warmup_plan)
 from deepspeed_tpu.inference.disagg import (DispatchTrace, HandoffQueue,
-                                            HandoffRecord, price_handoff)
+                                            HandoffRecord,
+                                            MigrationRecord,
+                                            price_handoff)
 from deepspeed_tpu.inference.draft import (CallableDrafter, NGramDrafter,
                                            make_drafter)
 from deepspeed_tpu.inference.engine import (InferenceEngine,
                                             qwz_distribute_params)
-from deepspeed_tpu.inference.fleet import FleetRouter, ReplicaHandle
+from deepspeed_tpu.inference.fleet import (FleetRouter, ReplicaHandle,
+                                           ReplicaProcess,
+                                           launch_replica_processes)
 from deepspeed_tpu.inference.kv_cache import (KVCacheSpec, PageAllocator,
                                               PagedKVSpec, cache_spec_for,
                                               init_kv_cache,
@@ -40,4 +44,5 @@ __all__ = [
     "warmup_plan", "qwz_distribute_params", "NGramDrafter",
     "CallableDrafter", "make_drafter", "HandoffQueue", "HandoffRecord",
     "DispatchTrace", "price_handoff", "FleetRouter", "ReplicaHandle",
+    "ReplicaProcess", "launch_replica_processes", "MigrationRecord",
 ]

@@ -39,7 +39,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 __all__ = ["HandoffRecord", "HandoffQueue", "HandoffStats",
-           "DispatchTrace", "price_handoff"]
+           "DispatchTrace", "MigrationRecord", "price_handoff"]
 
 
 @dataclass
@@ -145,6 +145,72 @@ def price_handoff(n_pages: int, page_bytes: int, link,
     us = hops * (link.latency_us(axis)
                  + bytes_moved / link.bytes_per_us(axis))
     return us / 1e3
+
+
+@dataclass
+class MigrationRecord:
+    """One in-flight request's complete portable state: everything a
+    destination engine needs to resume decode at the same
+    ``cache_position`` with bitwise-identical outputs (ISSUE 16 live
+    KV migration — the cross-*replica* sibling of the cross-pool
+    :class:`HandoffRecord`).
+
+    ``kslab``/``vslab`` are the live pages' K/V contents gathered by
+    the warmup-compiled export program, trimmed to ``live_pages``
+    (shape ``(layers, live_pages, kv_heads, page_size, head_dim)``,
+    host numpy — they ship as the raw binary segment of an RPC frame).
+    Resume is bitwise because sampling keys derive from
+    ``(request seed, absolute position)`` — never from batch
+    composition or wall clock — and clocks are shipped as *elapsed*
+    durations (``elapsed_ms`` since submit, ``queue_wait_ms``,
+    ``ttft_ms``), not absolute host times, because source and
+    destination perf counters share no epoch.
+    """
+    uid: int
+    prompt: List[int]
+    max_new_tokens: int
+    temperature: float
+    seed: int
+    eos_id: Optional[int]
+    priority: int
+    position: int                 # next write position (cache rows
+    pending_tok: int              # 0..position-1 are live content)
+    tokens: List[int]             # generated so far (incl. pending)
+    live_pages: int               # pages with real content
+    page_bytes: int               # source pool page size (pricing)
+    ttft_ms: Optional[float]
+    queue_wait_ms: float
+    elapsed_ms: float             # clock() - t_submit at export time
+    draft_proposed: int = 0
+    draft_accepted: int = 0
+    weight_version: Optional[str] = None
+    kslab: Optional[object] = None    # numpy (layers, live, kvh, ps, hd)
+    vslab: Optional[object] = None
+
+    def to_header(self) -> Dict:
+        """The JSON-able half (slabs ride the frame's binary segment —
+        see rpc.migration_to_wire)."""
+        return {
+            "uid": self.uid, "prompt": list(self.prompt),
+            "max_new_tokens": self.max_new_tokens,
+            "temperature": self.temperature, "seed": self.seed,
+            "eos_id": self.eos_id, "priority": self.priority,
+            "position": self.position, "pending_tok": self.pending_tok,
+            "tokens": list(self.tokens),
+            "live_pages": self.live_pages,
+            "page_bytes": self.page_bytes, "ttft_ms": self.ttft_ms,
+            "queue_wait_ms": self.queue_wait_ms,
+            "elapsed_ms": self.elapsed_ms,
+            "draft_proposed": self.draft_proposed,
+            "draft_accepted": self.draft_accepted,
+            "weight_version": self.weight_version,
+        }
+
+    @property
+    def nbytes(self) -> int:
+        k = getattr(self.kslab, "nbytes", 0)
+        v = getattr(self.vslab, "nbytes", 0)
+        return int(k) + int(v)
 
 
 class DispatchTrace:

@@ -95,6 +95,7 @@ from deepspeed_tpu.inference.buckets import (pad_prompts, pick_bucket,
                                              warmup_plan)
 from deepspeed_tpu.inference.disagg import (DispatchTrace, HandoffQueue,
                                             HandoffRecord, HandoffStats,
+                                            MigrationRecord,
                                             price_handoff)
 from deepspeed_tpu.inference.draft import make_drafter
 from deepspeed_tpu.inference.kv_cache import (PageAllocator, cache_spec_for,
@@ -436,6 +437,11 @@ class InferenceEngine:
         # null page): every live prompt page fits, shape stays static
         self._handoff_width = (self.paged_spec_prefill.pages_per_seq
                                if self._separate_pools else 0)
+        # cross-REPLICA live migration programs (ISSUE 16) — compiled
+        # on demand by warm_migration(), against the MAIN pool
+        self._mig_export = None
+        self._mig_import = None
+        self._mig_width = 0
         if self._cache_sharding_decode is not None:
             self._cache = tuple(
                 jax.device_put(c, self._cache_sharding_decode)
@@ -830,6 +836,121 @@ class InferenceEngine:
             if rec is not None:
                 self._handoff_q.dropped(rec)
         return self.scheduler.evict(uid, reason=reason)
+
+    # ------------------------------------------- live KV migration (16)
+    def export_request(self, uid: int):
+        """Export one in-flight request's complete portable state — a
+        :class:`~.disagg.MigrationRecord` with its live pages gathered
+        into a host slab — and evict it locally (reason "migrate", a
+        bookkeeping row the router drops, never the client's answer).
+        None when the request isn't portable from here: unknown uid,
+        migration not warmed, no token sampled yet (mid-prefill — the
+        queue path redistributes those), or pages still in the prefill
+        pool (separate-pools disagg, pre-claim). Call between
+        :meth:`step` calls."""
+        if self._mig_export is None:
+            return None
+        sched = self.scheduler
+        for sid in sched.active_slots():
+            slot = sched.slots[sid]
+            if slot.request.uid != uid:
+                continue
+            if slot.pending_tok is None:
+                return None
+            if self._separate_pools and slot.pool == "admit":
+                return None
+            spec = self.paged_spec
+            live = min(pages_for(slot.position, spec.page_size),
+                       len(slot.pages))
+            idx = np.zeros((self._mig_width,), np.int32)
+            idx[:live] = slot.pages[:live]
+            kslab, vslab = self._mig_export(self._cache,
+                                            jnp.asarray(idx))
+            # trim to the live pages on the host — the wire carries
+            # content, never the reservation
+            kslab = np.asarray(kslab[:, :live])
+            vslab = np.asarray(vslab[:, :live])
+            req = slot.request
+            now = sched._clock()
+            rec = MigrationRecord(
+                uid=uid, prompt=list(req.prompt),
+                max_new_tokens=req.max_new_tokens,
+                temperature=req.temperature, seed=req.seed,
+                eos_id=req.eos_id,
+                priority=getattr(req, "priority", 0),
+                position=slot.position, pending_tok=slot.pending_tok,
+                tokens=list(slot.tokens), live_pages=live,
+                page_bytes=self._page_bytes, ttft_ms=slot.ttft_ms,
+                queue_wait_ms=slot.queue_wait_ms,
+                elapsed_ms=(now - slot.t_submit) * 1e3,
+                draft_proposed=slot.draft_proposed,
+                draft_accepted=slot.draft_accepted,
+                weight_version=self._weight_version,
+                kslab=kslab, vslab=vslab)
+            sched.evict(uid, reason="migrate")
+            return rec
+        return None
+
+    def import_request(self, rec) -> Optional[int]:
+        """Resume a migrated request here: allocate its full-lifetime
+        page reservation, scatter the shipped slab at the same logical
+        positions (warmup-compiled ``migrate_import`` — zero
+        recompiles), and install the slot at the same
+        ``cache_position``. Decode continues bitwise-identically
+        because sampling keys derive from (seed, position) only. None
+        — with nothing leaked — when this replica can't take it (no
+        free slot, pool exhausted, or geometry/dtype mismatch with the
+        source: a mismatched slab would mint a new program signature)."""
+        if self._mig_import is None:
+            return None
+        spec = self.paged_spec
+        want = (spec.num_layers, rec.live_pages, spec.kv_heads,
+                spec.page_size, spec.head_dim)
+        if (rec.kslab is None or tuple(rec.kslab.shape) != want
+                or tuple(rec.vslab.shape) != want
+                or np.dtype(rec.kslab.dtype) != np.dtype(spec.dtype)
+                or rec.live_pages > self._mig_width):
+            return None
+        sched = self.scheduler
+        if not sched.free_slots():
+            return None
+        need = pages_for(len(rec.prompt) + rec.max_new_tokens,
+                         spec.page_size)
+        pages = sched.allocator.alloc(max(need, rec.live_pages))
+        if pages is None:
+            return None
+        width = self._mig_width
+        idx = np.zeros((width,), np.int32)
+        idx[:rec.live_pages] = pages[:rec.live_pages]
+        kw = np.zeros((spec.num_layers, width, spec.kv_heads,
+                       spec.page_size, spec.head_dim),
+                      np.dtype(spec.dtype))
+        vw = np.zeros_like(kw)
+        kw[:, :rec.live_pages] = rec.kslab
+        vw[:, :rec.live_pages] = rec.vslab
+        # pad rows scatter zeros into the null page — garbage by design
+        self._cache = self._mig_import(
+            self._cache, (jnp.asarray(kw), jnp.asarray(vw)),
+            jnp.asarray(idx))
+        req = Request(prompt=list(rec.prompt),
+                      max_new_tokens=rec.max_new_tokens,
+                      temperature=rec.temperature, seed=rec.seed,
+                      eos_id=rec.eos_id, priority=rec.priority,
+                      uid=rec.uid)
+        sid = sched.install_slot(
+            req, position=rec.position, pending_tok=rec.pending_tok,
+            tokens=rec.tokens, pages=pages, ttft_ms=rec.ttft_ms,
+            queue_wait_ms=rec.queue_wait_ms, elapsed_ms=rec.elapsed_ms,
+            draft_proposed=rec.draft_proposed,
+            draft_accepted=rec.draft_accepted, pool="main")
+        if sid is None:
+            sched.allocator.free(pages)
+            return None
+        if self._log is not None:
+            self._log.add_event("serve_resume", uid=rec.uid, slot=sid,
+                                position=rec.position,
+                                live_pages=rec.live_pages)
+        return sid
 
     # ------------------------------------------------- live weight swap
     @property
@@ -1466,6 +1587,69 @@ class InferenceEngine:
                                 verify_widths=list(self._verify_widths),
                                 disagg=self.disagg)
         return self._warm_compiles
+
+    @property
+    def can_migrate(self) -> bool:
+        """True once :meth:`warm_migration` compiled the live-migration
+        pair — the router's capability probe (duck-typed: proxies
+        forward the worker's hello)."""
+        return self._mig_export is not None
+
+    def warm_migration(self) -> int:
+        """Compile + warm the cross-REPLICA live-migration programs
+        (ISSUE 16): ``migrate_export`` gathers an in-flight request's
+        live pages out of the MAIN pool into a contiguous slab (no
+        donation — the pool keeps serving), ``migrate_import`` scatters
+        a shipped slab into this replica's pool (donated: migration
+        allocates nothing steady-state). Same jit pair as the PR 13
+        cross-pool handoff, but against the decode pool and at the full
+        block-table width (``pages_per_seq`` — any in-flight request
+        fits, shape stays static). Call AFTER :meth:`warmup`; the
+        recompile baseline is re-anchored so
+        :attr:`steady_state_recompiles` == 0 remains the contract with
+        migration armed. Returns the number of programs compiled."""
+        if not self.paged:
+            raise RuntimeError(
+                "live migration requires the paged KV pool "
+                "(inference.paged.enabled)")
+        assert self._warm_compiles is not None, \
+            "warm_migration() before warmup()"
+        if self._mig_export is not None:
+            return 0
+        self._mig_width = self.paged_spec.pages_per_seq
+        mesh = self._mesh_decode
+        if mesh is None:
+            ex = jax.jit(self._export_pages_impl)
+            im = jax.jit(self._import_pages_impl, donate_argnums=(0,))
+        else:
+            cs = self._cache_sharding_decode
+            slab_sh = NamedSharding(mesh, P(None, None, "model"))
+            repl = NamedSharding(mesh, P())
+            ex = jax.jit(self._export_pages_impl,
+                         in_shardings=((cs, cs), repl),
+                         out_shardings=(slab_sh, slab_sh))
+            im = jax.jit(self._import_pages_impl, donate_argnums=(0,),
+                         in_shardings=((cs, cs), (slab_sh, slab_sh),
+                                       repl),
+                         out_shardings=(cs, cs))
+        self._mig_export = self.compile_tracker.wrap(ex,
+                                                     "migrate_export")
+        self._mig_import = self.compile_tracker.wrap(im,
+                                                     "migrate_import")
+        before = self.compile_tracker.total_compiles
+        # warm both against the null page so the first real migration
+        # (mid-drain, latency-critical) doesn't compile on the clock
+        idx = jnp.zeros((self._mig_width,), jnp.int32)
+        slab = self._mig_export(self._cache, idx)
+        self._cache = self._mig_import(self._cache, slab, idx)
+        jax.block_until_ready(self._cache[0])
+        compiled = self.compile_tracker.total_compiles - before
+        self._warm_compiles = self.compile_tracker.total_compiles
+        if self._log is not None:
+            self._log.add_event("serve_warm_migration",
+                                programs=compiled,
+                                width=self._mig_width)
+        return compiled
 
     @property
     def steady_state_recompiles(self) -> int:

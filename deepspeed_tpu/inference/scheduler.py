@@ -351,6 +351,44 @@ class Scheduler:
         slot.pages = list(pages)
         slot.pool = "main"
 
+    def install_slot(self, request: Request, *, position: int,
+                     pending_tok: int, tokens: List[int],
+                     pages: List[int], ttft_ms: Optional[float] = None,
+                     queue_wait_ms: float = 0.0,
+                     elapsed_ms: float = 0.0,
+                     draft_proposed: int = 0, draft_accepted: int = 0,
+                     pool: str = "main") -> Optional[int]:
+        """Install an ALREADY-RUNNING request into a free slot — the
+        destination half of live KV migration (ISSUE 16). ``pages``
+        are already allocated (owner named by ``pool``) and already
+        hold the migrated cache content; ``position``/``pending_tok``
+        resume decode exactly where the source replica stopped — no
+        re-prefill, bitwise-identical continuation (sampling keys are
+        (seed, position)-derived). Cross-process clocks share no
+        epoch, so the source ships *elapsed* durations and
+        ``t_submit`` is back-dated against the local clock — latency
+        accounting stays continuous across the hop. No tracer hooks
+        fire (the request's serve trace lives on the source replica;
+        the router's ``serve_migration`` row stitches the timelines).
+        Returns the slot id, or None when no slot is free (the caller
+        still owns ``pages`` and falls back)."""
+        free = self.free_slots()
+        if not free:
+            return None
+        sid = free[0]
+        self.slots[sid] = _Slot(
+            request=request, position=int(position),
+            pending_tok=int(pending_tok), tokens=list(tokens),
+            t_submit=self._clock() - float(elapsed_ms) / 1e3,
+            ttft_ms=ttft_ms, pages=list(pages),
+            queue_wait_ms=float(queue_wait_ms), pool=pool,
+            draft_proposed=int(draft_proposed),
+            draft_accepted=int(draft_accepted))
+        self.total_admitted += 1
+        self.peak_tokens_in_flight = max(self.peak_tokens_in_flight,
+                                         self.tokens_in_flight)
+        return sid
+
     def admit(self) -> List[PrefillBatch]:
         """Assign waiting requests to free slots, grouped into bucketed
         prefill batches.

@@ -38,7 +38,23 @@ from typing import Callable, Dict, List, Optional
 
 from deepspeed_tpu.runtime.elastic import (RESTART_COUNT_ENV,
                                            RESUMABLE_EXIT_CODE)
+from deepspeed_tpu.utils.health import STALL_EXIT_CODE
 from deepspeed_tpu.utils.logging import logger
+
+#: exit codes the supervisor relaunches on: the graceful preemption
+#: drain (85) and the hang watchdog's distinguished ``os._exit`` (87) —
+#: a hung-then-killed job is exactly the preemption-shaped failure the
+#: supervisor exists for (ISSUE 16 satellite). Anything else is a
+#: genuine failure: give up immediately.
+RESTARTABLE_EXIT_CODES = (RESUMABLE_EXIT_CODE, STALL_EXIT_CODE)
+
+
+def restart_eligible(rc: Optional[int]) -> bool:
+    """True when exit code ``rc`` should be answered with a relaunch
+    (shared by :func:`supervise` and the serving fleet's replica
+    supervision in ``inference/fleet.py``)."""
+    return rc in RESTARTABLE_EXIT_CODES
+
 
 DLTS_HOSTFILE = "/job/hostfile"
 ENV_FILE = ".deepspeed_env"
@@ -71,8 +87,10 @@ def parse_args(args=None):
     parser.add_argument("--supervise", action="store_true",
                         help="Relaunch the job (with exponential backoff) "
                              "whenever it exits with the resumable "
-                             f"preemption code {RESUMABLE_EXIT_CODE} "
-                             "(checkpoint.drain_on_preemption)")
+                             f"preemption code {RESUMABLE_EXIT_CODE} or "
+                             f"the hang-watchdog code {STALL_EXIT_CODE} "
+                             "(checkpoint.drain_on_preemption / "
+                             "observability.health.watchdog)")
     parser.add_argument("--max_restarts", type=int, default=3,
                         help="Supervisor: give up after this many "
                              "resumable restarts (default 3)")
@@ -91,31 +109,37 @@ def supervise(run_once: Callable[[int], int], max_restarts: int = 3,
     """Relaunch-on-preemption loop (the launcher's elastic half).
 
     ``run_once(restart_count)`` launches the job and returns its exit
-    code. The loop relaunches ONLY on :data:`RESUMABLE_EXIT_CODE` (a
-    graceful preemption drain — the run left a committed checkpoint and
-    asked to be resumed), sleeping ``backoff * 2**restart`` seconds
+    code. The loop relaunches ONLY on :data:`RESTARTABLE_EXIT_CODES` —
+    the graceful preemption drain (85: the run left a committed
+    checkpoint and asked to be resumed) and the hang watchdog's
+    distinguished kill (87: a wedged run ``os._exit``-ed itself; the
+    committed checkpoint chain makes a relaunch exactly as safe as a
+    preemption resume) — sleeping ``backoff * 2**restart`` seconds
     between lives; any other nonzero code is a genuine failure returned
-    immediately, and after ``max_restarts`` resumable exits the code is
-    returned for the operator to act on. Returns the final exit code.
+    immediately, and after ``max_restarts`` restartable exits the code
+    is returned for the operator to act on. Returns the final exit
+    code.
     """
     sleep = time.sleep if sleep is None else sleep
     restarts = 0
     while True:
         rc = run_once(restarts)
-        if rc != RESUMABLE_EXIT_CODE:
+        if not restart_eligible(rc):
             if rc != 0:
                 logger.error(f"dstpu supervisor: job failed (exit {rc}); "
                              "not a preemption — giving up")
             return rc
         if restarts >= max_restarts:
             logger.error(
-                f"dstpu supervisor: resumable exit but max_restarts="
+                f"dstpu supervisor: restartable exit but max_restarts="
                 f"{max_restarts} exhausted; giving up with exit {rc}")
             return rc
         delay = backoff * (2 ** restarts)
         restarts += 1
+        kind = "preemption drain" if rc == RESUMABLE_EXIT_CODE \
+            else "watchdog kill"
         logger.warning(
-            f"dstpu supervisor: preemption drain (exit {rc}); relaunch "
+            f"dstpu supervisor: {kind} (exit {rc}); relaunch "
             f"{restarts}/{max_restarts} in {delay:.1f}s")
         sleep(delay)
 

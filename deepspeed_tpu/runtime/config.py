@@ -731,6 +731,8 @@ def get_inference_config(param_dict):
     fl = sub.get(C.INF_FLEET, {}) or {}
     shed = fl.get(C.INF_FLEET_SLO_SHED, {}) or {}
     swap = fl.get(C.INF_FLEET_SWAP, {}) or {}
+    pm = fl.get(C.INF_FLEET_PROCESS_MODE, {}) or {}
+    ascale = fl.get(C.INF_FLEET_AUTOSCALE, {}) or {}
     budget = shed.get(C.INF_FLEET_SHED_TTFT_BUDGET_MS,
                       C.INF_FLEET_SHED_TTFT_BUDGET_MS_DEFAULT)
     cfg["fleet"] = {
@@ -760,6 +762,48 @@ def get_inference_config(param_dict):
             "verify_integrity": bool(swap.get(
                 C.INF_FLEET_SWAP_VERIFY_INTEGRITY,
                 C.INF_FLEET_SWAP_VERIFY_INTEGRITY_DEFAULT)),
+        },
+        "process_mode": {
+            "enabled": bool(pm.get(C.INF_FLEET_PM_ENABLED,
+                                   C.INF_FLEET_PM_ENABLED_DEFAULT)),
+            "rpc_timeout_s": float(pm.get(
+                C.INF_FLEET_PM_RPC_TIMEOUT_S,
+                C.INF_FLEET_PM_RPC_TIMEOUT_S_DEFAULT)),
+            "rpc_retries": int(pm.get(
+                C.INF_FLEET_PM_RPC_RETRIES,
+                C.INF_FLEET_PM_RPC_RETRIES_DEFAULT)),
+            "rpc_backoff_s": float(pm.get(
+                C.INF_FLEET_PM_RPC_BACKOFF_S,
+                C.INF_FLEET_PM_RPC_BACKOFF_S_DEFAULT)),
+            "max_restarts": int(pm.get(
+                C.INF_FLEET_PM_MAX_RESTARTS,
+                C.INF_FLEET_PM_MAX_RESTARTS_DEFAULT)),
+            "restart_backoff_s": float(pm.get(
+                C.INF_FLEET_PM_RESTART_BACKOFF_S,
+                C.INF_FLEET_PM_RESTART_BACKOFF_S_DEFAULT)),
+            "ready_timeout_s": float(pm.get(
+                C.INF_FLEET_PM_READY_TIMEOUT_S,
+                C.INF_FLEET_PM_READY_TIMEOUT_S_DEFAULT)),
+        },
+        "autoscale": {
+            "enabled": bool(ascale.get(
+                C.INF_FLEET_AS_ENABLED,
+                C.INF_FLEET_AS_ENABLED_DEFAULT)),
+            "min_replicas": int(ascale.get(
+                C.INF_FLEET_AS_MIN_REPLICAS,
+                C.INF_FLEET_AS_MIN_REPLICAS_DEFAULT)),
+            "max_replicas": int(ascale.get(
+                C.INF_FLEET_AS_MAX_REPLICAS,
+                C.INF_FLEET_AS_MAX_REPLICAS_DEFAULT)),
+            "scale_up_patience": int(ascale.get(
+                C.INF_FLEET_AS_UP_PATIENCE,
+                C.INF_FLEET_AS_UP_PATIENCE_DEFAULT)),
+            "scale_down_patience": int(ascale.get(
+                C.INF_FLEET_AS_DOWN_PATIENCE,
+                C.INF_FLEET_AS_DOWN_PATIENCE_DEFAULT)),
+            "cooldown_steps": int(ascale.get(
+                C.INF_FLEET_AS_COOLDOWN_STEPS,
+                C.INF_FLEET_AS_COOLDOWN_STEPS_DEFAULT)),
         },
     }
     try:
@@ -897,6 +941,36 @@ def get_inference_config(param_dict):
             f"inference.fleet.slo_shed.degrade_factor must be >= 1.0 "
             f"(the degrade rung engages above the shed rung), got "
             f"{shc['degrade_factor']}")
+    pmc = flc["process_mode"]
+    if pmc["rpc_timeout_s"] <= 0 or pmc["ready_timeout_s"] <= 0:
+        raise DeepSpeedConfigError(
+            f"inference.fleet.process_mode: rpc_timeout_s and "
+            f"ready_timeout_s must be > 0, got "
+            f"{pmc['rpc_timeout_s']}/{pmc['ready_timeout_s']}")
+    if pmc["rpc_retries"] < 0 or pmc["rpc_backoff_s"] < 0 or \
+            pmc["max_restarts"] < 0 or pmc["restart_backoff_s"] < 0:
+        raise DeepSpeedConfigError(
+            "inference.fleet.process_mode: rpc_retries, rpc_backoff_s, "
+            "max_restarts and restart_backoff_s must be >= 0")
+    asc = flc["autoscale"]
+    if asc["min_replicas"] < 1:
+        raise DeepSpeedConfigError(
+            f"inference.fleet.autoscale.min_replicas must be >= 1, got "
+            f"{asc['min_replicas']}")
+    if asc["max_replicas"] < asc["min_replicas"]:
+        raise DeepSpeedConfigError(
+            f"inference.fleet.autoscale.max_replicas must be >= "
+            f"min_replicas ({asc['min_replicas']}), got "
+            f"{asc['max_replicas']}")
+    if asc["scale_up_patience"] < 1 or asc["scale_down_patience"] < 1:
+        raise DeepSpeedConfigError(
+            "inference.fleet.autoscale: scale_up_patience and "
+            "scale_down_patience must be >= 1 (hysteresis — a single "
+            "hot or idle step must never flap the fleet)")
+    if asc["cooldown_steps"] < 0:
+        raise DeepSpeedConfigError(
+            f"inference.fleet.autoscale.cooldown_steps must be >= 0, "
+            f"got {asc['cooldown_steps']}")
     return cfg
 
 

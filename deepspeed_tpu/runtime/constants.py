@@ -635,6 +635,38 @@ INF_FLEET_SHED_DEGRADE_MAX_NEW_DEFAULT = 32  # 0 = no cap
 INF_FLEET_SWAP = "swap"
 INF_FLEET_SWAP_VERIFY_INTEGRITY = "verify_integrity"
 INF_FLEET_SWAP_VERIFY_INTEGRITY_DEFAULT = True
+# process-isolated fleet (ISSUE 16): one engine per child process,
+# fronted over the inference/rpc.py channel
+INF_FLEET_PROCESS_MODE = "process_mode"
+INF_FLEET_PM_ENABLED = "enabled"
+INF_FLEET_PM_ENABLED_DEFAULT = False
+INF_FLEET_PM_RPC_TIMEOUT_S = "rpc_timeout_s"
+INF_FLEET_PM_RPC_TIMEOUT_S_DEFAULT = 120.0
+INF_FLEET_PM_RPC_RETRIES = "rpc_retries"
+INF_FLEET_PM_RPC_RETRIES_DEFAULT = 2
+INF_FLEET_PM_RPC_BACKOFF_S = "rpc_backoff_s"
+INF_FLEET_PM_RPC_BACKOFF_S_DEFAULT = 0.05
+INF_FLEET_PM_MAX_RESTARTS = "max_restarts"
+INF_FLEET_PM_MAX_RESTARTS_DEFAULT = 1
+INF_FLEET_PM_RESTART_BACKOFF_S = "restart_backoff_s"
+INF_FLEET_PM_RESTART_BACKOFF_S_DEFAULT = 0.5
+INF_FLEET_PM_READY_TIMEOUT_S = "ready_timeout_s"
+INF_FLEET_PM_READY_TIMEOUT_S_DEFAULT = 300.0
+# goodput-driven autoscale (ISSUE 16): spawn on sustained rung-1
+# shedding, retire (drain-via-migration) on sustained idleness
+INF_FLEET_AUTOSCALE = "autoscale"
+INF_FLEET_AS_ENABLED = "enabled"
+INF_FLEET_AS_ENABLED_DEFAULT = False
+INF_FLEET_AS_MIN_REPLICAS = "min_replicas"
+INF_FLEET_AS_MIN_REPLICAS_DEFAULT = 1
+INF_FLEET_AS_MAX_REPLICAS = "max_replicas"
+INF_FLEET_AS_MAX_REPLICAS_DEFAULT = 4
+INF_FLEET_AS_UP_PATIENCE = "scale_up_patience"
+INF_FLEET_AS_UP_PATIENCE_DEFAULT = 4
+INF_FLEET_AS_DOWN_PATIENCE = "scale_down_patience"
+INF_FLEET_AS_DOWN_PATIENCE_DEFAULT = 64
+INF_FLEET_AS_COOLDOWN_STEPS = "cooldown_steps"
+INF_FLEET_AS_COOLDOWN_STEPS_DEFAULT = 16
 
 TENSORBOARD = "tensorboard"
 TENSORBOARD_ENABLED = "enabled"

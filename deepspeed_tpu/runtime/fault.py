@@ -55,6 +55,31 @@ grammar):
                                  reroute the request to the next-best
                                  replica, never drop it
 
+RPC-plane points (ISSUE 16 — ``inference/rpc.py`` client and the
+``replica_worker`` child; one point per pinned error-taxonomy kind so a
+test targets exactly one failure mode):
+
+- ``rpc.transport``            : at the top of every RPC call attempt
+                                 (ctx: ``method``, ``name``) — raises
+                                 surface as ``RpcTransportError``, the
+                                 TRANSIENT kind the client retries with
+                                 bounded exponential backoff
+- ``rpc.timeout``              : same site — raises surface as
+                                 ``RpcTimeoutError`` (per-call deadline
+                                 exceeded; never retried, the call may
+                                 have been applied)
+- ``rpc.replica_dead``         : same site — raises surface as
+                                 ``ReplicaDeadError`` (peer gone;
+                                 terminal for the connection — the
+                                 router salvages/migrates/relaunches)
+- ``serve.replica_kill``       : in the replica worker's step handler,
+                                 fired ONLY while a request is
+                                 mid-decode (ctx: ``pid``) — the
+                                 env-armed kill test's hook: ``crash``
+                                 triggers the deathbed protocol (export
+                                 live pages, dump flight.json, exit 85)
+                                 at the worst possible moment
+
 Health-plane points (ISSUE 15 — ``utils/health.py`` watchdog and
 detectors; process-boundary-testable like the supervisor tests):
 

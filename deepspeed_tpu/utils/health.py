@@ -56,6 +56,7 @@ from deepspeed_tpu.utils.logging import logger
 __all__ = [
     "HEALTH_PHASES", "HEALTH_REASONS", "STALL_EXIT_CODE",
     "FlightRecorder", "Watchdog", "NumericHealth", "HealthPlane",
+    "load_flight",
 ]
 
 #: Pinned heartbeat phase vocabulary — one name per dispatch/phase
@@ -98,6 +99,23 @@ def _atomic_write_json(path: str, payload: dict) -> None:
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)
+
+
+def load_flight(path) -> Optional[dict]:
+    """Salvage a flight recorder dump (the black box a dead process
+    left behind): returns the parsed payload, or None when the file is
+    missing/unreadable/torn. The fleet router uses this to fold a dead
+    replica's last moments into ITS OWN event trail
+    (``fleet_flight_salvage`` rows) — the atomic dump protocol means a
+    readable file is always a complete one."""
+    if not path:
+        return None
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return payload if isinstance(payload, dict) else None
 
 
 def _all_thread_stacks() -> Dict[str, Any]:

@@ -58,6 +58,10 @@ TAG_SERVE_SHED_RATE = "Serve/shed_rate"             # shed / submitted
 TAG_SERVE_FLEET_QDEPTH = "Serve/fleet_queue_depth"  # sum of replica queues
 TAG_SERVE_WEIGHT_VERSION = "Serve/weight_version"   # committed swap
 #                                                     ordinal (0 = boot)
+# process-fleet plane (ISSUE 16): live KV-page migrations between
+# replicas and supervised child relaunches (inference/fleet.py)
+TAG_SERVE_MIGRATIONS = "Serve/migrations"           # live requests moved
+TAG_SERVE_REPLICA_RESTARTS = "Serve/replica_restarts"  # supervised
 # elastic / async-checkpoint plane (ISSUE 10): snapshot-vs-write split
 # of every save, the async writer's backlog, and how many times the
 # supervisor has relaunched this run. Canonical home — profiling/
@@ -395,7 +399,8 @@ class TensorBoardMonitor:
                               goodput_tokens_per_s=None,
                               spec_accept_rate=None, handoff_ms=None,
                               shed_rate=None, fleet_queue_depth=None,
-                              weight_version=None,
+                              weight_version=None, migrations=None,
+                              replica_restarts=None,
                               tokens: int = 0, flush: bool = True):
         """Serving telemetry (inference engine; TPU-native extension —
         the reference snapshot is training-only): time-to-first-token
@@ -464,6 +469,11 @@ class TensorBoardMonitor:
         if weight_version is not None:
             self.write_scalar(TAG_SERVE_WEIGHT_VERSION, weight_version,
                               tokens)
+        if migrations is not None:
+            self.write_scalar(TAG_SERVE_MIGRATIONS, migrations, tokens)
+        if replica_restarts is not None:
+            self.write_scalar(TAG_SERVE_REPLICA_RESTARTS,
+                              replica_restarts, tokens)
         if flush:
             self.flush()
 

@@ -32,6 +32,7 @@ import deepspeed_tpu
 from deepspeed_tpu.launcher import runner
 from deepspeed_tpu.runtime import checkpoint as ckpt
 from deepspeed_tpu.runtime import elastic, fault
+from deepspeed_tpu.utils import health
 from tests.unit.simple_model import (
     base_config, init_simple_params, random_batches, simple_loss_fn)
 
@@ -659,6 +660,39 @@ class TestSupervisor:
     def test_zero_exit_passes_through(self):
         assert runner.supervise(lambda r: 0, max_restarts=3,
                                 backoff=0.0) == 0
+
+    def test_restart_decision_matrix(self):
+        """The restart taxonomy is API: the preemption drain (85) and
+        the hang watchdog's distinguished kill (87) are the ONLY exit
+        codes worth another life — both certify a committed checkpoint
+        chain. Everything else is a genuine failure."""
+        assert runner.RESTARTABLE_EXIT_CODES == (85, 87)
+        assert runner.RESTARTABLE_EXIT_CODES == (
+            elastic.RESUMABLE_EXIT_CODE, health.STALL_EXIT_CODE)
+        for rc, eligible in [(85, True), (87, True), (143, False),
+                             (1, False), (0, False), (None, False)]:
+            assert runner.restart_eligible(rc) is eligible, rc
+
+    def test_watchdog_kill_is_restartable_end_to_end(self):
+        # 87 then clean exit: one relaunch, one backoff sleep
+        codes = iter([health.STALL_EXIT_CODE, 0])
+        sleeps = []
+        rc = runner.supervise(lambda r: next(codes), max_restarts=3,
+                              backoff=1.0, sleep=sleeps.append)
+        assert rc == 0
+        assert sleeps == [1.0]
+        # 87 then SIGTERM-ish 143: relaunched once, then give up
+        codes = iter([health.STALL_EXIT_CODE, 143])
+        rc = runner.supervise(lambda r: next(codes), max_restarts=3,
+                              backoff=0.0, sleep=lambda s: None)
+        assert rc == 143
+        # constant genuine failure: returned immediately, no restarts
+        calls = []
+        rc = runner.supervise(lambda r: (calls.append(r), 1)[1],
+                              max_restarts=3, backoff=0.0,
+                              sleep=lambda s: None)
+        assert rc == 1
+        assert calls == [0]
 
 
 CHILD_SCRIPT = textwrap.dedent("""

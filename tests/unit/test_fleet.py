@@ -607,6 +607,13 @@ class TestRegistrySync:
         assert m.TAG_SERVE_WEIGHT_VERSION == \
             prof.TAG_SERVE_WEIGHT_VERSION == \
             obs_report.T_WEIGHT_VERSION == "Serve/weight_version"
+        # ISSUE 16 process-fleet scalars ride the same registry
+        assert m.TAG_SERVE_MIGRATIONS == \
+            prof.TAG_SERVE_MIGRATIONS == \
+            obs_report.T_MIGRATIONS == "Serve/migrations"
+        assert m.TAG_SERVE_REPLICA_RESTARTS == \
+            prof.TAG_SERVE_REPLICA_RESTARTS == \
+            obs_report.T_REPLICA_RESTARTS == "Serve/replica_restarts"
 
     def test_shed_vocabulary_pinned(self):
         """Every shed decision lands in the trail with a reason from

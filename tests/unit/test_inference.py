@@ -118,7 +118,7 @@ def test_host_side_scheduling_modules_stay_jax_free():
     import deepspeed_tpu.inference as inf
     root = pathlib.Path(inf.__file__).parent
     for mod in ("scheduler.py", "paging.py", "buckets.py", "tracing.py",
-                "draft.py", "disagg.py", "fleet.py"):
+                "draft.py", "disagg.py", "fleet.py", "rpc.py"):
         src = (root / mod).read_text()
         for node in ast.walk(ast.parse(src)):
             if isinstance(node, ast.Import):

@@ -103,6 +103,12 @@ T_HANDOFF = "Serve/handoff_ms"
 T_SHED_RATE = "Serve/shed_rate"
 T_FLEET_QDEPTH = "Serve/fleet_queue_depth"
 T_WEIGHT_VERSION = "Serve/weight_version"
+# process-fleet plane (ISSUE 16): live KV-page migrations between
+# replicas, supervised child relaunches; the `fleet_replica_state` /
+# `serve_migration` / `fleet_flight_salvage` event rows carry the
+# per-replica process health and per-move details
+T_MIGRATIONS = "Serve/migrations"
+T_REPLICA_RESTARTS = "Serve/replica_restarts"
 # elastic / async-checkpoint plane (utils/monitor.py
 # write_elastic_metrics): snapshot-vs-write decomposition of each save,
 # async writer backlog, supervisor restart count; the `preemption` /
@@ -374,6 +380,23 @@ def summarize(path, host_gap_threshold=DEFAULT_HOST_GAP_THRESHOLD):
     drain_rows = [e for e in events if e.get("event") == "fleet_drain"]
     swap_rows = [e for e in events
                  if e.get("event") in ("fleet_swap", "fleet_swap_push")]
+    # process-mode rows (ISSUE 16): per-replica process health snapshots
+    # (keep the last per replica), live migrations, deaths/restarts,
+    # and salvaged flight recorders
+    proc_rows: dict = {}
+    for e in events:
+        if e.get("event") == "fleet_replica_state":
+            proc_rows[e.get("replica")] = e
+    mig_rows = [e for e in events
+                if e.get("event") == "serve_migration"]
+    death_rows = [e for e in events
+                  if e.get("event") == "fleet_replica_death"]
+    restart_rows = [e for e in events
+                    if e.get("event") == "fleet_replica_restart"]
+    salvage_rows = [e for e in events
+                    if e.get("event") == "fleet_flight_salvage"]
+    scale_rows = [e for e in events
+                  if e.get("event") == "fleet_autoscale"]
     if fleet_state is not None or shed_rows or drain_rows or swap_rows:
         shed_by_reason = defaultdict(int)
         for e in shed_rows:
@@ -393,6 +416,60 @@ def summarize(path, host_gap_threshold=DEFAULT_HOST_GAP_THRESHOLD):
                 "ok": e.get("ok"),
                 "rolled_back": e.get("rolled_back"),
             })
+        for e in mig_rows:
+            timeline.append({"kind": "migration", "uid": e.get("uid"),
+                             "src": e.get("src"), "dst": e.get("dst"),
+                             "pages": e.get("pages"),
+                             "nbytes": e.get("nbytes")})
+        for e in death_rows:
+            timeline.append({"kind": "death",
+                             "replica": e.get("replica"),
+                             "reason": e.get("reason"),
+                             "exit_code": e.get("exit_code"),
+                             "exports": e.get("exports")})
+        for e in restart_rows:
+            timeline.append({"kind": "restart",
+                             "replica": e.get("replica"),
+                             "decision": e.get("decision"),
+                             "exit_code": e.get("exit_code")})
+        for e in scale_rows:
+            timeline.append({"kind": "autoscale",
+                             "action": e.get("action"),
+                             "replica": e.get("replica"),
+                             "live": e.get("live")})
+        fs_mig = (fleet_state or {}).get("migrations") or {}
+        process = None
+        if proc_rows or mig_rows or restart_rows or salvage_rows:
+            process = {
+                "replicas": [proc_rows[k] and {
+                    "replica": proc_rows[k].get("replica"),
+                    "status": proc_rows[k].get("status"),
+                    "pid": proc_rows[k].get("pid"),
+                    "restarts": proc_rows[k].get("restarts"),
+                    "last_exit_code":
+                        proc_rows[k].get("last_exit_code"),
+                    "migrations_in": proc_rows[k].get("migrations_in"),
+                    "migrations_out":
+                        proc_rows[k].get("migrations_out"),
+                    "migration_bytes":
+                        proc_rows[k].get("migration_bytes"),
+                    "migration_priced_ms":
+                        proc_rows[k].get("migration_priced_ms"),
+                } for k in sorted(proc_rows,
+                                  key=lambda x: (x is None, x))],
+                "migrations": {
+                    "count": fs_mig.get("total", len(mig_rows)),
+                    "bytes": fs_mig.get("bytes", sum(
+                        int(e.get("nbytes") or 0) for e in mig_rows)),
+                    "priced_ms": fs_mig.get("priced_ms"),
+                },
+                "restarts": ((fleet_state or {}).get("restarts")
+                             if (fleet_state or {}).get("restarts")
+                             is not None
+                             else _last(scalars, T_REPLICA_RESTARTS)),
+                "deaths": len(death_rows),
+                "salvaged_flights": len(salvage_rows),
+            }
         serving["fleet"] = {
             "replicas": (fleet_state or {}).get("replicas"),
             "routing": (fleet_state or {}).get("routing"),
@@ -411,6 +488,7 @@ def summarize(path, host_gap_threshold=DEFAULT_HOST_GAP_THRESHOLD):
             },
             "redistributed": (fleet_state or {}).get("redistributed"),
             "reroutes": (fleet_state or {}).get("reroutes"),
+            "process": process,
             "slo": (fleet_state or {}).get("slo"),
             "queue_depth_peak": (max(_vals(scalars, T_FLEET_QDEPTH))
                                  if _vals(scalars, T_FLEET_QDEPTH)
@@ -718,84 +796,91 @@ def render_serve(s):
     person wants first when a serving alarm fires."""
     sv = s.get("serving") or {}
     lines = [f"serving report: {s['events_file']}"]
-    if not sv.get("requests"):
+    if not sv.get("requests") and not sv.get("fleet"):
         lines.append("  (no serving telemetry in this log)")
         return "\n".join(lines)
-    lines.append(
-        f"  requests          : {sv['requests']} "
-        f"(evictions={sv.get('evictions', 0)}) "
-        f"decode_steps={sv['decode_steps']}")
+    if not sv.get("requests"):
+        # a router-only event log (process-mode fleet: request rows
+        # live in each replica child's own log) still has a fleet
+        # plane worth rendering — fall through to it
+        lines.append("  (no request-level serving telemetry; "
+                     "fleet plane only)")
 
     def pline(label, d, note=""):
         return (f"  {label:<18}: p50={_fmt(d['p50'])} "
                 f"p95={_fmt(d['p95'])} p99={_fmt(d['p99'])} ms{note}")
-    lines += [
-        pline("queue_wait", sv["queue_wait_ms"]),
-        pline("ttft", sv["ttft_ms"]),
-        pline("tbt", sv["tbt_ms"], "  (per-dispatch means)"),
-    ]
-    slo = sv.get("slo") or {}
-    thr = slo.get("thresholds") or {}
-    if slo.get("attainment") is not None:
+    if sv.get("requests"):
         lines.append(
-            f"  slo_attainment    : {_fmt(slo['attainment'], '{:.1%}')}"
-            + (f"  (ttft<={_fmt(thr.get('ttft_ms'), '{:.0f}')} ms, "
-               f"tbt<={_fmt(thr.get('tbt_ms'), '{:.0f}')} ms)"
-               if thr else ""))
-        lines.append(
-            f"  goodput           : "
-            f"{_fmt(slo['goodput_tokens_per_s'])} tok/s within SLO "
-            f"(raw throughput "
-            f"{_fmt(slo['throughput_tokens_per_s'])} tok/s)")
-    hist = sv.get("histograms") or {}
-    tb = hist.get("tbt_ms")
-    if tb and tb.get("count"):
-        lines.append(
-            f"  tbt (per request) : p50={_fmt(tb['p50'])} "
-            f"p95={_fmt(tb['p95'])} p99={_fmt(tb['p99'])} ms "
-            f"({tb['count']} samples, histogram)")
-    pool = sv.get("pool")
-    if pool:
-        pc = pool.get("prefix_cache") or {}
-        seen = pc.get("hit_tokens", 0) + pc.get("miss_tokens", 0)
+            f"  requests          : {sv['requests']} "
+            f"(evictions={sv.get('evictions', 0)}) "
+            f"decode_steps={sv['decode_steps']}")
         lines += [
-            f"  page_pool         : {pool['pages_in_use']}/"
-            f"{pool['num_pages'] - 1} pages in use "
-            f"({pool['pages_free']} free, page_size "
-            f"{pool['page_size']}, shared={pool.get('pages_shared', 0)}, "
-            f"internal_frag="
-            f"{_fmt(pool.get('internal_fragmentation'), '{:.1%}')})",
-            f"  prefix_cache      : {pc.get('entries', 0)} entries, "
-            f"{pc.get('hit_requests', 0)} hit requests, "
-            f"hit_rate={_fmt(pc.get('hit_tokens', 0) / seen if seen else None, '{:.1%}')} "
-            f"of prompt tokens, {pc.get('evictions', 0)} evictions",
+            pline("queue_wait", sv["queue_wait_ms"]),
+            pline("ttft", sv["ttft_ms"]),
+            pline("tbt", sv["tbt_ms"], "  (per-dispatch means)"),
         ]
-        if pool.get("decode_attn_path") == "gather":
-            lines.append("  decode_attn       : gather  ** fallback: "
-                         "decode reads are stripe-wide, not "
-                         "O(live tokens) **")
-    occ = sv.get("batch_occupancy_mean")
-    lines.append(f"  occupancy         : mean={_fmt(occ, '{:.1%}')} "
-                 f"queue_depth_max="
-                 f"{_fmt(sv.get('queue_depth_max'), '{:.0f}')}")
-    spec = sv.get("speculation") or {}
-    if spec.get("dispatches"):
-        ar = spec.get("accept_rate") or {}
-        lines.append(
-            f"  speculation       : "
-            f"{_fmt(spec.get('accepted_per_dispatch'), '{:.2f}')} "
-            f"accepted drafts/dispatch over {spec['dispatches']} verify "
-            f"dispatches (accept_rate p50="
-            f"{_fmt(ar.get('p50'), '{:.1%}')} "
-            f"p95={_fmt(ar.get('p95'), '{:.1%}')}, "
-            f"lifetime={_fmt(ar.get('lifetime'), '{:.1%}')})")
-    dg = sv.get("disagg") or {}
-    if dg.get("handoffs"):
-        hm = dg.get("handoff_ms") or {}
-        lines.append(
-            f"  disagg_handoff    : {dg['handoffs']} handoffs, "
-            f"p50={_fmt(hm.get('p50'))} p95={_fmt(hm.get('p95'))} ms, "
-            f"requeues={dg.get('requeues', 0)}")
+        slo = sv.get("slo") or {}
+        thr = slo.get("thresholds") or {}
+        if slo.get("attainment") is not None:
+            lines.append(
+                f"  slo_attainment    : {_fmt(slo['attainment'], '{:.1%}')}"
+                + (f"  (ttft<={_fmt(thr.get('ttft_ms'), '{:.0f}')} ms, "
+                   f"tbt<={_fmt(thr.get('tbt_ms'), '{:.0f}')} ms)"
+                   if thr else ""))
+            lines.append(
+                f"  goodput           : "
+                f"{_fmt(slo['goodput_tokens_per_s'])} tok/s within SLO "
+                f"(raw throughput "
+                f"{_fmt(slo['throughput_tokens_per_s'])} tok/s)")
+        hist = sv.get("histograms") or {}
+        tb = hist.get("tbt_ms")
+        if tb and tb.get("count"):
+            lines.append(
+                f"  tbt (per request) : p50={_fmt(tb['p50'])} "
+                f"p95={_fmt(tb['p95'])} p99={_fmt(tb['p99'])} ms "
+                f"({tb['count']} samples, histogram)")
+        pool = sv.get("pool")
+        if pool:
+            pc = pool.get("prefix_cache") or {}
+            seen = pc.get("hit_tokens", 0) + pc.get("miss_tokens", 0)
+            lines += [
+                f"  page_pool         : {pool['pages_in_use']}/"
+                f"{pool['num_pages'] - 1} pages in use "
+                f"({pool['pages_free']} free, page_size "
+                f"{pool['page_size']}, shared={pool.get('pages_shared', 0)}, "
+                f"internal_frag="
+                f"{_fmt(pool.get('internal_fragmentation'), '{:.1%}')})",
+                f"  prefix_cache      : {pc.get('entries', 0)} entries, "
+                f"{pc.get('hit_requests', 0)} hit requests, "
+                f"hit_rate={_fmt(pc.get('hit_tokens', 0) / seen if seen else None, '{:.1%}')} "
+                f"of prompt tokens, {pc.get('evictions', 0)} evictions",
+            ]
+            if pool.get("decode_attn_path") == "gather":
+                lines.append("  decode_attn       : gather  ** fallback: "
+                             "decode reads are stripe-wide, not "
+                             "O(live tokens) **")
+        occ = sv.get("batch_occupancy_mean")
+        lines.append(f"  occupancy         : mean={_fmt(occ, '{:.1%}')} "
+                     f"queue_depth_max="
+                     f"{_fmt(sv.get('queue_depth_max'), '{:.0f}')}")
+        spec = sv.get("speculation") or {}
+        if spec.get("dispatches"):
+            ar = spec.get("accept_rate") or {}
+            lines.append(
+                f"  speculation       : "
+                f"{_fmt(spec.get('accepted_per_dispatch'), '{:.2f}')} "
+                f"accepted drafts/dispatch over {spec['dispatches']} verify "
+                f"dispatches (accept_rate p50="
+                f"{_fmt(ar.get('p50'), '{:.1%}')} "
+                f"p95={_fmt(ar.get('p95'), '{:.1%}')}, "
+                f"lifetime={_fmt(ar.get('lifetime'), '{:.1%}')})")
+        dg = sv.get("disagg") or {}
+        if dg.get("handoffs"):
+            hm = dg.get("handoff_ms") or {}
+            lines.append(
+                f"  disagg_handoff    : {dg['handoffs']} handoffs, "
+                f"p50={_fmt(hm.get('p50'))} p95={_fmt(hm.get('p95'))} ms, "
+                f"requeues={dg.get('requeues', 0)}")
     fl = sv.get("fleet")
     if fl:
         shed = fl.get("shed") or {}
@@ -833,6 +918,27 @@ def render_serve(s):
                 f"recompiles={r.get('steady_state_recompiles')}"
                 + (f" drain={r.get('drain_reason')}"
                    if r.get("drain_reason") else ""))
+        proc = fl.get("process")
+        if proc:
+            mig = proc.get("migrations") or {}
+            lines.append(
+                f"    process_fleet   : "
+                f"migrations={_fmt(mig.get('count'), '{:.0f}')} "
+                f"({_fmt(mig.get('bytes'), '{:.0f}')} B, priced "
+                f"{_fmt(mig.get('priced_ms'))} ms) "
+                f"restarts={_fmt(proc.get('restarts'), '{:.0f}')} "
+                f"deaths={proc.get('deaths', 0)} "
+                f"salvaged_flights={proc.get('salvaged_flights', 0)}")
+            for r in proc.get("replicas") or []:
+                lines.append(
+                    f"    proc replica {r.get('replica')}  : "
+                    f"pid={r.get('pid')} "
+                    f"restarts={r.get('restarts')} "
+                    f"last_exit={r.get('last_exit_code')} "
+                    f"mig_in={r.get('migrations_in')} "
+                    f"mig_out={r.get('migrations_out')} "
+                    f"mig_bytes={r.get('migration_bytes')} "
+                    f"priced_ms={r.get('migration_priced_ms')}")
         for t in fl.get("timeline") or []:
             if t["kind"] == "drain":
                 lines.append(
@@ -841,6 +947,25 @@ def render_serve(s):
                     + (f", queued={t.get('queued')} "
                        f"in_flight={t.get('in_flight')}"
                        if t.get("phase") == "begin" else "") + ")")
+            elif t["kind"] == "migration":
+                lines.append(
+                    f"    migration       : uid {t.get('uid')} "
+                    f"replica {t.get('src')} -> {t.get('dst')} "
+                    f"({t.get('pages')} pages, {t.get('nbytes')} B)")
+            elif t["kind"] == "death":
+                lines.append(
+                    f"    death           : replica {t.get('replica')} "
+                    f"({t.get('reason')}, exit={t.get('exit_code')}, "
+                    f"exports={t.get('exports')})")
+            elif t["kind"] == "restart":
+                lines.append(
+                    f"    restart         : replica {t.get('replica')} "
+                    f"{t.get('decision')} "
+                    f"(exit={t.get('exit_code')})")
+            elif t["kind"] == "autoscale":
+                lines.append(
+                    f"    autoscale       : {t.get('action')} replica "
+                    f"{t.get('replica')} (live={t.get('live')})")
             else:
                 if t.get("rolled_back") is not None:
                     ver = t.get("version")
