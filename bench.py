@@ -102,11 +102,30 @@ Metrics (BASELINE.md rows):
   (acceptance == 1.0), pins greedy parity vs the interleaved engine,
   0 recompiles, and TTFT queue/prefill/handoff decomposition in the
   trail
+- quant_serving_bytes : HARDWARE-FREE — serving-HBM payoff of int8
+  quantization on BOTH byte levers (ISSUE 17), pure accounting vs bf16
+  at the same geometry: value = bf16/int8 KV pool byte ratio
+  (per-token-row fp32 scales included), vs_baseline = bf16/int8
+  resident weight byte ratio (qwZ block 256, 1-D leaves dense);
+  detail cross-checks the pool ratio against the decode_read_bytes
+  cost model on the mixed-length workload (acceptance: both >= 1.8x)
+- quant_kv_occupancy : HARDWARE-FREE — serving-capacity payoff of the
+  int8 KV pool: the paged_kv_occupancy experiment with pool dtype as
+  the only variable; value = int8 engine's peak live tokens in flight
+  per cache KiB, vs_baseline = that density / the bf16 pool engine's;
+  pins 0 steady-state recompiles for both and carries greedy
+  agreement + decode tokens/s
 - paged_decode_tokens_per_s : TPU — wall-clock decode tokens/s of the
   serving engine with the compiled Pallas paged-decode kernel at a
   TPU-legal geometry (head_dim 128), vs_baseline = pallas tokens/s /
   the gather-fallback engine's at identical config; pins
   0 steady-state recompiles for both (next hardware window)
+- quant_decode_tokens_per_s : TPU — wall-clock decode tokens/s of the
+  FULLY quantized engine (int8-resident weights + int8 KV pool,
+  dequant in-program/in-kernel) vs the unquantized engine at identical
+  config; decode is KV-bandwidth-bound so the halved pool bytes should
+  price into tokens/s on hardware; functional pin off-TPU (next
+  hardware window)
 - disagg_ttft_p95 : TPU — p95 TTFT of the disaggregated engine
   (decode-first step order, handoff queue between the phases) vs the
   interleaved engine under the same open-loop load; on a non-TPU
@@ -177,7 +196,10 @@ METRICS = [
     "disagg_dispatch_structure",
     "fleet_drain_goodput",
     "fleet_migration_goodput",
+    "quant_serving_bytes",
+    "quant_kv_occupancy",
     "paged_decode_tokens_per_s",
+    "quant_decode_tokens_per_s",
     "disagg_ttft_p95",
     "bert_large_samples_per_s",
     "bert_onebit_samples_per_s",
@@ -197,7 +219,8 @@ HW_FREE = {"comm_wire_bytes_per_step", "comm_overlap_structure",
            "async_ckpt_stall_ms",
            "spec_decode_accepted_per_dispatch",
            "disagg_dispatch_structure", "fleet_drain_goodput",
-           "fleet_migration_goodput"}
+           "fleet_migration_goodput", "quant_serving_bytes",
+           "quant_kv_occupancy"}
 
 PARTIAL_PATH = os.environ.get(
     "BENCH_PARTIAL", "/tmp/dstpu_bench_partial.jsonl")
@@ -2333,6 +2356,221 @@ def bench_fleet_migration_goodput(on_tpu, rtt):
                    "survivors vs undisturbed (hardware-free)"})
 
 
+def bench_quant_serving_bytes(on_tpu, rtt):
+    """Hardware-free row: serving-HBM payoff of int8 quantization on
+    BOTH byte levers (ISSUE 17), priced against bf16 serving at the
+    same geometry — pure accounting, no wall clock.
+
+    Weight lever: a head_dim-128 GPT-2 param tree in bf16 is qwZ
+    block-quantized (block 256) and `quantized_tree_bytes` prices the
+    resident int8+fp32-scale footprint against the dense bf16 bytes
+    (1-D leaves stay dense by design, so the ratio honestly includes
+    them). KV lever: `paged_kv_bytes` of the int8+per-row-scale pool
+    vs the bf16 pool at identical page geometry, cross-checked by the
+    `decode_read_bytes` cost model on the mixed-length reference
+    workload (whole pages stream, so bytes/step shrinks by the same
+    ratio — the decode-bandwidth payoff rides the pool dtype).
+    value = the KV byte ratio; vs_baseline = the weight byte ratio
+    (ISSUE 17 acceptance: BOTH >= 1.8x on top of paged).
+    """
+    del on_tpu, rtt        # CPU-only byte accounting, tiny model
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.inference.kv_cache import (paged_kv_bytes,
+                                                  paged_spec_for)
+    from deepspeed_tpu.models.gpt2 import GPT2Config, init_gpt2_params
+    from deepspeed_tpu.ops.attention.paged import decode_read_bytes
+    from deepspeed_tpu.runtime.quantized_params import (
+        quantize_param_tree, quantized_tree_bytes)
+
+    cfg = GPT2Config(vocab_size=256, max_position_embeddings=512,
+                     hidden_size=512, num_layers=2, num_heads=4,
+                     embd_dropout=0.0, attn_dropout=0.0,
+                     resid_dropout=0.0)          # head_dim 128
+    params = init_gpt2_params(cfg, jax.random.PRNGKey(0))
+    params = jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.bfloat16), params)
+    qtree = quantize_param_tree(params, 256)
+    wq, wd = quantized_tree_bytes(qtree)
+    weight_ratio = wd / wq
+    _beat()
+
+    num_pages, ps = 144, 16                   # 9 slots x 256 tokens
+    spec_bf16 = paged_spec_for(cfg, num_pages, ps, 256,
+                               dtype=jnp.bfloat16)
+    spec_int8 = paged_spec_for(cfg, num_pages, ps, 256,
+                               dtype=jnp.int8, kv_quant_block=0)
+    bf16_pool = paged_kv_bytes(spec_bf16)
+    int8_pool = paged_kv_bytes(spec_int8)
+    kv_ratio = bf16_pool / int8_pool
+
+    # decode-bytes cross-check on the reference mixed-length workload
+    lens = (5, 9, 14, 3, 16, 7, 12, 4, 10, 6, 15, 8, 5, 11, 3, 13)
+    positions = [l + 8 for l in lens]
+    bf16_step, _ = decode_read_bytes(
+        positions, ps, spec_bf16.pages_per_seq, spec_bf16.kv_heads,
+        spec_bf16.head_dim, dtype_bytes=2)
+    int8_step, _ = decode_read_bytes(
+        positions, ps, spec_int8.pages_per_seq, spec_int8.kv_heads,
+        spec_int8.head_dim, dtype_bytes=1,
+        scale_blocks=spec_int8.scale_blocks)
+    step_ratio = bf16_step / int8_step if int8_step else 0.0
+    ok = weight_ratio >= 1.8 and kv_ratio >= 1.8 and step_ratio >= 1.8
+    return _emit(
+        "quant_serving_bytes", round(kv_ratio, 4),
+        "bf16/int8_kv_pool_bytes_ratio", round(weight_ratio, 3),
+        {"weight_bytes": {"int8_resident": wq, "bf16_dense": wd},
+         "weight_ratio": round(weight_ratio, 4),
+         "kv_pool_bytes": {"int8": int8_pool, "bf16": bf16_pool},
+         "kv_ratio": round(kv_ratio, 4),
+         "decode_bytes_per_step": {"int8": int(int8_step * 2),
+                                   "bf16": int(bf16_step * 2)},
+         "decode_bytes_ratio": round(step_ratio, 4),
+         "both_levers_ge_1p8x": bool(ok),
+         "quant_block": 256, "kv_quant_block": "head_dim",
+         "page_size": ps, "num_pages": num_pages,
+         "backend": jax.default_backend(),
+         "source": "quantized_tree_bytes + paged_kv_bytes + "
+                   "decode_read_bytes accounting (hardware-free)"})
+
+
+def bench_quant_kv_occupancy(on_tpu, rtt):
+    """Hardware-free row: serving-capacity payoff of the int8 KV pool
+    — the paged_kv_occupancy experiment re-run with the pool dtype as
+    the ONLY variable (ISSUE 17). The same mixed-length workload runs
+    on a bf16 pool and on an int8+per-row-scale pool at identical page
+    geometry; value = the int8 engine's peak live tokens in flight per
+    cache KiB, vs_baseline = that density / the bf16 engine's (the
+    byte ratio, since both engines pack the same peak concurrency).
+    Pins 0 steady-state recompiles for BOTH engines and carries the
+    greedy-vs-bf16 agreement plus decode tokens/s so the density win
+    is visibly not bought with accuracy or throughput collapse.
+    """
+    del on_tpu, rtt        # CPU-only accounting + wall clock, tiny model
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.inference import InferenceEngine, paged_kv_bytes
+    from deepspeed_tpu.models.gpt2 import GPT2Config, init_gpt2_params
+
+    cfg = GPT2Config(vocab_size=256, max_position_embeddings=128,
+                     hidden_size=64, num_layers=2, num_heads=4,
+                     embd_dropout=0.0, attn_dropout=0.0,
+                     resid_dropout=0.0)
+    params = init_gpt2_params(cfg, jax.random.PRNGKey(0))
+    max_len, new_tokens, ps = 128, 16, 16
+    num_pages = 40
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, 256, (l,)).tolist()
+               for l in (5, 9, 14, 3, 16, 7, 12, 4, 10, 6,
+                         15, 8, 5, 11, 3, 13)]
+
+    def serve(kv_dtype):
+        eng = InferenceEngine(cfg, params, {
+            "max_batch_size": 16, "prompt_buckets": [8, 16],
+            "batch_buckets": [1, 4, 16], "max_seq_len": max_len,
+            "max_new_tokens": new_tokens,
+            "paged_kv": {"page_size": ps, "num_pages": num_pages,
+                         "kv_dtype": kv_dtype}}, dtype=jnp.float32)
+        eng.warmup()
+        _beat()
+        t0 = time.perf_counter()
+        outs = eng.generate(prompts, max_new_tokens=new_tokens,
+                            temperature=0.0)
+        wall = time.perf_counter() - t0
+        gen = sum(len(o) - len(p) for o, p in zip(outs, prompts))
+        return (outs, gen / wall, paged_kv_bytes(eng.paged_spec),
+                eng.scheduler.peak_tokens_in_flight,
+                eng.steady_state_recompiles)
+
+    bf_outs, bf_tps, bf_bytes, bf_peak, bf_rc = serve("bf16")
+    q_outs, q_tps, q_bytes, q_peak, q_rc = serve("int8")
+    _beat()
+    q_density = q_peak / (q_bytes / 1024)
+    bf_density = bf_peak / (bf_bytes / 1024)
+    agree = sum(a == b for a, b in zip(q_outs, bf_outs))
+    return _emit(
+        "quant_kv_occupancy", round(q_density, 4),
+        "tokens_in_flight_per_cache_kib",
+        round(q_density / bf_density, 3) if bf_density > 0 else 0.0,
+        {"requests": len(prompts), "new_tokens": new_tokens,
+         "page_size": ps, "num_pages": num_pages,
+         "cache_bytes": {"int8": q_bytes, "bf16": bf_bytes},
+         "peak_tokens_in_flight": {"int8": q_peak, "bf16": bf_peak},
+         "decode_tokens_per_s": {"int8": round(q_tps, 2),
+                                 "bf16": round(bf_tps, 2)},
+         "greedy_agree_with_bf16": f"{agree}/{len(prompts)}",
+         "steady_state_recompiles": {"int8": q_rc, "bf16": bf_rc},
+         "backend": jax.default_backend(),
+         "source": "inference engine scheduler accounting, int8 vs "
+                   "bf16 KV pool at equal page geometry "
+                   "(hardware-free)"})
+
+
+def bench_quant_decode_tokens_per_s(on_tpu, rtt):
+    """TPU ladder row (next hardware window): wall-clock decode
+    tokens/s of the FULLY quantized serving engine — int8-resident
+    weights (in-program dequant at the matmuls) + int8 KV pool
+    (in-kernel dequant in the Pallas paged-decode kernel) — vs the
+    unquantized engine at identical config. The decode step is
+    KV-bandwidth-bound, so halving pool bytes should show up as
+    tokens/s on hardware; on a non-TPU backend the kernel runs
+    interpret mode and the row is a functional pin (zero steady-state
+    recompiles for both engines, greedy agreement count in detail),
+    not a perf number.
+    """
+    del rtt
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.inference import InferenceEngine
+    from deepspeed_tpu.models.gpt2 import GPT2Config, init_gpt2_params
+
+    cfg = GPT2Config(vocab_size=256, max_position_embeddings=512,
+                     hidden_size=512, num_layers=2, num_heads=4,
+                     embd_dropout=0.0, attn_dropout=0.0,
+                     resid_dropout=0.0)          # head_dim 128
+    params = init_gpt2_params(cfg, jax.random.PRNGKey(0))
+    new_tokens = 64
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, 256, (l,)).tolist()
+               for l in (5, 8, 13, 3, 16, 7, 11, 4)]
+
+    def serve(quantized):
+        icfg = {"max_batch_size": 8, "prompt_buckets": [16],
+                "batch_buckets": [8], "max_seq_len": 256,
+                "max_new_tokens": new_tokens,
+                "paged_kv": {"page_size": 16, "attn_kernel": "pallas"}}
+        if quantized:
+            icfg["quantize_weights"] = "int8"
+            icfg["paged_kv"]["kv_dtype"] = "int8"
+        eng = InferenceEngine(cfg, params, icfg, dtype=dtype)
+        eng.warmup()
+        _beat()
+        t0 = time.perf_counter()
+        outs = eng.generate(prompts, max_new_tokens=new_tokens,
+                            temperature=0.0)
+        wall = time.perf_counter() - t0
+        gen = sum(len(o) - len(p) for o, p in zip(outs, prompts))
+        return gen / wall, eng.steady_state_recompiles, outs
+    q_tps, q_rc, q_outs = serve(True)
+    fp_tps, fp_rc, fp_outs = serve(False)
+    _beat()
+    agree = sum(a == b for a, b in zip(q_outs, fp_outs))
+    return _emit(
+        "quant_decode_tokens_per_s", round(q_tps, 2),
+        "tokens_per_s",
+        round(q_tps / fp_tps, 3) if fp_tps > 0 else 0.0,
+        {"unquantized_tokens_per_s": round(fp_tps, 2),
+         "steady_state_recompiles": {"quantized": q_rc,
+                                     "unquantized": fp_rc},
+         "greedy_agree_with_fp": f"{agree}/{len(prompts)}",
+         "new_tokens": new_tokens, "requests": len(prompts),
+         "hbm_peak_mb": _hbm_peak_mb(),
+         "backend": jax.default_backend(),
+         "source": "inference engine wall clock, int8 weights + int8 "
+                   "KV pool vs unquantized at identical config"})
+
+
 def bench_disagg_ttft_p95(on_tpu, rtt):
     """TPU ladder row (next hardware window): p95 TTFT of the
     disaggregated engine — decode-first step order with the handoff
@@ -2489,6 +2727,12 @@ def run_child(metric):
         bench_fleet_drain_goodput(on_tpu, rtt)
     elif metric == "fleet_migration_goodput":
         bench_fleet_migration_goodput(on_tpu, rtt)
+    elif metric == "quant_serving_bytes":
+        bench_quant_serving_bytes(on_tpu, rtt)
+    elif metric == "quant_kv_occupancy":
+        bench_quant_kv_occupancy(on_tpu, rtt)
+    elif metric == "quant_decode_tokens_per_s":
+        bench_quant_decode_tokens_per_s(on_tpu, rtt)
     elif metric == "paged_decode_tokens_per_s":
         bench_paged_decode_tokens_per_s(on_tpu, rtt)
     elif metric == "disagg_ttft_p95":

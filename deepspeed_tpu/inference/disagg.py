@@ -159,6 +159,13 @@ class MigrationRecord:
     the warmup-compiled export program, trimmed to ``live_pages``
     (shape ``(layers, live_pages, kv_heads, page_size, head_dim)``,
     host numpy — they ship as the raw binary segment of an RPC frame).
+    Quantized (int8) pools additionally carry
+    ``kscale_slab``/``vscale_slab`` — the per-token-row fp32 scales,
+    shape ``(layers, live_pages, kv_heads, page_size, scale_blocks)``
+    — so migrated pages stay int8 on the wire and the destination
+    scatters payload + scales as one leaf-generic import. An fp-pool
+    record leaves them None; the destination engine rejects any
+    payload/scale combination its own pool geometry can't hold.
     Resume is bitwise because sampling keys derive from
     ``(request seed, absolute position)`` — never from batch
     composition or wall clock — and clocks are shipped as *elapsed*
@@ -186,6 +193,8 @@ class MigrationRecord:
     weight_version: Optional[str] = None
     kslab: Optional[object] = None    # numpy (layers, live, kvh, ps, hd)
     vslab: Optional[object] = None
+    kscale_slab: Optional[object] = None  # fp32 (layers, live, kvh, ps, nb)
+    vscale_slab: Optional[object] = None  # (int8 pools only)
 
     def to_header(self) -> Dict:
         """The JSON-able half (slabs ride the frame's binary segment —
@@ -208,9 +217,8 @@ class MigrationRecord:
 
     @property
     def nbytes(self) -> int:
-        k = getattr(self.kslab, "nbytes", 0)
-        v = getattr(self.vslab, "nbytes", 0)
-        return int(k) + int(v)
+        return sum(int(getattr(s, "nbytes", 0)) for s in (
+            self.kslab, self.vslab, self.kscale_slab, self.vscale_slab))
 
 
 class DispatchTrace:

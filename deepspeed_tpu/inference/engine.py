@@ -114,6 +114,11 @@ from deepspeed_tpu.ops.attention.flash import NEG_INF
 from deepspeed_tpu.parallel.mesh import axis_size, build_mesh
 from deepspeed_tpu.profiling.recompile import CompileTracker
 from deepspeed_tpu.profiling.spans import ChromeTraceRecorder, trace_span
+from deepspeed_tpu.runtime.quantized_params import (QuantizedParam,
+                                                    dequantize_param_tree,
+                                                    is_quantized_tree,
+                                                    quantize_param_tree,
+                                                    quantized_tree_bytes)
 from deepspeed_tpu.utils.logging import logger
 from deepspeed_tpu.utils.monitor import TensorBoardMonitor, _JsonlWriter
 
@@ -194,29 +199,58 @@ def _leaf_sharding(mesh, spec, shape) -> NamedSharding:
 
 def _param_shardings(mesh, specs_fn, model_config, template):
     """Per-leaf serving shardings for a params pytree (``template``:
-    real arrays or ``jax.eval_shape`` structs — only shapes are read)."""
+    real arrays or ``jax.eval_shape`` structs — only shapes are read).
+    Quantized (int8-resident) leaves mirror the template's
+    :class:`~deepspeed_tpu.runtime.quantized_params.QuantizedParam`
+    structure: ``q`` keeps the weight's original rank (blockwise along
+    the last axis), so the family's TP spec applies to it unchanged;
+    the scale tree takes the same spec through the divisibility
+    fallback (its trailing blocks dim is usually too small to split and
+    replicates)."""
+    def one(leaf, s):
+        if isinstance(leaf, QuantizedParam):
+            return QuantizedParam(
+                _leaf_sharding(mesh, s, leaf.q.shape),
+                _leaf_sharding(mesh, s, leaf.scale.shape),
+                leaf.orig_dtype, leaf.block)
+        return _leaf_sharding(mesh, s, leaf.shape)
     return jax.tree_util.tree_map(
-        lambda leaf, s: _leaf_sharding(mesh, s, leaf.shape),
-        template, specs_fn(model_config))
+        one, template, specs_fn(model_config),
+        is_leaf=lambda l: isinstance(l, QuantizedParam))
 
 
-def qwz_distribute_params(params, block: int = 256):
+def qwz_distribute_params(params, block: int = 256,
+                          resident: str = "bf16"):
     """Ship params through the qwZ int8 block wire format (ZeRO++
-    quantized weight gather, ``runtime/quantized_collectives``): every
-    float leaf crosses as int8 blocks + fp32 scales and dequantizes on
-    the serving replica — ~4x less weight traffic when fanning one
-    committed checkpoint out to many replicas. Returns the dequantized
-    params; the block-quantization rounding is the accuracy cost."""
-    from deepspeed_tpu.runtime.quantized_collectives import (
-        dequantize_blockwise, quantize_blockwise)
+    quantized weight gather): every floating matmul/embedding leaf
+    crosses as int8 blocks + fp32 scales — ~4x less weight traffic when
+    fanning one committed checkpoint out to many replicas. The WIRE
+    format and the RESIDENT format are decoupled (PR 17): both paths
+    quantize through ``runtime/quantized_params.quantize_param_tree``;
+    ``resident`` picks what the replica keeps.
 
-    def one(x):
-        x = jnp.asarray(x)
-        if not jnp.issubdtype(x.dtype, jnp.floating):
-            return x
-        q, s, n = quantize_blockwise(x, block)
-        return dequantize_blockwise(q, s, n, x.shape).astype(x.dtype)
-    return jax.tree_util.tree_map(one, params)
+    - ``"bf16"`` (historical behavior): dequantize eagerly back to the
+      original dtype. NOTE the cost this hides: eager dequant
+      re-materializes the FULL original-dtype HBM footprint on the
+      replica — the 4x saving is wire-only, resident weight HBM is
+      unchanged.
+    - ``"int8"``: keep the int8 blocks + scales live (a tree of
+      ``QuantizedParam`` leaves). The compiled prefill/decode programs
+      dequantize per block at each weight use (``models/*`` ``_wd``),
+      so resident weight HBM drops ~2x and the wire saving survives on
+      the replica.
+
+    1-D leaves (biases, norms) stay dense either way — their bytes are
+    noise and the historical all-leaf quantization bought nothing but
+    extra rounding error on them."""
+    qtree = quantize_param_tree(params, block)
+    if resident == "int8":
+        return qtree
+    if resident != "bf16":
+        raise ValueError(
+            f"qwz_distribute_params resident must be 'bf16' or 'int8', "
+            f"got {resident!r}")
+    return dequantize_param_tree(qtree)
 
 
 class InferenceEngine:
@@ -258,6 +292,26 @@ class InferenceEngine:
 
         # ---------------------------------------------- serving mesh
         self.mesh = _serving_mesh(cfg, mesh)
+
+        # ------------------------------------- int8-resident weights
+        # quantize_weights: False | "bf16" (wire-only) | "int8" (keep
+        # qwZ blocks + scales as the LIVE tree; compiled programs
+        # dequant per block at each matmul — models/* ``_wd``)
+        qw = cfg["quantize_weights"]
+        self.weights_resident = "int8" if qw == "int8" else (
+            "bf16" if qw else "off")
+        self._weight_block = int(cfg["quantize_block"])
+        if qw == "int8":
+            # no-op when from_checkpoint already shipped a quantized
+            # tree (quantize_param_tree passes quantized leaves through)
+            params = quantize_param_tree(params, self._weight_block)
+            if self.mesh is None:
+                # host round-trip: pin the quantized tree to the dense
+                # constructor's UNcommitted placement, so swap_params'
+                # requantize lands on identical program keys
+                params = jax.tree_util.tree_map(
+                    lambda x: jnp.asarray(np.asarray(x)), params)
+
         self._param_shardings = None
         self._cache_sharding = None
         if self.mesh is not None:
@@ -375,6 +429,10 @@ class InferenceEngine:
         self._steps = 0
         self._warm_compiles: Optional[int] = None
         self._serve_secs = 0.0
+        # offline fp-oracle probe result (record_quant_logit_err):
+        # serving can't afford an fp oracle per dispatch, so the error
+        # rides telemetry only when a test/bench measures it
+        self.quant_logit_err: Optional[float] = None
         self._state_event_every = 64       # serve_state cadence (steps)
         self._key_cache: Dict[int, np.ndarray] = {}
 
@@ -396,14 +454,25 @@ class InferenceEngine:
             # max_len each
             num_pages = pk["num_pages"] or (
                 self.num_slots * pages_for(max_len, ps) + 1)
-            self.paged_spec = paged_spec_for(model_config, num_pages, ps,
-                                             max_len, dtype=dtype)
+            # pool payload dtype: the engine dtype unless paged_kv.
+            # kv_dtype overrides it ("int8" = quantized pool — the
+            # cache tree grows per-token-row fp32 scale pools and the
+            # decode kernel dequantizes tiles in VMEM)
+            kv_dtype = {"bf16": jnp.bfloat16, "int8": jnp.int8}.get(
+                pk["kv_dtype"], dtype)
+            self.paged_spec = paged_spec_for(
+                model_config, num_pages, ps, max_len, dtype=kv_dtype,
+                kv_quant_block=pk["kv_quant_block"])
             self.cache_spec = None
             self._cache = init_paged_kv_cache(self.paged_spec)
             allocator = PageAllocator(num_pages, ps,
                                       prefix_cache=pk["prefix_cache"])
             cache_bytes = paged_kv_bytes(self.paged_spec)
             self._page_bytes = cache_bytes // num_pages
+            # static pool cost per token of capacity — the
+            # Serve/kv_pool_bytes_per_token gauge (int8 pools land
+            # near half the bf16 figure; scales are the remainder)
+            self._kv_bpt = cache_bytes / float(num_pages * ps)
             if self._separate_pools:
                 # the prefill workers' own pool: prompts only (decode
                 # lifetime is reserved from the main pool at handoff
@@ -415,7 +484,8 @@ class InferenceEngine:
                 ppages = dg["prefill_pages"] or (
                     self.num_slots * pages_for(max_prompt, ps) + 1)
                 self.paged_spec_prefill = paged_spec_for(
-                    model_config, ppages, ps, max_prompt, dtype=dtype)
+                    model_config, ppages, ps, max_prompt, dtype=kv_dtype,
+                    kv_quant_block=pk["kv_quant_block"])
                 self._cache_prefill = init_paged_kv_cache(
                     self.paged_spec_prefill)
                 admit_allocator = PageAllocator(
@@ -428,6 +498,7 @@ class InferenceEngine:
                                              max_len, dtype=dtype)
             self._cache = init_kv_cache(self.cache_spec)
             cache_bytes = kv_cache_bytes(self.cache_spec)
+            self._kv_bpt = cache_bytes / float(self._rows * max_len)
         # pages_per_seq of the pool the PREFILL program scatters into
         self._prefill_pps = (self.paged_spec_prefill.pages_per_seq
                              if self._separate_pools else
@@ -487,7 +558,10 @@ class InferenceEngine:
                 self._wrap_handoff_programs()
             geom = (f"paged KV cache: {self.paged_spec.num_pages} pages "
                     f"x {self.paged_spec.page_size} tokens "
-                    f"({cache_bytes / 2**20:.1f} MiB), prefix cache "
+                    f"({cache_bytes / 2**20:.1f} MiB, "
+                    f"{jnp.dtype(self.paged_spec.dtype).name}"
+                    f"{' + fp32 scales' if self.paged_spec.quantized else ''}"
+                    f"), prefix cache "
                     f"{'on' if pk['prefix_cache'] else 'off'}, "
                     f"decode attn {self._decode_attn_path}")
             # the which-decode-attention-compiled line (PR 6's
@@ -606,7 +680,10 @@ class InferenceEngine:
                     return _fn(*args)
 
             repl = NamedSharding(mesh, P())
-            cache_sh = (cache_sharding, cache_sharding)
+            # one sharding per cache leaf: the (kc, vc) pair, or the
+            # quantized 4-tuple (kc, vc, kscale, vscale) — scale pools
+            # carry kv_heads at dim 2 exactly like the payload pools
+            cache_sh = tuple(cache_sharding for _ in self._cache)
             in_sh = (param_shardings, cache_sh) + \
                 (repl,) * (nargs - 2)
             jitted = jax.jit(fn_under_mesh, donate_argnums=(1,),
@@ -625,6 +702,7 @@ class InferenceEngine:
         warmup-compiled program set. Between them the slab crosses
         meshes by ``device_put`` when ``disagg.decode_mesh`` differs —
         the priced hop."""
+        nleaf = len(self._cache)
         if self.mesh is None:
             ex = jax.jit(self._export_pages_impl)
         else:
@@ -632,8 +710,8 @@ class InferenceEngine:
             slab_sh = NamedSharding(self.mesh, P(None, None, "model"))
             repl = NamedSharding(self.mesh, P())
             ex = jax.jit(self._export_pages_impl,
-                         in_shardings=((cs, cs), repl),
-                         out_shardings=(slab_sh, slab_sh))
+                         in_shardings=((cs,) * nleaf, repl),
+                         out_shardings=(slab_sh,) * nleaf)
         self._export = self.compile_tracker.wrap(ex, "handoff_export")
         self._slab_sharding_decode = None
         if self._mesh_decode is None:
@@ -645,9 +723,9 @@ class InferenceEngine:
             self._slab_sharding_decode = slab_sh
             repl = NamedSharding(self._mesh_decode, P())
             im = jax.jit(self._import_pages_impl, donate_argnums=(0,),
-                         in_shardings=((cs, cs), (slab_sh, slab_sh),
-                                       repl),
-                         out_shardings=(cs, cs))
+                         in_shardings=((cs,) * nleaf,
+                                       (slab_sh,) * nleaf, repl),
+                         out_shardings=(cs,) * nleaf)
         self._import = self.compile_tracker.wrap(im, "handoff_import")
 
     # -------------------------------------------------- compiled programs
@@ -781,18 +859,18 @@ class InferenceEngine:
     def _export_pages_impl(self, cache, idx):
         """Gather ``idx``'s rows (live prompt pages) out of the prefill
         pool into a contiguous slab — the unit that crosses the
-        prefill->decode link. No donation: the pool keeps serving."""
-        k, v = cache
-        return k[:, idx], v[:, idx]
+        prefill->decode link. No donation: the pool keeps serving.
+        Leaf-generic over the cache tree: a quantized pool's fp32 scale
+        pools ride the same gather, so migrated pages stay int8 on the
+        wire (the scale slab is the small side-channel)."""
+        return tuple(c[:, idx] for c in cache)
 
     def _import_pages_impl(self, cache, slab, idx):
         """Scatter a handoff slab into the decode pool at ``idx``
         (pad index 0 rows land in the null page — garbage by design).
         The pool is donated: steady-state migration allocates
-        nothing."""
-        k, v = cache
-        sk, sv = slab
-        return k.at[:, idx].set(sk), v.at[:, idx].set(sv)
+        nothing. Leaf-generic like the export."""
+        return tuple(c.at[:, idx].set(s) for c, s in zip(cache, slab))
 
     # ----------------------------------------------------------- serving
     # seeds are caller-supplied, so the memo must be bounded: a serving
@@ -864,12 +942,15 @@ class InferenceEngine:
                        len(slot.pages))
             idx = np.zeros((self._mig_width,), np.int32)
             idx[:live] = slot.pages[:live]
-            kslab, vslab = self._mig_export(self._cache,
-                                            jnp.asarray(idx))
+            slabs = self._mig_export(self._cache, jnp.asarray(idx))
             # trim to the live pages on the host — the wire carries
-            # content, never the reservation
-            kslab = np.asarray(kslab[:, :live])
-            vslab = np.asarray(vslab[:, :live])
+            # content, never the reservation. Quantized pools export
+            # four slabs (payload + fp32 scales); migrated pages stay
+            # int8 on the wire.
+            slabs = tuple(np.asarray(s[:, :live]) for s in slabs)
+            kslab, vslab = slabs[0], slabs[1]
+            kscale_slab = slabs[2] if len(slabs) == 4 else None
+            vscale_slab = slabs[3] if len(slabs) == 4 else None
             req = slot.request
             now = sched._clock()
             rec = MigrationRecord(
@@ -886,7 +967,8 @@ class InferenceEngine:
                 draft_proposed=slot.draft_proposed,
                 draft_accepted=slot.draft_accepted,
                 weight_version=self._weight_version,
-                kslab=kslab, vslab=vslab)
+                kslab=kslab, vslab=vslab,
+                kscale_slab=kscale_slab, vscale_slab=vscale_slab)
             sched.evict(uid, reason="migrate")
             return rec
         return None
@@ -911,6 +993,22 @@ class InferenceEngine:
                 or np.dtype(rec.kslab.dtype) != np.dtype(spec.dtype)
                 or rec.live_pages > self._mig_width):
             return None
+        slabs_in = [rec.kslab, rec.vslab]
+        if spec.quantized:
+            # a quantized pool needs the scale slabs too — an fp-pool
+            # record (or a geometry-mismatched scale slab) bounces with
+            # nothing leaked, same as a payload dtype mismatch
+            swant = (spec.num_layers, rec.live_pages, spec.kv_heads,
+                     spec.page_size, spec.scale_blocks)
+            ks = getattr(rec, "kscale_slab", None)
+            vs = getattr(rec, "vscale_slab", None)
+            if (ks is None or vs is None
+                    or tuple(ks.shape) != swant
+                    or tuple(vs.shape) != swant):
+                return None
+            slabs_in += [ks, vs]
+        elif getattr(rec, "kscale_slab", None) is not None:
+            return None    # int8-pool record into an fp pool
         sched = self.scheduler
         if not sched.free_slots():
             return None
@@ -922,16 +1020,15 @@ class InferenceEngine:
         width = self._mig_width
         idx = np.zeros((width,), np.int32)
         idx[:rec.live_pages] = pages[:rec.live_pages]
-        kw = np.zeros((spec.num_layers, width, spec.kv_heads,
-                       spec.page_size, spec.head_dim),
-                      np.dtype(spec.dtype))
-        vw = np.zeros_like(kw)
-        kw[:, :rec.live_pages] = rec.kslab
-        vw[:, :rec.live_pages] = rec.vslab
+        wide = []
+        for s, leaf in zip(slabs_in, self._cache):
+            w = np.zeros((spec.num_layers, width) + tuple(leaf.shape[2:]),
+                         np.dtype(leaf.dtype))
+            w[:, :rec.live_pages] = s
+            wide.append(jnp.asarray(w))
         # pad rows scatter zeros into the null page — garbage by design
-        self._cache = self._mig_import(
-            self._cache, (jnp.asarray(kw), jnp.asarray(vw)),
-            jnp.asarray(idx))
+        self._cache = self._mig_import(self._cache, tuple(wide),
+                                       jnp.asarray(idx))
         req = Request(prompt=list(rec.prompt),
                       max_new_tokens=rec.max_new_tokens,
                       temperature=rec.temperature, seed=rec.seed,
@@ -994,8 +1091,33 @@ class InferenceEngine:
                                             verify_integrity)
             version = os.path.basename(chosen)
             fault.fire("serve.swap_load", path=chosen, version=version)
-            new_params = ckptlib.load_params_only(
-                chosen, self.params, self._param_shardings)
+            if is_quantized_tree(self.params):
+                # int8-resident replica: the checkpoint holds fp
+                # weights, so the live tree can't be the load template.
+                # Load fp against a dense eval_shape template (resharded
+                # onto the fp TP specs), then REQUANTIZE into the exact
+                # resident layout — same avals, same shardings, same
+                # committedness as the constructor's tree, so the warm
+                # program set keys hit: zero recompiles.
+                _, _, init_fn, specs_fn = _family_of(self.model_config)
+                template = jax.eval_shape(
+                    lambda k: init_fn(self.model_config, k),
+                    jax.random.PRNGKey(0))
+                fp_sh = None
+                if self.mesh is not None:
+                    fp_sh = _param_shardings(
+                        self.mesh, specs_fn, self.model_config, template)
+                new_params = ckptlib.load_params_only(chosen, template,
+                                                      fp_sh)
+                new_params = quantize_param_tree(new_params,
+                                                 self._weight_block)
+                if self.mesh is not None:
+                    new_params = jax.tree_util.tree_map(
+                        lambda x, s: jax.device_put(x, s),
+                        new_params, self._param_shardings)
+            else:
+                new_params = ckptlib.load_params_only(
+                    chosen, self.params, self._param_shardings)
         except BaseException as e:
             if self._log is not None:
                 self._log.add_event(
@@ -1046,6 +1168,15 @@ class InferenceEngine:
                     f"{wall_ms:.1f} ms, zero recompiles by construction)")
         return version
 
+    def record_quant_logit_err(self, err: float) -> None:
+        """Record an offline quantized-vs-fp-oracle max-logit-error
+        probe (tests/bench compute it against a
+        :func:`~deepspeed_tpu.runtime.quantized_params.dequantize_param_tree`
+        oracle — the serving path itself never pays for one). The next
+        decode telemetry write carries it as ``Serve/quant_logit_err``
+        and ``debug_state`` mirrors it for ``obs_report --serve``."""
+        self.quant_logit_err = float(err)
+
     def set_speculation(self, on: bool) -> bool:
         """Degrade rung of the fleet shed ladder: toggle speculative
         decoding without touching the compiled program set (the plain
@@ -1089,9 +1220,23 @@ class InferenceEngine:
                 1.0 - sched.tokens_in_flight / used_tokens, 4) \
                 if used_tokens else 0.0
             pool["decode_attn_path"] = self._decode_attn_path
+        wq, wd = quantized_tree_bytes(self.params)
+        quant = {
+            "weights_resident": self.weights_resident,
+            "weight_bytes": wq,
+            "weight_bytes_dense": wd,
+            "kv_dtype": (jnp.dtype(self.paged_spec.dtype).name
+                         if self.paged else
+                         jnp.dtype(self.cache_spec.dtype).name),
+            "kv_quant_block": (self.paged_spec.quant_block
+                               if self.paged else 0),
+            "kv_pool_bytes_per_token": round(self._kv_bpt, 3),
+            "quant_logit_err": self.quant_logit_err,
+        }
         state = {
             "family": self.family,
             "steps": self._steps,
+            "quantization": quant,
             "queue_depth": sched.queue_depth,
             "queue_by_bucket": sched.queue_by_bucket(),
             "occupancy": round(sched.occupancy, 4),
@@ -1417,7 +1562,10 @@ class InferenceEngine:
                                  if seen else 0.0),
                 decode_attn_path=(
                     1.0 if self._decode_attn_path == "pallas"
-                    else 0.0))
+                    else 0.0),
+                kv_pool_bytes_per_token=self._kv_bpt)
+        if self.quant_logit_err is not None:
+            paged_kw["quant_logit_err"] = self.quant_logit_err
         tracer = self._tracer
         slo_kw = {}
         if tracer.enabled:
@@ -1618,6 +1766,7 @@ class InferenceEngine:
             return 0
         self._mig_width = self.paged_spec.pages_per_seq
         mesh = self._mesh_decode
+        nleaf = len(self._cache)
         if mesh is None:
             ex = jax.jit(self._export_pages_impl)
             im = jax.jit(self._import_pages_impl, donate_argnums=(0,))
@@ -1626,12 +1775,12 @@ class InferenceEngine:
             slab_sh = NamedSharding(mesh, P(None, None, "model"))
             repl = NamedSharding(mesh, P())
             ex = jax.jit(self._export_pages_impl,
-                         in_shardings=((cs, cs), repl),
-                         out_shardings=(slab_sh, slab_sh))
+                         in_shardings=((cs,) * nleaf, repl),
+                         out_shardings=(slab_sh,) * nleaf)
             im = jax.jit(self._import_pages_impl, donate_argnums=(0,),
-                         in_shardings=((cs, cs), (slab_sh, slab_sh),
-                                       repl),
-                         out_shardings=(cs, cs))
+                         in_shardings=((cs,) * nleaf,
+                                       (slab_sh,) * nleaf, repl),
+                         out_shardings=(cs,) * nleaf)
         self._mig_export = self.compile_tracker.wrap(ex,
                                                      "migrate_export")
         self._mig_import = self.compile_tracker.wrap(im,
@@ -1680,9 +1829,11 @@ class InferenceEngine:
         any train mesh restores onto any serving mesh
         (``load_params_only`` materializes straight into the serving
         NamedShardings). ``quantize_weights`` (default: the
-        ``inference.quantize_weights`` config) ships the weights
-        through the qwZ int8 block wire format
-        (:func:`qwz_distribute_params`)."""
+        ``inference.quantize_weights`` config; ``True`` is an alias for
+        ``"bf16"``) ships the weights through the qwZ int8 block wire
+        format (:func:`qwz_distribute_params`); ``"int8"`` additionally
+        keeps them int8-RESIDENT — the engine's compiled programs
+        dequantize per block at each matmul, halving weight HBM."""
         from deepspeed_tpu.runtime import checkpoint as ckptlib
         cfg = _normalize_inference_config(inference_config)
         chosen = _resolve_committed_tag(ckptlib, load_dir, tag,
@@ -1700,10 +1851,18 @@ class InferenceEngine:
         params = ckptlib.load_params_only(chosen, template, shardings)
         if quantize_weights is None:
             quantize_weights = cfg["quantize_weights"]
+        elif quantize_weights is True:
+            quantize_weights = "bf16"
         if quantize_weights:
-            params = qwz_distribute_params(params, cfg["quantize_block"])
+            params = qwz_distribute_params(params, cfg["quantize_block"],
+                                           resident=quantize_weights)
+            # keep the engine's view consistent with what actually
+            # shipped (an explicit kwarg overrides the config)
+            cfg = dict(cfg)
+            cfg["quantize_weights"] = quantize_weights
             logger.info(f"from_checkpoint: params distributed via qwZ "
-                        f"int8 (block {cfg['quantize_block']})")
+                        f"int8 (block {cfg['quantize_block']}, "
+                        f"resident {quantize_weights})")
         engine = cls(model_config, params, cfg, dtype=dtype,
                      monitor=monitor, mesh=mesh,
                      observability_config=observability_config,
@@ -1713,7 +1872,7 @@ class InferenceEngine:
         if engine._log is not None:
             engine._log.add_event(
                 "serve_load", checkpoint=chosen,
-                quantize_weights=bool(quantize_weights))
+                quantize_weights=quantize_weights or False)
         logger.info(f"inference engine loaded params from {chosen}")
         return engine
 

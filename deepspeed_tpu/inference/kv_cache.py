@@ -107,7 +107,18 @@ class PagedKVSpec(NamedTuple):
     """Static geometry of the paged serving KV cache. ``pages_per_seq``
     is the block-table width: every slot's table maps that many logical
     page positions (covering ``max_len`` tokens), entries beyond its
-    reservation pointing at the null page 0."""
+    reservation pointing at the null page 0.
+
+    **Quantized pool (PR 17)** — ``dtype=int8`` switches the pool to
+    int8 payload with per-token-row fp32 absmax scales stored alongside
+    (the EQuARX/qwZ recipe applied to the KV pool): the cache tree
+    becomes the 4-tuple ``(kc, vc, kscale, vscale)`` where the scale
+    pools are shaped ``(layers, num_pages, kv_heads, page_size,
+    scale_blocks)``. ``quant_block`` is the scale granularity along
+    head_dim (0 = one scale per token row, i.e. the whole head_dim);
+    scales are per token row because decode fills pages one token at a
+    time — a page-wide scale would be rewritten (and degrade) on every
+    append."""
     num_layers: int
     num_pages: int       # pool size, INCLUDING the reserved null page 0
     page_size: int
@@ -115,19 +126,38 @@ class PagedKVSpec(NamedTuple):
     head_dim: int
     pages_per_seq: int
     dtype: Any = jnp.bfloat16
+    quant_block: int = 0  # scale block over head_dim (0 = head_dim)
 
     @property
     def shape(self) -> Tuple[int, int, int, int, int]:
         return (self.num_layers, self.num_pages, self.kv_heads,
                 self.page_size, self.head_dim)
 
+    @property
+    def quantized(self) -> bool:
+        return jnp.dtype(self.dtype) == jnp.dtype(jnp.int8)
+
+    @property
+    def scale_blocks(self) -> int:
+        """Scales per token row: head_dim / quant_block."""
+        block = self.quant_block or self.head_dim
+        return self.head_dim // block
+
+    @property
+    def scale_shape(self) -> Tuple[int, int, int, int, int]:
+        return (self.num_layers, self.num_pages, self.kv_heads,
+                self.page_size, self.scale_blocks)
+
 
 def paged_spec_for(model_config, num_pages: int, page_size: int,
-                   max_len: int, dtype=jnp.bfloat16) -> PagedKVSpec:
+                   max_len: int, dtype=jnp.bfloat16,
+                   kv_quant_block: int = 0) -> PagedKVSpec:
     """Paged cache geometry from a model config. ``num_pages == 0``
     auto-sizes the pool to the dense worst case (every slot is not known
     here, so callers pass the resolved count); the engine resolves 0
-    before calling."""
+    before calling. ``dtype=int8`` selects the quantized pool;
+    ``kv_quant_block`` (0 = head_dim) sets the per-row scale block and
+    must divide head_dim."""
     kv_heads, head_dim = _model_kv_geometry(model_config)
     if max_len > model_config.max_position_embeddings:
         raise ValueError(
@@ -138,19 +168,38 @@ def paged_spec_for(model_config, num_pages: int, page_size: int,
             f"paged kv cache needs page_size >= 1 and num_pages >= 2 "
             f"(one null + one usable), got page_size={page_size}, "
             f"num_pages={num_pages}")
+    quantized = jnp.dtype(dtype) == jnp.dtype(jnp.int8)
+    block = int(kv_quant_block) if quantized else 0
+    if quantized and block and head_dim % block != 0:
+        raise ValueError(
+            f"paged kv cache kv_quant_block ({block}) must divide "
+            f"head_dim ({head_dim})")
     return PagedKVSpec(num_layers=model_config.num_layers,
                        num_pages=num_pages, page_size=page_size,
                        kv_heads=kv_heads, head_dim=head_dim,
                        pages_per_seq=pages_for(max_len, page_size),
-                       dtype=dtype)
+                       dtype=dtype, quant_block=block)
 
 
 def init_paged_kv_cache(spec: PagedKVSpec):
-    """Allocate the zeroed paged ``(kc, vc)`` pool pair."""
-    return (jnp.zeros(spec.shape, spec.dtype),
-            jnp.zeros(spec.shape, spec.dtype))
+    """Allocate the zeroed paged pool tree: the ``(kc, vc)`` pair, plus
+    ``(kscale, vscale)`` fp32 scale pools when the spec is int8-
+    quantized (4-tuple). Every engine cache op is leaf-generic over this
+    tuple, so the two geometries share one code path."""
+    pools = (jnp.zeros(spec.shape, spec.dtype),
+             jnp.zeros(spec.shape, spec.dtype))
+    if spec.quantized:
+        # zero scales are fine: the null page / unwritten rows are never
+        # read unmasked, and quantized writes always store a scale > 0
+        pools = pools + (jnp.zeros(spec.scale_shape, jnp.float32),
+                         jnp.zeros(spec.scale_shape, jnp.float32))
+    return pools
 
 
 def paged_kv_bytes(spec: PagedKVSpec) -> int:
-    """Total bytes of the paged (kc, vc) pool pair."""
-    return _pair_bytes(spec)
+    """Total bytes of the paged pool tree — int8 payload + fp32 scales
+    when quantized (the KV lever of ``quant_serving_bytes``)."""
+    total = _pair_bytes(spec)
+    if spec.quantized:
+        total += 2 * int(np.prod(spec.scale_shape)) * 4
+    return total

@@ -182,8 +182,14 @@ def decode_arrays(metas: Sequence[Dict[str, Any]],
 
 
 def migration_to_wire(rec) -> Tuple[Dict[str, Any], bytes]:
-    """:class:`~.disagg.MigrationRecord` -> (header dict, slab bytes)."""
-    metas, payload = encode_arrays([rec.kslab, rec.vslab])
+    """:class:`~.disagg.MigrationRecord` -> (header dict, slab bytes).
+    Quantized (int8-pool) records append their fp32 scale slabs as
+    arrays 3 and 4 — the payload stays int8 on the wire; the array
+    count in the header is what the decoder branches on."""
+    slabs = [rec.kslab, rec.vslab]
+    if getattr(rec, "kscale_slab", None) is not None:
+        slabs += [rec.kscale_slab, rec.vscale_slab]
+    metas, payload = encode_arrays(slabs)
     head = rec.to_header()
     head["arrays"] = metas
     return head, payload
@@ -191,9 +197,14 @@ def migration_to_wire(rec) -> Tuple[Dict[str, Any], bytes]:
 
 def migration_from_wire(head: Dict[str, Any], payload: bytes):
     from deepspeed_tpu.inference.disagg import MigrationRecord
-    kslab, vslab = decode_arrays(head["arrays"], payload)
+    arrays = decode_arrays(head["arrays"], payload)
+    kscale = vscale = None
+    if len(arrays) == 4:
+        kscale, vscale = arrays[2], arrays[3]
     fields = {k: v for k, v in head.items() if k != "arrays"}
-    return MigrationRecord(kslab=kslab, vslab=vslab, **fields)
+    return MigrationRecord(kslab=arrays[0], vslab=arrays[1],
+                           kscale_slab=kscale, vscale_slab=vscale,
+                           **fields)
 
 
 def decode_migrations(headers: Sequence[Dict[str, Any]],

@@ -142,9 +142,41 @@ def _layer_norm(x, p, eps):
     return _ln_wb(x, p["w"], p["b"], eps)
 
 
+def _wd(leaf, dtype):
+    """Weight at its use site: int8-resident leaves (serving under
+    ``inference.quantize_weights: "int8"`` — runtime/quantized_params)
+    dequantize per block RIGHT HERE, inside the compiled program, so
+    the resident HBM copy stays int8; dense leaves just cast. The
+    isinstance test is trace-time — training trees never carry
+    QuantizedParam leaves, so the training path compiles unchanged."""
+    from deepspeed_tpu.runtime.quantized_params import (QuantizedParam,
+                                                        dequantize_param)
+    if isinstance(leaf, QuantizedParam):
+        return dequantize_param(leaf, dtype)
+    return leaf.astype(dtype)
+
+
+def _emb_rows(leaf, ids, dtype):
+    """Embedding-table row gather for dense or int8-resident tables:
+    quantized tables gather the int8 rows AND their per-block scales,
+    dequantizing only the gathered rows — the full-vocab table is never
+    materialized at the model dtype."""
+    from deepspeed_tpu.runtime.quantized_params import QuantizedParam
+    if isinstance(leaf, QuantizedParam):
+        q = leaf.q[ids]
+        s = jnp.repeat(leaf.scale[ids], leaf.block, axis=-1)
+        return (q.astype(jnp.float32) * s[..., :q.shape[-1]]
+                ).astype(dtype)
+    return leaf[ids].astype(dtype)
+
+
 def _embed(wte, wpe, ids, dtype):
     """Token + position embedding (shared by flat and pipelined forms)."""
+    from deepspeed_tpu.runtime.quantized_params import QuantizedParam
     pos = jnp.arange(ids.shape[1])[None, :]
+    if isinstance(wte, QuantizedParam) or isinstance(wpe, QuantizedParam):
+        return (_emb_rows(wte, ids, jnp.float32)
+                + _emb_rows(wpe, pos, jnp.float32)).astype(dtype)
     return (wte[ids] + wpe[pos]).astype(dtype)
 
 
@@ -153,7 +185,7 @@ def _tied_logits(x, wte, dtype):
     keeps the vocab GEMM on the MXU's fast path while the downstream
     softmax stays fp32."""
     return jax.lax.dot_general(
-        x.astype(dtype), wte.astype(dtype),
+        x.astype(dtype), _wd(wte, dtype),
         (((2,), (1,)), ((), ())), preferred_element_type=jnp.float32)
 
 
@@ -232,7 +264,7 @@ def gpt2_block(block_params, config: GPT2Config, x, rng, deterministic,
     # attention (pre-LN)
     a_in = _layer_norm(x, block_params["ln_1"], config.layer_norm_eps)
     ap = block_params["attn"]
-    qkv = a_in @ ap["qkvw"].astype(dtype) + ap["qkvb"].astype(dtype)
+    qkv = a_in @ _wd(ap["qkvw"], dtype) + _wd(ap["qkvb"], dtype)
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q = q.reshape(B, S, heads, hd).transpose(0, 2, 1, 3)
     k = k.reshape(B, S, heads, hd).transpose(0, 2, 1, 3)
@@ -253,7 +285,7 @@ def gpt2_block(block_params, config: GPT2Config, x, rng, deterministic,
     else:
         ctx = flash_attention(q, k, v, causal=True)
     ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, h)
-    attn_out = ctx @ ap["ow"].astype(dtype) + ap["ob"].astype(dtype)
+    attn_out = ctx @ _wd(ap["ow"], dtype) + _wd(ap["ob"], dtype)
     x = x + _dropout(attn_out, config.resid_dropout, r1, deterministic)
 
     # mlp
@@ -264,9 +296,9 @@ def gpt2_block(block_params, config: GPT2Config, x, rng, deterministic,
         x = x + _dropout(m_out.astype(dtype), config.resid_dropout, r2,
                          deterministic)
         return x, aux
-    hmid = m_in @ mp["fc_w"].astype(dtype) + mp["fc_b"].astype(dtype)
+    hmid = m_in @ _wd(mp["fc_w"], dtype) + _wd(mp["fc_b"], dtype)
     hmid = jax.nn.gelu(hmid, approximate=True)
-    m_out = hmid @ mp["proj_w"].astype(dtype) + mp["proj_b"].astype(dtype)
+    m_out = hmid @ _wd(mp["proj_w"], dtype) + _wd(mp["proj_b"], dtype)
     x = x + _dropout(m_out, config.resid_dropout, r2, deterministic)
     return x
 
@@ -344,28 +376,34 @@ def _gpt2_trunk_cached(params, config: GPT2Config, input_ids, kv_cache,
     attention runs the paged path (:func:`_paged_cache_attention`) —
     same block, same mask; ``paged_attn_kernel`` picks the fused Pallas
     decode kernel ("pallas") or the gather oracle ("gather") for seq-1
-    queries."""
-    kc, vc = kv_cache
+    queries. An int8-quantized pool arrives as the 4-tuple
+    ``(kc, vc, kscale, vscale)`` (scale pools
+    (layers, num_pages, heads, page_size, nb) fp32) — writes quantize
+    per token row, reads dequantize at the attention site."""
+    kc, vc = kv_cache[0], kv_cache[1]
+    kscale, vscale = (kv_cache[2], kv_cache[3]) if len(kv_cache) == 4 \
+        else (None, None)
     B, S = input_ids.shape
     pos = cache_position[:, None] + jnp.arange(S)[None, :]
-    x = (params["wte"][input_ids] + params["wpe"][pos]).astype(dtype)
-    new_kc, new_vc = [], []
+    x = (_emb_rows(params["wte"], input_ids, jnp.float32)
+         + _emb_rows(params["wpe"], pos, jnp.float32)).astype(dtype)
+    new_caches = []
     for i in range(config.num_layers):
         box = []
         if block_tables is not None:
-            attn = _paged_cache_attention(kc[i], vc[i], block_tables,
-                                          cache_position, box,
-                                          attn_kernel=paged_attn_kernel)
+            attn = _paged_cache_attention(
+                kc[i], vc[i], block_tables, cache_position, box,
+                attn_kernel=paged_attn_kernel,
+                kscale_pool=None if kscale is None else kscale[i],
+                vscale_pool=None if vscale is None else vscale[i])
         else:
             attn = _offset_cache_attention(kc[i], vc[i], cache_position,
                                            box)
         x = gpt2_block(layer_params(params, config, i), config, x, None,
                        True, dtype, attention_fn=attn)
-        ki, vi = box[0]
-        new_kc.append(ki)
-        new_vc.append(vi)
+        new_caches.append(box[0])
     x = _layer_norm(x, params["ln_f"], config.layer_norm_eps)
-    return x, (jnp.stack(new_kc), jnp.stack(new_vc))
+    return x, tuple(jnp.stack(leaf) for leaf in zip(*new_caches))
 
 
 def gpt2_forward(params, config: GPT2Config, input_ids, rng=None,
@@ -541,12 +579,15 @@ def gather_paged_kv(pool, block_table):
         B, H, P * ps, hd)
 
 
-def paged_decode_ctx(q, kpool, vpool, block_table, cache_position):
+def paged_decode_ctx(q, kpool, vpool, block_table, cache_position,
+                     k_scales=None, v_scales=None):
     """The seq-1 fused-kernel dispatch both families share: run
     :func:`deepspeed_tpu.ops.attention.paged.paged_decode_attention`
     against the (already-written) pool and restore the (B, H, 1, hd)
     context layout. One home so the kernel call contract cannot drift
-    between gpt2 and llama.
+    between gpt2 and llama. ``k_scales``/``v_scales`` select the int8
+    pool arity — the per-page scale tiles stream into the kernel and
+    dequant happens in VMEM.
 
     Under a serving mesh the engine traces its compiled programs inside
     ``parallel/pallas_shard.pallas_kernel_mesh``; consulting that
@@ -560,15 +601,19 @@ def paged_decode_ctx(q, kpool, vpool, block_table, cache_position):
     if km is not None:
         out = sharded_paged_decode(q[:, :, 0], kpool, vpool, block_table,
                                    cache_position, mesh=km.mesh,
-                                   axis=km.axis)
+                                   axis=km.axis, k_scales=k_scales,
+                                   v_scales=v_scales)
     else:
         out = paged_decode_attention(q[:, :, 0], kpool, vpool,
-                                     block_table, cache_position)
+                                     block_table, cache_position,
+                                     k_scales=k_scales,
+                                     v_scales=v_scales)
     return out[:, :, None, :]
 
 
 def _paged_cache_attention(kpool, vpool, block_table, cache_position,
-                           out_box, attn_kernel: str = "gather"):
+                           out_box, attn_kernel: str = "gather",
+                           kscale_pool=None, vscale_pool=None):
     """attention_fn for the paged cached forward (prefill-into-pages and
     paged decode alike): scatter this call's K/V into the page pool via
     the block table, then attend. Single-query calls (decode — and any
@@ -577,17 +622,49 @@ def _paged_cache_attention(kpool, vpool, block_table, cache_position,
     (:func:`paged_decode_ctx` — only live pages are read); everything
     else gathers each row's logical stripe back and attends under the
     shared ``causal_cache_mask`` (the numerics oracle / fallback).
-    Updated pools return through ``out_box``."""
+    Updated pools return through ``out_box``.
+
+    With ``kscale_pool``/``vscale_pool`` the pool is int8: this call's
+    K/V quantize per token row (``ops.attention.paged.quantize_kv``)
+    before the scatter — payload and scales land through the SAME
+    block-table scatter — and every read path dequantizes (in-kernel
+    for pallas, post-gather for the oracle). ``out_box`` then carries
+    the 4-tuple ``(kp, vp, ksp, vsp)``."""
+    quantized = kscale_pool is not None
+
     def attn(q, k, v, rate, rng):
         del rate, rng                  # cached forward is deterministic
-        kp = write_paged_kv_cache(kpool, k, block_table, cache_position)
-        vp = write_paged_kv_cache(vpool, v, block_table, cache_position)
-        out_box.append((kp, vp))
+        if quantized:
+            from deepspeed_tpu.ops.attention.paged import (dequantize_pool,
+                                                           quantize_kv)
+            nb = kscale_pool.shape[-1]
+            k_q, k_s = quantize_kv(k, nb)
+            v_q, v_s = quantize_kv(v, nb)
+            kp = write_paged_kv_cache(kpool, k_q, block_table,
+                                      cache_position)
+            vp = write_paged_kv_cache(vpool, v_q, block_table,
+                                      cache_position)
+            ksp = write_paged_kv_cache(kscale_pool, k_s, block_table,
+                                       cache_position)
+            vsp = write_paged_kv_cache(vscale_pool, v_s, block_table,
+                                       cache_position)
+            out_box.append((kp, vp, ksp, vsp))
+        else:
+            kp = write_paged_kv_cache(kpool, k, block_table,
+                                      cache_position)
+            vp = write_paged_kv_cache(vpool, v, block_table,
+                                      cache_position)
+            ksp = vsp = None
+            out_box.append((kp, vp))
         if attn_kernel == "pallas" and q.shape[2] == 1:
             return paged_decode_ctx(q, kp, vp, block_table,
-                                    cache_position)
+                                    cache_position, k_scales=ksp,
+                                    v_scales=vsp)
         kc = gather_paged_kv(kp, block_table)
         vc = gather_paged_kv(vp, block_table)
+        if quantized:
+            kc = dequantize_pool(kc, gather_paged_kv(ksp, block_table))
+            vc = dequantize_pool(vc, gather_paged_kv(vsp, block_table))
         hd = q.shape[-1]
         scores = jnp.einsum("bhqd,bhld->bhql", q.astype(jnp.float32),
                             kc.astype(jnp.float32)) / np.sqrt(hd)

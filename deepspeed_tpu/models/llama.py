@@ -167,11 +167,12 @@ def llama_block(block_params, config: LlamaConfig, x, cos, sin, dtype,
     B, S, h = x.shape
     H, hkv, hd = config.num_heads, config.kv_heads, config.head_dim
 
+    from deepspeed_tpu.models.gpt2 import _wd
     a_in = rms_norm(x, block_params["ln_1"]["w"], config.rms_norm_eps)
     ap = block_params["attn"]
-    q = (a_in @ ap["wq"].astype(dtype)).reshape(B, S, H, hd)
-    k = (a_in @ ap["wk"].astype(dtype)).reshape(B, S, hkv, hd)
-    v = (a_in @ ap["wv"].astype(dtype)).reshape(B, S, hkv, hd)
+    q = (a_in @ _wd(ap["wq"], dtype)).reshape(B, S, H, hd)
+    k = (a_in @ _wd(ap["wk"], dtype)).reshape(B, S, hkv, hd)
+    v = (a_in @ _wd(ap["wv"], dtype)).reshape(B, S, hkv, hd)
     q = apply_rope(q.transpose(0, 2, 1, 3), cos, sin)
     k = apply_rope(k.transpose(0, 2, 1, 3), cos, sin)
     v = v.transpose(0, 2, 1, 3)
@@ -180,13 +181,13 @@ def llama_block(block_params, config: LlamaConfig, x, cos, sin, dtype,
     else:
         ctx = flash_attention(q, k, v, causal=True)  # native GQA
     ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, h)
-    x = x + ctx @ ap["wo"].astype(dtype)
+    x = x + ctx @ _wd(ap["wo"], dtype)
 
     m_in = rms_norm(x, block_params["ln_2"]["w"], config.rms_norm_eps)
     mp = block_params["mlp"]
-    gate = jax.nn.silu(m_in @ mp["w_gate"].astype(dtype))
-    up = m_in @ mp["w_up"].astype(dtype)
-    return x + (gate * up) @ mp["w_down"].astype(dtype)
+    gate = jax.nn.silu(m_in @ _wd(mp["w_gate"], dtype))
+    up = m_in @ _wd(mp["w_up"], dtype)
+    return x + (gate * up) @ _wd(mp["w_down"], dtype)
 
 
 def _llama_trunk(params, config: LlamaConfig, input_ids,
@@ -195,7 +196,8 @@ def _llama_trunk(params, config: LlamaConfig, input_ids,
     assert S <= config.max_position_embeddings, (
         "sequence length exceeds max_position_embeddings — RoPE would "
         "silently extrapolate", S, config.max_position_embeddings)
-    x = params["tok_emb"][input_ids].astype(dtype)
+    from deepspeed_tpu.models.gpt2 import _emb_rows
+    x = _emb_rows(params["tok_emb"], input_ids, dtype)
     cos, sin = rope_cos_sin(S, config.head_dim, config.rope_theta)
 
     block = llama_block
@@ -240,7 +242,8 @@ def _gqa_offset_cache_attention(kcache, vcache, cache_position, out_box):
 
 
 def _gqa_paged_cache_attention(kpool, vpool, block_table, cache_position,
-                               out_box, attn_kernel: str = "gather"):
+                               out_box, attn_kernel: str = "gather",
+                               kscale_pool=None, vscale_pool=None):
     """Paged attention_fn for the cached llama forward: scatter this
     call's post-RoPE K/V into the kv_heads-sized page pool via the block
     table (``gpt2.write_paged_kv_cache``), then attend. Single-query
@@ -250,21 +253,47 @@ def _gqa_paged_cache_attention(kpool, vpool, block_table, cache_position,
     so no head replication ever materializes. Otherwise gather each
     row's logical stripe back and attend group-wise under the shared
     ``causal_cache_mask`` (the oracle/fallback). Updated pools return
-    through ``out_box``."""
+    through ``out_box``. ``kscale_pool``/``vscale_pool`` select the int8
+    pool (see ``gpt2._paged_cache_attention``): writes quantize per
+    token row, reads dequantize, ``out_box`` carries the 4-tuple."""
     from deepspeed_tpu.models.gpt2 import (causal_cache_mask,
                                            gather_paged_kv,
                                            paged_decode_ctx,
                                            write_paged_kv_cache)
+    quantized = kscale_pool is not None
 
     def attn(q, k, v):
-        kp = write_paged_kv_cache(kpool, k, block_table, cache_position)
-        vp = write_paged_kv_cache(vpool, v, block_table, cache_position)
-        out_box.append((kp, vp))
+        if quantized:
+            from deepspeed_tpu.ops.attention.paged import (dequantize_pool,
+                                                           quantize_kv)
+            nb = kscale_pool.shape[-1]
+            k_q, k_s = quantize_kv(k, nb)
+            v_q, v_s = quantize_kv(v, nb)
+            kp = write_paged_kv_cache(kpool, k_q, block_table,
+                                      cache_position)
+            vp = write_paged_kv_cache(vpool, v_q, block_table,
+                                      cache_position)
+            ksp = write_paged_kv_cache(kscale_pool, k_s, block_table,
+                                       cache_position)
+            vsp = write_paged_kv_cache(vscale_pool, v_s, block_table,
+                                       cache_position)
+            out_box.append((kp, vp, ksp, vsp))
+        else:
+            kp = write_paged_kv_cache(kpool, k, block_table,
+                                      cache_position)
+            vp = write_paged_kv_cache(vpool, v, block_table,
+                                      cache_position)
+            ksp = vsp = None
+            out_box.append((kp, vp))
         if attn_kernel == "pallas" and q.shape[2] == 1:
             return paged_decode_ctx(q, kp, vp, block_table,
-                                    cache_position)
+                                    cache_position, k_scales=ksp,
+                                    v_scales=vsp)
         kc = gather_paged_kv(kp, block_table)
         vc = gather_paged_kv(vp, block_table)
+        if quantized:
+            kc = dequantize_pool(kc, gather_paged_kv(ksp, block_table))
+            vc = dequantize_pool(vc, gather_paged_kv(vsp, block_table))
         B, H, S, hd = q.shape
         hkv = kc.shape[1]
         qg = q.reshape(B, hkv, H // hkv, S, hd)
@@ -287,11 +316,14 @@ def _llama_trunk_cached(params, config: LlamaConfig, input_ids, kv_cache,
     training. RoPE angles are gathered per row at each token's absolute
     position. Returns (hidden states after ln_f, updated kv_cache).
     ``block_tables`` switches to the paged pool pair (each
-    (layers, num_pages, kv_heads, page_size, hd));
+    (layers, num_pages, kv_heads, page_size, hd)); an int8-quantized
+    pool arrives as the 4-tuple ``(kc, vc, kscale, vscale)``;
     ``paged_attn_kernel`` picks the fused Pallas decode kernel or the
     gather oracle for seq-1 queries."""
-    from deepspeed_tpu.models.gpt2 import layer_params
-    kc, vc = kv_cache
+    from deepspeed_tpu.models.gpt2 import _emb_rows, layer_params
+    kc, vc = kv_cache[0], kv_cache[1]
+    kscale, vscale = (kv_cache[2], kv_cache[3]) if len(kv_cache) == 4 \
+        else (None, None)
     B, S = input_ids.shape
     if block_tables is not None:
         max_len = block_tables.shape[1] * kc.shape[3]  # pages x page_size
@@ -301,24 +333,24 @@ def _llama_trunk_cached(params, config: LlamaConfig, input_ids, kv_cache,
     cos_full, sin_full = rope_cos_sin(max_len, config.head_dim,
                                       config.rope_theta)
     cos_b, sin_b = cos_full[pos], sin_full[pos]        # (B, S, hd/2)
-    x = params["tok_emb"][input_ids].astype(dtype)
-    new_kc, new_vc = [], []
+    x = _emb_rows(params["tok_emb"], input_ids, dtype)
+    new_caches = []
     for i in range(config.num_layers):
         box = []
         if block_tables is not None:
             attn = _gqa_paged_cache_attention(
                 kc[i], vc[i], block_tables, cache_position, box,
-                attn_kernel=paged_attn_kernel)
+                attn_kernel=paged_attn_kernel,
+                kscale_pool=None if kscale is None else kscale[i],
+                vscale_pool=None if vscale is None else vscale[i])
         else:
             attn = _gqa_offset_cache_attention(kc[i], vc[i],
                                                cache_position, box)
         x = llama_block(layer_params(params, config, i), config, x,
                         cos_b, sin_b, dtype, attention_fn=attn)
-        ki, vi = box[0]
-        new_kc.append(ki)
-        new_vc.append(vi)
+        new_caches.append(box[0])
     x = rms_norm(x, params["ln_f"]["w"], config.rms_norm_eps)
-    return x, (jnp.stack(new_kc), jnp.stack(new_vc))
+    return x, tuple(jnp.stack(leaf) for leaf in zip(*new_caches))
 
 
 def llama_forward(params, config: LlamaConfig, input_ids,

@@ -70,7 +70,7 @@ from deepspeed_tpu.ops.attention.flash import NEG_INF
 
 __all__ = ["paged_decode_attention", "paged_decode_reference",
            "paged_decode_supported", "decode_read_bytes",
-           "live_pages"]
+           "live_pages", "dequantize_pool", "quantize_kv"]
 
 
 def live_pages(cache_position, page_size: int):
@@ -93,8 +93,8 @@ def paged_decode_supported(page_size: int, head_dim: int,
     layout constraints) — always supported. On TPU the DMA tile is
     ``(page_size, head_dim)``: Mosaic needs the lane dim 128-aligned
     (``head_dim % 128``) and the sublane dim a full tile
-    (8 fp32 / 16 bf16 rows), so small pages or narrow heads fall back
-    to the gather path.
+    (8 fp32 / 16 bf16 / 32 int8 rows), so small pages or narrow heads
+    fall back to the gather path.
     """
     if pltpu is None:
         return False, "pallas tpu backend unavailable"
@@ -108,7 +108,8 @@ def paged_decode_supported(page_size: int, head_dim: int,
     if head_dim % 128 != 0:
         return False, (f"head_dim {head_dim} not a multiple of 128 "
                        "(DMA lane dim)")
-    sublane = 16 if jnp.dtype(dtype).itemsize < 4 else 8
+    itemsize = jnp.dtype(dtype).itemsize
+    sublane = {1: 32, 2: 16}.get(itemsize, 8)
     if page_size % sublane != 0:
         return False, (f"page_size {page_size} not a multiple of the "
                        f"{sublane}-row sublane tile for "
@@ -118,7 +119,7 @@ def paged_decode_supported(page_size: int, head_dim: int,
 
 def decode_read_bytes(cache_positions: Sequence[int], page_size: int,
                       pages_per_seq: int, kv_heads: int, head_dim: int,
-                      dtype_bytes: int = 2):
+                      dtype_bytes: int = 2, scale_blocks: int = 0):
     """Modeled K+V bytes one decode step reads from the pool, paged
     kernel vs gather stripe — the ``paged_decode_bytes`` bench row's
     cost model (mfu_cost_model pattern: analytic accounting that the
@@ -129,9 +130,14 @@ def decode_read_bytes(cache_positions: Sequence[int], page_size: int,
     The gather fallback materializes the full ``pages_per_seq``-wide
     stripe per row regardless of how short the row is. Returns
     ``(pallas_bytes, gather_bytes)`` per layer for the whole batch.
+
+    For the int8 pool pass ``dtype_bytes=1`` and
+    ``scale_blocks=spec.scale_blocks``: each token row also streams its
+    per-row fp32 scales (K and V), the ``quant_serving_bytes`` KV lever.
     """
     positions = [int(p) for p in cache_positions]
     per_tok = kv_heads * head_dim * dtype_bytes * 2          # K and V
+    per_tok += kv_heads * scale_blocks * 4 * 2               # fp32 scales
     pallas = sum(live_pages(p, page_size) * page_size * per_tok
                  for p in positions)
     gather = len(positions) * pages_per_seq * page_size * per_tok
@@ -142,16 +148,52 @@ def decode_read_bytes(cache_positions: Sequence[int], page_size: int,
 # reference (oracle / fallback) — the gather path's math, kept here so
 # kernel tests can pin parity without importing a model family
 # --------------------------------------------------------------------- #
+def quantize_kv(x, scale_blocks: int = 1):
+    """Symmetric int8 absmax quantization of new K/V values per token
+    row: ``x`` (..., hd) float -> (q (..., hd) int8, scales (..., nb)
+    fp32) with ``nb = scale_blocks`` blocks along head_dim. The inverse
+    of :func:`dequantize_pool`'s math — the models' paged write path
+    quantizes each appended row with this before scattering into the
+    int8 pool (EQuARX: the bytes at rest are int8, attention math stays
+    fp32)."""
+    hd = x.shape[-1]
+    nb = max(int(scale_blocks), 1)
+    blk = hd // nb
+    xb = x.astype(jnp.float32).reshape(x.shape[:-1] + (nb, blk))
+    absmax = jnp.max(jnp.abs(xb), axis=-1)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(xb / scale[..., None]), -127, 127)
+    return (q.reshape(x.shape).astype(jnp.int8),
+            scale.astype(jnp.float32))
+
+
+def dequantize_pool(pool, scales):
+    """fp32 view of an int8 page pool: ``pool`` (..., page_size, hd)
+    int8, ``scales`` (..., page_size, nb) fp32 per-token-row absmax
+    scales with nb dividing hd. The gather/oracle-path dequant — the
+    Pallas kernel applies the same math per streamed tile in VMEM."""
+    hd = pool.shape[-1]
+    nb = scales.shape[-1]
+    s = jnp.repeat(scales, hd // nb, axis=-1)
+    return pool.astype(jnp.float32) * s
+
+
 def paged_decode_reference(q, kpool, vpool, block_tables, cache_position,
-                           sm_scale: Optional[float] = None):
+                           sm_scale: Optional[float] = None,
+                           k_scales=None, v_scales=None):
     """Dense oracle: gather each row's full logical stripe from the
     pool, mask positions past ``cache_position``, softmax in fp32 —
     exactly what the models' gather fallback computes for a seq-1
     query. q: (B, H, hd); pools: (num_pages, kv_heads, page_size, hd);
     block_tables: (B, P) int32; cache_position: (B,) int32 (position of
-    the already-written current token). Returns (B, H, hd)."""
+    the already-written current token). With ``k_scales``/``v_scales``
+    ((num_pages, kv_heads, page_size, nb) fp32) the pools are int8 and
+    dequantized before the gather. Returns (B, H, hd)."""
     if sm_scale is None:
         sm_scale = 1.0 / np.sqrt(q.shape[-1])
+    if k_scales is not None:
+        kpool = dequantize_pool(kpool, k_scales)
+        vpool = dequantize_pool(vpool, v_scales)
     B, H, hd = q.shape
     _, KH, ps, _ = kpool.shape
     kc = kpool[block_tables].transpose(0, 2, 1, 3, 4).reshape(
@@ -172,11 +214,23 @@ def paged_decode_reference(q, kpool, vpool, block_tables, cache_position,
 # --------------------------------------------------------------------- #
 # the kernel
 # --------------------------------------------------------------------- #
-def _decode_kernel(tables_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
-                   kbuf, vbuf, ksem, vsem, *, sm_scale, page_size):
+def _decode_kernel(tables_ref, pos_ref, q_ref, k_ref, v_ref, *rest,
+                   sm_scale, page_size, quantized):
     """One (sequence, kv head) program: walk the row's live pages from
     the pool via double-buffered DMA, online-softmax the GQA group's
-    queries against each streamed page tile."""
+    queries against each streamed page tile.
+
+    ``quantized`` adds two operand refs (the per-token-row fp32 scale
+    pools) and two scale scratch buffers: each walked page streams its
+    int8 K/V tile AND its (page_size, nb) scale tile, and the dequant
+    happens right after the DMA'd tile lands in VMEM — the int8 bytes
+    are what crossed HBM, the math below (scores, online softmax,
+    accumulation) stays fp32 exactly like the dense-pool path."""
+    if quantized:
+        (ks_ref, vs_ref, o_ref, kbuf, vbuf, ksbuf, vsbuf,
+         ksem, vsem, kssem, vssem) = rest
+    else:
+        o_ref, kbuf, vbuf, ksem, vsem = rest
     b = pl.program_id(0)
     kh = pl.program_id(1)
     pos = pos_ref[b]
@@ -185,6 +239,8 @@ def _decode_kernel(tables_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
     # exactly pos // page_size + 1 pages: the O(live tokens) bound
     num_pg = pos // page_size + 1
     q = q_ref[0, 0]                                   # (G, hd)
+    if quantized:
+        q = q.astype(jnp.float32)   # dequantized tiles are fp32
 
     def _start(i):
         page = tables_ref[b, i]
@@ -193,6 +249,11 @@ def _decode_kernel(tables_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
                               ksem.at[slot]).start()
         pltpu.make_async_copy(v_ref.at[page, kh], vbuf.at[slot],
                               vsem.at[slot]).start()
+        if quantized:
+            pltpu.make_async_copy(ks_ref.at[page, kh], ksbuf.at[slot],
+                                  kssem.at[slot]).start()
+            pltpu.make_async_copy(vs_ref.at[page, kh], vsbuf.at[slot],
+                                  vssem.at[slot]).start()
 
     _start(0)                                         # num_pg >= 1 always
 
@@ -210,6 +271,20 @@ def _decode_kernel(tables_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
                               vsem.at[slot]).wait()
         kt = kbuf[slot]                               # (page_size, hd)
         vt = vbuf[slot]
+        if quantized:
+            pltpu.make_async_copy(ks_ref.at[page, kh], ksbuf.at[slot],
+                                  kssem.at[slot]).wait()
+            pltpu.make_async_copy(vs_ref.at[page, kh], vsbuf.at[slot],
+                                  vssem.at[slot]).wait()
+            hd = kt.shape[-1]
+            nb = ksbuf.shape[-1]
+            blk = hd // nb
+            # per-token-row blockwise dequant of the landed tile:
+            # (ps, hd) int8 * (ps, nb) scales broadcast per block
+            kt = (kt.astype(jnp.float32).reshape(page_size, nb, blk)
+                  * ksbuf[slot][:, :, None]).reshape(page_size, hd)
+            vt = (vt.astype(jnp.float32).reshape(page_size, nb, blk)
+                  * vsbuf[slot][:, :, None]).reshape(page_size, hd)
         s = jax.lax.dot_general(
             q, kt, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale   # (G, ps)
@@ -262,35 +337,52 @@ def _compiler_params(interpret):
     return cls(dimension_semantics=("parallel", "arbitrary"))
 
 
-@functools.partial(jax.jit, static_argnames=("sm_scale", "interpret"))
-def _paged_decode_call(q, kpool, vpool, block_tables, cache_position,
-                       sm_scale, interpret):
+def _paged_decode_pallas(q, kpool, vpool, scales, block_tables,
+                         cache_position, sm_scale, interpret):
+    """Shared pallas_call builder for the dense-pool and int8-pool
+    arities; ``scales`` is None or the (k_scales, v_scales) pair."""
     B, H, hd = q.shape
     num_pages, KH, ps, _ = kpool.shape
     G = H // KH
     qg = q.reshape(B, KH, G, hd)
+    quantized = scales is not None
     kernel = functools.partial(_decode_kernel, sm_scale=sm_scale,
-                               page_size=ps)
+                               page_size=ps, quantized=quantized)
+    in_specs = [
+        pl.BlockSpec((1, 1, G, hd), lambda b, k, *_: (b, k, 0, 0)),
+        # pools stay pinned in HBM; the kernel DMAs one
+        # (page_size, hd) tile per walked page — never the stripe
+        pl.BlockSpec(memory_space=pltpu.HBM),
+        pl.BlockSpec(memory_space=pltpu.HBM),
+    ]
+    scratch = [
+        pltpu.VMEM((2, ps, hd), kpool.dtype),
+        pltpu.VMEM((2, ps, hd), vpool.dtype),
+    ]
+    operands = [block_tables, cache_position, qg, kpool, vpool]
+    if quantized:
+        nb = scales[0].shape[-1]
+        # scale pools ride in HBM too: one (page_size, nb) fp32 tile
+        # DMAs alongside each int8 page tile
+        in_specs += [pl.BlockSpec(memory_space=pltpu.HBM),
+                     pl.BlockSpec(memory_space=pltpu.HBM)]
+        scratch += [pltpu.VMEM((2, ps, nb), jnp.float32),
+                    pltpu.VMEM((2, ps, nb), jnp.float32)]
+        operands += [scales[0], scales[1]]
+    scratch += [pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,))]
+    if quantized:
+        scratch += [pltpu.SemaphoreType.DMA((2,)),
+                    pltpu.SemaphoreType.DMA((2,))]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         # tables + positions prefetch into SMEM: page ids must be
         # available to index the DMAs before the body runs
         num_scalar_prefetch=2,
         grid=(B, KH),
-        in_specs=[
-            pl.BlockSpec((1, 1, G, hd), lambda b, k, *_: (b, k, 0, 0)),
-            # pools stay pinned in HBM; the kernel DMAs one
-            # (page_size, hd) tile per walked page — never the stripe
-            pl.BlockSpec(memory_space=pltpu.HBM),
-            pl.BlockSpec(memory_space=pltpu.HBM),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, G, hd),
                                lambda b, k, *_: (b, k, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((2, ps, hd), kpool.dtype),
-            pltpu.VMEM((2, ps, hd), vpool.dtype),
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.DMA((2,)),
-        ],
+        scratch_shapes=scratch,
     )
     out = pl.pallas_call(
         kernel,
@@ -298,13 +390,30 @@ def _paged_decode_call(q, kpool, vpool, block_tables, cache_position,
         out_shape=jax.ShapeDtypeStruct((B, KH, G, hd), q.dtype),
         interpret=interpret,
         compiler_params=_compiler_params(interpret),
-    )(block_tables, cache_position, qg, kpool, vpool)
+    )(*operands)
     return out.reshape(B, H, hd)
+
+
+@functools.partial(jax.jit, static_argnames=("sm_scale", "interpret"))
+def _paged_decode_call(q, kpool, vpool, block_tables, cache_position,
+                       sm_scale, interpret):
+    return _paged_decode_pallas(q, kpool, vpool, None, block_tables,
+                                cache_position, sm_scale, interpret)
+
+
+@functools.partial(jax.jit, static_argnames=("sm_scale", "interpret"))
+def _paged_decode_call_quant(q, kpool, vpool, k_scales, v_scales,
+                             block_tables, cache_position, sm_scale,
+                             interpret):
+    return _paged_decode_pallas(q, kpool, vpool, (k_scales, v_scales),
+                                block_tables, cache_position, sm_scale,
+                                interpret)
 
 
 def paged_decode_attention(q, kpool, vpool, block_tables, cache_position,
                            sm_scale: Optional[float] = None,
-                           interpret: Optional[bool] = None):
+                           interpret: Optional[bool] = None,
+                           k_scales=None, v_scales=None):
     """Decode attention straight from the page pool — O(live tokens).
 
     q: ``(B, q_heads, head_dim)`` — ONE query token per row (the seq-1
@@ -320,6 +429,12 @@ def paged_decode_attention(q, kpool, vpool, block_tables, cache_position,
     read from HBM. Returns ``(B, q_heads, head_dim)`` in q's dtype,
     matching the gather path's math (fp32 softmax, masked identically).
 
+    ``k_scales``/``v_scales`` ((num_pages, kv_heads, page_size, nb)
+    fp32, both or neither) select the int8-pool arity: the pools are
+    int8 payload and each walked page's scale tile streams alongside,
+    dequantized in VMEM after the DMA lands (PR 17 — the decode step
+    moves ~half the bytes per live token).
+
     ``interpret=None`` auto-selects: compiled on TPU, interpret mode
     elsewhere (the tier-1 CPU parity path). Callers gate the compiled
     path on :func:`paged_decode_supported`.
@@ -333,10 +448,20 @@ def paged_decode_attention(q, kpool, vpool, block_tables, cache_position,
                                                         vpool.shape)
     assert block_tables.shape[0] == B and cache_position.shape == (B,), (
         block_tables.shape, cache_position.shape)
+    assert (k_scales is None) == (v_scales is None), \
+        "int8 pool needs BOTH k_scales and v_scales"
     if sm_scale is None:
         sm_scale = 1.0 / np.sqrt(hd)
     if interpret is None:
         interpret = not _use_pallas()
+    if k_scales is not None:
+        assert k_scales.shape[:3] == kpool.shape[:3] and \
+            hd % k_scales.shape[-1] == 0, (k_scales.shape, kpool.shape)
+        return _paged_decode_call_quant(
+            q, kpool, vpool, k_scales, v_scales,
+            block_tables.astype(jnp.int32),
+            cache_position.astype(jnp.int32), float(sm_scale),
+            bool(interpret))
     return _paged_decode_call(q, kpool, vpool,
                               block_tables.astype(jnp.int32),
                               cache_position.astype(jnp.int32),

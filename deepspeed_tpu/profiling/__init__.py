@@ -68,8 +68,9 @@ TAG_HOST_GAP = "Observability/host_gap_ms"        # per-step host gap time
 # pair is pinned by tests/unit/test_inference.py)
 from deepspeed_tpu.utils.monitor import (  # noqa: E402,F401
     TAG_SERVE_DECODE_ATTN, TAG_SERVE_FLEET_QDEPTH, TAG_SERVE_GOODPUT,
-    TAG_SERVE_HANDOFF, TAG_SERVE_KV_PAGES, TAG_SERVE_MIGRATIONS,
-    TAG_SERVE_OCCUPANCY, TAG_SERVE_PREFIX_HIT, TAG_SERVE_QUEUE_DEPTH,
+    TAG_SERVE_HANDOFF, TAG_SERVE_KV_PAGES, TAG_SERVE_KV_POOL_BPT,
+    TAG_SERVE_MIGRATIONS, TAG_SERVE_OCCUPANCY, TAG_SERVE_PREFIX_HIT,
+    TAG_SERVE_QUANT_LOGIT_ERR, TAG_SERVE_QUEUE_DEPTH,
     TAG_SERVE_QUEUE_WAIT, TAG_SERVE_REPLICA_RESTARTS,
     TAG_SERVE_SHED_RATE, TAG_SERVE_SLO, TAG_SERVE_SPEC_ACCEPT,
     TAG_SERVE_TBT, TAG_SERVE_TOKEN_LATENCY, TAG_SERVE_TOKENS_IN_FLIGHT,

@@ -653,6 +653,20 @@ def get_checkpoint_config(param_dict):
     return cfg
 
 
+def _norm_quantize_weights(v):
+    """``inference.quantize_weights``: False | "bf16" | "int8". True is
+    a back-compat alias for "bf16" (the historical wire-only behavior);
+    the normalized value is what the engine branches on."""
+    if isinstance(v, str):
+        low = v.lower()
+        if low in ("bf16", "int8"):
+            return low
+        raise DeepSpeedConfigError(
+            f"inference.quantize_weights must be false, true (alias for "
+            f"'bf16'), 'bf16', or 'int8', got {v!r}")
+    return "bf16" if v else False
+
+
 def get_inference_config(param_dict):
     """Serving-engine knobs (deepspeed_tpu/inference/; docs/inference.md).
     Bucket lists are validated up front — a malformed bucket table would
@@ -677,8 +691,9 @@ def get_inference_config(param_dict):
         "eos_token_id": sub.get(C.INF_EOS_TOKEN_ID,
                                 C.INF_EOS_TOKEN_ID_DEFAULT),
         "events_dir": sub.get(C.INF_EVENTS_DIR, C.INF_EVENTS_DIR_DEFAULT),
-        "quantize_weights": bool(sub.get(C.INF_QUANTIZE_WEIGHTS,
-                                         C.INF_QUANTIZE_WEIGHTS_DEFAULT)),
+        "quantize_weights": _norm_quantize_weights(
+            sub.get(C.INF_QUANTIZE_WEIGHTS,
+                    C.INF_QUANTIZE_WEIGHTS_DEFAULT)),
         "quantize_block": int(sub.get(C.INF_QUANTIZE_BLOCK,
                                       C.INF_QUANTIZE_BLOCK_DEFAULT)),
         "admit_lookahead": int(sub.get(C.INF_ADMIT_LOOKAHEAD,
@@ -699,6 +714,10 @@ def get_inference_config(param_dict):
         "decode_page_buckets": list(pk.get(
             C.INF_PAGED_DECODE_PAGE_BUCKETS,
             C.INF_PAGED_DECODE_PAGE_BUCKETS_DEFAULT)),
+        "kv_dtype": pk.get(C.INF_PAGED_KV_DTYPE,
+                           C.INF_PAGED_KV_DTYPE_DEFAULT),
+        "kv_quant_block": int(pk.get(C.INF_PAGED_KV_QUANT_BLOCK,
+                                     C.INF_PAGED_KV_QUANT_BLOCK_DEFAULT)),
     }
     mesh_sub = sub.get(C.INF_MESH, {}) or {}
     cfg["mesh"] = {"axes": dict(mesh_sub.get(C.INF_MESH_AXES, {}) or {})}
@@ -855,6 +874,20 @@ def get_inference_config(param_dict):
                 "inference.paged_kv.decode_page_buckets"))
         except ValueError as e:
             raise DeepSpeedConfigError(str(e))
+    if pkc["kv_dtype"] is not None:
+        pkc["kv_dtype"] = str(pkc["kv_dtype"]).lower()
+        if pkc["kv_dtype"] not in ("bf16", "int8"):
+            raise DeepSpeedConfigError(
+                f"inference.paged_kv.kv_dtype must be null (engine "
+                f"dtype), 'bf16', or 'int8', got {pkc['kv_dtype']!r}")
+    if pkc["kv_quant_block"] < 0:
+        raise DeepSpeedConfigError(
+            f"inference.paged_kv.kv_quant_block must be >= 0 (0 = one "
+            f"scale per token row), got {pkc['kv_quant_block']}")
+    if pkc["kv_quant_block"] and pkc["kv_dtype"] != "int8":
+        raise DeepSpeedConfigError(
+            "inference.paged_kv.kv_quant_block requires "
+            "kv_dtype: 'int8'")
     for where, axes in (("inference.mesh", cfg["mesh"]["axes"]),
                         ("inference.disagg.decode_mesh",
                          cfg["disagg"]["decode_mesh"]["axes"])):

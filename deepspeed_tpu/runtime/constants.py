@@ -478,7 +478,12 @@ CHECKPOINT_SUPERVISOR_BACKOFF_DEFAULT = 1.0
 #   "top_k": 0,                   # engine-global (compiled-in) filter
 #   "eos_token_id": null,         # default stop token
 #   "events_dir": "",             # serving events.jsonl ("" disables)
-#   "quantize_weights": false,    # qwZ int8 block weight distribution
+#   "quantize_weights": false,    # qwZ int8 block weight shipping:
+#                                 # false | "bf16" (wire-only, eager
+#                                 # dequant; true is an alias) | "int8"
+#                                 # (int8-RESIDENT weights — compiled
+#                                 # programs dequant per block at each
+#                                 # matmul, ~2x less weight HBM)
 #   "quantize_block": 256,        # qwZ block size
 #   "admit_lookahead": 4,         # HOL fix: queue entries scanned for a
 #                                 # head that fits (0 = strict FIFO)
@@ -495,13 +500,20 @@ CHECKPOINT_SUPERVISOR_BACKOFF_DEFAULT = 1.0
 #                                 # reads) | "gather" (stripe oracle);
 #                                 # unsupported geometries auto-fall
 #                                 # back to gather with a one-line log
-#     "decode_page_buckets": []   # table-width buckets (pages) for the
+#     "decode_page_buckets": [],  # table-width buckets (pages) for the
 #                                 # decode dispatch; [] = one program
 #                                 # at full pages_per_seq width. More
 #                                 # buckets = one decode program per
 #                                 # width at warmup; gather fallback
 #                                 # bandwidth then scales with the
 #                                 # batch's LIVE pages, not max_len
+#     "kv_dtype": null,           # pool payload dtype: null = the
+#                                 # engine dtype; "int8" = quantized
+#                                 # pool (per-token-row fp32 scales
+#                                 # ride alongside, dequant in-kernel)
+#     "kv_quant_block": 0         # int8 pool scale block over
+#                                 # head_dim; 0 = one scale per token
+#                                 # row (must divide head_dim)
 #   },
 #   "mesh": {                     # serving mesh (GSPMD NamedShardings)
 #     "axes": {}                  # e.g. {"model": 4}: tensor-parallel
@@ -590,6 +602,10 @@ INF_PAGED_ATTN_KERNEL = "attn_kernel"
 INF_PAGED_ATTN_KERNEL_DEFAULT = "pallas"   # "gather" = stripe fallback
 INF_PAGED_DECODE_PAGE_BUCKETS = "decode_page_buckets"
 INF_PAGED_DECODE_PAGE_BUCKETS_DEFAULT = ()  # () = one full-width program
+INF_PAGED_KV_DTYPE = "kv_dtype"
+INF_PAGED_KV_DTYPE_DEFAULT = None   # None = follow the engine dtype
+INF_PAGED_KV_QUANT_BLOCK = "kv_quant_block"
+INF_PAGED_KV_QUANT_BLOCK_DEFAULT = 0  # 0 = one scale per token row
 INF_MESH = "mesh"
 INF_MESH_AXES = "axes"
 INF_SPEC_DECODE = "spec_decode"

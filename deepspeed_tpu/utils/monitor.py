@@ -62,6 +62,12 @@ TAG_SERVE_WEIGHT_VERSION = "Serve/weight_version"   # committed swap
 # replicas and supervised child relaunches (inference/fleet.py)
 TAG_SERVE_MIGRATIONS = "Serve/migrations"           # live requests moved
 TAG_SERVE_REPLICA_RESTARTS = "Serve/replica_restarts"  # supervised
+# quantized-serving plane (ISSUE 17): static pool cost per token of KV
+# capacity (int8 pools land near half the bf16 figure) and the offline
+# quantized-vs-fp-oracle max logit error probe (engine.
+# record_quant_logit_err — the serving path never pays for the oracle)
+TAG_SERVE_KV_POOL_BPT = "Serve/kv_pool_bytes_per_token"
+TAG_SERVE_QUANT_LOGIT_ERR = "Serve/quant_logit_err"
 # elastic / async-checkpoint plane (ISSUE 10): snapshot-vs-write split
 # of every save, the async writer's backlog, and how many times the
 # supervisor has relaunched this run. Canonical home — profiling/
@@ -401,6 +407,8 @@ class TensorBoardMonitor:
                               shed_rate=None, fleet_queue_depth=None,
                               weight_version=None, migrations=None,
                               replica_restarts=None,
+                              kv_pool_bytes_per_token=None,
+                              quant_logit_err=None,
                               tokens: int = 0, flush: bool = True):
         """Serving telemetry (inference engine; TPU-native extension —
         the reference snapshot is training-only): time-to-first-token
@@ -474,6 +482,12 @@ class TensorBoardMonitor:
         if replica_restarts is not None:
             self.write_scalar(TAG_SERVE_REPLICA_RESTARTS,
                               replica_restarts, tokens)
+        if kv_pool_bytes_per_token is not None:
+            self.write_scalar(TAG_SERVE_KV_POOL_BPT,
+                              kv_pool_bytes_per_token, tokens)
+        if quant_logit_err is not None:
+            self.write_scalar(TAG_SERVE_QUANT_LOGIT_ERR,
+                              quant_logit_err, tokens)
         if flush:
             self.flush()
 

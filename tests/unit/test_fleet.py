@@ -626,3 +626,80 @@ class TestRegistrySync:
         # the serve-trail defer vocabulary is unchanged by the fleet
         assert isinstance(DEFER_REASONS, tuple) and DEFER_REASONS
         assert not set(SHED_REASONS) & set(DEFER_REASONS)
+
+
+class TestQuantizedSwap:
+    """ISSUE 17 satellite: a mid-run weight swap onto an int8-RESIDENT
+    replica loads the full-precision checkpoint, re-quantizes, and
+    re-places the tree with the warmup programs' exact avals — the
+    fleet's zero-recompile live-swap guarantee survives quantized
+    serving."""
+
+    def test_swap_onto_int8_resident_replicas(self, tmp_path):
+        from deepspeed_tpu.inference import FleetRouter, InferenceEngine
+        from deepspeed_tpu.runtime import checkpoint as ckptlib
+        from deepspeed_tpu.runtime.quantized_params import \
+            is_quantized_tree
+
+        cfg, p1 = tiny_gpt2()
+        from deepspeed_tpu.models.gpt2 import init_gpt2_params
+        p2 = init_gpt2_params(cfg, jax.random.PRNGKey(7))
+        ckroot = str(tmp_path)
+        _save_tag(ckptlib, ckroot, "global_step1", p1, 1)
+        _save_tag(ckptlib, ckroot, "global_step2", p2, 2)
+
+        qinf = dict(INF, quantize_weights="int8",
+                    paged_kv={"kv_dtype": "int8"})
+
+        def serve_once(params):
+            eng = InferenceEngine(cfg, params, dict(qinf),
+                                  dtype=jnp.float32)
+            eng.warmup()
+            uids = _submit_all(eng)
+            by_uid = {f.uid: f.tokens for f in eng.run()}
+            outs = [by_uid[u] for u in uids]
+            rc = eng.steady_state_recompiles
+            eng.close()
+            return outs, rc
+
+        base_q, base_rc = serve_once(p1)
+        p2_q, _ = serve_once(p2)
+        assert base_rc == 0 and base_q != p2_q
+
+        engines = []
+        for _ in range(2):
+            eng = InferenceEngine(cfg, p1, dict(qinf),
+                                  dtype=jnp.float32)
+            eng.warmup()
+            assert is_quantized_tree(eng.params)
+            engines.append(eng)
+        router = FleetRouter(engines, {"replicas": 2})
+        try:
+            uids = _submit_all(router)
+            fins = router.step()
+            while len(fins) < 4:            # some answers land pre-swap
+                fins.extend(router.step())
+            # same weights back: the swap itself must not perturb
+            # outputs, and the tree must come back int8-resident
+            swap = router.swap_weights(ckroot, tag="global_step1")
+            assert swap == {0: "global_step1", 1: "global_step1"}
+            fins.extend(router.run())
+            by_uid = {f.uid: f.tokens for f in fins}
+            assert [by_uid[u] for u in uids] == base_q
+            assert len(fins) == len(WORKLOAD)
+            for eng in engines:
+                assert is_quantized_tree(eng.params)
+                assert eng.steady_state_recompiles == 0
+
+            # push genuinely new weights: outputs become the p2
+            # quantized reference, still zero recompiles
+            uids = _submit_all(router)
+            assert router.swap_weights(ckroot) == \
+                {0: "global_step2", 1: "global_step2"}
+            by_uid = {f.uid: f.tokens for f in router.run()}
+            assert [by_uid[u] for u in uids] == p2_q
+            for eng in engines:
+                assert is_quantized_tree(eng.params)
+                assert eng.steady_state_recompiles == 0
+        finally:
+            router.close()

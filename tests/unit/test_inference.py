@@ -432,6 +432,12 @@ class TestInferenceEngine:
             obs_report.T_SPEC_ACCEPT == "Serve/spec_accept_rate"
         assert m.TAG_SERVE_HANDOFF == prof.TAG_SERVE_HANDOFF == \
             obs_report.T_HANDOFF == "Serve/handoff_ms"
+        # ISSUE 17: quantized-serving scalars
+        assert m.TAG_SERVE_KV_POOL_BPT == prof.TAG_SERVE_KV_POOL_BPT \
+            == obs_report.T_KV_POOL_BPT == "Serve/kv_pool_bytes_per_token"
+        assert m.TAG_SERVE_QUANT_LOGIT_ERR == \
+            prof.TAG_SERVE_QUANT_LOGIT_ERR == \
+            obs_report.T_QUANT_LOGIT_ERR == "Serve/quant_logit_err"
 
     def test_rejects_unservable_config(self):
         from deepspeed_tpu.inference import InferenceEngine
@@ -1022,7 +1028,8 @@ class TestPagedConfigSection:
         assert cfg["paged_kv"] == {"enabled": True, "page_size": 16,
                                    "num_pages": 0, "prefix_cache": True,
                                    "attn_kernel": "pallas",
-                                   "decode_page_buckets": []}
+                                   "decode_page_buckets": [],
+                                   "kv_dtype": None, "kv_quant_block": 0}
         assert cfg["mesh"] == {"axes": {}}
         assert cfg["admit_lookahead"] == 4
 
@@ -1054,3 +1061,185 @@ class TestPagedConfigSection:
         # max_batch_size 3, max_len 32, page_size 16 -> 3*2 + null
         assert engine.paged_spec.num_pages == 7
         assert engine.paged_spec.pages_per_seq == 2
+
+
+# --------------------------------------------------------------------- #
+# quantized serving (ISSUE 17)
+# --------------------------------------------------------------------- #
+class TestQuantizedServing:
+    """int8-resident weights + int8 KV page pool: the serving bytes
+    halve on both levers while greedy decode stays within the pinned
+    error budget — and the zero-recompile/continuous-batching pins
+    hold with quantization on."""
+
+    # max |logits_fp - logits_quant| budget at the tiny geometry: the
+    # measured error is ~0.02; 0.05 leaves slack without ever letting a
+    # real regression (e.g. a dropped scale) through
+    LOGIT_BUDGET = 0.05
+
+    @pytest.mark.parametrize("family", ["gpt2", "llama"])
+    @pytest.mark.parametrize("mode", ["weights", "kv", "both"])
+    def test_quant_matrix_greedy_and_zero_recompiles(self, family,
+                                                     mode):
+        """The quantized-serving matrix: each quantization lever (and
+        both together) serves the mixed-length prefix-sharing workload
+        under continuous batching with greedy outputs matching the fp
+        engine (the quantization error at this scale sits far below
+        the logit gaps — the budget itself is pinned by the logit-err
+        probe test) and zero steady-state recompiles."""
+        from deepspeed_tpu.inference import InferenceEngine
+        from deepspeed_tpu.runtime.quantized_params import \
+            is_quantized_tree
+        cfg, params = tiny_gpt2() if family == "gpt2" else tiny_llama()
+        rng = np.random.RandomState(11)
+        # 2 full pages of shared system prompt + staggered readers (the
+        # admission batches split 2+1, so the later reader reuses the
+        # registered prefix pages)
+        sys_prompt = rng.randint(1, 61, (8,)).tolist()
+        prompts = [rng.randint(1, 61, (n,)).tolist()
+                   for n in (3, 6, 2, 7)]
+        prompts += [sys_prompt + [10], sys_prompt + [20, 21],
+                    sys_prompt[:]]
+        base_inf = dict(TINY_INF, prompt_buckets=[4, 16])
+
+        extra = {}
+        if mode in ("weights", "both"):
+            extra["quantize_weights"] = "int8"
+        pk = {"page_size": 4, "num_pages": 20}
+        if mode in ("kv", "both"):
+            pk["kv_dtype"] = "int8"
+            if mode == "both":
+                pk["kv_quant_block"] = 4
+        ref_eng = InferenceEngine(
+            cfg, params, dict(base_inf, paged_kv=dict(
+                page_size=4, num_pages=20)), dtype=jnp.float32)
+        ref = ref_eng.generate(prompts, max_new_tokens=4,
+                               temperature=0.0)
+        q_eng = InferenceEngine(
+            cfg, params, dict(base_inf, paged_kv=pk, **extra),
+            dtype=jnp.float32)
+        q_eng.warmup()
+        got = q_eng.generate(prompts, max_new_tokens=4,
+                             temperature=0.0)
+        assert got == ref
+        assert q_eng.steady_state_recompiles == 0
+        assert is_quantized_tree(q_eng.params) == \
+            (mode in ("weights", "both"))
+        assert len(q_eng._cache) == (4 if mode in ("kv", "both")
+                                     else 2)
+        dq = q_eng.debug_state()["quantization"]
+        assert dq["weights_resident"] == (
+            "int8" if mode in ("weights", "both") else "off")
+        assert dq["kv_dtype"] == ("int8" if mode in ("kv", "both")
+                                  else "float32")
+        if mode in ("weights", "both"):
+            assert dq["weight_bytes"] < dq["weight_bytes_dense"]
+        # prefix reuse really happened under quantization
+        assert q_eng.scheduler.allocator.prefix_hit_tokens >= 4
+
+    @pytest.mark.parametrize("family", ["gpt2", "llama"])
+    def test_quant_logit_err_budget_and_probe(self, family, tmp_path):
+        """The pinned error budget (NOT bitwise): max logit delta of
+        the int8-resident forward vs the fp forward stays under
+        LOGIT_BUDGET, and recording it on the engine lands the
+        Serve/quant_logit_err scalar + debug_state field + obs_report
+        quantization block."""
+        from deepspeed_tpu.inference import InferenceEngine
+        from deepspeed_tpu.runtime.quantized_params import \
+            quantize_param_tree
+        if family == "gpt2":
+            from deepspeed_tpu.models.gpt2 import gpt2_forward as fwd
+            cfg, params = tiny_gpt2()
+        else:
+            from deepspeed_tpu.models.llama import llama_forward as fwd
+            cfg, params = tiny_llama()
+        rng = np.random.RandomState(12)
+        ids = jnp.asarray(rng.randint(1, 61, (2, 8)), jnp.int32)
+        logits_fp = fwd(params, cfg, ids, dtype=jnp.float32)
+        logits_q = fwd(quantize_param_tree(params), cfg, ids,
+                       dtype=jnp.float32)
+        err = float(jnp.max(jnp.abs(logits_fp - logits_q)))
+        assert 0.0 < err < self.LOGIT_BUDGET
+
+        icfg = dict(TINY_INF, events_dir=str(tmp_path),
+                    quantize_weights="int8",
+                    paged_kv={"page_size": 4, "num_pages": 20,
+                              "kv_dtype": "int8"})
+        eng = InferenceEngine(cfg, params, icfg, dtype=jnp.float32)
+        eng.record_quant_logit_err(err)
+        eng.generate([[1, 2, 3], [4, 5]], max_new_tokens=3)
+        state = eng.debug_state()
+        assert state["quantization"]["quant_logit_err"] == err
+        assert state["quantization"]["kv_pool_bytes_per_token"] > 0
+        eng.close()
+        rows = [json.loads(line)
+                for line in open(tmp_path / "events.jsonl")]
+        tags = {r["tag"] for r in rows if "tag" in r}
+        assert {"Serve/quant_logit_err",
+                "Serve/kv_pool_bytes_per_token"} <= tags
+        obs_report = _load_tool("obs_report")
+        s = obs_report.summarize(str(tmp_path))
+        qz = s["serving"]["quantization"]
+        assert qz["quant_logit_err"] == pytest.approx(err)
+        assert qz["kv_pool_bytes_per_token"] > 0
+
+    def test_all_levers_plus_spec_decode_zero_recompiles(self):
+        """ISSUE 17 acceptance: quant-weights + quant-KV + spec-decode
+        all ON — greedy outputs bitwise match the same quantized
+        engine without speculation, steady_state_recompiles == 0, and
+        every submitted request finishes exactly once."""
+        from deepspeed_tpu.inference import InferenceEngine
+        cfg, params = tiny_gpt2()
+        quant = {"quantize_weights": "int8",
+                 "paged_kv": {"page_size": 4, "num_pages": 20,
+                              "kv_dtype": "int8"}}
+        # repetitive prompts so the n-gram drafter actually proposes
+        prompts = [[1, 2, 3, 1, 2, 3, 1, 2], [4, 5, 4, 5, 4, 5],
+                   [7, 8, 9, 7, 8, 9, 7]]
+        base = InferenceEngine(cfg, params, dict(TINY_INF, **quant),
+                               dtype=jnp.float32)
+        base.warmup()
+        ref = base.generate(prompts, max_new_tokens=8,
+                            temperature=0.0)
+        spec = InferenceEngine(
+            cfg, params,
+            dict(TINY_INF, spec_decode={"enabled": True, "k": 4},
+                 **quant), dtype=jnp.float32)
+        spec.warmup()
+        got = spec.generate(prompts, max_new_tokens=8, temperature=0.0)
+        assert got == ref
+        assert spec.steady_state_recompiles == 0
+        assert base.steady_state_recompiles == 0
+        st = spec.debug_state()
+        assert st["quantization"]["weights_resident"] == "int8"
+        assert st["quantization"]["kv_dtype"] == "int8"
+
+    def test_quant_config_normalization_and_validation(self):
+        from deepspeed_tpu.runtime.config import (DeepSpeedConfigError,
+                                                  get_inference_config)
+        c = get_inference_config({})
+        assert c["quantize_weights"] is False
+        assert c["paged_kv"]["kv_dtype"] is None
+        assert c["paged_kv"]["kv_quant_block"] == 0
+        # legacy boolean means wire-quantize, dequantize to bf16
+        c = get_inference_config(
+            {"inference": {"quantize_weights": True}})
+        assert c["quantize_weights"] == "bf16"
+        c = get_inference_config(
+            {"inference": {"quantize_weights": "int8",
+                           "paged_kv": {"kv_dtype": "int8",
+                                        "kv_quant_block": 8}}})
+        assert c["quantize_weights"] == "int8"
+        assert c["paged_kv"]["kv_dtype"] == "int8"
+        assert c["paged_kv"]["kv_quant_block"] == 8
+        with pytest.raises(DeepSpeedConfigError,
+                           match="quantize_weights"):
+            get_inference_config(
+                {"inference": {"quantize_weights": "fp8"}})
+        with pytest.raises(DeepSpeedConfigError, match="kv_dtype"):
+            get_inference_config(
+                {"inference": {"paged_kv": {"kv_dtype": "fp4"}}})
+        with pytest.raises(DeepSpeedConfigError,
+                           match="kv_quant_block"):
+            get_inference_config(
+                {"inference": {"paged_kv": {"kv_quant_block": 4}}})

@@ -109,6 +109,10 @@ T_WEIGHT_VERSION = "Serve/weight_version"
 # per-replica process health and per-move details
 T_MIGRATIONS = "Serve/migrations"
 T_REPLICA_RESTARTS = "Serve/replica_restarts"
+# quantized-serving plane (ISSUE 17): static KV pool bytes per token
+# of capacity, and the offline quantized-vs-fp max-logit-error probe
+T_KV_POOL_BPT = "Serve/kv_pool_bytes_per_token"
+T_QUANT_LOGIT_ERR = "Serve/quant_logit_err"
 # elastic / async-checkpoint plane (utils/monitor.py
 # write_elastic_metrics): snapshot-vs-write decomposition of each save,
 # async writer backlog, supervisor restart count; the `preemption` /
@@ -326,6 +330,25 @@ def summarize(path, host_gap_threshold=DEFAULT_HOST_GAP_THRESHOLD):
             (str(attn_event.get("path")) if attn_event else None)),
         "decode_attn_reason": (str(attn_event.get("reason"))
                                if attn_event else None),
+    }
+    # quantized-serving view (ISSUE 17; absent on fp runs -> None).
+    # The pool byte rate is a static gauge; logit error comes from the
+    # offline engine.record_quant_logit_err probe, and the serve_state
+    # "quantization" block carries the resident-format detail.
+    kv_bpt = _vals(scalars, T_KV_POOL_BPT)
+    qerr = _vals(scalars, T_QUANT_LOGIT_ERR)
+    state_quant = (serve_state or {}).get("quantization") or {}
+    serving["quantization"] = {
+        "weights_resident": state_quant.get("weights_resident"),
+        "kv_dtype": state_quant.get("kv_dtype"),
+        "kv_quant_block": state_quant.get("kv_quant_block"),
+        "kv_pool_bytes_per_token": (kv_bpt[-1] if kv_bpt else
+                                    state_quant.get(
+                                        "kv_pool_bytes_per_token")),
+        "quant_logit_err": (max(qerr) if qerr else
+                            state_quant.get("quant_logit_err")),
+        "weight_bytes": state_quant.get("weight_bytes"),
+        "weight_bytes_dense": state_quant.get("weight_bytes_dense"),
     }
     # disagg + speculation view (ISSUE 13; absent -> counts 0, keys
     # None). Accept-rate percentiles come from the per-verify-dispatch
@@ -1036,6 +1059,15 @@ DIFF_METRICS = (
     ("goodput_tokens_per_s",
      lambda s: ((s.get("serving") or {}).get("slo")
                 or {}).get("goodput_tokens_per_s"), "higher", 0.10),
+    # quantized-serving error budget (ISSUE 17): the offline
+    # quantized-vs-fp max-logit-error probe must not drift up across
+    # runs, and the static pool cost per token must never grow
+    ("quant_logit_err",
+     lambda s: ((s.get("serving") or {}).get("quantization")
+                or {}).get("quant_logit_err"), "lower", 0.10),
+    ("kv_pool_bytes_per_token",
+     lambda s: ((s.get("serving") or {}).get("quantization")
+                or {}).get("kv_pool_bytes_per_token"), "counter", 0.0),
     ("recompiles", lambda s: s["recompiles"]["count"], "counter", 0.0),
     ("health_alerts",
      lambda s: (s.get("health") or {}).get("alerts", 0), "counter",

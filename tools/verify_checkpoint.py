@@ -163,7 +163,11 @@ def main(argv=None):
                     help="exit nonzero unless every verified tag also "
                          "carries a model_states group — the fleet "
                          "swap-weights preflight (engine.swap_params / "
-                         "FleetRouter.swap_weights load params-only)")
+                         "FleetRouter.swap_weights load params-only). "
+                         "Checkpoints always hold full-precision "
+                         "weights; an int8-resident replica "
+                         "re-quantizes them on swap, so the same "
+                         "preflight covers quantized engines")
     args = ap.parse_args(argv)
     check_crc = not args.no_crc
 
