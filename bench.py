@@ -196,6 +196,7 @@ METRICS = [
     "disagg_dispatch_structure",
     "fleet_drain_goodput",
     "fleet_migration_goodput",
+    "fleet_trace_overhead",
     "quant_serving_bytes",
     "quant_kv_occupancy",
     "paged_decode_tokens_per_s",
@@ -219,8 +220,8 @@ HW_FREE = {"comm_wire_bytes_per_step", "comm_overlap_structure",
            "async_ckpt_stall_ms",
            "spec_decode_accepted_per_dispatch",
            "disagg_dispatch_structure", "fleet_drain_goodput",
-           "fleet_migration_goodput", "quant_serving_bytes",
-           "quant_kv_occupancy"}
+           "fleet_migration_goodput", "fleet_trace_overhead",
+           "quant_serving_bytes", "quant_kv_occupancy"}
 
 PARTIAL_PATH = os.environ.get(
     "BENCH_PARTIAL", "/tmp/dstpu_bench_partial.jsonl")
@@ -2356,6 +2357,142 @@ def bench_fleet_migration_goodput(on_tpu, rtt):
                    "survivors vs undisturbed (hardware-free)"})
 
 
+def bench_fleet_trace_overhead(on_tpu, rtt):
+    """Hardware-free row: the cross-process tracing plane (ISSUE 18)
+    must be free at the dispatch level. The same mixed greedy/seeded
+    workload runs over two 2-replica PROCESS fleets — tracing fully
+    OFF (serve tracer disabled in every child, no router event log)
+    vs fully ON (router trace-id stamping + ``fleet_dispatch`` rows,
+    per-child serve trails into per-replica ``events.jsonl``,
+    ``clock_sync`` ping rows). The children report their
+    CompileTracker dispatch counts through the RPC state piggyback,
+    so the pin crosses the process boundary: per-run dispatch counts
+    IDENTICAL (``dispatch_delta == 0`` — tracing is host-side pure
+    Python on both sides of the wire), steady-state recompiles 0 on
+    every replica, outputs bitwise equal between the two fleets.
+    value = wall overhead percent of the traced fleet (min-of-3
+    interleaved runs); acceptance <= 5%.
+    """
+    del on_tpu, rtt
+    import shutil
+    import tempfile
+
+    from deepspeed_tpu.inference import Request
+    from deepspeed_tpu.inference.fleet import (FleetRouter,
+                                               launch_replica_processes)
+    from deepspeed_tpu.utils.monitor import _JsonlWriter
+
+    mcfg = {"vocab_size": 61, "max_position_embeddings": 64,
+            "hidden_size": 32, "num_layers": 2, "num_heads": 4,
+            "embd_dropout": 0.0, "attn_dropout": 0.0,
+            "resid_dropout": 0.0}
+    new_tokens = 8
+    icfg = {"max_batch_size": 2, "prompt_buckets": [8, 16],
+            "batch_buckets": [1, 2], "max_seq_len": 48,
+            "max_new_tokens": new_tokens}
+    tmp = tempfile.mkdtemp(prefix="dstpu_fleet_trace_")
+    env = {"JAX_PLATFORMS": "cpu", "JAX_THREEFRY_PARTITIONABLE": "1"}
+    spec = {"family": "gpt2", "model_config": mcfg, "init_seed": 3,
+            "dtype": "float32", "inference": icfg}
+
+    def build(traced):
+        tag = "on" if traced else "off"
+        spec_by = {}
+        for i in range(2):
+            if traced:
+                spec_by[i] = {
+                    "inference": dict(icfg, events_dir=os.path.join(
+                        tmp, f"{tag}_r{i}")),
+                    "observability": {"enabled": True,
+                                      "serve": {"enabled": True}}}
+            else:
+                spec_by[i] = {"observability": {
+                    "enabled": True, "serve": {"enabled": False}}}
+        reps = launch_replica_processes(
+            spec, 2, env_by_replica={i: dict(env) for i in range(2)},
+            spec_by_replica=spec_by)
+        writer = _JsonlWriter(os.path.join(tmp, f"{tag}_router")) \
+            if traced else None
+        router = FleetRouter(
+            reps, {"process_mode": {"enabled": True}}, writer=writer)
+        return router, reps, writer
+
+    def requests(round_no):
+        return [Request(prompt=[1 + u % 7, 2, 3, 4, (5 + u) % 61],
+                        max_new_tokens=new_tokens,
+                        temperature=0.0 if u % 2 == 0 else 0.7,
+                        seed=100 + u, uid=round_no * 100 + u)
+                for u in range(6)]
+
+    def one_run(router, round_no):
+        t0 = time.perf_counter()
+        for r in requests(round_no):
+            router.submit(r)
+        fins = router.run()
+        # uid mod 100 folds the per-round uid namespace back so runs
+        # compare like-for-like
+        return (time.perf_counter() - t0,
+                {f.uid % 100: tuple(f.tokens) for f in fins})
+
+    router_off, reps_off, _w_off = build(False)
+    _beat()
+    router_on, reps_on, w_on = build(True)
+    _beat()
+    # warm round (not timed) — also primes the dispatch-count baseline
+    # via the state piggyback on each RPC reply
+    one_run(router_off, 0)
+    one_run(router_on, 0)
+    disp0_off = sum(r.total_dispatches or 0 for r in reps_off)
+    disp0_on = sum(r.total_dispatches or 0 for r in reps_on)
+    walls_off, walls_on = [], []
+    parity = True
+    tokens = 0
+    for k in range(1, 4):
+        w, o_off = one_run(router_off, k)
+        walls_off.append(w)
+        w, o_on = one_run(router_on, k)
+        walls_on.append(w)
+        parity = parity and (o_on == o_off)
+        tokens = sum(len(t) for t in o_off.values())
+        _beat()
+    disp_off = sum(r.total_dispatches or 0
+                   for r in reps_off) - disp0_off
+    disp_on = sum(r.total_dispatches or 0 for r in reps_on) - disp0_on
+    rc = [r.steady_state_recompiles for r in reps_off + reps_on]
+    overhead_pct = (min(walls_on) - min(walls_off)) \
+        / min(walls_off) * 100
+    router_off.close()
+    router_on.close()
+    if w_on is not None:
+        w_on.close()
+    trail_rows = 0
+    for i in range(2):
+        p = os.path.join(tmp, f"on_r{i}", "events.jsonl")
+        if os.path.exists(p):
+            trail_rows += sum(1 for _ in open(p))
+    row = _emit(
+        "fleet_trace_overhead", round(overhead_pct, 2),
+        "pct_wall_overhead",
+        round(min(walls_off) / min(walls_on), 3)
+        if min(walls_on) > 0 else 0.0,
+        {"accept_overhead_pct": 5.0,
+         "wall_off_s": round(min(walls_off), 4),
+         "wall_on_s": round(min(walls_on), 4),
+         "tokens_per_run": tokens,
+         "dispatches_off": disp_off, "dispatches_on": disp_on,
+         "dispatch_delta": disp_on - disp_off,
+         "steady_state_recompiles": rc,
+         "greedy_parity": parity,
+         "replica_trail_rows": trail_rows,
+         "requests_per_run": 6, "new_tokens": new_tokens,
+         "replicas_per_fleet": 2,
+         "source": "two 2-replica process fleets, interleaved "
+                   "min-of-3 wall + RPC-piggybacked CompileTracker "
+                   "dispatch accounting (hardware-free)"})
+    shutil.rmtree(tmp, ignore_errors=True)
+    return row
+
+
 def bench_quant_serving_bytes(on_tpu, rtt):
     """Hardware-free row: serving-HBM payoff of int8 quantization on
     BOTH byte levers (ISSUE 17), priced against bf16 serving at the
@@ -2727,6 +2864,8 @@ def run_child(metric):
         bench_fleet_drain_goodput(on_tpu, rtt)
     elif metric == "fleet_migration_goodput":
         bench_fleet_migration_goodput(on_tpu, rtt)
+    elif metric == "fleet_trace_overhead":
+        bench_fleet_trace_overhead(on_tpu, rtt)
     elif metric == "quant_serving_bytes":
         bench_quant_serving_bytes(on_tpu, rtt)
     elif metric == "quant_kv_occupancy":

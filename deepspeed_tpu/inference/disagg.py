@@ -191,6 +191,14 @@ class MigrationRecord:
     draft_proposed: int = 0
     draft_accepted: int = 0
     weight_version: Optional[str] = None
+    # distributed-trace context (ISSUE 18): the router-stamped trace id
+    # and the hop ordinal AT EXPORT TIME ride the record so the
+    # destination's ``serve_migrate_in`` row (hop + 1) links to the
+    # source's ``serve_migrate_out`` — request lineage survives replica
+    # death. Durations-not-absolute-times doctrine unchanged: trace ids
+    # are opaque strings, alignment stays in ``clock_sync`` rows.
+    trace_id: Optional[str] = None
+    hop: int = 0
     kslab: Optional[object] = None    # numpy (layers, live, kvh, ps, hd)
     vslab: Optional[object] = None
     kscale_slab: Optional[object] = None  # fp32 (layers, live, kvh, ps, nb)
@@ -213,6 +221,7 @@ class MigrationRecord:
             "draft_proposed": self.draft_proposed,
             "draft_accepted": self.draft_accepted,
             "weight_version": self.weight_version,
+            "trace_id": self.trace_id, "hop": self.hop,
         }
 
     @property

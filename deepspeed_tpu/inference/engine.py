@@ -967,8 +967,15 @@ class InferenceEngine:
                 draft_proposed=slot.draft_proposed,
                 draft_accepted=slot.draft_accepted,
                 weight_version=self._weight_version,
+                trace_id=getattr(req, "trace_id", None),
+                hop=getattr(req, "hop", 0),
                 kslab=kslab, vslab=vslab,
                 kscale_slab=kscale_slab, vscale_slab=vscale_slab)
+            # lineage row BEFORE the eviction below pops the trace —
+            # the destination's serve_migrate_in shares the trace id
+            self._tracer.on_migrate_out(uid, position=rec.position,
+                                        pages=rec.live_pages,
+                                        nbytes=rec.nbytes)
             sched.evict(uid, reason="migrate")
             return rec
         return None
@@ -1033,7 +1040,9 @@ class InferenceEngine:
                       max_new_tokens=rec.max_new_tokens,
                       temperature=rec.temperature, seed=rec.seed,
                       eos_id=rec.eos_id, priority=rec.priority,
-                      uid=rec.uid)
+                      uid=rec.uid,
+                      trace_id=getattr(rec, "trace_id", None),
+                      hop=int(getattr(rec, "hop", 0)) + 1)
         sid = sched.install_slot(
             req, position=rec.position, pending_tok=rec.pending_tok,
             tokens=rec.tokens, pages=pages, ttft_ms=rec.ttft_ms,
@@ -1043,6 +1052,15 @@ class InferenceEngine:
         if sid is None:
             sched.allocator.free(pages)
             return None
+        # destination half of the lineage pair: resumes the ORIGINAL
+        # trace id (hop bumped), so later decode-window/finish rows on
+        # this replica stitch to the source's serve_migrate_out
+        self._tracer.on_migrate_in(
+            rec.uid, trace_id=req.trace_id, hop=req.hop,
+            position=rec.position, pages=rec.live_pages,
+            nbytes=rec.nbytes, queue_wait_ms=rec.queue_wait_ms,
+            ttft_ms=rec.ttft_ms, elapsed_ms=rec.elapsed_ms,
+            tokens=len(rec.tokens))
         if self._log is not None:
             self._log.add_event("serve_resume", uid=rec.uid, slot=sid,
                                 position=rec.position,

@@ -76,6 +76,7 @@ engines purely through their host-side surface, so routing policy is
 unit-testable in microseconds and cannot perturb any compiled program.
 """
 
+import itertools
 import json
 import os
 import subprocess
@@ -278,6 +279,7 @@ class ReplicaProcess:
         self.weight_version: Optional[str] = "initial"
         self.weight_ordinal = 0
         self.steady_state_recompiles = -1
+        self.total_dispatches: Optional[int] = None
         self._can_migrate = False
         self._proc: Optional[subprocess.Popen] = None
         self._client: Optional[rpc.RpcClient] = None
@@ -358,6 +360,7 @@ class ReplicaProcess:
         self.weight_version = "initial"
         self.weight_ordinal = 0
         self.steady_state_recompiles = -1
+        self.total_dispatches = None
         self._can_migrate = False
         self.start()
         self.wait_ready()
@@ -415,6 +418,8 @@ class ReplicaProcess:
                                         self.weight_ordinal)
         self.steady_state_recompiles = state.get(
             "steady_state_recompiles", self.steady_state_recompiles)
+        if state.get("dispatches") is not None:
+            self.total_dispatches = int(state["dispatches"])
         self._can_migrate = bool(state.get("can_migrate", False))
 
     def _call(self, method: str, params: Optional[Dict] = None,
@@ -512,6 +517,29 @@ class ReplicaProcess:
             return False
         return bool(res.get("changed"))
 
+    def clock_ping(self, samples: int = 3) -> Dict[str, float]:
+        """Estimate the child's wall-clock offset against this process
+        (midpoint method): the child replies with its ``time.time()``;
+        we bracket the call with our own ``t0``/``t1`` and take
+        ``offset = t_child - (t0 + t1) / 2``, true to within
+        ``uncertainty = (t1 - t0) / 2`` (the reply can have landed
+        anywhere inside the round trip). Of ``samples`` exchanges the
+        minimum-RTT one wins — it carries the tightest bound. The
+        router records the result as a ``clock_sync`` event row so
+        offline log merging (``obs_report --fleet``) can align replica
+        timelines without trusting any single wall clock."""
+        best: Optional[Tuple[float, float]] = None
+        for _ in range(max(1, int(samples))):
+            t0 = time.time()
+            res, _ = self._call("clock_ping", {})
+            t1 = time.time()
+            rtt = t1 - t0
+            offset = float(res["t_child"]) - (t0 + t1) / 2.0
+            if best is None or rtt < best[1]:
+                best = (offset, rtt)
+        return {"offset_s": best[0], "uncertainty_s": best[1] / 2.0,
+                "rtt_s": best[1]}
+
     @property
     def can_migrate(self) -> bool:
         return self._can_migrate and not self._dead
@@ -544,6 +572,15 @@ def launch_replica_processes(spec: Dict[str, Any], count: int, *,
     reps = []
     for i in range(count):
         merged = {**spec, **(spec_by_replica or {}).get(i, {})}
+        # stamp the fleet identity into the child's serve-tracer config
+        # (unless the caller already picked one): every event row the
+        # child writes carries ``replica_id``, so the offline fleet
+        # merger attributes rows without trusting directory names
+        obs = dict(merged.get("observability") or {})
+        srv = dict(obs.get("serve") or {})
+        srv.setdefault("replica_id", i)
+        obs["serve"] = srv
+        merged["observability"] = obs
         reps.append(ReplicaProcess(
             merged, name=f"r{i}",
             rpc_timeout_s=pm["rpc_timeout_s"],
@@ -591,13 +628,17 @@ class FleetRouter:
 
     #: fleet_state event / scalar cadence (router steps)
     _STATE_EVERY = 16
+    #: periodic clock re-sync cadence (router steps) — cheap (one
+    #: tiny RPC per replica) but offsets drift slowly, so sparse
+    _CLOCK_SYNC_EVERY = 256
 
     def __init__(self, engines: Sequence[Any], fleet_config=None,
                  monitor=None, writer=None,
                  install_signal_handlers: bool = False,
                  clock=time.perf_counter,
                  replica_factory: Optional[Callable[[int], Any]] = None,
-                 sleep: Callable[[float], None] = time.sleep):
+                 sleep: Callable[[float], None] = time.sleep,
+                 health=None):
         if not engines:
             raise ValueError("FleetRouter needs at least one engine")
         self.cfg = _normalize_fleet_config(fleet_config)
@@ -625,8 +666,17 @@ class FleetRouter:
         fault.arm_from_env()
         # health plane: the router beats the FIRST replica's watchdog
         # once per scheduling round (duck-typed like monitor/_log — a
-        # fleet of stubs without one simply has no fleet heartbeat)
-        self.health = getattr(engines[0], "health", None)
+        # fleet of stubs without one simply has no fleet heartbeat).
+        # Process replicas have no in-process .health, so a process-
+        # mode router passes its OWN HealthPlane via the kwarg — the
+        # rpc_call beats then name which replica a hung wait was on.
+        self.health = health if health is not None else \
+            getattr(engines[0], "health", None)
+        # distributed tracing: the router mints every trace id (one
+        # per client request, monotonic — no RNG, no wall clock in the
+        # id itself, so traced runs stay bitwise-reproducible)
+        self._trace_seq = itertools.count()
+        self._trace_prefix = f"f{os.getpid():x}"
         self._steps = 0
         self._pending: List[FinishedRequest] = []
         # ladder + ledger
@@ -660,11 +710,53 @@ class FleetRouter:
             f"routing={self.cfg['routing']}, slo_shed="
             f"{'on' if sh['enabled'] else 'off'} "
             f"(p95 TTFT budget {self._budget_ms:.0f} ms)")
+        # initial clock alignment (process replicas only — in-process
+        # engines share our clock, offset is definitionally zero)
+        self._sync_clocks()
 
     # ---------------------------------------------------------- events
     def _event(self, kind: str, **fields) -> None:
         if self._log is not None:
             self._log.add_event(kind, **fields)
+
+    def _beat_rpc(self, r: "ReplicaHandle") -> None:
+        """Heartbeat the ``rpc_call`` phase before a blocking wait on a
+        process replica, naming WHICH replica — a watchdog trip during
+        a hung RPC then reads ``rpc_call (replica 2)``, not a generic
+        fleet stall. In-process engines don't block on a wire, so the
+        beat is skipped (phase attribution stays precise)."""
+        if self.health is not None and \
+                hasattr(r.engine, "poll_exit"):
+            self.health.heartbeat("rpc_call",
+                                  detail=f"replica {r.idx}")
+
+    # ------------------------------------------------- clock alignment
+    def _sync_clocks(self) -> None:
+        """Estimate every process replica's wall-clock offset (midpoint
+        method: ``offset = t_child - (t0 + t1)/2``, uncertainty =
+        half the best RTT) and record a ``clock_sync`` trail row per
+        replica. The offline fleet merger (obs_report --fleet) uses the
+        latest row per replica to place that replica's event rows on
+        the router's timeline; the uncertainty bounds how much apparent
+        reordering is attributable to clock skew vs. a real anomaly."""
+        for r in self.replicas:
+            if r.status != LIVE:
+                continue
+            ping = getattr(r.engine, "clock_ping", None)
+            if ping is None:
+                continue
+            self._beat_rpc(r)
+            try:
+                est = ping()
+            except (RpcError, OSError, ReplicaDeadError) as e:
+                logger.warning(f"fleet clock sync: replica {r.idx} "
+                               f"ping failed ({e!r}); skipping")
+                continue
+            self._event("clock_sync", replica=r.idx,
+                        offset_ms=round(est["offset_s"] * 1e3, 4),
+                        uncertainty_ms=round(
+                            est["uncertainty_s"] * 1e3, 4),
+                        rtt_ms=round(est["rtt_s"] * 1e3, 4))
 
     # ------------------------------------------------------ shed ladder
     def _ttft_stats(self):
@@ -749,9 +841,11 @@ class FleetRouter:
         """Hand ``req`` to the best live replica; a transient
         ``serve.dispatch`` fault reroutes to the next-best instead of
         dropping. None = no replica accepted (caller sheds)."""
+        t0 = self._clock()
         for r in self._ranked(req):
             try:
                 fault.fire("serve.dispatch", replica=r.idx, uid=req.uid)
+                self._beat_rpc(r)
                 r.engine.submit(req)
             except ReplicaDeadError as e:
                 # a process replica died under us: run the full death
@@ -768,6 +862,15 @@ class FleetRouter:
                                f"rerouting")
                 continue
             r.routed += 1
+            # the trace spine: every placement writes one row tying
+            # (trace_id, hop) to a replica, with the router-side route
+            # cost. The fleet merger anchors each request's timeline
+            # here — rpc_wire = replica's serve_submit.t (aligned)
+            # minus this row's t.
+            self._event("fleet_dispatch", uid=req.uid,
+                        trace_id=getattr(req, "trace_id", None),
+                        hop=getattr(req, "hop", 0), replica=r.idx,
+                        route_ms=round((self._clock() - t0) * 1e3, 4))
             return r
         return None
 
@@ -776,6 +879,13 @@ class FleetRouter:
         """Admit (or shed) one request; returns its uid either way —
         the response arrives through :meth:`step`/:meth:`run`."""
         self.total_submitted += 1
+        # mint the trace context at the fleet's front door: one id per
+        # client request, hop 0. Already-stamped requests (a caller
+        # propagating an upstream trace) keep their id.
+        if getattr(request, "trace_id", None) is None:
+            request.trace_id = \
+                f"{self._trace_prefix}-{next(self._trace_seq):06x}"
+            request.hop = 0
         prio = getattr(request, "priority", 0)
         level = self.shed_level()
         self._apply_spec_degrade(level)
@@ -873,6 +983,7 @@ class FleetRouter:
                 continue
             t0 = self._clock()
             try:
+                self._beat_rpc(t)
                 sid = t.engine.import_request(rec)
             except (RpcError, OSError) as e:
                 logger.warning(f"fleet migration: import of uid "
@@ -892,6 +1003,8 @@ class FleetRouter:
             t.migrations_in += 1
             t.routed += 1
             self._event("serve_migration", uid=rec.uid,
+                        trace_id=getattr(rec, "trace_id", None),
+                        hop=getattr(rec, "hop", 0),
                         src=source.idx, dst=t.idx,
                         pages=rec.live_pages, nbytes=rec.nbytes,
                         position=rec.position,
@@ -912,7 +1025,11 @@ class FleetRouter:
                       max_new_tokens=rec.max_new_tokens,
                       temperature=rec.temperature, seed=rec.seed,
                       eos_id=rec.eos_id, priority=rec.priority,
-                      uid=rec.uid)
+                      uid=rec.uid,
+                      # resubmit is still a hop of the SAME trace —
+                      # lineage survives even the fallback path
+                      trace_id=getattr(rec, "trace_id", None),
+                      hop=int(getattr(rec, "hop", 0)) + 1)
         self.total_redistributed += 1
         if self._dispatch(req) is None:
             self._shed(req, "shed_capacity", drained_from=source.idx)
@@ -926,6 +1043,7 @@ class FleetRouter:
             return
         for uid in r.active_uids():
             try:
+                self._beat_rpc(r)
                 rec = r.engine.export_request(uid)
             except (RpcError, OSError) as e:
                 logger.warning(f"fleet migration: export of uid {uid} "
@@ -971,6 +1089,7 @@ class FleetRouter:
                 continue
             if not r.idle():
                 try:
+                    self._beat_rpc(r)
                     out.extend(self._collect(r.engine.step()))
                 except ReplicaDeadError as e:
                     self._on_replica_death(r, e)
@@ -985,6 +1104,8 @@ class FleetRouter:
         self._steps += 1
         if self._steps % self._STATE_EVERY == 0:
             self._write_telemetry()
+        if self._steps % self._CLOCK_SYNC_EVERY == 0:
+            self._sync_clocks()
         return out
 
     # ------------------------------------------------ death supervision
@@ -1082,6 +1203,9 @@ class FleetRouter:
                     pid=getattr(r.engine, "pid", None))
         logger.info(f"fleet: replica {r.idx} relaunched "
                     f"(restart {r.restarts}, backoff {delay:g}s)")
+        # the fresh child is a fresh clock — re-estimate its offset so
+        # post-restart rows still align on the merged timeline
+        self._sync_clocks()
 
     # -------------------------------------------------------- autoscale
     def _autoscale_tick(self) -> None:

@@ -40,6 +40,7 @@ import argparse
 import json
 import os
 import sys
+import time
 from dataclasses import asdict
 from typing import Any, Dict, Tuple
 
@@ -79,6 +80,7 @@ class ReplicaWorker:
             "import_request": self._h_import,
             "swap_params": self._h_swap,
             "set_speculation": self._h_spec,
+            "clock_ping": self._h_clock_ping,
             "shutdown": self._h_shutdown,
         }
 
@@ -105,6 +107,11 @@ class ReplicaWorker:
             "weight_ordinal": eng.weight_ordinal,
             "steady_state_recompiles": eng.steady_state_recompiles,
             "can_migrate": getattr(eng, "can_migrate", False),
+            # cumulative device dispatches (CompileTracker) — the
+            # fleet_trace_overhead bench's dispatch_delta pin reads
+            # this through the router proxy; a host int, never a sync
+            "dispatches": getattr(getattr(eng, "compile_tracker", None),
+                                  "total_dispatches", None),
         }
 
     def hello(self) -> Dict[str, Any]:
@@ -163,6 +170,15 @@ class ReplicaWorker:
     def _h_spec(self, params, payload):
         changed = self.engine.set_speculation(bool(params["on"]))
         return {"changed": changed, "state": self.state()}, b""
+
+    def _h_clock_ping(self, params, payload):
+        # clock-alignment probe (ISSUE 18): reply with this process's
+        # wall clock and NOTHING else — no state snapshot, so the reply
+        # is as small (and the midpoint estimate as tight) as the
+        # channel allows. The router brackets the call with its own
+        # t0/t1 and estimates offset = t_child - (t0 + t1) / 2 with
+        # uncertainty (t1 - t0) / 2.
+        return {"t_child": time.time()}, b""
 
     def _h_shutdown(self, params, payload):
         raise rpc.ServerExit(result={"bye": True,

@@ -227,7 +227,12 @@ def request_to_wire(req) -> Dict[str, Any]:
             "max_new_tokens": req.max_new_tokens,
             "temperature": req.temperature, "seed": req.seed,
             "eos_id": req.eos_id,
-            "priority": getattr(req, "priority", 0), "uid": req.uid}
+            "priority": getattr(req, "priority", 0), "uid": req.uid,
+            # distributed-trace context: the router's stamp rides every
+            # frame, so the replica-side tracer rows correlate across
+            # the process boundary (None/0 for unstamped requests)
+            "trace_id": getattr(req, "trace_id", None),
+            "hop": getattr(req, "hop", 0)}
 
 
 def request_from_wire(d: Dict[str, Any]):
@@ -237,7 +242,9 @@ def request_from_wire(d: Dict[str, Any]):
                    temperature=float(d.get("temperature", 0.0)),
                    seed=int(d.get("seed", 0)), eos_id=d.get("eos_id"),
                    priority=int(d.get("priority", 0)),
-                   uid=int(d["uid"]))
+                   uid=int(d["uid"]),
+                   trace_id=d.get("trace_id"),
+                   hop=int(d.get("hop", 0)))
 
 
 # --------------------------------------------------------------- client

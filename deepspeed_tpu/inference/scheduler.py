@@ -59,6 +59,13 @@ class Request:
     # slot policy (inference/fleet.py).
     priority: int = 0
     uid: int = field(default_factory=lambda: next(_uid_counter))
+    # distributed-trace context (inference/fleet.py stamps these at the
+    # router): one trace id follows the request across every process
+    # boundary — RPC dispatch, live KV migration, resubmit — and the
+    # hop ordinal counts boundary crossings. None/0 when the request
+    # never leaves one engine; the tracer simply omits the fields.
+    trace_id: Optional[str] = None
+    hop: int = 0
 
     def __post_init__(self):
         self.prompt = [int(t) for t in np.asarray(self.prompt).reshape(-1)]
@@ -265,7 +272,10 @@ class Scheduler:
         self.queue.append(request)
         if self.tracer is not None:
             self.tracer.on_submit(request.uid, plen,
-                                  request.max_new_tokens)
+                                  request.max_new_tokens,
+                                  trace_id=getattr(request, "trace_id",
+                                                   None),
+                                  hop=getattr(request, "hop", 0))
         return request.uid
 
     def queue_by_bucket(self) -> Dict[int, int]:

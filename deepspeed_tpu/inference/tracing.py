@@ -102,6 +102,14 @@ class _ReqTrace:
     uid: int
     prompt_tokens: int = 0
     max_new_tokens: int = 0
+    # distributed-trace context (ISSUE 18): stamped by the fleet router
+    # before dispatch and carried on every row this request emits, so
+    # ``obs_report --fleet`` can stitch one timeline across process
+    # boundaries. ``hop`` counts boundary crossings (0 = the replica
+    # the request was first dispatched to; each migration import
+    # increments it).
+    trace_id: Optional[str] = None
+    hop: int = 0
     t_submit: float = 0.0
     t_admit: Optional[float] = None
     t_first: Optional[float] = None
@@ -155,11 +163,29 @@ class ServeTracer:
     DEFAULT_SLO_TBT_MS = 200.0
     DEFAULT_SAMPLE_RATE = 0.0625          # one window row per 16 tokens
 
+    #: every ``serve_*`` event kind this tracer can emit — the schema
+    #: contract tests walk (each kind must appear in the pinned
+    #: TRAIL_SCHEMA and have an obs_report handler, so a new trail row
+    #: cannot silently fall out of the report)
+    EVENT_KINDS = (
+        "serve_submit", "serve_defer", "serve_prefix_hit",
+        "serve_admit", "serve_prefill", "serve_handoff",
+        "serve_spec_window", "serve_first_token", "serve_decode_window",
+        "serve_finish", "serve_evict",
+        "serve_migrate_out", "serve_migrate_in",
+    )
+
     def __init__(self, cfg: Optional[Dict[str, Any]] = None,
                  writer=None, recorder=None, clock=time.perf_counter):
         cfg = cfg or {}
         slo = cfg.get("slo") or {}
         self.enabled = bool(cfg.get("enabled", True))
+        # fleet identity: which replica's log this is. Stamped on every
+        # event row (``replica_id``) so the offline fleet merger can
+        # attribute rows without trusting directory names. None for a
+        # standalone engine — the field is simply omitted.
+        rid = cfg.get("replica_id")
+        self.replica_id = int(rid) if rid is not None else None
         self.slo_ttft_ms = float(slo.get("ttft_ms",
                                          self.DEFAULT_SLO_TTFT_MS))
         self.slo_tbt_ms = float(slo.get("tbt_ms", self.DEFAULT_SLO_TBT_MS))
@@ -192,7 +218,17 @@ class ServeTracer:
     # ------------------------------------------------------------- sinks
     def _event(self, kind: str, **fields) -> None:
         if self.writer is not None:
+            if self.replica_id is not None:
+                fields.setdefault("replica_id", self.replica_id)
             self.writer.add_event(kind, **fields)
+
+    def _ctx(self, uid: int) -> Dict[str, Any]:
+        """Trace-context fields for ``uid``'s rows ({} when the request
+        was never stamped — single-engine serving stays schema-stable)."""
+        tr = self._req.get(uid)
+        if tr is None or tr.trace_id is None:
+            return {}
+        return {"trace_id": tr.trace_id, "hop": tr.hop}
 
     @staticmethod
     def _r(v: Optional[float]) -> Optional[float]:
@@ -200,14 +236,16 @@ class ServeTracer:
 
     # ------------------------------------------------------------- hooks
     def on_submit(self, uid: int, prompt_tokens: int,
-                  max_new_tokens: int) -> None:
+                  max_new_tokens: int,
+                  trace_id: Optional[str] = None, hop: int = 0) -> None:
         if not self.enabled:
             return
         self._req[uid] = _ReqTrace(uid=uid, prompt_tokens=prompt_tokens,
                                    max_new_tokens=max_new_tokens,
-                                   t_submit=self._clock())
+                                   t_submit=self._clock(),
+                                   trace_id=trace_id, hop=int(hop))
         self._event("serve_submit", uid=uid, prompt_tokens=prompt_tokens,
-                    max_new_tokens=max_new_tokens)
+                    max_new_tokens=max_new_tokens, **self._ctx(uid))
 
     def on_defer(self, uid: int, reason: str) -> None:
         """One admission pass skipped ``uid`` for ``reason``. Deduped
@@ -220,13 +258,14 @@ class ServeTracer:
         if tr is None or reason in tr.deferred:
             return
         tr.deferred.add(reason)
-        self._event("serve_defer", uid=uid, reason=str(reason))
+        self._event("serve_defer", uid=uid, reason=str(reason),
+                    **self._ctx(uid))
 
     def on_prefix_hit(self, uid: int, tokens: int, pages: int) -> None:
         if not self.enabled:
             return
         self._event("serve_prefix_hit", uid=uid, tokens=int(tokens),
-                    pages=int(pages))
+                    pages=int(pages), **self._ctx(uid))
 
     def on_admit(self, uid: int, slot: int, queue_wait_ms: float,
                  prefix_tokens: int, prompt_bucket: int,
@@ -246,7 +285,7 @@ class ServeTracer:
                     queue_wait_ms=self._r(queue_wait_ms),
                     prefix_tokens=int(prefix_tokens),
                     prompt_bucket=int(prompt_bucket),
-                    batch_bucket=int(batch_bucket))
+                    batch_bucket=int(batch_bucket), **self._ctx(uid))
 
     def on_prefill(self, uid: int, slot: int, wall_ms: float,
                    prompt_bucket: int, batch_bucket: int,
@@ -260,7 +299,8 @@ class ServeTracer:
         self._event("serve_prefill", uid=uid, slot=int(slot),
                     wall_ms=self._r(wall_ms),
                     prompt_bucket=int(prompt_bucket),
-                    batch_bucket=int(batch_bucket), rows=int(rows))
+                    batch_bucket=int(batch_bucket), rows=int(rows),
+                    **self._ctx(uid))
 
     def on_handoff(self, uid: int, queue_ms: float, transfer_ms: float,
                    pages: int, bytes_moved: int, mode: str,
@@ -286,7 +326,8 @@ class ServeTracer:
                     transfer_ms=self._r(transfer_ms),
                     handoff_ms=self._r(total),
                     priced_ms=self._r(priced_ms),
-                    pages=int(pages), bytes_moved=int(bytes_moved))
+                    pages=int(pages), bytes_moved=int(bytes_moved),
+                    **self._ctx(uid))
 
     def on_spec(self, uid: int, proposed: int, accepted: int) -> None:
         """One verify dispatch's draft outcome for ``uid``: ``proposed``
@@ -317,7 +358,8 @@ class ServeTracer:
                 accepted=tr.spec_window_accepted,
                 dispatches=tr.spec_window_dispatches,
                 accept_rate=self._r(tr.spec_window_accepted
-                                    / tr.spec_window_proposed))
+                                    / tr.spec_window_proposed),
+                **self._ctx(uid))
             tr.spec_window_proposed = 0
             tr.spec_window_accepted = 0
             tr.spec_window_dispatches = 0
@@ -345,7 +387,7 @@ class ServeTracer:
             self.hist["prefill_ms"].record(max(prefill_ms, 0.0))
         self._event("serve_first_token", uid=uid, ttft_ms=self._r(ttft_ms),
                     prefill_ms=self._r(prefill_ms),
-                    handoff_ms=self._r(tr.handoff_ms))
+                    handoff_ms=self._r(tr.handoff_ms), **self._ctx(uid))
 
     def on_token(self, uid: int) -> None:
         """One decode token for ``uid``: a time-between-tokens sample,
@@ -372,7 +414,8 @@ class ServeTracer:
                 "serve_decode_window", uid=uid, tokens=tr.window_tokens,
                 end_token=tr.n_tokens,
                 window_ms=self._r(window_ms),
-                tbt_ms=self._r(window_ms / max(tr.window_intervals, 1)))
+                tbt_ms=self._r(window_ms / max(tr.window_intervals, 1)),
+                **self._ctx(uid))
             tr.window_t0 = now
             tr.window_tokens = 0
             tr.window_intervals = 0
@@ -402,6 +445,8 @@ class ServeTracer:
                       if fin.ttft_ms is not None
                       and tr.queue_wait_ms is not None else None)
         slo_ok = self._account(fin, evicted, tbt_mean)
+        ctx = ({"trace_id": tr.trace_id, "hop": tr.hop}
+               if tr.trace_id is not None else {})
         self._event(kind, uid=fin.uid, reason=fin.finish_reason,
                     new_tokens=len(fin.tokens),
                     ttft_ms=self._r(fin.ttft_ms),
@@ -414,8 +459,51 @@ class ServeTracer:
                                        else None),
                     slo_ok=slo_ok,
                     draft_proposed=tr.spec_proposed,
-                    draft_accepted=tr.spec_accepted)
+                    draft_accepted=tr.spec_accepted, **ctx)
         self._lanes(tr)
+
+    # ----------------------------------------------- migration lineage
+    def on_migrate_out(self, uid: int, *, position: int, pages: int,
+                       nbytes: int, reason: str = "migrate") -> None:
+        """The engine exported ``uid``'s live state for migration (the
+        source half of the lineage pair). Emitted BEFORE the local
+        "migrate" eviction, so the row still carries the request's
+        trace context; the destination's ``serve_migrate_in`` shares
+        the trace id, stitching the timeline across replica death."""
+        if not self.enabled:
+            return
+        self._event("serve_migrate_out", uid=uid, position=int(position),
+                    pages=int(pages), nbytes=int(nbytes),
+                    reason=str(reason), **self._ctx(uid))
+
+    def on_migrate_in(self, uid: int, *, trace_id: Optional[str],
+                      hop: int, position: int, pages: int, nbytes: int,
+                      queue_wait_ms: Optional[float] = None,
+                      ttft_ms: Optional[float] = None,
+                      elapsed_ms: float = 0.0, tokens: int = 0) -> None:
+        """The engine resumed a migrated request here (the destination
+        half). Installs a resumed trace so every later row —
+        decode windows, the finish row — carries the ORIGINAL trace id
+        with the hop ordinal bumped; the carried elapsed/queue/ttft
+        durations keep the finish row's latency decomposition summing
+        exactly across the hop (clocks ship as durations, never
+        absolute times — disagg.MigrationRecord doctrine)."""
+        if not self.enabled:
+            return
+        now = self._clock()
+        tr = self._req[uid] = _ReqTrace(
+            uid=uid, trace_id=trace_id, hop=int(hop),
+            t_submit=now - max(float(elapsed_ms), 0.0) / 1e3,
+            queue_wait_ms=queue_wait_ms, ttft_ms=ttft_ms,
+            n_tokens=int(tokens))
+        if ttft_ms is not None:
+            # first token already happened on the source replica —
+            # resume TBT/window sampling from the import instant
+            tr.t_first = tr.t_last = now
+            tr.window_t0 = now
+        self._event("serve_migrate_in", uid=uid, position=int(position),
+                    pages=int(pages), nbytes=int(nbytes),
+                    resumed_tokens=int(tokens), **self._ctx(uid))
 
     def _account(self, fin, evicted: bool,
                  tbt_mean: Optional[float]) -> bool:

@@ -488,6 +488,8 @@ def get_observability_config(param_dict):
         # unless overridden inside the serve section
         "events_max_mb": float(events_max_mb if serve_max_mb is None
                                else serve_max_mb),
+        "replica_id": srv.get(C.OBS_SERVE_REPLICA_ID,
+                              C.OBS_SERVE_REPLICA_ID_DEFAULT),
     }
     # validated here (not only in DeepSpeedConfig) because the
     # inference engine parses this section standalone
@@ -507,6 +509,12 @@ def get_observability_config(param_dict):
         raise DeepSpeedConfigError(
             "observability.serve.events_max_mb must be >= 0 (0 disables "
             "rotation)")
+    if serve["replica_id"] is not None:
+        serve["replica_id"] = int(serve["replica_id"])
+        if serve["replica_id"] < 0:
+            raise DeepSpeedConfigError(
+                "observability.serve.replica_id must be >= 0, got "
+                f"{serve['replica_id']}")
     hl = sub.get(C.OBS_HEALTH, {}) or {}
     det = hl.get(C.OBS_HEALTH_DETECTORS, {}) or {}
     health = {

@@ -314,6 +314,13 @@ OBS_SERVE_SAMPLE_RATE_DEFAULT = 0.0625
 # (None = inherit the top-level observability.events_max_mb)
 OBS_SERVE_EVENTS_MAX_MB = "events_max_mb"
 OBS_SERVE_EVENTS_MAX_MB_DEFAULT = None
+# fleet identity: which replica this engine serves as. Stamped onto
+# every serve-tracer event row (``replica_id``) so the offline fleet
+# merger (tools/obs_report.py --fleet) can attribute rows across
+# process boundaries. None (the default) omits the field — a
+# standalone engine's trail is unchanged.
+OBS_SERVE_REPLICA_ID = "replica_id"
+OBS_SERVE_REPLICA_ID_DEFAULT = None
 # postmortem health plane (deepspeed_tpu/utils/health.py): flight
 # recorder ring, stall watchdog, numeric anomaly detectors. Entirely
 # host-side; enabling it is pinned to leave losses/params/outputs

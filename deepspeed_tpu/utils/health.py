@@ -71,6 +71,7 @@ HEALTH_PHASES = (
     "checkpoint_commit",  # save snapshot/commit stages
     "fleet_step",         # FleetRouter scheduling round
     "bench_metric",       # bench.py ladder child metric body
+    "rpc_call",           # router-side blocking RPC wait on a replica
 )
 
 #: Pinned numeric-anomaly reason vocabulary (``health`` event rows).
@@ -274,6 +275,7 @@ class Watchdog:
         self._clock = clock
         self._last_beat = clock()
         self._last_phase: Optional[str] = None
+        self._last_detail: Optional[str] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.trips = 0
@@ -286,11 +288,14 @@ class Watchdog:
             target=self._run, name="dstpu-health-watchdog", daemon=True)
         self._thread.start()
 
-    def beat(self, phase: str) -> None:
+    def beat(self, phase: str, detail: Optional[str] = None) -> None:
         # plain assignments: atomic under the GIL, no lock on the hot
         # path (the poll thread tolerates a torn phase/beat pair — it
-        # only costs one poll interval of slack)
+        # only costs one poll interval of slack). ``detail`` names the
+        # specific thing this phase is waiting on (e.g. which replica a
+        # blocking rpc_call targets) so a trip can report it.
         self._last_phase = phase
+        self._last_detail = detail
         self._last_beat = self._clock()
 
     def _run(self) -> None:
@@ -301,22 +306,24 @@ class Watchdog:
                 continue
             self.trips += 1
             phase = self._last_phase or "(no heartbeat yet)"
+            detail = self._last_detail
             stacks = _all_thread_stacks()
             logger.error(
                 f"health: watchdog tripped — {silent:.1f}s without a "
-                f"heartbeat (last phase {phase!r}, timeout "
-                f"{self.stall_timeout_s:.1f}s)")
+                f"heartbeat (last phase {phase!r}"
+                + (f" [{detail}]" if detail else "")
+                + f", timeout {self.stall_timeout_s:.1f}s)")
             if self._on_trip is not None:
                 try:
                     self._on_trip(phase=phase, silent_s=silent,
-                                  stacks=stacks)
+                                  stacks=stacks, detail=detail)
                 except Exception as e:
                     logger.warning(f"health: on_trip failed ({e!r})")
             if self.on_stall == "exit":
                 # os._exit, not sys.exit: the main thread is wedged
                 # (that is WHY we tripped) and cannot unwind
                 os._exit(STALL_EXIT_CODE)
-            self.beat(phase)   # warn mode: re-arm, don't spam
+            self.beat(phase, detail)   # warn mode: re-arm, don't spam
 
     def stop(self) -> None:
         self._stop.set()
@@ -522,31 +529,36 @@ class HealthPlane:
                 self.detectors.alerts_total if self.detectors else 0,
                 step)
 
-    def _on_trip(self, phase: str, silent_s: float, stacks: dict) -> None:
+    def _on_trip(self, phase: str, silent_s: float, stacks: dict,
+                 detail: Optional[str] = None) -> None:
         path = None
         if self.recorder is not None:
             path = self.recorder.dump(
                 "watchdog", extra={"stall": {
-                    "phase": phase, "silent_s": round(silent_s, 3),
+                    "phase": phase, "detail": detail,
+                    "silent_s": round(silent_s, 3),
                     "timeout_s": self.watchdog.stall_timeout_s,
                     "component": self.component,
                 }, "stacks": stacks})
-        self._event("stall_detected", phase=phase,
+        self._event("stall_detected", phase=phase, detail=detail,
                     silent_s=round(silent_s, 3),
                     timeout_s=self.watchdog.stall_timeout_s,
                     component=self.component, flight=path)
 
     # ----------------------------------------------------------- surface
-    def heartbeat(self, phase: str) -> None:
+    def heartbeat(self, phase: str, detail: Optional[str] = None) -> None:
         """One liveness beat from a pinned phase boundary. Unknown
         phases raise — the vocabulary is the contract obs_report and
-        the stall postmortem render, not free text."""
+        the stall postmortem render, not free text. ``detail`` is free
+        text naming what the phase waits on (e.g. ``"replica 2"`` for
+        an ``rpc_call`` beat) — a trip reports it so a hung replica
+        call names its target."""
         if phase not in HEALTH_PHASES:
             raise ValueError(
                 f"health: unknown heartbeat phase {phase!r} "
                 f"(pinned vocabulary: {HEALTH_PHASES})")
         if self.watchdog is not None:
-            self.watchdog.beat(phase)
+            self.watchdog.beat(phase, detail)
 
     def observe_loss(self, loss, step: int) -> None:
         if self.detectors is not None:

@@ -18,6 +18,7 @@ import json
 import math
 import os
 import socket
+import time
 from typing import Dict, Optional
 
 from deepspeed_tpu.utils.logging import logger
@@ -222,11 +223,19 @@ class _JsonlWriter:
             + "\n")
 
     def add_event(self, kind, **fields):
-        """One structured (non-scalar) record, e.g. a compile event."""
+        """One structured (non-scalar) record, e.g. a compile event.
+
+        Every row is stamped with ``t`` — wall-clock epoch seconds —
+        unless the caller supplied one. Event rows are the only record
+        the fleet merger (``obs_report --fleet``) can align across
+        process boundaries, and alignment needs a shared-epoch clock
+        plus the per-replica ``clock_sync`` offsets; ``time.time()`` is
+        that clock. Host-side only — never a device sync."""
         if self._f is None:
             return
         row = {"event": str(kind)}
         row.update(fields)
+        row.setdefault("t", round(time.time(), 6))
         self._write_line(json.dumps(row, default=str) + "\n")
 
     def flush(self):

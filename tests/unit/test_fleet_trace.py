@@ -1,0 +1,540 @@
+"""Fleet-wide distributed tracing (ISSUE 18), jax-free units:
+
+- trace context (trace id + hop ordinal) rides the RPC request frames
+  and the migration record headers, with old-wire fallbacks;
+- the router mints trace ids (monotonic, RNG-free) and writes the
+  ``fleet_dispatch`` spine rows;
+- clock alignment: the midpoint-method offset estimate (best-RTT
+  sample wins, uncertainty = RTT/2) and the ``clock_sync`` trail rows;
+- the ``obs_report --fleet`` merger: rotation segments interleaved
+  across replicas, out-of-order timestamps beyond the clock-sync
+  uncertainty are FLAGGED (never silently re-ordered), a missing
+  replica log degrades to a router-spine-only (salvaged) timeline;
+- ``obs_report --diff`` covers the quantized-serving tags.
+
+The end-to-end lineage pin (kill mid-decode -> one merged timeline)
+lives in tests/unit/test_fleet_process.py::TestFleetTracing.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _load_tool(name):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class _Writer:
+    """Captures add_event rows like monitor._JsonlWriter would write
+    them (plus the auto 't' stamp the real writer adds)."""
+
+    def __init__(self):
+        self.rows = []
+
+    def add_event(self, kind, **fields):
+        row = {"event": str(kind)}
+        row.update(fields)
+        row.setdefault("t", time.time())
+        self.rows.append(row)
+
+
+# ===================================================================== #
+# trace context over the wire
+# ===================================================================== #
+
+class TestTraceContextWire:
+    def test_request_wire_roundtrip_preserves_trace(self):
+        from deepspeed_tpu.inference import rpc
+        from deepspeed_tpu.inference.scheduler import Request
+        req = Request(prompt=[1, 2, 3], max_new_tokens=4,
+                      temperature=0.0, seed=7, uid=42,
+                      trace_id="f1a-000003", hop=2)
+        back = rpc.request_from_wire(rpc.request_to_wire(req))
+        assert back.trace_id == "f1a-000003" and back.hop == 2
+        assert back.uid == 42
+
+    def test_old_wire_dict_defaults_unstamped(self):
+        # a frame from a pre-tracing router: no trace keys at all
+        from deepspeed_tpu.inference import rpc
+        back = rpc.request_from_wire(
+            {"prompt": [1, 2], "uid": 5, "max_new_tokens": 4})
+        assert back.trace_id is None and back.hop == 0
+
+    def test_migration_record_carries_trace_over_wire(self):
+        from deepspeed_tpu.inference import rpc
+        from deepspeed_tpu.inference.disagg import MigrationRecord
+        k = np.arange(2 * 2 * 2 * 4 * 4, dtype=np.float32
+                      ).reshape(2, 2, 2, 4, 4)
+        rec = MigrationRecord(
+            uid=7, prompt=[1, 2, 3], max_new_tokens=8, temperature=0.0,
+            seed=11, eos_id=None, priority=0, position=5,
+            pending_tok=42, tokens=[42], live_pages=2, page_bytes=64,
+            ttft_ms=1.5, queue_wait_ms=0.25, elapsed_ms=3.0,
+            trace_id="fbeef-00002a", hop=1, kslab=k, vslab=k + 1.0)
+        head, payload = rpc.migration_to_wire(rec)
+        assert head["trace_id"] == "fbeef-00002a" and head["hop"] == 1
+        back = rpc.migration_from_wire(head, payload)
+        assert back.trace_id == "fbeef-00002a" and back.hop == 1
+        # durations-not-absolute-times doctrine: the header ships no
+        # wall-clock field, only elapsed durations
+        assert "t" not in head
+        assert back.elapsed_ms == 3.0
+
+    def test_old_migration_header_defaults(self):
+        from deepspeed_tpu.inference.disagg import MigrationRecord
+        rec = MigrationRecord(
+            uid=1, prompt=[1], max_new_tokens=2, temperature=0.0,
+            seed=0, eos_id=None, priority=0, position=1,
+            pending_tok=3, tokens=[3], live_pages=1, page_bytes=16,
+            ttft_ms=None, queue_wait_ms=None, elapsed_ms=0.0)
+        assert rec.trace_id is None and rec.hop == 0
+
+
+# ===================================================================== #
+# tracer-side context: replica_id stamping, migration lineage rows
+# ===================================================================== #
+
+class TestTracerContext:
+    def _tracer(self, w, replica_id=1):
+        from deepspeed_tpu.inference.tracing import ServeTracer
+        return ServeTracer({"enabled": True, "replica_id": replica_id},
+                           writer=w)
+
+    def test_rows_carry_replica_and_trace_context(self):
+        w = _Writer()
+        tr = self._tracer(w)
+        tr.on_submit(5, prompt_tokens=3, max_new_tokens=4,
+                     trace_id="fa-000001", hop=0)
+        row = w.rows[-1]
+        assert row["event"] == "serve_submit"
+        assert row["replica_id"] == 1
+        assert row["trace_id"] == "fa-000001" and row["hop"] == 0
+
+    def test_unstamped_request_rows_stay_schema_stable(self):
+        from deepspeed_tpu.inference.tracing import ServeTracer
+        w = _Writer()
+        tr = ServeTracer({"enabled": True}, writer=w)
+        tr.on_submit(5, prompt_tokens=3, max_new_tokens=4)
+        row = w.rows[-1]
+        assert "trace_id" not in row and "replica_id" not in row
+
+    def test_migrate_out_row_keeps_context_before_evict(self):
+        w = _Writer()
+        tr = self._tracer(w)
+        tr.on_submit(5, prompt_tokens=3, max_new_tokens=4,
+                     trace_id="fa-000002", hop=0)
+        tr.on_migrate_out(5, position=7, pages=2, nbytes=128)
+        row = w.rows[-1]
+        assert row["event"] == "serve_migrate_out"
+        assert row["trace_id"] == "fa-000002" and row["hop"] == 0
+        assert row["pages"] == 2 and row["nbytes"] == 128
+        assert row["reason"] == "migrate"
+
+    def test_migrate_in_resumes_trace_for_finish(self):
+        """The destination half installs a resumed trace: the finish
+        row carries the ORIGINAL trace id with the bumped hop, and the
+        carried queue/ttft durations keep the decomposition summing."""
+        from deepspeed_tpu.inference.scheduler import FinishedRequest
+        w = _Writer()
+        tr = self._tracer(w, replica_id=2)
+        tr.on_migrate_in(5, trace_id="fa-000002", hop=1, position=7,
+                         pages=2, nbytes=128, queue_wait_ms=0.5,
+                         ttft_ms=2.5, elapsed_ms=4.0, tokens=3)
+        row = w.rows[-1]
+        assert row["event"] == "serve_migrate_in"
+        assert row["trace_id"] == "fa-000002" and row["hop"] == 1
+        assert row["resumed_tokens"] == 3
+        tr.on_token(5)
+        fin = FinishedRequest(uid=5, prompt=[1, 2, 3], tokens=[9] * 4,
+                              finish_reason="length", ttft_ms=2.5,
+                              latency_ms=6.0)
+        tr.on_finish(fin)
+        frow = w.rows[-1]
+        assert frow["event"] == "serve_finish"
+        assert frow["trace_id"] == "fa-000002" and frow["hop"] == 1
+        assert frow["queue_wait_ms"] == 0.5
+        # prefill = ttft - queue_wait: the identity the merger re-checks
+        assert frow["prefill_ms"] == pytest.approx(2.0)
+
+    def test_event_kinds_pinned(self):
+        from deepspeed_tpu.inference.tracing import ServeTracer
+        assert "serve_migrate_out" in ServeTracer.EVENT_KINDS
+        assert "serve_migrate_in" in ServeTracer.EVENT_KINDS
+        assert len(set(ServeTracer.EVENT_KINDS)) == \
+            len(ServeTracer.EVENT_KINDS)
+
+
+# ===================================================================== #
+# router: trace minting + dispatch spine + clock sync
+# ===================================================================== #
+
+class _FakeSched:
+    def __init__(self):
+        self.queue = []
+        self.total_tokens = 0
+        self.occupancy = 0.0
+
+    @property
+    def queue_depth(self):
+        return len(self.queue)
+
+    def active_slots(self):
+        return []
+
+    def idle(self):
+        return not self.queue
+
+
+class _FakeEngine:
+    def __init__(self):
+        self.scheduler = _FakeSched()
+        self.received = []
+        self.monitor = None
+        self._log = None
+
+    def submit(self, req):
+        self.scheduler.queue.append(req)
+        self.received.append(req)
+        return req.uid
+
+    def step(self):
+        from deepspeed_tpu.inference import FinishedRequest
+        fins = [FinishedRequest(uid=r.uid, prompt=list(r.prompt),
+                                tokens=[1] * r.max_new_tokens,
+                                finish_reason="length", ttft_ms=1.0,
+                                latency_ms=1.0)
+                for r in self.scheduler.queue]
+        self.scheduler.queue = []
+        return fins
+
+
+class TestRouterTraceSpine:
+    def _run(self, writer=None, engines=None, reqs=2):
+        from deepspeed_tpu.inference import FleetRouter, Request
+        engines = engines or [_FakeEngine(), _FakeEngine()]
+        router = FleetRouter(engines, writer=writer)
+        reqs = [Request(prompt=[1, 2, 3], max_new_tokens=2,
+                        temperature=0.0) for _ in range(reqs)]
+        for r in reqs:
+            router.submit(r)
+        router.run()
+        return router, reqs
+
+    def test_submit_mints_unique_monotonic_trace_ids(self):
+        w = _Writer()
+        _router, reqs = self._run(writer=w)
+        ids = [r.trace_id for r in reqs]
+        assert all(ids) and len(set(ids)) == len(ids)
+        assert all(r.hop == 0 for r in reqs)
+        disp = [r for r in w.rows if r["event"] == "fleet_dispatch"]
+        assert {d["trace_id"] for d in disp} == set(ids)
+        assert all(d["hop"] == 0 and d["route_ms"] >= 0.0
+                   for d in disp)
+
+    def test_prestamped_request_keeps_upstream_trace(self):
+        from deepspeed_tpu.inference import FleetRouter, Request
+        router = FleetRouter([_FakeEngine()])
+        req = Request(prompt=[1], max_new_tokens=1, temperature=0.0,
+                      trace_id="upstream-7", hop=3)
+        router.submit(req)
+        assert req.trace_id == "upstream-7" and req.hop == 3
+
+    def test_sync_clocks_writes_rows_for_pingable_replicas(self):
+        w = _Writer()
+        eng = _FakeEngine()
+        eng.clock_ping = lambda: {"offset_s": 0.002,
+                                  "uncertainty_s": 0.0005,
+                                  "rtt_s": 0.001}
+        # launched alongside an in-process engine with no ping surface:
+        # only the process replica gets a clock_sync row
+        self._run(writer=w, engines=[eng, _FakeEngine()], reqs=1)
+        cs = [r for r in w.rows if r["event"] == "clock_sync"]
+        assert len(cs) >= 1
+        assert cs[0]["replica"] == 0
+        assert cs[0]["offset_ms"] == pytest.approx(2.0)
+        assert cs[0]["uncertainty_ms"] == pytest.approx(0.5)
+        assert cs[0]["rtt_ms"] == pytest.approx(1.0)
+
+    def test_clock_ping_midpoint_math_best_rtt_wins(self):
+        from deepspeed_tpu.inference import fleet as fleet_mod
+        rp = fleet_mod.ReplicaProcess.__new__(fleet_mod.ReplicaProcess)
+        # three (t0, t1) brackets; the middle sample has the tightest
+        # RTT (2 ms) and a child clock 0.5 s ahead of its midpoint
+        real = time.time
+        clock = [100.0, 100.010, 200.0, 200.002, 300.0, 300.020]
+        children = iter([100.105, 200.501, 300.910])
+
+        def fake_call(method, params, payload=b""):
+            assert method == "clock_ping"
+            return {"t_child": next(children)}, b""
+
+        rp._call = fake_call
+        orig = fleet_mod.time.time
+        fleet_mod.time.time = lambda: clock.pop(0) if clock else real()
+        try:
+            est = rp.clock_ping(samples=3)
+        finally:
+            fleet_mod.time.time = orig
+        assert est["rtt_s"] == pytest.approx(0.002)
+        assert est["uncertainty_s"] == pytest.approx(0.001)
+        assert est["offset_s"] == pytest.approx(0.5)
+
+
+# ===================================================================== #
+# the merged fleet report: edge cases on synthesized logs
+# ===================================================================== #
+
+def _write(dirpath, rows, seg=None):
+    os.makedirs(dirpath, exist_ok=True)
+    name = "events.jsonl" if seg is None else f"events.jsonl.{seg}"
+    with open(os.path.join(dirpath, name), "a") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+
+
+def _router_rows():
+    return [
+        {"event": "clock_sync", "replica": 0, "offset_ms": 0.0,
+         "uncertainty_ms": 0.5, "rtt_ms": 1.0, "t": 99.0},
+        # replica 1's clock runs 1 s ahead of the router's
+        {"event": "clock_sync", "replica": 1, "offset_ms": 1000.0,
+         "uncertainty_ms": 0.5, "rtt_ms": 1.0, "t": 99.0},
+        {"event": "fleet_dispatch", "uid": 5, "trace_id": "t-1",
+         "hop": 0, "replica": 0, "route_ms": 0.2, "t": 100.0},
+        {"event": "serve_migration", "uid": 5, "trace_id": "t-1",
+         "hop": 0, "src": 0, "dst": 1, "pages": 2, "nbytes": 256,
+         "position": 7, "transfer_ms": 1.5, "priced_ms": 0.8,
+         "t": 100.1},
+    ]
+
+
+def _replica0_rows():
+    # hop 0 on replica 0: submit -> admit -> first token -> exported
+    return [
+        {"event": "serve_submit", "uid": 5, "trace_id": "t-1",
+         "hop": 0, "replica_id": 0, "prompt_tokens": 3,
+         "max_new_tokens": 8, "t": 100.001},
+        {"event": "serve_admit", "uid": 5, "trace_id": "t-1",
+         "hop": 0, "replica_id": 0, "slot": 0, "queue_wait_ms": 2.0,
+         "prefix_tokens": 0, "prompt_bucket": 8, "batch_bucket": 1,
+         "t": 100.003},
+        {"event": "serve_first_token", "uid": 5, "trace_id": "t-1",
+         "hop": 0, "replica_id": 0, "ttft_ms": 5.0, "prefill_ms": 3.0,
+         "t": 100.006},
+        {"event": "serve_migrate_out", "uid": 5, "trace_id": "t-1",
+         "hop": 0, "replica_id": 0, "position": 7, "pages": 2,
+         "nbytes": 256, "reason": "migrate", "t": 100.05},
+    ]
+
+
+def _replica1_rows():
+    # hop 1 on replica 1, raw t = router time + 1.0 s (its clock skew)
+    return [
+        {"event": "serve_migrate_in", "uid": 5, "trace_id": "t-1",
+         "hop": 1, "replica_id": 1, "position": 7, "pages": 2,
+         "nbytes": 256, "resumed_tokens": 1, "t": 101.102},
+        {"event": "serve_decode_window", "uid": 5, "trace_id": "t-1",
+         "hop": 1, "replica_id": 1, "tokens": 4, "end_token": 5,
+         "window_ms": 3.0, "tbt_ms": 0.75, "t": 101.106},
+        {"event": "serve_finish", "uid": 5, "trace_id": "t-1",
+         "hop": 1, "replica_id": 1, "reason": "length",
+         "new_tokens": 8, "ttft_ms": 5.0, "latency_ms": 9.0,
+         "queue_wait_ms": 2.0, "prefill_ms": 3.0, "tbt_ms": 0.6,
+         "tbt_ms_max": 1.0, "slo_ok": True, "t": 101.109},
+    ]
+
+
+class TestFleetMerge:
+    def test_migrated_trace_stitches_across_logs(self, tmp_path):
+        obs_report = _load_tool("obs_report")
+        _write(tmp_path / "router", _router_rows())
+        _write(tmp_path / "r0", _replica0_rows())
+        _write(tmp_path / "r1", _replica1_rows())
+        s = obs_report.summarize_fleet(
+            [str(tmp_path / d) for d in ("router", "r0", "r1")])
+        assert len(s["requests"]) == 1
+        r = s["requests"][0]
+        assert r["trace_id"] == "t-1" and r["uid"] == 5
+        assert r["path"] == [0, 1]
+        assert "migrate_out" in r["hops"][0]
+        assert "migrate_in" in r["hops"][1]
+        assert r["route_ms"] == 0.2
+        # wire = aligned submit (100.001) - dispatch (100.0) = 1 ms
+        assert r["rpc_wire_ms"] == pytest.approx(1.0, abs=1e-6)
+        assert r["replica_queue_ms"] == 2.0 and r["prefill_ms"] == 3.0
+        assert r["decode_ms"] == pytest.approx(4.0)
+        assert r["migration_ms"] == pytest.approx(1.5)
+        assert r["migration_priced_ms"] == pytest.approx(0.8)
+        assert r["decomp_exact"] is True and r["flags"] == []
+        assert s["out_of_order"] == []
+        assert s["missing_replica_logs"] == []
+        assert s["rollup"]["migrated"] == 1
+        assert s["rollup"]["slo_attainment"] == 1.0
+        # the clock table made it out for the report
+        assert s["clock_offsets"]["1"]["offset_ms"] == 1000.0
+        text = obs_report.render_fleet(s)
+        assert "t-1" in text and "replica 1" in text
+
+    def test_rotation_segments_interleave_across_replicas(
+            self, tmp_path):
+        """Each replica's rotated segments read back in sequence order
+        ahead of its live file — splitting hop 1's rows across
+        events.jsonl.1/.2/live must not lose or reorder lifecycle."""
+        obs_report = _load_tool("obs_report")
+        _write(tmp_path / "router", _router_rows())
+        r0 = _replica0_rows()
+        _write(tmp_path / "r0", r0[:2], seg=1)
+        _write(tmp_path / "r0", r0[2:])
+        r1 = _replica1_rows()
+        _write(tmp_path / "r1", r1[:1], seg=1)
+        _write(tmp_path / "r1", r1[1:2], seg=2)
+        _write(tmp_path / "r1", r1[2:])
+        s = obs_report.summarize_fleet(
+            [str(tmp_path / d) for d in ("router", "r0", "r1")])
+        r = s["requests"][0]
+        assert r["path"] == [0, 1]
+        assert "finish" in r["hops"][1]
+        assert r["decomp_exact"] is True
+        assert s["out_of_order"] == []
+
+    def test_out_of_order_beyond_uncertainty_is_flagged(
+            self, tmp_path):
+        """A row whose aligned timestamp runs BACKWARDS by more than
+        the clock-sync uncertainty is a real anomaly: the merger keeps
+        lifecycle order and flags it — never silently re-sorts."""
+        obs_report = _load_tool("obs_report")
+        _write(tmp_path / "router", _router_rows())
+        rows = _replica0_rows()
+        # the first-token row claims a time 100 ms BEFORE the admit
+        rows[2]["t"] = 99.9
+        _write(tmp_path / "r0", rows)
+        _write(tmp_path / "r1", _replica1_rows())
+        s = obs_report.summarize_fleet(
+            [str(tmp_path / d) for d in ("router", "r0", "r1")])
+        assert len(s["out_of_order"]) == 1
+        o = s["out_of_order"][0]
+        assert o["trace_id"] == "t-1"
+        assert o["event"] == "serve_first_token"
+        assert o["after"] == "serve_admit"
+        assert o["skew_ms"] > o["bound_ms"]
+        # lifecycle kept: the request still assembled in hop order
+        r = s["requests"][0]
+        assert "finish" in r["hops"][1]
+        text = obs_report.render_fleet(s)
+        assert "out-of-order" in text
+
+    def test_skew_within_uncertainty_is_not_flagged(self, tmp_path):
+        obs_report = _load_tool("obs_report")
+        _write(tmp_path / "router", _router_rows())
+        rows = _replica0_rows()
+        # 1 ms backwards: inside 2*uncertainty (1 ms) + 1 ms slack
+        rows[2]["t"] = rows[1]["t"] - 0.001
+        _write(tmp_path / "r0", rows)
+        _write(tmp_path / "r1", _replica1_rows())
+        s = obs_report.summarize_fleet(
+            [str(tmp_path / d) for d in ("router", "r0", "r1")])
+        assert s["out_of_order"] == []
+
+    def test_missing_replica_log_degrades_to_router_spine(
+            self, tmp_path):
+        """A replica whose log is gone entirely (child died before
+        flushing, disk lost): its hops reconstruct from the router's
+        dispatch/migration rows alone, flagged salvaged-only, and the
+        report names the missing replica."""
+        obs_report = _load_tool("obs_report")
+        rows = _router_rows() + [
+            {"event": "fleet_dispatch", "uid": 6, "trace_id": "t-2",
+             "hop": 0, "replica": 2, "route_ms": 0.1, "t": 102.0},
+        ]
+        _write(tmp_path / "router", rows)
+        _write(tmp_path / "r0", _replica0_rows())
+        _write(tmp_path / "r1", _replica1_rows())
+        s = obs_report.summarize_fleet(
+            [str(tmp_path / d) for d in ("router", "r0", "r1")])
+        assert s["missing_replica_logs"] == [2]
+        lost = next(r for r in s["requests"]
+                    if r["trace_id"] == "t-2")
+        assert lost["hops"] == []             # no replica rows at all
+        assert "hop0_salvaged_only" in lost["flags"]
+        assert lost["route_ms"] == 0.1        # the spine survives
+        text = obs_report.render_fleet(s)
+        assert "missing replica logs" in text
+
+    def test_no_router_log_is_an_error(self, tmp_path):
+        obs_report = _load_tool("obs_report")
+        _write(tmp_path / "r0", _replica0_rows())
+        with pytest.raises(ValueError, match="router"):
+            obs_report.summarize_fleet([str(tmp_path / "r0")])
+
+    def test_chrome_trace_has_one_lane_per_replica(self, tmp_path):
+        obs_report = _load_tool("obs_report")
+        _write(tmp_path / "router", _router_rows())
+        _write(tmp_path / "r0", _replica0_rows())
+        _write(tmp_path / "r1", _replica1_rows())
+        s = obs_report.summarize_fleet(
+            [str(tmp_path / d) for d in ("router", "r0", "r1")])
+        out = str(tmp_path / "trace.json")
+        obs_report.write_fleet_trace(s, out)
+        trace = json.load(open(out))
+        meta = {e["args"]["name"]: e["pid"]
+                for e in trace["traceEvents"] if e.get("ph") == "M"}
+        assert meta["router"] == 0
+        assert meta["replica 0"] == 1 and meta["replica 1"] == 2
+        xs = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+        assert xs and all(e["dur"] >= 0 for e in xs)
+
+
+# ===================================================================== #
+# --diff covers the quantized-serving tags (ISSUE 18 satellite)
+# ===================================================================== #
+
+class TestDiffQuantMetrics:
+    def _run_dir(self, tmp_path, name, qerr, kv_bpt):
+        d = tmp_path / name
+        _write(d, [])
+        with open(os.path.join(d, "events.jsonl"), "a") as f:
+            f.write(json.dumps({"tag": "Serve/quant_logit_err",
+                                "value": qerr, "step": 0}) + "\n")
+            f.write(json.dumps({"tag": "Serve/kv_pool_bytes_per_token",
+                                "value": kv_bpt, "step": 0}) + "\n")
+        return str(d)
+
+    def test_metrics_registered_with_correct_directions(self):
+        obs_report = _load_tool("obs_report")
+        by_name = {m[0]: m for m in obs_report.DIFF_METRICS}
+        assert by_name["quant_logit_err"][2] == "lower"
+        assert by_name["kv_pool_bytes_per_token"][2] == "counter"
+
+    def test_quant_regressions_fail_the_diff(self, tmp_path):
+        obs_report = _load_tool("obs_report")
+        a = self._run_dir(tmp_path, "a", qerr=0.05, kv_bpt=100.0)
+        b = self._run_dir(tmp_path, "b", qerr=0.20, kv_bpt=104.0)
+        d = obs_report.diff_runs(a, b)
+        assert "quant_logit_err" in d["regressed"]
+        assert "kv_pool_bytes_per_token" in d["regressed"]
+        assert d["verdict"] == "REGRESSED"
+        # and the CLI exits nonzero on it
+        assert obs_report.main(["--diff", a, b]) == 1
+
+    def test_quant_improvements_pass(self, tmp_path):
+        obs_report = _load_tool("obs_report")
+        a = self._run_dir(tmp_path, "a", qerr=0.20, kv_bpt=104.0)
+        b = self._run_dir(tmp_path, "b", qerr=0.05, kv_bpt=100.0)
+        d = obs_report.diff_runs(a, b)
+        by_name = {m["metric"]: m for m in d["metrics"]}
+        assert by_name["quant_logit_err"]["verdict"] == "IMPROVED"
+        assert by_name["kv_pool_bytes_per_token"]["verdict"] == \
+            "IMPROVED"
+        assert d["verdict"] == "OK"

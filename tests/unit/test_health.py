@@ -183,6 +183,26 @@ def test_watchdog_trips_in_warn_mode_and_rearms():
         wd.stop()
 
 
+def test_watchdog_trip_names_awaited_replica():
+    """The router beats ``rpc_call`` with ``detail="replica N"``
+    before every blocking wait — a trip during a hung RPC must carry
+    that detail so the postmortem names WHICH replica was awaited."""
+    trips = []
+    wd = Watchdog(0.15, on_stall="warn",
+                  on_trip=lambda **kw: trips.append(kw))
+    wd.start()
+    try:
+        wd.beat("rpc_call", detail="replica 2")
+        deadline = time.monotonic() + 3.0
+        while not trips and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert trips, "watchdog never tripped"
+        assert trips[0]["phase"] == "rpc_call"
+        assert trips[0]["detail"] == "replica 2"
+    finally:
+        wd.stop()
+
+
 def test_watchdog_heartbeats_prevent_trip():
     trips = []
     wd = Watchdog(0.25, on_stall="warn",
@@ -217,7 +237,8 @@ def test_heartbeat_phase_vocabulary_pinned(tmp_path):
     pinned and unknown phases raise even on an ENABLED plane."""
     assert HEALTH_PHASES == (
         "train_batch", "prefill", "decode", "handoff_claim",
-        "checkpoint_commit", "fleet_step", "bench_metric")
+        "checkpoint_commit", "fleet_step", "bench_metric",
+        "rpc_call")
     hp = HealthPlane({"enabled": True, "stall_timeout_s": 60.0},
                      events_dir=str(tmp_path))
     try:

@@ -78,6 +78,13 @@ TRAIL_SCHEMA = {
                      "tbt_ms", "tbt_ms_max", "slo_ok"},
     "serve_evict": {"uid", "reason", "new_tokens", "ttft_ms",
                     "latency_ms"},
+    # fleet tracing (ISSUE 18): migration lineage rows — emitted by
+    # the source at export and the destination at import, sharing the
+    # request's trace id so the merged timeline survives replica death
+    "serve_migrate_out": {"uid", "position", "pages", "nbytes",
+                          "reason"},
+    "serve_migrate_in": {"uid", "position", "pages", "nbytes",
+                         "resumed_tokens"},
 }
 TRAIL_KINDS = set(TRAIL_SCHEMA)
 
@@ -459,6 +466,23 @@ class TestLifecycleTrail:
         assert {"serve_submit", "serve_defer", "serve_admit",
                 "serve_prefill", "serve_first_token",
                 "serve_decode_window", "serve_finish"} <= seen
+
+    def test_no_schema_drift_every_tracer_kind_is_renderable(self):
+        """Structural version of the PR 13 ``serve_handoff`` near-miss:
+        every event kind the tracer can emit must (a) have a pinned
+        TRAIL_SCHEMA entry and (b) have a fold handler in the
+        obs_report fleet merger — a new trail row that the merged
+        report would silently drop fails here, not in production."""
+        from deepspeed_tpu.inference.tracing import ServeTracer
+        obs_report = _load_tool("obs_report")
+        kinds = set(ServeTracer.EVENT_KINDS)
+        assert kinds == set(TRAIL_SCHEMA), (
+            "tracer kinds and TRAIL_SCHEMA diverged",
+            kinds ^ set(TRAIL_SCHEMA))
+        unrendered = kinds - set(obs_report.EVENT_HANDLERS)
+        assert not unrendered, (
+            "tracer kinds with no obs_report fleet handler",
+            unrendered)
 
     def test_defer_reasons_pinned_and_exercised(self, trail_run):
         from deepspeed_tpu.inference.tracing import DEFER_REASONS

@@ -34,11 +34,21 @@ p50/p95, samples/s, MFU, goodput, recompiles, health alerts/stalls)
 with threshold-based REGRESSED / IMPROVED / OK verdicts and exits
 nonzero on any regression — the bench-trajectory regression gate.
 
+``--fleet DIR [DIR ...]`` merges one fleet run's router log plus its
+replica logs into per-request end-to-end timelines: replica rows are
+moved onto the router's clock via the recorded ``clock_sync`` offsets,
+each request's hops (router dispatch -> rpc wire -> replica queue ->
+prefill -> decode, with any live migrations in between) are stitched
+by trace id, and ``--trace-out`` writes the merged Chrome trace with
+one process lane per replica.
+
 Usage::
 
     python tools/obs_report.py <events.jsonl | dir> [--json] [--serve]
                                [--health]
     python tools/obs_report.py --diff RUN_A RUN_B [--json]
+    python tools/obs_report.py --fleet DIR [DIR ...] [--json]
+                               [--trace-out trace.json]
 
 Rotated event logs (``observability.events_max_mb``) are read as one
 stream: ``events.jsonl.1``, ``.2``, ... in sequence order, then the
@@ -1149,6 +1159,431 @@ def render_diff(d):
     return "\n".join(lines)
 
 
+# ------------------------------------------------------------------- #
+# fleet-wide merged tracing (--fleet DIR [DIR ...])
+# ------------------------------------------------------------------- #
+
+# --fleet JSON schema version (independent of SCHEMA_VERSION: the
+# per-run report and the merged-fleet view evolve separately)
+FLEET_SCHEMA_VERSION = 1
+
+# aligned timestamps may legitimately disagree by the clock-sync
+# uncertainty plus a little scheduling noise; reordering beyond
+# combined uncertainty + this slack is flagged as a real anomaly
+OUT_OF_ORDER_SLACK_MS = 1.0
+
+
+def _fold_finish(hop, row):
+    hop["finish"] = {k: row.get(k) for k in (
+        "reason", "new_tokens", "ttft_ms", "latency_ms",
+        "queue_wait_ms", "prefill_ms", "tbt_ms", "tbt_ms_max",
+        "slo_ok")}
+    hop["t_finish"] = row.get("_t_aligned")
+
+
+def _fold_decode(hop, row):
+    hop["decode_tokens"] = hop.get("decode_tokens", 0) + \
+        int(row.get("tokens") or 0)
+    hop["tbt_ms"] = row.get("tbt_ms")
+
+
+def _fold_spec(hop, row):
+    hop["spec_proposed"] = hop.get("spec_proposed", 0) + \
+        int(row.get("proposed") or 0)
+    hop["spec_accepted"] = hop.get("spec_accepted", 0) + \
+        int(row.get("accepted") or 0)
+
+
+# every serve-plane event kind the tracer can emit, and how the fleet
+# merger folds it into a per-(trace, hop) record. The schema-drift
+# test (tests/unit/test_serve_trace.py) walks ServeTracer.EVENT_KINDS
+# and asserts each has a handler here AND a TRAIL_SCHEMA entry — a new
+# tracer event that the merged report would silently drop fails CI.
+EVENT_HANDLERS = {
+    "serve_submit": lambda hop, row: hop.update(
+        t_submit=row.get("_t_aligned"),
+        prompt_tokens=row.get("prompt_tokens")),
+    "serve_defer": lambda hop, row: hop.update(
+        defers=hop.get("defers", 0) + 1),
+    "serve_prefix_hit": lambda hop, row: hop.update(
+        prefix_tokens=row.get("tokens")),
+    "serve_admit": lambda hop, row: hop.update(
+        queue_wait_ms=row.get("queue_wait_ms"),
+        slot=row.get("slot")),
+    "serve_prefill": lambda hop, row: hop.update(
+        prefill_wall_ms=row.get("wall_ms")),
+    "serve_handoff": lambda hop, row: hop.update(
+        handoff_ms=row.get("handoff_ms")),
+    "serve_spec_window": _fold_spec,
+    "serve_first_token": lambda hop, row: hop.update(
+        ttft_ms=row.get("ttft_ms"), prefill_ms=row.get("prefill_ms"),
+        t_first_token=row.get("_t_aligned")),
+    "serve_decode_window": _fold_decode,
+    "serve_finish": _fold_finish,
+    "serve_evict": lambda hop, row: hop.update(
+        evict_reason=row.get("reason"),
+        t_evict=row.get("_t_aligned")),
+    "serve_migrate_out": lambda hop, row: hop.update(
+        migrate_out={"position": row.get("position"),
+                     "pages": row.get("pages"),
+                     "nbytes": row.get("nbytes"),
+                     "reason": row.get("reason"),
+                     "t": row.get("_t_aligned")}),
+    "serve_migrate_in": lambda hop, row: hop.update(
+        migrate_in={"position": row.get("position"),
+                    "pages": row.get("pages"),
+                    "resumed_tokens": row.get("resumed_tokens"),
+                    "t": row.get("_t_aligned")}),
+}
+
+
+def _load_fleet_logs(dirs):
+    """Load every log, classify router vs replica. The router log is
+    the one carrying ``fleet_dispatch``/``fleet_state``/``clock_sync``
+    rows; replica logs are attributed by the ``replica_id`` field the
+    tracer stamps on every row (never by directory name)."""
+    logs = []
+    for d in dirs:
+        path = find_events_file(d)
+        _scalars, events = load_events(path)
+        logs.append({"dir": d, "path": path, "events": events})
+    router = None
+    for lg in logs:
+        if any(r.get("event") in ("fleet_dispatch", "fleet_state",
+                                  "clock_sync") for r in lg["events"]):
+            router = lg
+            break
+    if router is None:
+        raise ValueError(
+            "no router log among the given dirs (need fleet_dispatch/"
+            "fleet_state/clock_sync rows)")
+    return logs, router
+
+
+def _clock_offsets(router_events):
+    """replica -> latest clock_sync estimate (seconds). Latest wins:
+    offsets drift, and the router re-syncs periodically and after
+    every relaunch."""
+    offsets = {}
+    for r in router_events:
+        if r.get("event") == "clock_sync":
+            offsets[int(r["replica"])] = {
+                "offset_s": float(r.get("offset_ms") or 0.0) / 1e3,
+                "uncertainty_s":
+                    float(r.get("uncertainty_ms") or 0.0) / 1e3,
+            }
+    return offsets
+
+
+def summarize_fleet(dirs):
+    """Merge one router log + N replica logs into per-request
+    end-to-end timelines. Replica timestamps are moved onto the
+    router's clock via the ``clock_sync`` offsets (aligned t =
+    t_row - offset); lifecycle order is NEVER resorted by timestamp —
+    apparent reordering beyond the sync uncertainty is flagged in
+    ``out_of_order`` instead of silently mis-ordered."""
+    logs, router = _load_fleet_logs(dirs)
+    offsets = _clock_offsets(router["events"])
+
+    traces = {}
+
+    def trace(tid):
+        return traces.setdefault(tid, {
+            "trace_id": tid, "uid": None, "hops": {},
+            "dispatches": {}, "migrations": [], "flags": []})
+
+    def hop_rec(tid, h, replica):
+        t = trace(tid)
+        return t["hops"].setdefault(int(h), {"hop": int(h),
+                                             "replica": replica})
+
+    # router spine: dispatches + migrations (router-clock timestamps
+    # are already the reference frame — no alignment)
+    for row in router["events"]:
+        ev = row.get("event")
+        tid = row.get("trace_id")
+        if ev == "fleet_dispatch" and tid is not None:
+            t = trace(tid)
+            t["uid"] = row.get("uid")
+            t["dispatches"][int(row.get("hop") or 0)] = {
+                "replica": row.get("replica"),
+                "route_ms": row.get("route_ms"),
+                "t": row.get("t"),
+            }
+        elif ev == "serve_migration" and tid is not None:
+            trace(tid)["migrations"].append({
+                "src": row.get("src"), "dst": row.get("dst"),
+                "pages": row.get("pages"), "nbytes": row.get("nbytes"),
+                "transfer_ms": row.get("transfer_ms"),
+                "priced_ms": row.get("priced_ms"), "t": row.get("t")})
+
+    # replica rows: align, fold, and check ordering per (log, trace)
+    out_of_order = []
+    replicas_seen = set()
+    for lg in logs:
+        last_by_trace = {}
+        for row in lg["events"]:
+            ev = row.get("event")
+            tid = row.get("trace_id")
+            if ev not in EVENT_HANDLERS or tid is None:
+                continue
+            rid = row.get("replica_id")
+            if rid is not None:
+                replicas_seen.add(int(rid))
+            off = offsets.get(rid, {})
+            t_raw = row.get("t")
+            unc_s = off.get("uncertainty_s", 0.0)
+            row = dict(row)
+            row["_t_aligned"] = (
+                t_raw - off.get("offset_s", 0.0)
+                if t_raw is not None else None)
+            h = hop_rec(tid, row.get("hop") or 0, rid)
+            EVENT_HANDLERS[ev](h, row)
+            if trace(tid)["uid"] is None:
+                trace(tid)["uid"] = row.get("uid")
+            # ordering check: within one log's file order (the true
+            # lifecycle order on that replica), aligned time must not
+            # run backwards by more than the sync uncertainty
+            prev = last_by_trace.get(tid)
+            if prev is not None and row["_t_aligned"] is not None:
+                prev_t, prev_unc, prev_ev = prev
+                skew_ms = (prev_t - row["_t_aligned"]) * 1e3
+                bound_ms = (prev_unc + unc_s) * 1e3 + \
+                    OUT_OF_ORDER_SLACK_MS
+                if skew_ms > bound_ms:
+                    out_of_order.append({
+                        "trace_id": tid, "event": ev,
+                        "after": prev_ev,
+                        "skew_ms": round(skew_ms, 3),
+                        "bound_ms": round(bound_ms, 3),
+                        "log": lg["path"]})
+            if row["_t_aligned"] is not None:
+                last_by_trace[tid] = (row["_t_aligned"], unc_s, ev)
+
+    # per-trace assembly: decomposition + lineage flags
+    requests = []
+    for tid in sorted(traces):
+        t = traces[tid]
+        hops = [t["hops"][h] for h in sorted(t["hops"])]
+        final = next((h for h in reversed(hops) if "finish" in h), None)
+        fin = (final or {}).get("finish") or {}
+        d0 = t["dispatches"].get(0) or {}
+        first_hop = hops[0] if hops else {}
+        rpc_wire_ms = None
+        if d0.get("t") is not None and \
+                first_hop.get("t_submit") is not None:
+            rpc_wire_ms = max(
+                0.0, (first_hop["t_submit"] - d0["t"]) * 1e3)
+        ttft = fin.get("ttft_ms")
+        latency = fin.get("latency_ms")
+        decode_ms = (latency - ttft if latency is not None
+                     and ttft is not None else None)
+        # the pinned TTFT identity (tracing.py): queue_wait + prefill
+        # (+ handoff) == ttft; decode = latency - ttft. A finish row
+        # violating it is a tracer bug, not noise — flag it.
+        decomp_ok = None
+        if ttft is not None and fin.get("queue_wait_ms") is not None \
+                and fin.get("prefill_ms") is not None:
+            handoff = next((h.get("handoff_ms") for h in hops
+                            if h.get("handoff_ms") is not None), 0.0)
+            # the tracer rounds each term to 3 decimals independently,
+            # so the sum may differ from ttft by up to 0.5e-3 per term
+            decomp_ok = abs(fin["queue_wait_ms"] + fin["prefill_ms"]
+                            + (handoff or 0.0) - ttft) < 2e-3
+            if not decomp_ok:
+                t["flags"].append("decomp_mismatch")
+        # lineage: every hop past 0 must pair a migrate_out on the
+        # source with a migrate_in on the destination. A hop whose
+        # replica wrote no rows at all (child died before flushing,
+        # log lost) is salvaged-only: the router's dispatch/migration
+        # spine still reconstructs the path.
+        for h in hops:
+            if h["hop"] > 0 and "migrate_in" not in h:
+                t["flags"].append(f"hop{h['hop']}_no_migrate_in")
+        for dh, disp in t["dispatches"].items():
+            if dh not in t["hops"] and disp.get("replica") is not None:
+                t["flags"].append(f"hop{dh}_salvaged_only")
+        requests.append({
+            "trace_id": tid, "uid": t["uid"],
+            "hops": hops, "migrations": t["migrations"],
+            "path": [h.get("replica") for h in hops],
+            "route_ms": d0.get("route_ms"),
+            "rpc_wire_ms": (round(rpc_wire_ms, 3)
+                            if rpc_wire_ms is not None else None),
+            "replica_queue_ms": fin.get("queue_wait_ms"),
+            "prefill_ms": fin.get("prefill_ms"),
+            "decode_ms": (round(decode_ms, 3)
+                          if decode_ms is not None else None),
+            "migration_ms": round(sum(
+                m.get("transfer_ms") or 0.0
+                for m in t["migrations"]), 3),
+            "migration_priced_ms": round(sum(
+                m.get("priced_ms") or 0.0
+                for m in t["migrations"]), 4),
+            "ttft_ms": ttft, "latency_ms": latency,
+            "slo_ok": fin.get("slo_ok"),
+            "new_tokens": fin.get("new_tokens"),
+            "finish_reason": fin.get("reason"),
+            "decomp_exact": decomp_ok,
+            "flags": t["flags"],
+        })
+
+    finished = [r for r in requests if r["latency_ms"] is not None]
+    lat = [r["latency_ms"] for r in finished]
+    ttfts = [r["ttft_ms"] for r in finished
+             if r["ttft_ms"] is not None]
+    slo_known = [r for r in finished if r["slo_ok"] is not None]
+    migrated = [r for r in requests if r["migrations"]]
+    # replica ids the router dispatched to but that wrote no rows in
+    # ANY provided log — the whole log is missing, not just a hop
+    dispatched_to = {d.get("replica")
+                     for t in traces.values()
+                     for d in t["dispatches"].values()
+                     if d.get("replica") is not None}
+    missing = sorted(int(r) for r in dispatched_to
+                     if int(r) not in replicas_seen)
+    return {
+        "fleet_schema": FLEET_SCHEMA_VERSION,
+        "router_log": router["path"],
+        "logs": [lg["path"] for lg in logs],
+        "clock_offsets": {
+            str(k): {"offset_ms": round(v["offset_s"] * 1e3, 4),
+                     "uncertainty_ms":
+                         round(v["uncertainty_s"] * 1e3, 4)}
+            for k, v in sorted(offsets.items())},
+        "requests": requests,
+        "rollup": {
+            "traces": len(requests),
+            "finished": len(finished),
+            "migrated": len(migrated),
+            "latency_ms": {"p50": percentile(lat, 0.5),
+                           "p95": percentile(lat, 0.95)},
+            "ttft_ms": {"p50": percentile(ttfts, 0.5),
+                        "p95": percentile(ttfts, 0.95)},
+            "slo_attainment": (
+                sum(1 for r in slo_known if r["slo_ok"])
+                / len(slo_known) if slo_known else None),
+            "goodput_tokens": sum(
+                r["new_tokens"] or 0 for r in slo_known
+                if r["slo_ok"]),
+        },
+        "out_of_order": out_of_order,
+        "missing_replica_logs": missing,
+    }
+
+
+def write_fleet_trace(s, out_path):
+    """Chrome trace (chrome://tracing / Perfetto) of the merged fleet:
+    one process lane per replica (pid = replica + 1; the router is
+    pid 0), one thread per request uid, complete spans for the
+    queue/prefill/decode phases on whichever replica hosted them."""
+    spans = []
+    pids = {None: 0}
+
+    def pid(replica):
+        return 0 if replica is None else int(replica) + 1
+
+    spans.append({"ph": "M", "pid": 0, "name": "process_name",
+                  "args": {"name": "router"}})
+    t0 = None
+    for r in s["requests"]:
+        for h in r["hops"]:
+            for key in ("t_submit", "t_first_token", "t_finish"):
+                if h.get(key) is not None:
+                    t0 = h[key] if t0 is None else min(t0, h[key])
+    if t0 is None:
+        t0 = 0.0
+
+    def us(t):
+        return round((t - t0) * 1e6, 1)
+
+    for r in s["requests"]:
+        tid = r["trace_id"]
+        for h in r["hops"]:
+            p = pid(h.get("replica"))
+            if p not in pids.values():
+                spans.append({"ph": "M", "pid": p,
+                              "name": "process_name",
+                              "args": {"name":
+                                       f"replica {h.get('replica')}"}})
+                pids[h.get("replica")] = p
+            base = {"pid": p, "tid": r["uid"],
+                    "args": {"trace_id": tid, "hop": h["hop"]}}
+            if h.get("t_submit") is not None and \
+                    h.get("queue_wait_ms") is not None:
+                spans.append({**base, "ph": "X", "name": "queue",
+                              "ts": us(h["t_submit"]),
+                              "dur": h["queue_wait_ms"] * 1e3})
+            if h.get("t_first_token") is not None and \
+                    h.get("prefill_ms") is not None:
+                spans.append({
+                    **base, "ph": "X", "name": "prefill",
+                    "ts": us(h["t_first_token"]
+                             - h["prefill_ms"] / 1e3),
+                    "dur": h["prefill_ms"] * 1e3})
+            t_end = h.get("t_finish")
+            t_start = h.get("t_first_token", h.get("t_submit"))
+            if t_end is not None and t_start is not None:
+                spans.append({**base, "ph": "X", "name": "decode",
+                              "ts": us(t_start),
+                              "dur": max(0.0,
+                                         (t_end - t_start) * 1e6)})
+    with open(out_path, "w") as f:
+        json.dump({"traceEvents": spans,
+                   "displayTimeUnit": "ms"}, f)
+
+
+def render_fleet(s):
+    lines = [f"fleet report: {len(s['logs'])} logs "
+             f"(router: {s['router_log']})"]
+    if s["clock_offsets"]:
+        lines.append("clock offsets (vs router):")
+        for rid, o in s["clock_offsets"].items():
+            lines.append(
+                f"  replica {rid}: {o['offset_ms']:+.3f} ms "
+                f"(± {o['uncertainty_ms']:.3f} ms)")
+    ru = s["rollup"]
+    lines.append(
+        f"requests: {ru['traces']} traced, {ru['finished']} finished, "
+        f"{ru['migrated']} migrated")
+    lines.append(
+        f"  latency p50/p95: {_fmt(ru['latency_ms']['p50'])} / "
+        f"{_fmt(ru['latency_ms']['p95'])} ms   ttft p50/p95: "
+        f"{_fmt(ru['ttft_ms']['p50'])} / "
+        f"{_fmt(ru['ttft_ms']['p95'])} ms")
+    att = ru["slo_attainment"]
+    lines.append(
+        f"  SLO attainment: "
+        f"{_fmt(att * 100 if att is not None else None, '{:.1f}')}%   "
+        f"goodput tokens: {ru['goodput_tokens']}")
+    for r in s["requests"]:
+        path = "->".join(str(p) for p in r["path"])
+        lines.append(
+            f"  {r['trace_id']} uid={r['uid']} path=[{path}] "
+            f"route={_fmt(r['route_ms'], '{:.3f}')} "
+            f"wire={_fmt(r['rpc_wire_ms'], '{:.3f}')} "
+            f"queue={_fmt(r['replica_queue_ms'], '{:.3f}')} "
+            f"prefill={_fmt(r['prefill_ms'], '{:.3f}')} "
+            f"decode={_fmt(r['decode_ms'], '{:.3f}')} "
+            f"migrate={_fmt(r['migration_ms'], '{:.3f}')} ms "
+            f"-> {r['finish_reason'] or '?'}"
+            + (f"  FLAGS: {','.join(r['flags'])}" if r["flags"]
+               else ""))
+    if s["out_of_order"]:
+        lines.append(f"out-of-order events (beyond clock-sync "
+                     f"uncertainty): {len(s['out_of_order'])}")
+        for o in s["out_of_order"][:10]:
+            lines.append(
+                f"  {o['trace_id']}: {o['event']} after {o['after']} "
+                f"(skew {o['skew_ms']} ms > bound {o['bound_ms']} ms)")
+    if s["missing_replica_logs"]:
+        lines.append(
+            "missing replica logs (router dispatched there, no rows "
+            f"found): {s['missing_replica_logs']} — those hops are "
+            "reconstructed from the router spine only")
+    return "\n".join(lines)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("path", nargs="?",
@@ -1169,11 +1604,30 @@ def main(argv=None):
                     help="compare two runs' event logs (A = baseline, "
                          "B = candidate); exits 1 when any metric "
                          "REGRESSED past its threshold")
+    ap.add_argument("--fleet", nargs="+", metavar="DIR",
+                    help="merge one router log + N replica logs into "
+                         "per-request end-to-end timelines (clock-"
+                         "aligned via the router's clock_sync rows)")
+    ap.add_argument("--trace-out", metavar="PATH",
+                    help="with --fleet: also write a merged Chrome "
+                         "trace (one process lane per replica) to "
+                         "PATH")
     ap.add_argument("--host-gap-threshold", type=float,
                     default=DEFAULT_HOST_GAP_THRESHOLD,
                     help="flag the run when host-gap p50 exceeds this "
                          "fraction of step-time p50 (default %(default)s)")
     args = ap.parse_args(argv)
+    if args.fleet:
+        try:
+            s = summarize_fleet(args.fleet)
+        except (FileNotFoundError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        if args.trace_out:
+            write_fleet_trace(s, args.trace_out)
+        print(json.dumps(s, indent=2) if args.json
+              else render_fleet(s))
+        return 0
     if args.diff:
         try:
             d = diff_runs(args.diff[0], args.diff[1])
