@@ -16,7 +16,7 @@ from typing import List, Sequence, Tuple
 import numpy as np
 
 __all__ = ["pick_bucket", "validate_buckets", "pad_prompts",
-           "warmup_plan"]
+           "warmup_plan", "chunk_warmup_plan"]
 
 
 def validate_buckets(buckets: Sequence[int], name: str) -> Tuple[int, ...]:
@@ -70,3 +70,17 @@ def warmup_plan(batch_buckets: Sequence[int],
     """Every (batch_bucket, prompt_bucket) pair the steady state can
     dispatch — the warmup compile set."""
     return [(int(b), int(s)) for b in batch_buckets for s in prompt_buckets]
+
+
+def chunk_warmup_plan(batch_buckets: Sequence[int],
+                      chunk_tokens: int) -> List[Tuple[int, int]]:
+    """The chunked-prefill warmup compile set: one (batch_bucket,
+    chunk_tokens) shape per batch bucket. This is the ladder collapse —
+    chunked prefill replaces the ``len(batch_buckets) ×
+    len(prompt_buckets)`` prompt-bucket grid with a single token width,
+    so prompt length stops being a compile axis entirely (any length up
+    to max_seq_len is a row count of chunk dispatches, not a new
+    program)."""
+    if chunk_tokens <= 0:
+        return []
+    return [(int(b), int(chunk_tokens)) for b in batch_buckets]

@@ -233,12 +233,14 @@ class MigrationRecord:
 class DispatchTrace:
     """The interleaving trace of device dispatches under disaggregated
     serving: (step, kind) per dispatch, kind in {"decode", "verify",
-    "prefill", "handoff"}. The structural serving guarantee — no decode
-    dispatch ever waits behind a prefill dispatch — is checkable as
-    pure ordering: within every step, all decode/verify ordinals
-    precede all prefill ordinals (the engine's disagg step runs its
-    decode phase first). Bounded (ring of ``cap`` entries) so a serving
-    daemon can leave it on."""
+    "prefill", "handoff", "chunk"}. The structural serving guarantee —
+    no decode dispatch ever waits behind a prefill dispatch — is
+    checkable as pure ordering: within every step, all decode/verify
+    ordinals precede all prefill ordinals (the engine's disagg step
+    runs its decode phase first; chunked prefill slips its at-most-one
+    "chunk" dispatch between them, after every decode of the step).
+    Bounded (ring of ``cap`` entries) so a serving daemon can leave it
+    on."""
 
     DECODE_KINDS = ("decode", "verify", "handoff")
 

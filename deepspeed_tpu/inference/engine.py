@@ -91,8 +91,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from deepspeed_tpu.inference.buckets import (pad_prompts, pick_bucket,
-                                             warmup_plan)
+from deepspeed_tpu.inference.buckets import (chunk_warmup_plan, pad_prompts,
+                                             pick_bucket, warmup_plan)
 from deepspeed_tpu.inference.disagg import (DispatchTrace, HandoffQueue,
                                             HandoffRecord, HandoffStats,
                                             MigrationRecord,
@@ -290,6 +290,25 @@ class InferenceEngine:
         self._vocab = model_config.vocab_size
         self._top_k = min(cfg["top_k"], self._vocab)
 
+        # ------------------------------------------ chunked prefill
+        # a long prompt becomes k fixed-size chunk dispatches that
+        # interleave with the decode cadence: chunk state is just
+        # cache_position advancing over pages the request already
+        # owns, and the chunk program IS the prefill program at ids
+        # shape (batch_bucket, chunk_tokens). Prompts longer than the
+        # largest prompt bucket can only be served this way.
+        ck = cfg["chunked_prefill"]
+        self.chunked = bool(ck["enabled"])
+        self._chunk_tokens = min(int(ck["chunk_tokens"]), max_len) \
+            if self.chunked else 0
+        self._cp_threshold = int(ck["cp_threshold_tokens"]) \
+            if self.chunked else 0
+        self._cp_shards = 1           # >1 = context-parallel chunks
+        self._cp_reason = "chunked prefill off" if not self.chunked \
+            else "cp_threshold_tokens unset"
+        self._chunk_cp = None
+        self._chunk_dispatches = 0
+
         # ---------------------------------------------- serving mesh
         self.mesh = _serving_mesh(cfg, mesh)
 
@@ -387,7 +406,11 @@ class InferenceEngine:
                 self.params, self._param_shardings_decode)
         self._handoff_q = HandoffQueue() if self.disagg else None
         self._handoff_stats = HandoffStats() if self.disagg else None
-        self._dispatch_trace = DispatchTrace() if self.disagg else None
+        # chunked engines keep the trace too: the TBT bound is the pure
+        # ordering pin "at most one chunk dispatch per step, after every
+        # decode of that step" (bench chunked_prefill_tbt checks it)
+        self._dispatch_trace = DispatchTrace() \
+            if (self.disagg or self.chunked) else None
         self._link = None
         if self._separate_pools:
             from deepspeed_tpu.runtime.comm_autotune import LinkModel
@@ -480,7 +503,12 @@ class InferenceEngine:
                 # pinned by disagg.prefill_pages. The prefix cache
                 # lives HERE — sharing is a prefill-side concern and
                 # ends at the handoff (the migrated copy is private)
-                max_prompt = max(cfg["prompt_buckets"])
+                # chunked prefill holds WHOLE long prompts on the
+                # prefill side until the final chunk hands off, so the
+                # pool (and the handoff slab width) is sized by max_len
+                # rather than the largest prompt bucket
+                max_prompt = max_len if self.chunked \
+                    else max(cfg["prompt_buckets"])
                 ppages = dg["prefill_pages"] or (
                     self.num_slots * pages_for(max_prompt, ps) + 1)
                 self.paged_spec_prefill = paged_spec_for(
@@ -529,7 +557,8 @@ class InferenceEngine:
                                    tracer=self._tracer,
                                    admit_allocator=admit_allocator,
                                    drafter=self._drafter,
-                                   spec_k=self._spec_k)
+                                   spec_k=self._spec_k,
+                                   chunk_tokens=self._chunk_tokens)
         # serving-weights version stamp: "initial" for constructor
         # params; from_checkpoint / swap_params overwrite it with the
         # checkpoint tag. The ordinal counts committed swaps (the
@@ -556,6 +585,8 @@ class InferenceEngine:
                     cache_sharding=self._cache_sharding_decode)
             if self._separate_pools:
                 self._wrap_handoff_programs()
+            if self.chunked:
+                self._resolve_context_parallel()
             geom = (f"paged KV cache: {self.paged_spec.num_pages} pages "
                     f"x {self.paged_spec.page_size} tokens "
                     f"({cache_bytes / 2**20:.1f} MiB, "
@@ -653,6 +684,50 @@ class InferenceEngine:
         pps = self.paged_spec.pages_per_seq
         widths = [int(b) for b in pk["decode_page_buckets"] if b < pps]
         self._decode_page_buckets = tuple(widths) + (pps,)
+
+    def _resolve_context_parallel(self):
+        """Decide once, at init, whether chunk dispatches for prompts
+        past ``cp_threshold_tokens`` run context-parallel: the chunk's
+        sequence axis ring-sharded over the serving mesh's model axis
+        (``ops/attention/ring.ring_prefill_attention`` — forward-only
+        online-softmax merge, K/V stripes rotating via ppermute). Any
+        ineligibility falls back to single-shard chunks with the reason
+        logged — the fallback matrix in docs/inference.md. The CP chunk
+        program is a SECOND compiled program (``chunk_cp``) so
+        sub-threshold chunks keep the plain prefill program and the
+        compiled set stays fixed."""
+        if self._cp_threshold <= 0:
+            return
+        stripe = self._prefill_pps * self.paged_spec.page_size
+        if self.mesh is None:
+            self._cp_reason = "no serving mesh (inference.mesh unset)"
+        else:
+            n = axis_size(self.mesh, "model")
+            if n <= 1:
+                self._cp_reason = "mesh model axis is size 1"
+            elif self._chunk_tokens % n:
+                self._cp_reason = (
+                    f"chunk_tokens ({self._chunk_tokens}) not divisible "
+                    f"by mesh model axis ({n})")
+            elif stripe % n:
+                self._cp_reason = (
+                    f"kv stripe ({stripe} tokens) not divisible by "
+                    f"mesh model axis ({n})")
+            else:
+                self._cp_shards = n
+                self._cp_reason = (
+                    f"ring prefill over mesh axis 'model' ({n}-way)")
+                self._chunk_cp = self._wrap_program(
+                    self._chunk_cp_impl, 8, "chunk_cp")
+        logger.info(
+            f"inference context-parallel prefill: "
+            f"{'on' if self._cp_shards > 1 else 'off'} "
+            f"({self._cp_reason}; threshold {self._cp_threshold} tokens)")
+        if self._log is not None:
+            self._log.add_event(
+                "chunked_prefill_path", chunk_tokens=self._chunk_tokens,
+                cp_shards=self._cp_shards, cp_reason=self._cp_reason,
+                cp_threshold_tokens=self._cp_threshold)
 
     def _wrap_program(self, fn, nargs: int, name: str, mesh="__self__",
                       param_shardings=None, cache_sharding=None):
@@ -802,6 +877,23 @@ class InferenceEngine:
                                                   positions + lengths)
         first = self._sample_tokens(last, first_keys, temps)
         return first, cache
+
+    def _chunk_cp_impl(self, params, cache, ids, lengths, positions,
+                       tables, keys, temps):
+        """The context-parallel chunk program: the SAME paged prefill
+        body traced under the ``context_prefill_mesh`` context, so the
+        models' q_len>1 gather attention routes through
+        ``ring_prefill_attention`` — queries sequence-sharded over the
+        mesh's model axis, K/V stripes rotating via ppermute, partials
+        merged with the exact online-softmax combine. Everything else
+        (scatter into the pool, final-position sampling, the key
+        schedule) is byte-identical to :meth:`_prefill_paged_impl`."""
+        from deepspeed_tpu.parallel.pallas_shard import \
+            context_prefill_mesh
+        with context_prefill_mesh(self.mesh, "model"):
+            return self._prefill_paged_impl(params, cache, ids, lengths,
+                                            positions, tables, keys,
+                                            temps)
 
     def _decode_paged_impl(self, params, cache, toks, positions, tables,
                            keys, temps):
@@ -1282,6 +1374,15 @@ class InferenceEngine:
             if self._separate_pools:
                 dg["prefill_pool"] = sched.admit_allocator.debug_state()
             state["disagg"] = dg
+        if self.chunked:
+            state["chunked_prefill"] = {
+                "chunk_tokens": self._chunk_tokens,
+                "dispatches": self._chunk_dispatches,
+                "chunking_slots": len(sched.chunking_slots()),
+                "cp_shards": self._cp_shards,
+                "cp_threshold_tokens": self._cp_threshold,
+                "cp_reason": self._cp_reason,
+            }
         return state
 
     def _run_prefill(self, batch) -> np.ndarray:
@@ -1376,6 +1477,105 @@ class InferenceEngine:
                     {sid: int(first[i])
                      for i, sid in enumerate(batch.slot_ids)}))
             self._drain_request_metrics()
+        self._serve_secs += time.perf_counter() - t0
+
+    def _chunk_phase(self, finished: List[FinishedRequest]) -> None:
+        """At most ONE chunk dispatch per engine step — the pinned TBT
+        bound: a decode dispatch never waits behind more than one
+        ``chunk_tokens``-sized prefill slice, however long the prompt.
+        The dispatch reuses the prefill program at ids shape
+        (batch_bucket, chunk_tokens) — ``positions`` is each slot's
+        absolute prefilled offset, ``tables`` its full page list, K/V
+        scatter straight into the pool. Intermediate chunks' sampled
+        tokens are discarded on the host; the FINAL chunk samples from
+        ``fold_in(key, positions + lengths)`` = the whole-prompt key,
+        so the first token is bitwise the one whole-prompt prefill
+        would have produced. Past ``cp_threshold_tokens`` (and with an
+        eligible mesh) the dispatch runs the context-parallel chunk
+        program instead."""
+        if not self.chunked:
+            return
+        sched = self.scheduler
+        cand = sched.chunk_batch(cap=max(self.config["batch_buckets"]))
+        if not cand:
+            return
+        self.health.heartbeat("chunk_prefill")
+        t0 = time.perf_counter()
+        use_cp = False
+        if self._cp_shards > 1:
+            # one program per dispatch: the head's eligibility class
+            # picks it, rows of the other class wait for a later step
+            def _cp(sid):
+                return (len(sched.slots[sid].request.prompt)
+                        >= self._cp_threshold)
+            use_cp = _cp(cand[0])
+            cand = [sid for sid in cand if _cp(sid) == use_cp]
+        bb = pick_bucket(len(cand), self.config["batch_buckets"])
+        ct = self._chunk_tokens
+        ids = np.zeros((bb, ct), np.int32)
+        lengths = np.ones((bb,), np.int32)
+        positions = np.zeros((bb,), np.int32)
+        tables = np.zeros((bb, self._prefill_pps), np.int32)
+        keys = np.zeros((bb, 2), np.uint32)
+        temps = np.zeros((bb,), np.float32)
+        spans = []
+        for i, sid in enumerate(cand):
+            slot = sched.slots[sid]
+            req = slot.request
+            start, n = sched.chunk_span(sid)
+            spans.append((sid, req, start, n,
+                          (start - slot.prefix_len) // ct))
+            ids[i, :n] = req.prompt[start:start + n]
+            lengths[i] = n
+            positions[i] = start
+            tables[i, :len(slot.pages)] = slot.pages
+            keys[i] = self._key_for(req.seed)
+            temps[i] = req.temperature
+        prog = self._chunk_cp if use_cp else self._prefill
+        t_c = time.perf_counter()
+        with trace_span("serve/chunk", recorder=self._recorder,
+                        batch=bb, chunk=ct,
+                        cp_shards=self._cp_shards if use_cp else 1):
+            if self._separate_pools:
+                first, self._cache_prefill = prog(
+                    self.params, self._cache_prefill, jnp.asarray(ids),
+                    jnp.asarray(lengths), jnp.asarray(positions),
+                    jnp.asarray(tables), jnp.asarray(keys),
+                    jnp.asarray(temps))
+            else:
+                first, self._cache = prog(
+                    self.params, self._cache, jnp.asarray(ids),
+                    jnp.asarray(lengths), jnp.asarray(positions),
+                    jnp.asarray(tables), jnp.asarray(keys),
+                    jnp.asarray(temps))
+            # host sync: final chunks release their first token
+            first = np.asarray(first)
+        wall_ms = (time.perf_counter() - t_c) * 1e3
+        if self._dispatch_trace is not None:
+            self._dispatch_trace.record(self._steps, "chunk")
+        self._chunk_dispatches += 1
+        shards = self._cp_shards if use_cp else 1
+        now = time.perf_counter()
+        released: Dict[int, int] = {}
+        for i, (sid, req, start, n, k) in enumerate(spans):
+            self._tracer.on_prefill_chunk(req.uid, sid, k, n, wall_ms,
+                                          cp_shards=shards)
+            if not sched.record_chunk(sid, n):
+                continue                    # mid-prompt, keep chunking
+            if self.disagg:
+                ps = self.paged_spec.page_size
+                self._handoff_q.push(HandoffRecord(
+                    uid=req.uid, slot=sid, first_token=int(first[i]),
+                    live_pages=pages_for(len(req.prompt), ps),
+                    prompt_tokens=len(req.prompt), t_ready=now))
+            else:
+                released[sid] = int(first[i])
+        if released:
+            finished.extend(sched.record_tokens(released))
+        self.monitor.write_serving_metrics(
+            chunk_dispatches=self._chunk_dispatches,
+            tokens=sched.total_tokens, flush=False)
+        self._drain_request_metrics()
         self._serve_secs += time.perf_counter() - t0
 
     def _claim_phase(self, finished: List[FinishedRequest]) -> None:
@@ -1590,6 +1790,7 @@ class InferenceEngine:
             tbts = tracer.drain_step_tbts()
             if tbts:
                 slo_kw["tbt_ms"] = sum(tbts) / len(tbts)
+                slo_kw["tbt_max_ms"] = max(tbts)
             att = tracer.slo_attainment
             if att is not None:
                 slo_kw["slo_attainment"] = att
@@ -1611,12 +1812,24 @@ class InferenceEngine:
         DECODE phase runs FIRST — handoff claims, then the decode/
         verify dispatch — and the prefill phase runs after it, so no
         decode dispatch ever waits behind a prefill dispatch
-        (structural; pinned by the dispatch trace). Returns requests
-        that finished this iteration."""
+        (structural; pinned by the dispatch trace). Chunked prefill
+        (``inference.chunked_prefill``) makes every step decode-first
+        and slips AT MOST ONE chunk dispatch between the decode and
+        admission phases: claim? -> decode -> chunk -> prefill.
+        Returns requests that finished this iteration."""
         finished: List[FinishedRequest] = []
+        finished.extend(self.scheduler.drain_rejects())
         if self.disagg:
             self._claim_phase(finished)
             self._decode_phase(finished)
+            self._chunk_phase(finished)
+            self._prefill_phase(finished)
+        elif self.chunked:
+            # decode-first for chunked engines: the in-flight decodes
+            # advance, then at most one chunk slice, then admission —
+            # the interleave guarantee that bounds TBT-max
+            self._decode_phase(finished)
+            self._chunk_phase(finished)
             self._prefill_phase(finished)
         else:
             self._prefill_phase(finished)
@@ -1635,9 +1848,10 @@ class InferenceEngine:
     def run(self) -> List[FinishedRequest]:
         """Serve until queue and slots drain; returns everything that
         finished."""
-        out: List[FinishedRequest] = []
+        out: List[FinishedRequest] = list(self.scheduler.drain_rejects())
         while not self.scheduler.idle():
             out.extend(self.step())
+        out.extend(self.scheduler.drain_rejects())
         return out
 
     def generate(self, prompts: Sequence[Sequence[int]],
@@ -1702,6 +1916,30 @@ class InferenceEngine:
                     self.params, self._cache, jnp.asarray(ids),
                     jnp.asarray(lengths), jnp.asarray(slots),
                     jnp.asarray(keys), jnp.asarray(temps))
+        if self.paged and self.chunked:
+            # one chunk shape per batch bucket (single chunk bucket x
+            # batch buckets — the ladder collapse), plus the CP chunk
+            # program when context parallelism resolved on
+            progs = [self._prefill] + (
+                [self._chunk_cp] if self._chunk_cp is not None else [])
+            plan = chunk_warmup_plan(self.config["batch_buckets"],
+                                     self._chunk_tokens)
+            for prog in progs:
+                for bb, ct in plan:
+                    ids = np.zeros((bb, ct), np.int32)
+                    ztab = jnp.zeros((bb, self._prefill_pps), jnp.int32)
+                    cache = self._cache_prefill if self._separate_pools \
+                        else self._cache
+                    first, cache = prog(
+                        self.params, cache, jnp.asarray(ids),
+                        jnp.ones((bb,), jnp.int32),
+                        jnp.zeros((bb,), jnp.int32), ztab,
+                        jnp.zeros((bb, 2), jnp.uint32),
+                        jnp.zeros((bb,), jnp.float32))
+                    if self._separate_pools:
+                        self._cache_prefill = cache
+                    else:
+                        self._cache = cache
         if self.paged:
             for w in self._decode_page_buckets:
                 nxt, self._cache = self._decode(
@@ -1751,7 +1989,9 @@ class InferenceEngine:
                                 prompt_buckets=self.config["prompt_buckets"],
                                 paged=self.paged,
                                 verify_widths=list(self._verify_widths),
-                                disagg=self.disagg)
+                                disagg=self.disagg,
+                                chunk_tokens=self._chunk_tokens,
+                                cp_shards=self._cp_shards)
         return self._warm_compiles
 
     @property

@@ -142,6 +142,13 @@ class _Slot:
     pool: str = "admit"
     draft_proposed: int = 0      # speculative-decoding ledger
     draft_accepted: int = 0
+    # chunked prefill (long prompts): absolute prompt tokens already
+    # scattered into this slot's pages — the ONLY extra state a chunk
+    # needs (the next chunk is just the prefill program at
+    # ``positions = chunk_pos``). None = not chunked / prefill done.
+    # While an int, ``pending_tok`` stays None, which already keeps the
+    # slot out of decode dispatches and nulls its block-table rows.
+    chunk_pos: Optional[int] = None
 
 
 class Scheduler:
@@ -169,7 +176,8 @@ class Scheduler:
                  allocator: Optional[PageAllocator] = None,
                  lookahead: int = 0, tracer=None,
                  admit_allocator: Optional[PageAllocator] = None,
-                 drafter=None, spec_k: int = 0):
+                 drafter=None, spec_k: int = 0,
+                 chunk_tokens: int = 0):
         if num_slots < 1:
             raise ValueError("num_slots must be >= 1")
         if lookahead < 0:
@@ -193,12 +201,21 @@ class Scheduler:
         # ``spec_k`` tokens per slot per decode dispatch
         self.drafter = drafter
         self.spec_k = int(spec_k)
+        # chunked prefill: a prompt whose (post-prefix) suffix exceeds
+        # the largest prompt bucket is admitted as a sequence of
+        # ``chunk_tokens``-sized prefill chunks instead of one bucketed
+        # dispatch (0 = off — over-bucket prompts are rejected at
+        # submit with reason "reject_too_long").
+        self.chunk_tokens = int(chunk_tokens)
         self.lookahead = int(lookahead)
         self.tracer = tracer
         self.queue: List[Request] = []
         self.slots: List[Optional[_Slot]] = [None] * self.num_slots
         self._submit_time: Dict[int, float] = {}
         self.finished: List[FinishedRequest] = []
+        # graceful submit-time rejections awaiting the engine's next
+        # ``step``/``run`` drain (they are already in ``finished`` too)
+        self._rejects: List[FinishedRequest] = []
         self._new_ttfts: List[float] = []
         self._new_queue_waits: List[float] = []
         # cumulative counters (serving telemetry)
@@ -242,32 +259,53 @@ class Scheduler:
         return not self.queue and not self.active_slots()
 
     # ----------------------------------------------------------- submit
+    def _reject_too_long(self, request: Request) -> int:
+        """Graceful submit-time rejection of a request no bucket/cache
+        geometry could ever serve: the caller gets a normal
+        :class:`FinishedRequest` with the pinned reason
+        ``"reject_too_long"`` (tokens empty, ``ttft_ms`` None) on the
+        next ``step``/``run`` drain — never a crash, never a silent
+        truncation. The trail records submit -> evict like any other
+        terminal outcome."""
+        if self.tracer is not None:
+            self.tracer.on_submit(request.uid, len(request.prompt),
+                                  request.max_new_tokens,
+                                  trace_id=getattr(request, "trace_id",
+                                                   None),
+                                  hop=getattr(request, "hop", 0))
+        fin = FinishedRequest(
+            uid=request.uid, prompt=list(request.prompt), tokens=[],
+            finish_reason="reject_too_long", ttft_ms=None,
+            latency_ms=0.0, queue_wait_ms=None,
+            weight_version=self.weight_version)
+        self.finished.append(fin)
+        self._rejects.append(fin)
+        if self.tracer is not None:
+            self.tracer.on_finish(fin, evicted=True)
+        return request.uid
+
     def submit(self, request: Request) -> int:
-        """Queue a request; returns its uid. Rejects up front what no
-        bucket/cache geometry could ever serve — a queued request never
-        dies later of a shape it arrived with."""
+        """Queue a request; returns its uid. What no bucket/cache
+        geometry could ever serve is rejected up front with a graceful
+        ``"reject_too_long"`` :class:`FinishedRequest` (drained by the
+        engine's next step) — a queued request never dies later of a
+        shape it arrived with. With chunked prefill on
+        (``chunk_tokens > 0``) the prompt-bucket ceiling does not apply:
+        any prompt fitting ``max_len`` and the page pool serves."""
         plen = len(request.prompt)
-        if plen > max(self.prompt_buckets):
-            raise ValueError(
-                f"prompt length {plen} exceeds the largest prompt bucket "
-                f"{max(self.prompt_buckets)}")
+        if self.chunk_tokens <= 0 and plen > max(self.prompt_buckets):
+            return self._reject_too_long(request)
         if plen + request.max_new_tokens > self.max_len:
-            raise ValueError(
-                f"prompt ({plen}) + max_new_tokens "
-                f"({request.max_new_tokens}) exceeds max_len {self.max_len}")
+            return self._reject_too_long(request)
         if self.allocator is not None:
             total = pages_for(plen + request.max_new_tokens,
                               self.allocator.page_size)
             if total > self.allocator.num_pages - 1:
-                raise ValueError(
-                    f"request needs {total} pages but the pool has "
-                    f"{self.allocator.num_pages - 1} usable")
+                return self._reject_too_long(request)
         if self._separate_pools:
             ppages = pages_for(plen, self.admit_allocator.page_size)
             if ppages > self.admit_allocator.num_pages - 1:
-                raise ValueError(
-                    f"prompt needs {ppages} pages but the prefill pool "
-                    f"has {self.admit_allocator.num_pages - 1} usable")
+                return self._reject_too_long(request)
         self._submit_time[request.uid] = self._clock()
         self.queue.append(request)
         if self.tracer is not None:
@@ -278,13 +316,26 @@ class Scheduler:
                                   hop=getattr(request, "hop", 0))
         return request.uid
 
+    def drain_rejects(self) -> List[FinishedRequest]:
+        """Submit-time rejections since the last drain — the engine
+        returns them from its next ``step`` so ``run``/``generate``
+        callers see rejected requests as ordinary finished results."""
+        out = self._rejects
+        self._rejects = []
+        return out
+
     def queue_by_bucket(self) -> Dict[int, int]:
         """Waiting requests per prompt bucket (live-pool introspection;
         buckets are of the FULL prompt — admission may land a shorter
         suffix bucket after a prefix hit)."""
         out: Dict[int, int] = {}
+        top = max(self.prompt_buckets)
         for req in self.queue:
-            b = pick_bucket(len(req.prompt), self.prompt_buckets)
+            # over-bucket prompts (queueable only with chunked prefill
+            # on) count under the largest bucket — they have no ladder
+            # rung of their own
+            b = pick_bucket(min(len(req.prompt), top),
+                            self.prompt_buckets)
             out[b] = out.get(b, 0) + 1
         return out
 
@@ -442,6 +493,34 @@ class Scheduler:
                         self.queue[self.lookahead + 1].uid, "lookahead")
                 break
             head = self.queue[head_idx]
+            if (self.chunk_tokens > 0 and
+                    len(head.prompt) - head_res[1]
+                    > max(self.prompt_buckets)):
+                # chunked admission: the long prompt bypasses the
+                # prompt-bucket ladder — it takes ONE slot now and the
+                # engine prefills it ``chunk_tokens`` at a time,
+                # interleaved with decode steps (at most one chunk
+                # dispatch per step, so in-flight decodes never wait
+                # behind the whole prompt). Pages were already reserved
+                # whole-lifetime by ``_try_reserve``; chunk state is
+                # just ``chunk_pos`` advancing over them.
+                self.queue.pop(head_idx)
+                sid = free.pop(0)
+                now = self._clock()
+                t_sub = self._submit_time.pop(head.uid, now)
+                qwait = (now - t_sub) * 1e3
+                pages, reused = head_res
+                self.slots[sid] = _Slot(
+                    request=head, position=reused, pending_tok=None,
+                    tokens=[], t_submit=t_sub, pages=pages,
+                    prefix_len=reused, queue_wait_ms=qwait,
+                    chunk_pos=reused)
+                self._new_queue_waits.append(qwait)
+                if tracer is not None:
+                    tracer.on_admit(head.uid, sid, qwait, reused,
+                                    self.chunk_tokens, 1)
+                self.total_admitted += 1
+                continue
             head_bucket = pick_bucket(len(head.prompt) - head_res[1],
                                       self.prompt_buckets)
             cap = min(len(free), max(self.batch_buckets))
@@ -451,6 +530,10 @@ class Scheduler:
                 if len(take) >= cap:
                     break
                 match = self._match_prefix(req)
+                if (self.chunk_tokens > 0 and
+                        len(req.prompt) - match[1]
+                        > max(self.prompt_buckets)):
+                    continue    # chunked: only ever admitted as a head
                 if pick_bucket(len(req.prompt) - match[1],
                                self.prompt_buckets) != head_bucket:
                     if tracer is not None:
@@ -586,6 +669,58 @@ class Scheduler:
         self.peak_tokens_in_flight = max(self.peak_tokens_in_flight,
                                          self.tokens_in_flight)
         return done
+
+    # ------------------------------------------------- chunked prefill
+    def chunk_batch(self, cap: int) -> List[int]:
+        """Slot ids with chunked prefill still in flight (oldest slot
+        first), up to ``cap`` — the engine batches them into ONE chunk
+        dispatch per step, so the per-step prefill work is bounded by
+        ``cap * chunk_tokens`` regardless of prompt length."""
+        out: List[int] = []
+        for sid in self.active_slots():
+            slot = self.slots[sid]
+            if slot.chunk_pos is None:
+                continue
+            out.append(sid)
+            if len(out) >= cap:
+                break
+        return out
+
+    def chunk_span(self, sid: int) -> Tuple[int, int]:
+        """(start, length) of slot ``sid``'s next prefill chunk in
+        absolute prompt positions — the last chunk is simply shorter
+        (the program pads it; ``lengths`` carries the true size)."""
+        slot = self.slots[sid]
+        if slot is None or slot.chunk_pos is None:
+            raise KeyError(f"slot {sid} has no chunked prefill in flight")
+        start = slot.chunk_pos
+        return start, min(self.chunk_tokens,
+                          len(slot.request.prompt) - start)
+
+    def record_chunk(self, sid: int, ntokens: int) -> bool:
+        """One prefill chunk of ``ntokens`` landed in slot ``sid``'s
+        cache. Returns True when the prompt is now fully prefilled —
+        the slot leaves chunk state with ``position == len(prompt)``
+        and ``pending_tok`` still None: byte-identical to a freshly
+        whole-prompt-prefilled slot, so the caller records the final
+        chunk's first token (or pushes the disagg handoff) through the
+        exact same paths."""
+        slot = self.slots[sid]
+        if slot is None or slot.chunk_pos is None:
+            raise KeyError(f"slot {sid} has no chunked prefill in flight")
+        slot.chunk_pos += int(ntokens)
+        slot.position = slot.chunk_pos
+        if slot.chunk_pos >= len(slot.request.prompt):
+            slot.position = len(slot.request.prompt)
+            slot.chunk_pos = None
+            return True
+        return False
+
+    def chunking_slots(self) -> List[int]:
+        """All slot ids currently mid-chunked-prefill (introspection /
+        idle accounting)."""
+        return [sid for sid in self.active_slots()
+                if self.slots[sid].chunk_pos is not None]
 
     def draft_proposals(self, cap: Optional[int] = None
                         ) -> Dict[int, List[int]]:

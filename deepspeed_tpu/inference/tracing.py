@@ -92,8 +92,14 @@ DEFER_REASONS = ("pages", "bucket", "lookahead", "handoff", "draft_stall")
 #:                     to a survivor (the client still gets exactly one
 #:                     response; the drain-side eviction row is
 #:                     bookkeeping, not an answer)
+#: "reject_too_long" - rejected at submit: the prompt exceeds what the
+#:                     engine's geometry can EVER serve (over the
+#:                     largest prompt bucket with chunked prefill off,
+#:                     or prompt + max_new over max_len / the page
+#:                     pool). A graceful FinishedRequest, never a
+#:                     crash or silent truncation.
 SHED_REASONS = ("shed_slo", "shed_capacity", "degrade_max_new",
-                "degrade_spec_off", "drain")
+                "degrade_spec_off", "drain", "reject_too_long")
 
 
 @dataclass
@@ -135,6 +141,11 @@ class _ReqTrace:
     spec_window_proposed: int = 0
     spec_window_accepted: int = 0
     spec_window_dispatches: int = 0
+    # chunked prefill: chunk dispatches this request's prompt rode and
+    # their summed wall time (the trail's per-chunk rows carry the
+    # running ``cum_ms`` so TTFT decomposes into queue + k*chunk)
+    chunks: int = 0
+    chunk_ms: float = 0.0
 
 
 class ServeTracer:
@@ -169,7 +180,8 @@ class ServeTracer:
     #: cannot silently fall out of the report)
     EVENT_KINDS = (
         "serve_submit", "serve_defer", "serve_prefix_hit",
-        "serve_admit", "serve_prefill", "serve_handoff",
+        "serve_admit", "serve_prefill", "serve_prefill_chunk",
+        "serve_handoff",
         "serve_spec_window", "serve_first_token", "serve_decode_window",
         "serve_finish", "serve_evict",
         "serve_migrate_out", "serve_migrate_in",
@@ -200,7 +212,9 @@ class ServeTracer:
         self.hist = {"queue_wait_ms": Histogram(), "ttft_ms": Histogram(),
                      "prefill_ms": Histogram(), "tbt_ms": Histogram(),
                      "handoff_ms": Histogram(),
-                     "spec_accept_rate": Histogram()}
+                     "spec_accept_rate": Histogram(),
+                     "chunk_ms": Histogram(),
+                     "chunks_per_request": Histogram()}
         # SLO / goodput accounting
         self.finished = 0
         self.finished_in_slo = 0
@@ -214,6 +228,10 @@ class ServeTracer:
         self.spec_accepted = 0
         self.spec_dispatches = 0
         self.handoffs = 0
+        # chunked prefill: chunk-row dispatches across all requests
+        # (one request contributes ceil(suffix / chunk_tokens) rows)
+        self.chunk_rows = 0
+        self.chunked_requests = 0
 
     # ------------------------------------------------------------- sinks
     def _event(self, kind: str, **fields) -> None:
@@ -301,6 +319,34 @@ class ServeTracer:
                     prompt_bucket=int(prompt_bucket),
                     batch_bucket=int(batch_bucket), rows=int(rows),
                     **self._ctx(uid))
+
+    def on_prefill_chunk(self, uid: int, slot: int, index: int,
+                         tokens: int, wall_ms: float,
+                         cp_shards: int = 1) -> None:
+        """One chunk of ``uid``'s chunked prefill landed: ``index`` is
+        the 0-based chunk ordinal, ``tokens`` the real (unpadded)
+        tokens it scattered, ``wall_ms`` the dispatch wall time
+        (amortized over the rows sharing it), ``cum_ms`` the running
+        sum — so the trail shows TTFT decomposing into
+        ``queue + k*chunk`` per request. ``cp_shards > 1`` marks a
+        context-parallel chunk (the sequence axis ran sharded over the
+        serving mesh)."""
+        if not self.enabled:
+            return
+        self.chunk_rows += 1
+        self.hist["chunk_ms"].record(wall_ms)
+        tr = self._req.get(uid)
+        cum = None
+        if tr is not None:
+            if tr.chunks == 0:
+                self.chunked_requests += 1
+            tr.chunks += 1
+            tr.chunk_ms += wall_ms
+            cum = tr.chunk_ms
+        self._event("serve_prefill_chunk", uid=uid, slot=int(slot),
+                    chunk=int(index), tokens=int(tokens),
+                    wall_ms=self._r(wall_ms), cum_ms=self._r(cum),
+                    cp_shards=int(cp_shards), **self._ctx(uid))
 
     def on_handoff(self, uid: int, queue_ms: float, transfer_ms: float,
                    pages: int, bytes_moved: int, mode: str,
@@ -445,6 +491,8 @@ class ServeTracer:
                       if fin.ttft_ms is not None
                       and tr.queue_wait_ms is not None else None)
         slo_ok = self._account(fin, evicted, tbt_mean)
+        if tr.chunks:
+            self.hist["chunks_per_request"].record(float(tr.chunks))
         ctx = ({"trace_id": tr.trace_id, "hop": tr.hop}
                if tr.trace_id is not None else {})
         self._event(kind, uid=fin.uid, reason=fin.finish_reason,
@@ -459,7 +507,8 @@ class ServeTracer:
                                        else None),
                     slo_ok=slo_ok,
                     draft_proposed=tr.spec_proposed,
-                    draft_accepted=tr.spec_accepted, **ctx)
+                    draft_accepted=tr.spec_accepted,
+                    chunks=tr.chunks, **ctx)
         self._lanes(tr)
 
     # ----------------------------------------------- migration lineage
@@ -592,5 +641,7 @@ class ServeTracer:
                                      if self.spec_accept_rate is not None
                                      else None)},
             "handoffs": self.handoffs,
+            "chunked_prefill": {"chunk_rows": self.chunk_rows,
+                                "requests": self.chunked_requests},
             "latency": {k: h.snapshot() for k, h in self.hist.items()},
         }

@@ -665,6 +665,19 @@ def _paged_cache_attention(kpool, vpool, block_table, cache_position,
         if quantized:
             kc = dequantize_pool(kc, gather_paged_kv(ksp, block_table))
             vc = dequantize_pool(vc, gather_paged_kv(vsp, block_table))
+        if q.shape[2] > 1:
+            # context-parallel chunked prefill (ISSUE 19): under the
+            # engine's CP trace context, the chunk's sequence axis runs
+            # ring-sharded over the serving mesh — same stripe, same
+            # absolute-position causal rule
+            from deepspeed_tpu.parallel.pallas_shard import \
+                current_cp_mesh
+            cp = current_cp_mesh()
+            if cp is not None:
+                from deepspeed_tpu.ops.attention.ring import \
+                    ring_prefill_attention
+                return ring_prefill_attention(q, kc, vc, cache_position,
+                                              cp.mesh, cp.axis)
         hd = q.shape[-1]
         scores = jnp.einsum("bhqd,bhld->bhql", q.astype(jnp.float32),
                             kc.astype(jnp.float32)) / np.sqrt(hd)

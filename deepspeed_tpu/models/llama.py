@@ -294,6 +294,18 @@ def _gqa_paged_cache_attention(kpool, vpool, block_table, cache_position,
         if quantized:
             kc = dequantize_pool(kc, gather_paged_kv(ksp, block_table))
             vc = dequantize_pool(vc, gather_paged_kv(vsp, block_table))
+        if q.shape[2] > 1:
+            # context-parallel chunked prefill (ISSUE 19): ring over
+            # the serving mesh; GQA folds group-wise inside the ring
+            # exactly like the dense fallback below
+            from deepspeed_tpu.parallel.pallas_shard import \
+                current_cp_mesh
+            cp = current_cp_mesh()
+            if cp is not None:
+                from deepspeed_tpu.ops.attention.ring import \
+                    ring_prefill_attention
+                return ring_prefill_attention(q, kc, vc, cache_position,
+                                              cp.mesh, cp.axis)
         B, H, S, hd = q.shape
         hkv = kc.shape[1]
         qg = q.reshape(B, hkv, H // hkv, S, hd)

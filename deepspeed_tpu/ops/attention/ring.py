@@ -450,3 +450,95 @@ def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = False,
                                interpret, dropout_rate)
     return _ring_attention(q, k, v, seed, False, axis_name, causal,
                            float(sm_scale), interpret, dropout_rate)
+
+
+# --------------------------------------------------------------------- #
+# forward-only ring prefill over a paged-KV stripe (serving, ISSUE 19)
+# --------------------------------------------------------------------- #
+def _ring_prefill_shard(q, kc, vc, cache_position, axis_name, P, Sl, Ll,
+                        sm_scale):
+    """Per-shard body of :func:`ring_prefill_attention` (inside the
+    shard_map): my Q block stays resident while K/V stripe blocks
+    rotate around the ring; each visit contributes a normalized fp32
+    partial (o_j, lse_j) masked by the ABSOLUTE-position causal rule of
+    ``models/gpt2.causal_cache_mask`` — q position ``cache_position +
+    global_q_idx`` attends stripe slots ``<=`` it — and partials merge
+    with the exact online-softmax combine. GQA runs group-wise like
+    the llama gather fallback (q heads fold onto their kv head)."""
+    idx = jax.lax.axis_index(axis_name)
+    B, H, _, hd = q.shape
+    KH = kc.shape[1]
+    G = H // KH
+    qg = q.astype(jnp.float32).reshape(B, KH, G, Sl, hd)
+    q_pos = (cache_position[:, None] + idx * Sl
+             + jnp.arange(Sl)[None, :])                       # (B, Sl)
+
+    def partial(k_blk, v_blk, src):
+        scores = jnp.einsum("bkgsd,bkld->bkgsl", qg,
+                            k_blk.astype(jnp.float32)) * sm_scale
+        kv_pos = src * Ll + jnp.arange(Ll)                    # (Ll,)
+        valid = kv_pos[None, None, :] <= q_pos[:, :, None]    # (B,Sl,Ll)
+        scores = jnp.where(valid[:, None, None], scores, NEG_BIG)
+        m = jnp.max(scores, axis=-1)
+        p = jnp.exp(scores - m[..., None])
+        s = jnp.sum(p, axis=-1)
+        o = jnp.einsum("bkgsl,bkld->bkgsd", p,
+                       v_blk.astype(jnp.float32)) / \
+            jnp.maximum(s, 1e-30)[..., None]
+        lse = jnp.where(m <= VALID_THRESH, NEG_BIG,
+                        m + jnp.log(jnp.maximum(s, 1e-30)))
+        return o, lse
+
+    o_acc, lse_acc = partial(kc, vc, idx)
+
+    def step(carry, j):
+        k_cur, v_cur, o_acc, lse_acc = carry
+        k_cur = _rot(k_cur, axis_name, P)
+        v_cur = _rot(v_cur, axis_name, P)
+        src = (idx - j) % P
+        o_j, lse_j = partial(k_cur, v_cur, src)
+        o_acc, lse_acc = _combine(o_acc, lse_acc, o_j, lse_j)
+        return (k_cur, v_cur, o_acc, lse_acc), None
+
+    if P > 1:
+        (_, _, o_acc, lse_acc), _ = jax.lax.scan(
+            step, (kc, vc, o_acc, lse_acc), jnp.arange(1, P))
+    return o_acc.reshape(B, H, Sl, hd).astype(q.dtype)
+
+
+def ring_prefill_attention(q, kc, vc, cache_position, mesh,
+                           axis: str = "model",
+                           sm_scale: Optional[float] = None):
+    """Context-parallel PREFILL attention for the serving engine's
+    chunk dispatches (forward-only — serving never needs the ring
+    backward): ``q`` (B, H, S, hd) is the chunk's queries, ``kc``/
+    ``vc`` (B, KH, L, hd) the gathered (dequantized) paged-KV stripe,
+    ``cache_position`` (B,) each row's absolute prefilled offset —
+    exactly the operands of the models' gather-fallback attention,
+    same masking rule, same fp32 math, with the sequence axes sharded
+    over ``(mesh, axis)``: Q blocks stay resident, K/V stripe blocks
+    ring via ppermute, partials merge with the exact online-softmax
+    combine. Requires S and L divisible by the axis size (the engine
+    validates at init and logs the fallback otherwise)."""
+    from jax.sharding import PartitionSpec as P_
+
+    P = mesh.shape[axis]
+    B, H, S, hd = q.shape
+    L = kc.shape[2]
+    assert S % P == 0 and L % P == 0, (
+        f"ring_prefill_attention: seq ({S}) and stripe ({L}) must be "
+        f"divisible by mesh axis {axis!r} ({P}-way)")
+    assert H % kc.shape[1] == 0, (H, kc.shape[1])
+    if sm_scale is None:
+        sm_scale = 1.0 / np.sqrt(hd)
+
+    def inner(q, kc, vc, cache_position):
+        return _ring_prefill_shard(q, kc, vc, cache_position, axis, P,
+                                   S // P, L // P, float(sm_scale))
+
+    seq_spec = P_(None, None, axis, None)
+    f = jax.shard_map(
+        inner, mesh=mesh,
+        in_specs=(seq_spec, seq_spec, seq_spec, P_()),
+        out_specs=seq_spec, check_vma=False)
+    return f(q, kc, vc, cache_position)

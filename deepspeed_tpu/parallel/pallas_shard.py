@@ -46,7 +46,8 @@ from deepspeed_tpu.parallel.mesh import axis_size
 
 __all__ = ["sharded_paged_decode", "sharded_masked_flash",
            "pallas_kernel_mesh", "current_kernel_mesh", "KernelMesh",
-           "head_shard_supported"]
+           "head_shard_supported", "context_prefill_mesh",
+           "current_cp_mesh"]
 
 
 class KernelMesh(NamedTuple):
@@ -55,6 +56,7 @@ class KernelMesh(NamedTuple):
 
 
 _ACTIVE: list = []          # stack; trace-time only
+_CP_ACTIVE: list = []       # context-parallel prefill stack (ISSUE 19)
 
 
 @contextlib.contextmanager
@@ -75,6 +77,31 @@ def pallas_kernel_mesh(mesh: Optional[Mesh], axis: str = "model"):
 
 def current_kernel_mesh() -> Optional[KernelMesh]:
     return _ACTIVE[-1] if _ACTIVE else None
+
+
+@contextlib.contextmanager
+def context_prefill_mesh(mesh: Optional[Mesh], axis: str = "model"):
+    """Trace-time context for CONTEXT-PARALLEL prefill (ISSUE 19):
+    while active, the models' multi-query paged gather attention
+    routes through ``ops.attention.ring.ring_prefill_attention`` —
+    the chunk's sequence axis sharded over ``(mesh, axis)`` with K/V
+    stripes rotating around the ring. A separate stack from
+    :func:`pallas_kernel_mesh` because the serving engine traces its
+    CP chunk program under BOTH (the decode-side kernel context stays
+    on for any seq-1 call sites). ``mesh=None``/size-1 axis is a
+    no-op."""
+    if mesh is None or axis_size(mesh, axis) <= 1:
+        yield
+        return
+    _CP_ACTIVE.append(KernelMesh(mesh, axis))
+    try:
+        yield
+    finally:
+        _CP_ACTIVE.pop()
+
+
+def current_cp_mesh() -> Optional[KernelMesh]:
+    return _CP_ACTIVE[-1] if _CP_ACTIVE else None
 
 
 def head_shard_supported(n: int, *head_counts) -> bool:

@@ -67,14 +67,16 @@ TAG_HOST_GAP = "Observability/host_gap_ms"        # per-step host gap time
 # them; stdlib-only tools/obs_report.py mirrors the strings and the
 # pair is pinned by tests/unit/test_inference.py)
 from deepspeed_tpu.utils.monitor import (  # noqa: E402,F401
-    TAG_SERVE_DECODE_ATTN, TAG_SERVE_FLEET_QDEPTH, TAG_SERVE_GOODPUT,
+    TAG_SERVE_CHUNK_DISPATCHES, TAG_SERVE_DECODE_ATTN,
+    TAG_SERVE_FLEET_QDEPTH, TAG_SERVE_GOODPUT,
     TAG_SERVE_HANDOFF, TAG_SERVE_KV_PAGES, TAG_SERVE_KV_POOL_BPT,
     TAG_SERVE_MIGRATIONS, TAG_SERVE_OCCUPANCY, TAG_SERVE_PREFIX_HIT,
     TAG_SERVE_QUANT_LOGIT_ERR, TAG_SERVE_QUEUE_DEPTH,
     TAG_SERVE_QUEUE_WAIT, TAG_SERVE_REPLICA_RESTARTS,
     TAG_SERVE_SHED_RATE, TAG_SERVE_SLO, TAG_SERVE_SPEC_ACCEPT,
-    TAG_SERVE_TBT, TAG_SERVE_TOKEN_LATENCY, TAG_SERVE_TOKENS_IN_FLIGHT,
-    TAG_SERVE_TPS, TAG_SERVE_TTFT, TAG_SERVE_WEIGHT_VERSION)
+    TAG_SERVE_TBT, TAG_SERVE_TBT_MAX, TAG_SERVE_TOKEN_LATENCY,
+    TAG_SERVE_TOKENS_IN_FLIGHT, TAG_SERVE_TPS, TAG_SERVE_TTFT,
+    TAG_SERVE_WEIGHT_VERSION)
 # elastic / async-checkpoint plane (ISSUE 10), same canonical-home
 # arrangement (utils/monitor.py write_elastic_metrics writes them;
 # obs_report mirrors; pinned by tests/unit/test_elastic.py)

@@ -729,6 +729,16 @@ def get_inference_config(param_dict):
     }
     mesh_sub = sub.get(C.INF_MESH, {}) or {}
     cfg["mesh"] = {"axes": dict(mesh_sub.get(C.INF_MESH_AXES, {}) or {})}
+    ck = sub.get(C.INF_CHUNKED_PREFILL, {}) or {}
+    cfg["chunked_prefill"] = {
+        "enabled": bool(ck.get(C.INF_CHUNK_ENABLED,
+                               C.INF_CHUNK_ENABLED_DEFAULT)),
+        "chunk_tokens": int(ck.get(C.INF_CHUNK_TOKENS,
+                                   C.INF_CHUNK_TOKENS_DEFAULT)),
+        "cp_threshold_tokens": int(ck.get(
+            C.INF_CHUNK_CP_THRESHOLD,
+            C.INF_CHUNK_CP_THRESHOLD_DEFAULT)),
+    }
     sd = sub.get(C.INF_SPEC_DECODE, {}) or {}
     cfg["spec_decode"] = {
         "enabled": bool(sd.get(C.INF_SPEC_ENABLED,
@@ -912,6 +922,21 @@ def get_inference_config(param_dict):
                 raise DeepSpeedConfigError(
                     f"{where}.axes entries must be positive ints, "
                     f"got {name}={size!r}")
+    ckc = cfg["chunked_prefill"]
+    if ckc["enabled"] and not pkc["enabled"]:
+        raise DeepSpeedConfigError(
+            "inference.chunked_prefill requires paged_kv.enabled (a "
+            "chunk is cache_position advancing over the slot's pages)")
+    if ckc["enabled"] and (ckc["chunk_tokens"] < 1
+                           or ckc["chunk_tokens"] > cfg["max_seq_len"]):
+        raise DeepSpeedConfigError(
+            f"inference.chunked_prefill.chunk_tokens must be in "
+            f"[1, max_seq_len], got {ckc['chunk_tokens']}")
+    if ckc["cp_threshold_tokens"] < 0:
+        raise DeepSpeedConfigError(
+            f"inference.chunked_prefill.cp_threshold_tokens must be "
+            f">= 0 (0 = context-parallel off), got "
+            f"{ckc['cp_threshold_tokens']}")
     sdc = cfg["spec_decode"]
     if sdc["enabled"] and not pkc["enabled"]:
         raise DeepSpeedConfigError(

@@ -526,6 +526,20 @@ CHECKPOINT_SUPERVISOR_BACKOFF_DEFAULT = 1.0
 #     "axes": {}                  # e.g. {"model": 4}: tensor-parallel
 #                                 # prefill/decode over ICI
 #   },
+#   "chunked_prefill": {          # long-prompt chunked prefill
+#     "enabled": false,           # requires paged_kv.enabled; prompts
+#                                 # whose suffix exceeds the largest
+#                                 # prompt bucket prefill chunk-by-
+#                                 # chunk, interleaved with decode
+#                                 # (at most one chunk dispatch/step)
+#     "chunk_tokens": 256,        # tokens per chunk dispatch (one
+#                                 # compiled chunk program per batch
+#                                 # bucket — no prompt-bucket ladder)
+#     "cp_threshold_tokens": 0    # prompts at least this long run
+#                                 # their chunks context-parallel
+#                                 # (ring attention over the serving
+#                                 # mesh); 0 = off
+#   },
 #   "spec_decode": {              # speculative multi-token decoding
 #     "enabled": false,           # requires paged_kv.enabled
 #     "k": 4,                     # max draft tokens proposed/dispatch
@@ -615,6 +629,21 @@ INF_PAGED_KV_QUANT_BLOCK = "kv_quant_block"
 INF_PAGED_KV_QUANT_BLOCK_DEFAULT = 0  # 0 = one scale per token row
 INF_MESH = "mesh"
 INF_MESH_AXES = "axes"
+# chunked prefill (long prompts): split prefill into fixed
+# chunk_tokens-sized dispatches interleaved with decode steps — TBT
+# stays bounded under long prompts, ONE compiled chunk program per
+# batch bucket replaces the prompt-bucket ladder for chunked requests,
+# and prompts past the largest bucket (up to max_seq_len) serve
+# instead of rejecting. cp_threshold_tokens >= chunk-size routes
+# chunks of prompts at least that long through the context-parallel
+# (ring attention) prefill program over the serving mesh (0 = off).
+INF_CHUNKED_PREFILL = "chunked_prefill"
+INF_CHUNK_ENABLED = "enabled"
+INF_CHUNK_ENABLED_DEFAULT = False
+INF_CHUNK_TOKENS = "chunk_tokens"
+INF_CHUNK_TOKENS_DEFAULT = 256
+INF_CHUNK_CP_THRESHOLD = "cp_threshold_tokens"
+INF_CHUNK_CP_THRESHOLD_DEFAULT = 0   # 0 = context-parallel off
 INF_SPEC_DECODE = "spec_decode"
 INF_SPEC_ENABLED = "enabled"
 INF_SPEC_ENABLED_DEFAULT = False

@@ -68,6 +68,7 @@ HEALTH_PHASES = (
     "prefill",            # inference prefill phase
     "decode",             # inference decode/verify phase
     "handoff_claim",      # disagg decode-worker handoff intake
+    "chunk_prefill",      # chunked-prefill chunk dispatch (ISSUE 19)
     "checkpoint_commit",  # save snapshot/commit stages
     "fleet_step",         # FleetRouter scheduling round
     "bench_metric",       # bench.py ladder child metric body

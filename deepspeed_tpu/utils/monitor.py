@@ -69,6 +69,12 @@ TAG_SERVE_REPLICA_RESTARTS = "Serve/replica_restarts"  # supervised
 # record_quant_logit_err — the serving path never pays for the oracle)
 TAG_SERVE_KV_POOL_BPT = "Serve/kv_pool_bytes_per_token"
 TAG_SERVE_QUANT_LOGIT_ERR = "Serve/quant_logit_err"
+# chunked-prefill plane (ISSUE 19): long prompts land as fixed-size
+# chunk dispatches interleaved with decode — the dispatch counter plus
+# the per-step WORST time-between-tokens (the bound chunking pins; the
+# mean alone would hide a whole-prompt prefill stall)
+TAG_SERVE_CHUNK_DISPATCHES = "Serve/chunk_dispatches"  # cumulative
+TAG_SERVE_TBT_MAX = "Serve/tbt_max_ms"              # per decode dispatch
 # elastic / async-checkpoint plane (ISSUE 10): snapshot-vs-write split
 # of every save, the async writer's backlog, and how many times the
 # supervisor has relaunched this run. Canonical home — profiling/
@@ -418,6 +424,7 @@ class TensorBoardMonitor:
                               replica_restarts=None,
                               kv_pool_bytes_per_token=None,
                               quant_logit_err=None,
+                              chunk_dispatches=None, tbt_max_ms=None,
                               tokens: int = 0, flush: bool = True):
         """Serving telemetry (inference engine; TPU-native extension —
         the reference snapshot is training-only): time-to-first-token
@@ -468,6 +475,11 @@ class TensorBoardMonitor:
             self.write_scalar(TAG_SERVE_QUEUE_WAIT, queue_wait_ms, tokens)
         if tbt_ms is not None:
             self.write_scalar(TAG_SERVE_TBT, tbt_ms, tokens)
+        if tbt_max_ms is not None:
+            self.write_scalar(TAG_SERVE_TBT_MAX, tbt_max_ms, tokens)
+        if chunk_dispatches is not None:
+            self.write_scalar(TAG_SERVE_CHUNK_DISPATCHES,
+                              chunk_dispatches, tokens)
         if slo_attainment is not None:
             self.write_scalar(TAG_SERVE_SLO, slo_attainment, tokens)
         if goodput_tokens_per_s is not None:

@@ -622,7 +622,7 @@ class TestRegistrySync:
                                                      SHED_REASONS)
         assert SHED_REASONS == ("shed_slo", "shed_capacity",
                                 "degrade_max_new", "degrade_spec_off",
-                                "drain")
+                                "drain", "reject_too_long")
         # the serve-trail defer vocabulary is unchanged by the fleet
         assert isinstance(DEFER_REASONS, tuple) and DEFER_REASONS
         assert not set(SHED_REASONS) & set(DEFER_REASONS)

@@ -237,8 +237,8 @@ def test_heartbeat_phase_vocabulary_pinned(tmp_path):
     pinned and unknown phases raise even on an ENABLED plane."""
     assert HEALTH_PHASES == (
         "train_batch", "prefill", "decode", "handoff_claim",
-        "checkpoint_commit", "fleet_step", "bench_metric",
-        "rpc_call")
+        "chunk_prefill", "checkpoint_commit", "fleet_step",
+        "bench_metric", "rpc_call")
     hp = HealthPlane({"enabled": True, "stall_timeout_s": 60.0},
                      events_dir=str(tmp_path))
     try:

@@ -144,12 +144,19 @@ class TestScheduler:
         return Scheduler(slots, (4, 8), (1, 2), 32, **kw)
 
     def test_submit_validation(self):
+        # ISSUE 19: unservable shapes are a graceful submit-time
+        # rejection (pinned reason "reject_too_long"), never a crash
         from deepspeed_tpu.inference.scheduler import Request
         s = self._sched()
-        with pytest.raises(ValueError, match="largest prompt bucket"):
-            s.submit(Request(prompt=list(range(1, 10))))
-        with pytest.raises(ValueError, match="max_len"):
-            s.submit(Request(prompt=[1, 2, 3], max_new_tokens=30))
+        uid = s.submit(Request(prompt=list(range(1, 10))))  # > bucket 8
+        uid2 = s.submit(Request(prompt=[1, 2, 3], max_new_tokens=30))
+        rejects = s.drain_rejects()
+        assert [r.uid for r in rejects] == [uid, uid2]
+        for r in rejects:
+            assert r.finish_reason == "reject_too_long"
+            assert r.tokens == [] and r.ttft_ms is None
+        assert s.drain_rejects() == []          # one-shot drain
+        assert not s.queue                      # never queued
         with pytest.raises(ValueError, match="empty"):
             Request(prompt=[])
 
@@ -438,6 +445,12 @@ class TestInferenceEngine:
         assert m.TAG_SERVE_QUANT_LOGIT_ERR == \
             prof.TAG_SERVE_QUANT_LOGIT_ERR == \
             obs_report.T_QUANT_LOGIT_ERR == "Serve/quant_logit_err"
+        # ISSUE 19: chunked-prefill scalars
+        assert m.TAG_SERVE_CHUNK_DISPATCHES == \
+            prof.TAG_SERVE_CHUNK_DISPATCHES == \
+            obs_report.T_CHUNK_DISPATCHES == "Serve/chunk_dispatches"
+        assert m.TAG_SERVE_TBT_MAX == prof.TAG_SERVE_TBT_MAX == \
+            obs_report.T_TBT_MAX == "Serve/tbt_max_ms"
 
     def test_rejects_unservable_config(self):
         from deepspeed_tpu.inference import InferenceEngine
@@ -835,6 +848,8 @@ class TestPagedServing:
         assert "paged_kv" in obs_report.render(s)
 
     def test_submit_rejects_request_larger_than_pool(self):
+        # ISSUE 19: graceful rejection — the caller sees an ordinary
+        # FinishedRequest with the pinned reason from the next step
         from deepspeed_tpu.inference import InferenceEngine
         from deepspeed_tpu.inference.scheduler import Request
         cfg, params = tiny_gpt2()
@@ -842,8 +857,10 @@ class TestPagedServing:
             cfg, params,
             dict(TINY_INF, paged_kv={"page_size": 4, "num_pages": 3}),
             dtype=jnp.float32)
-        with pytest.raises(ValueError, match="pages"):
-            engine.submit(Request(prompt=[1, 2, 3], max_new_tokens=8))
+        uid = engine.submit(Request(prompt=[1, 2, 3], max_new_tokens=8))
+        fins = engine.step()
+        assert [f.uid for f in fins] == [uid]
+        assert fins[0].finish_reason == "reject_too_long"
 
 
 class TestLookaheadAdmission:

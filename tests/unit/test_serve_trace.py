@@ -71,6 +71,10 @@ TRAIL_SCHEMA = {
                       "handoff_ms", "pages", "bytes_moved"},
     "serve_spec_window": {"uid", "proposed", "accepted", "dispatches",
                           "accept_rate"},
+    # chunked prefill (ISSUE 19): one row per chunk dispatch — chunk
+    # ordinal, tokens scattered, wall and cumulative prefill ms
+    "serve_prefill_chunk": {"uid", "slot", "chunk", "tokens",
+                            "wall_ms", "cum_ms"},
     "serve_decode_window": {"uid", "tokens", "end_token", "window_ms",
                             "tbt_ms"},
     "serve_finish": {"uid", "reason", "new_tokens", "ttft_ms",

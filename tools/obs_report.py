@@ -123,6 +123,11 @@ T_REPLICA_RESTARTS = "Serve/replica_restarts"
 # of capacity, and the offline quantized-vs-fp max-logit-error probe
 T_KV_POOL_BPT = "Serve/kv_pool_bytes_per_token"
 T_QUANT_LOGIT_ERR = "Serve/quant_logit_err"
+# chunked-prefill plane (ISSUE 19): chunk dispatch counter + per-step
+# WORST time-between-tokens (the bound chunked prefill pins); the
+# `serve_prefill_chunk` event rows carry the per-chunk detail
+T_CHUNK_DISPATCHES = "Serve/chunk_dispatches"
+T_TBT_MAX = "Serve/tbt_max_ms"
 # elastic / async-checkpoint plane (utils/monitor.py
 # write_elastic_metrics): snapshot-vs-write decomposition of each save,
 # async writer backlog, supervisor restart count; the `preemption` /
@@ -400,6 +405,38 @@ def summarize(path, host_gap_threshold=DEFAULT_HOST_GAP_THRESHOLD):
         "requeues": sum(1 for e in events
                         if e.get("event") == "serve_defer"
                         and e.get("reason") == "handoff"),
+    }
+    # chunked-prefill view (ISSUE 19; absent -> counts 0, keys None).
+    # Chunk walls come from the serve_prefill_chunk rows; chunks-per-
+    # request from the per-request max chunk ordinal; TBT-max from the
+    # per-step worst-TBT scalar (vs the mean in tbt_ms above — the
+    # spike a whole-prompt prefill would have caused shows HERE).
+    chunk_rows = [e for e in events
+                  if e.get("event") == "serve_prefill_chunk"]
+    tbt_max_rows = _vals(scalars, T_TBT_MAX)
+    chunk_disp = _vals(scalars, T_CHUNK_DISPATCHES)
+    per_req: dict = {}
+    for e in chunk_rows:
+        u = e.get("uid")
+        per_req[u] = max(per_req.get(u, 0), int(e.get("chunk", 0)) + 1)
+    cpr = sorted(per_req.values())
+    walls = [float(e["wall_ms"]) for e in chunk_rows
+             if e.get("wall_ms") is not None]
+    rejects = sum(1 for e in events
+                  if e.get("event") in ("serve_finish", "serve_evict")
+                  and e.get("reason") == "reject_too_long")
+    serving["chunked_prefill"] = {
+        "dispatches": (int(chunk_disp[-1]) if chunk_disp
+                       else len(chunk_rows)),
+        "chunked_requests": len(per_req),
+        "chunks_per_request": {"p50": percentile(cpr, 0.50),
+                               "p95": percentile(cpr, 0.95)},
+        "chunk_ms": {"p50": percentile(walls, 0.50),
+                     "p95": percentile(walls, 0.95)},
+        "cp_chunks": sum(1 for e in chunk_rows
+                         if int(e.get("cp_shards", 1) or 1) > 1),
+        "tbt_max_ms": max(tbt_max_rows) if tbt_max_rows else None,
+        "rejected_too_long": rejects,
     }
 
     # fleet view (multi-replica router; absent on single-engine runs:
@@ -914,6 +951,21 @@ def render_serve(s):
                 f"  disagg_handoff    : {dg['handoffs']} handoffs, "
                 f"p50={_fmt(hm.get('p50'))} p95={_fmt(hm.get('p95'))} ms, "
                 f"requeues={dg.get('requeues', 0)}")
+        ck = sv.get("chunked_prefill") or {}
+        if ck.get("dispatches") or ck.get("rejected_too_long"):
+            cpr = ck.get("chunks_per_request") or {}
+            cm = ck.get("chunk_ms") or {}
+            lines.append(
+                f"  chunked_prefill   : {ck.get('dispatches', 0)} chunk "
+                f"dispatches over {ck.get('chunked_requests', 0)} "
+                f"requests (chunks/req p50={_fmt(cpr.get('p50'), '{:.0f}')} "
+                f"p95={_fmt(cpr.get('p95'), '{:.0f}')}, chunk p50="
+                f"{_fmt(cm.get('p50'))} p95={_fmt(cm.get('p95'))} ms, "
+                f"cp_chunks={ck.get('cp_chunks', 0)})")
+            lines.append(
+                f"    tbt_max         : {_fmt(ck.get('tbt_max_ms'))} ms "
+                f"worst step TBT; rejected_too_long="
+                f"{ck.get('rejected_too_long', 0)}")
     fl = sv.get("fleet")
     if fl:
         shed = fl.get("shed") or {}
@@ -1212,6 +1264,9 @@ EVENT_HANDLERS = {
         slot=row.get("slot")),
     "serve_prefill": lambda hop, row: hop.update(
         prefill_wall_ms=row.get("wall_ms")),
+    "serve_prefill_chunk": lambda hop, row: hop.update(
+        chunks=int(row.get("chunk", 0) or 0) + 1,
+        chunk_cum_ms=row.get("cum_ms")),
     "serve_handoff": lambda hop, row: hop.update(
         handoff_ms=row.get("handoff_ms")),
     "serve_spec_window": _fold_spec,
